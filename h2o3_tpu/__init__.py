@@ -1,0 +1,41 @@
+"""h2o3_tpu — a TPU-native distributed ML framework with H2O-3's capabilities.
+
+Architecture (see SURVEY.md for the reference analysis):
+
+- The reference (H2O-3) is a JVM peer-to-peer cluster: a distributed K/V store
+  (``water/DKV.java``) holding column-compressed chunks (``water/fvec/Chunk.java``),
+  map/reduce tasks over chunk-local data with tree reductions over a custom RPC
+  (``water/MRTask.java``).
+- Here the same contracts are expressed TPU-first: a :class:`~h2o3_tpu.frame.Frame`
+  is a set of row-sharded ``jax.Array`` columns living in HBM across a
+  ``jax.sharding.Mesh``; the MRTask map/reduce contract (commutative-associative
+  reduce of per-shard partials) becomes ``shard_map`` + ``lax.psum`` over ICI
+  (:mod:`h2o3_tpu.ops.map_reduce`), or — for most algorithms — plain ``jnp``
+  programs ``jit``-compiled over sharded inputs, letting XLA's SPMD partitioner
+  insert the collectives.
+
+Public surface mirrors the h2o-py client (``h2o-py/h2o/h2o.py``): ``import_file``,
+``H2OFrame``-like :class:`Frame`, estimator classes under :mod:`h2o3_tpu.models`.
+"""
+
+from h2o3_tpu.frame import Frame, Vec, VecType
+from h2o3_tpu.frame.parse import import_file, parse_raw, upload_file
+from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
+from h2o3_tpu.utils.registry import DKV
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Frame",
+    "Vec",
+    "VecType",
+    "import_file",
+    "parse_raw",
+    "upload_file",
+    "get_mesh",
+    "set_mesh",
+    "mesh_context",
+    "num_devices",
+    "DKV",
+    "__version__",
+]

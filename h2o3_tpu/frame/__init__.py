@@ -1,0 +1,7 @@
+"""Data plane: distributed columnar frames (reference: ``water/fvec``)."""
+
+from h2o3_tpu.frame.types import VecType, CAT_NA
+from h2o3_tpu.frame.vec import Vec, padded_len
+from h2o3_tpu.frame.frame import Frame
+
+__all__ = ["Frame", "Vec", "VecType", "CAT_NA", "padded_len"]

@@ -1,0 +1,122 @@
+"""Lazy rollup statistics over a sharded column.
+
+Reference: ``water/fvec/RollupStats.java:19-30,40-146`` — every Vec lazily
+computes min/max/mean/sigma/NA-count/zero-count (plus a histogram) exactly once
+per mutation epoch, via an MRTask whose per-chunk partials reduce with a
+commutative-associative merge.
+
+TPU-native expression: the whole column is a single row-sharded ``jax.Array``,
+so the "MRTask" is one jitted reduction — XLA's SPMD partitioner computes
+per-shard partials on each chip and all-reduces them over ICI. Results are
+cached on the Vec and invalidated on mutation, mirroring the reference's
+rollup epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollups:
+    """Column summary statistics (reference: ``RollupStats``)."""
+
+    nrows: int
+    na_cnt: int
+    min: float
+    max: float
+    mean: float
+    sigma: float       # sample standard deviation (H2O semantics, n-1)
+    nzero: int         # count of exact zeros among non-missing values
+    is_int: bool       # every non-missing value is integral
+    pinfs: int
+    ninfs: int
+
+
+@partial(jax.jit, static_argnames=("padded",))
+def _numeric_rollups(data: jax.Array, nrows: jax.Array, padded: int):
+    """One pass over a padded, row-sharded float column.
+
+    Rows at index >= nrows are padding (NaN); NaN in-range means missing.
+    """
+    idx = jnp.arange(padded)
+    in_range = idx < nrows
+    finite = jnp.isfinite(data)
+    valid = in_range & finite
+    pinf = in_range & jnp.isposinf(data)
+    ninf = in_range & jnp.isneginf(data)
+    na = in_range & jnp.isnan(data)
+
+    x = jnp.where(valid, data, 0.0)
+    cnt = valid.sum()
+    s = x.sum()
+    mean = jnp.where(cnt > 0, s / cnt, jnp.nan)
+    # Centered second pass avoids float32 catastrophic cancellation of the
+    # naive sum-of-squares formula (large-mean columns); still one fused kernel.
+    d = jnp.where(valid, data - mean, 0.0)
+    var = jnp.where(cnt > 1, (d * d).sum() / (cnt - 1), 0.0)
+    sigma = jnp.sqrt(jnp.maximum(var, 0.0))
+    mn = jnp.where(valid, data, jnp.inf).min()
+    mx = jnp.where(valid, data, -jnp.inf).max()
+    # include infs in min/max like the reference (Double.POSITIVE_INFINITY sorts)
+    mn = jnp.where(ninf.any(), -jnp.inf, mn)
+    mx = jnp.where(pinf.any(), jnp.inf, mx)
+    nzero = (valid & (data == 0.0)).sum()
+    is_int = jnp.where(cnt > 0, (jnp.where(valid, data - jnp.round(data), 0.0) == 0.0).all(), False)
+    return dict(
+        na_cnt=na.sum(),  # NaN only; infs tracked separately
+        min=mn, max=mx, mean=mean, sigma=sigma, nzero=nzero,
+        is_int=is_int, pinfs=pinf.sum(), ninfs=ninf.sum(), cnt=cnt,
+    )
+
+
+def numeric_rollups(data: jax.Array, nrows: int) -> Rollups:
+    r = jax.device_get(_numeric_rollups(data, jnp.int32(nrows), data.shape[0]))
+    return Rollups(
+        nrows=nrows,
+        na_cnt=int(r["na_cnt"]),
+        min=float(r["min"]) if r["cnt"] > 0 else float("nan"),
+        max=float(r["max"]) if r["cnt"] > 0 else float("nan"),
+        mean=float(r["mean"]),
+        sigma=float(r["sigma"]),
+        nzero=int(r["nzero"]),
+        is_int=bool(r["is_int"]),
+        pinfs=int(r["pinfs"]),
+        ninfs=int(r["ninfs"]),
+    )
+
+
+@partial(jax.jit, static_argnames=("padded",))
+def _cat_rollups(codes: jax.Array, nrows: jax.Array, padded: int):
+    idx = jnp.arange(padded)
+    in_range = idx < nrows
+    valid = in_range & (codes >= 0)
+    cnt = valid.sum()
+    c = jnp.where(valid, codes, 0)
+    s = c.sum()
+    mean = jnp.where(cnt > 0, s / cnt, jnp.nan)
+    mn = jnp.where(valid, codes, jnp.iinfo(jnp.int32).max).min()
+    mx = jnp.where(valid, codes, -1).max()
+    return dict(na_cnt=in_range.sum() - cnt, min=mn, max=mx, mean=mean, cnt=cnt,
+                nzero=(valid & (codes == 0)).sum())
+
+
+def cat_rollups(codes: jax.Array, nrows: int) -> Rollups:
+    r = jax.device_get(_cat_rollups(codes, jnp.int32(nrows), codes.shape[0]))
+    cnt = int(r["cnt"])
+    return Rollups(
+        nrows=nrows,
+        na_cnt=int(r["na_cnt"]),
+        min=float(r["min"]) if cnt else float("nan"),
+        max=float(r["max"]) if cnt else float("nan"),
+        mean=float(r["mean"]),
+        sigma=float("nan"),
+        nzero=int(r["nzero"]),
+        is_int=True,
+        pinfs=0,
+        ninfs=0,
+    )

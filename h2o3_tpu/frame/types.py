@@ -1,0 +1,41 @@
+"""Column types — mirrors the reference Vec type system.
+
+Reference: ``water/fvec/Vec.java:207-212`` defines T_BAD, T_UUID, T_STR, T_NUM,
+T_CAT, T_TIME. On TPU, the 20+ chunk compression codecs of the reference
+(``water/fvec/NewChunk.java:993-997`` picks the cheapest of ``C0DChunk``,
+``C1Chunk``, ``C2SChunk``, ... per ~64KB fragment) collapse into dtype choice:
+numeric data is float32 in HBM (NaN = missing, replacing the reference's NA
+sentinel scheme), categoricals are int32 codes (-1 = missing) with a host-side
+string domain, and the compressed-int bias/scale codecs are unnecessary because
+XLA operates on dense typed arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class VecType(enum.Enum):
+    BAD = "bad"        # all-missing column
+    NUM = "real"       # numeric (float32 on device)
+    INT = "int"        # integer-valued numeric (still float32 on device)
+    CAT = "enum"       # categorical: int32 codes + host domain
+    TIME = "time"      # epoch millis (float64 host / float32 device)
+    STR = "string"     # host-resident string column (not uploaded)
+    UUID = "uuid"      # host-resident uuid column
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (VecType.NUM, VecType.INT, VecType.TIME)
+
+    @property
+    def on_device(self) -> bool:
+        return self in (VecType.NUM, VecType.INT, VecType.TIME, VecType.CAT)
+
+    def __str__(self) -> str:  # matches h2o-py frame "types" display names
+        return self.value
+
+
+# Missing-value sentinel for categorical codes (reference uses per-chunk NA
+# codes; a single negative sentinel suffices for int32 codes).
+CAT_NA = -1

@@ -1,0 +1,193 @@
+"""Vec — one distributed column.
+
+Reference: ``water/fvec/Vec.java`` — a Vec is a collection of ~64KB compressed
+chunks distributed over the cloud by an ESPC (element-start-per-chunk) layout
+shared per VectorGroup (``Vec.java:152,264``), with lazily computed rollup
+statistics (``RollupStats.java``).
+
+TPU-native redesign: a Vec is ONE row-sharded ``jax.Array`` in HBM, padded to a
+multiple of the mesh's row-axis size. The ESPC layout becomes the (uniform)
+``NamedSharding(mesh, P("rows"))`` partition; chunk compression becomes dtype
+choice (see :mod:`h2o3_tpu.frame.types`); decompress-on-access (``Chunk.atd``)
+is unnecessary. String/UUID columns stay host-resident (numpy object arrays) —
+they feed munging and parsing, never device compute, matching how the reference
+excludes them from model training.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.types import CAT_NA, VecType
+from h2o3_tpu.frame.rollups import Rollups, cat_rollups, numeric_rollups
+from h2o3_tpu.parallel.mesh import num_devices, row_sharding
+
+# Pad row counts to a multiple of (devices * _ROW_ALIGN) so every shard is
+# sublane-aligned for float32 tiles (8 x 128 min tile).
+_ROW_ALIGN = 8
+
+
+def padded_len(nrows: int, ndev: int | None = None) -> int:
+    ndev = ndev or num_devices()
+    unit = ndev * _ROW_ALIGN
+    return max(unit, ((nrows + unit - 1) // unit) * unit)
+
+
+def _upload(host: np.ndarray, nrows: int, fill) -> jax.Array:
+    plen = padded_len(nrows)
+    padded = np.full(plen, fill, dtype=host.dtype)
+    padded[:nrows] = host
+    return jax.device_put(padded, row_sharding(1))
+
+
+class Vec:
+    """One named, typed, distributed column of a Frame."""
+
+    def __init__(
+        self,
+        data: jax.Array | None,
+        type: VecType,
+        nrows: int,
+        domain: tuple[str, ...] | None = None,
+        host_values: np.ndarray | None = None,
+        time_offset: float = 0.0,
+    ):
+        self.data = data                  # padded, row-sharded device array (or None for STR/UUID)
+        self.type = type
+        self.nrows = nrows
+        self.domain = domain              # categorical level names, sorted (parser semantics)
+        self.host_values = host_values    # host-only payload (STR/UUID; exact f64 ms for TIME)
+        # TIME device data is float32 *relative* ms (value - time_offset): epoch
+        # millis (~1.8e12) overflow a float32 mantissa, so absolute times live
+        # host-side in float64 and device compute uses the shifted column.
+        self.time_offset = time_offset
+        self._rollups: Rollups | None = None
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, type: VecType | None = None,
+                   domain: Sequence[str] | None = None) -> "Vec":
+        """Build a Vec from a host array, guessing the type if not given."""
+        nrows = len(values)
+        if type is None:
+            type = _guess_type(values)
+        if type in (VecType.STR, VecType.UUID):
+            return Vec(None, type, nrows, host_values=np.asarray(values, dtype=object))
+        if type is VecType.CAT:
+            if domain is None:
+                codes, domain = _factorize(values)
+            else:
+                codes = np.asarray(values, dtype=np.int32)
+            data = _upload(codes.astype(np.int32), nrows, CAT_NA)
+            return Vec(data, type, nrows, domain=tuple(domain))
+        host = np.asarray(values, dtype=np.float32)
+        data = _upload(host, nrows, np.nan)
+        return Vec(data, type, nrows)
+
+    @staticmethod
+    def from_device(data: jax.Array, nrows: int, type: VecType = VecType.NUM,
+                    domain: tuple[str, ...] | None = None) -> "Vec":
+        """Wrap an existing padded, row-sharded device array."""
+        return Vec(data, type, nrows, domain=domain)
+
+    # -- properties ---------------------------------------------------------
+
+    @property
+    def plen(self) -> int:
+        return self.data.shape[0] if self.data is not None else padded_len(self.nrows)
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.type is VecType.CAT
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type.is_numeric
+
+    def cardinality(self) -> int:
+        """Number of categorical levels (reference: ``Vec.cardinality()``)."""
+        return len(self.domain) if self.domain is not None else -1
+
+    # -- rollups (lazy, cached; reference RollupStats semantics) ------------
+
+    def rollups(self) -> Rollups:
+        if self._rollups is None:
+            if self.type is VecType.CAT:
+                self._rollups = cat_rollups(self.data, self.nrows)
+            elif self.type.on_device:
+                self._rollups = numeric_rollups(self.data, self.nrows)
+            else:
+                na = int(sum(v is None for v in self.host_values))
+                self._rollups = Rollups(self.nrows, na, float("nan"), float("nan"),
+                                        float("nan"), float("nan"), 0, False, 0, 0)
+        return self._rollups
+
+    def invalidate_rollups(self) -> None:
+        """Call after mutating ``data`` (reference: rollup epoch bump)."""
+        self._rollups = None
+
+    def min(self) -> float: return self.rollups().min
+    def max(self) -> float: return self.rollups().max
+    def mean(self) -> float: return self.rollups().mean
+    def sigma(self) -> float: return self.rollups().sigma
+    def na_cnt(self) -> int: return self.rollups().na_cnt
+    def is_int(self) -> bool: return self.rollups().is_int
+
+    # -- access -------------------------------------------------------------
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather the logical (unpadded) column to host (TIME: exact f64 ms)."""
+        if not self.type.on_device:
+            return self.host_values
+        if self.type is VecType.TIME and self.host_values is not None:
+            return self.host_values[: self.nrows]
+        return np.asarray(jax.device_get(self.data))[: self.nrows]
+
+    def as_float(self) -> jax.Array:
+        """Device column as float32 with NaN for missing (cats → code floats)."""
+        if self.type is VecType.CAT:
+            return jnp.where(self.data < 0, jnp.nan, self.data.astype(jnp.float32))
+        return self.data
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    def __repr__(self) -> str:
+        dom = f", card={self.cardinality()}" if self.is_categorical else ""
+        return f"Vec({self.type}, nrows={self.nrows}{dom})"
+
+
+def _guess_type(values: np.ndarray) -> VecType:
+    values = np.asarray(values)
+    if values.dtype.kind in "fc":
+        finite = values[np.isfinite(values)]
+        return VecType.INT if finite.size and np.all(finite == np.round(finite)) else VecType.NUM
+    if values.dtype.kind in "iu":
+        return VecType.INT
+    if values.dtype.kind == "b":
+        return VecType.INT
+    if values.dtype.kind == "M":
+        return VecType.TIME
+    return VecType.CAT
+
+
+def _factorize(values: np.ndarray) -> tuple[np.ndarray, list[str]]:
+    """Categorical encoding with a lexicographically sorted domain.
+
+    Reference: the parser sorts categorical domains (``water/parser`` packed
+    domain merge), so codes are stable across chunk orderings.
+    """
+    arr = np.asarray(values, dtype=object)
+    mask = np.array([v is None or (isinstance(v, (float, np.floating)) and np.isnan(v)) for v in arr],
+                    dtype=bool)
+    strs = np.array([str(v) for v in arr[~mask]])
+    domain = sorted(set(strs.tolist()))
+    lut = {s: i for i, s in enumerate(domain)}
+    codes = np.full(len(arr), CAT_NA, dtype=np.int32)
+    codes[~mask] = np.array([lut[s] for s in strs], dtype=np.int32)
+    return codes, domain

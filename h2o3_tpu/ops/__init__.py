@@ -1,0 +1,5 @@
+"""Compute substrate: map/reduce over sharded columns, histograms, linalg."""
+
+from h2o3_tpu.ops.map_reduce import map_reduce, map_cols
+
+__all__ = ["map_reduce", "map_cols"]

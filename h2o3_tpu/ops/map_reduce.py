@@ -1,0 +1,99 @@
+"""map_reduce — the MRTask contract on a TPU mesh.
+
+Reference: ``water/MRTask.java:83-118,257-305`` — user code supplies
+``map(Chunk[])`` producing per-chunk partial state and ``reduce(MRTask)``
+merging two partials; the runtime fans out over nodes in a binary tree, runs
+map on every local chunk via recursive fork/join, and reduces partials up the
+tree over RPC.
+
+TPU-native expression: the contract — a commutative-associative monoid over
+row shards — maps 1:1 onto ``shard_map`` + ``lax.psum``:
+
+- fan-out over nodes + per-chunk fork/join  →  SPMD: each device runs ``map_fn``
+  on its shard (XLA vectorizes the "loop over rows" instead of forking tasks);
+- tree reduction over RPC                   →  ``lax.psum`` over the ``rows``
+  mesh axis (XLA lowers to an ICI all-reduce, which IS a ring/tree reduction
+  in hardware).
+
+Two styles are supported, and most algorithm code uses the second:
+
+1. Explicit: ``map_reduce(map_fn, cols...)`` — per-shard partials psum-reduced.
+   Use when the partial is a fixed-shape statistic (histogram, Gram, counts).
+2. Implicit: write plain ``jnp`` reductions over the sharded column inside
+   ``jax.jit`` — the SPMD partitioner inserts the same collectives. (This is
+   why most of the framework contains no explicit communication code at all.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel.mesh import ROWS, get_mesh
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+# Compiled-program cache: jit executables are tied to the wrapper instance, so
+# re-wrapping per call would recompile every invocation (deadly in iterative
+# algorithms like tree building). Keyed by (fn, mesh, arg ranks, donate);
+# jax.jit's own cache handles shape/dtype specialization underneath.
+_compiled: dict = {}
+
+
+def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
+    """Run ``map_fn`` on each device's row shard; psum-reduce the results.
+
+    ``map_fn(*shards) -> pytree of arrays`` must produce partials whose
+    elementwise sum is the correct global result (the MRTask ``reduce``
+    contract specialized to addition, which covers every reference use:
+    histograms, Gram matrices, gradient sums, counts).
+    """
+    mesh = get_mesh()
+    ndims = tuple(c.ndim for c in cols)
+    key = ("mr", map_fn, mesh, ndims, donate)
+    fn = _compiled.get(key)
+    if fn is None:
+        in_specs = tuple(P(ROWS, *([None] * (nd - 1))) for nd in ndims)
+
+        def shard_body(*shards):
+            return jax.tree.map(lambda p: lax.psum(p, ROWS), map_fn(*shards))
+
+        fn = jax.jit(_shard_map(shard_body, mesh=mesh, in_specs=in_specs, out_specs=P()),
+                     donate_argnums=tuple(range(len(cols))) if donate else ())
+        _compiled[key] = fn
+    return fn(*cols)
+
+
+def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:
+    """Elementwise/column transform preserving row sharding.
+
+    Reference analog: MRTask with ``NewChunk`` outputs (``outputFrame``) — a map
+    with no reduce. Under jit on sharded inputs this is embarrassingly parallel;
+    provided as a named entry point for symmetry and for fusing multi-column
+    expressions in one compiled program.
+    """
+    key = ("mc", fn)
+    jfn = _compiled.get(key)
+    if jfn is None:
+        jfn = _compiled[key] = jax.jit(fn)
+    return jfn(*cols)
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def segment_sum_cols(values: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Global segment-sum over sharded rows (building block for group-by and
+    histogram accumulation). values: [rows] or [rows, k]; ids: [rows] int32
+    with negative ids dropped."""
+    ok = segment_ids >= 0
+    ids = jnp.where(ok, segment_ids, 0)
+    vals = jnp.where((ok if values.ndim == 1 else ok[:, None]), values, 0)
+    return jax.ops.segment_sum(vals, ids, num_segments=num_segments)

@@ -1,0 +1,27 @@
+"""Parallel substrate: device mesh management and collective helpers.
+
+Replaces the reference's distributed runtime (cloud formation ``water/Paxos.java``,
+RPC ``water/RPC.java``, transport ``water/TCPReceiverThread.java``): on TPU the
+"cloud" is the JAX device mesh — membership is static per slice, transport is ICI
+driven by XLA collectives, and there is no user-level RPC to implement.
+"""
+
+from h2o3_tpu.parallel.mesh import (
+    ROWS,
+    get_mesh,
+    set_mesh,
+    mesh_context,
+    num_devices,
+    row_sharding,
+    replicated_sharding,
+)
+
+__all__ = [
+    "ROWS",
+    "get_mesh",
+    "set_mesh",
+    "mesh_context",
+    "num_devices",
+    "row_sharding",
+    "replicated_sharding",
+]

@@ -1,0 +1,84 @@
+"""Global device mesh — the TPU-native equivalent of H2O's "cloud".
+
+In the reference, every node gossips heartbeats until all agree on the member
+list (``water/Paxos.java:27-124``) and the cloud is then locked — membership is
+static for the lifetime of a job. A TPU slice has exactly that property out of
+the box: the set of chips is fixed, so "cloud formation" reduces to constructing
+a ``jax.sharding.Mesh`` over ``jax.devices()``.
+
+The default mesh is 1-D over all addressable devices with axis name ``"rows"``:
+frames are row-partitioned across it the way H2O chunks rows across nodes
+(ESPC layout, ``water/fvec/Vec.java:152``). Multi-dim meshes (e.g. rows × model
+for sharded Gram linear algebra) can be installed with :func:`set_mesh`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Name of the data-parallel (row) mesh axis. Every Frame column is sharded
+# along this axis; reductions over it ride ICI (lax.psum / XLA SPMD).
+ROWS = "rows"
+
+_lock = threading.Lock()
+_mesh: Mesh | None = None
+
+
+def _default_mesh() -> Mesh:
+    devices = np.array(jax.devices())
+    return Mesh(devices, axis_names=(ROWS,))
+
+
+def get_mesh() -> Mesh:
+    """Return the process-global mesh, creating the default 1-D mesh lazily."""
+    global _mesh
+    with _lock:
+        if _mesh is None:
+            _mesh = _default_mesh()
+        return _mesh
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    """Install a mesh globally (``None`` resets to the lazy default).
+
+    The mesh must have a ``"rows"`` axis; extra axes are allowed and are used by
+    model-parallel code paths (e.g. sharded Cholesky for wide GLM Gram matrices).
+    """
+    global _mesh
+    if mesh is not None and ROWS not in mesh.axis_names:
+        raise ValueError(f"mesh must have a {ROWS!r} axis, got {mesh.axis_names}")
+    with _lock:
+        _mesh = mesh
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    """Temporarily install ``mesh`` as the global mesh."""
+    prev = _mesh
+    set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(prev)
+
+
+def num_devices() -> int:
+    """Number of devices along the row axis (H2O: ``H2O.CLOUD.size()``)."""
+    mesh = get_mesh()
+    return mesh.shape[ROWS]
+
+
+def row_sharding(ndim: int = 1) -> NamedSharding:
+    """Sharding that partitions axis 0 (rows) and replicates the rest."""
+    spec = P(ROWS, *([None] * (ndim - 1)))
+    return NamedSharding(get_mesh(), spec)
+
+
+def replicated_sharding() -> NamedSharding:
+    """Fully-replicated sharding on the global mesh."""
+    return NamedSharding(get_mesh(), P())

@@ -1,0 +1,5 @@
+"""Utilities: keyed registry (DKV equivalent), logging, tables."""
+
+from h2o3_tpu.utils.registry import DKV, KeyedStore
+
+__all__ = ["DKV", "KeyedStore"]

@@ -1,0 +1,60 @@
+"""Keyed object registry — the single-controller stand-in for the reference DKV.
+
+Reference: ``water/DKV.java`` + ``water/Key.java`` — a cluster-wide K/V store
+where every key hashes to a home node, non-home nodes cache values, and puts
+invalidate replicas over RPC. In the TPU design there is exactly one controller
+process per job (JAX's multi-controller SPMD runs the *same* program on every
+host, so global metadata like frames/models/jobs needs no replication protocol
+— device data is already resident in HBM, addressed by ``jax.Array`` sharding).
+What remains of DKV is a process-local name → object registry used by the REST
+layer and the Python client to address frames/models/jobs by key.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterator
+
+
+class KeyedStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[str, Any] = {}
+
+    def put(self, key: str | None, value: Any) -> str | None:
+        if key is None:
+            return None
+        with self._lock:
+            self._store[key] = value
+        return key
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._store.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        with self._lock:
+            return self._store[key]
+
+    def remove(self, key: str) -> Any:
+        with self._lock:
+            return self._store.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._store.keys())
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+
+# Global registry (reference: the DKV singleton).
+DKV = KeyedStore()

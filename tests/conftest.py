@@ -1,0 +1,38 @@
+"""Test harness: simulate a multi-chip TPU cloud with 8 virtual CPU devices.
+
+Reference test strategy (SURVEY.md §4): H2O tests boot N JVMs on localhost and
+block in ``TestUtil.stall_till_cloudsize(n)`` until the cloud forms. The TPU
+equivalent is N virtual devices on one host via
+``--xla_force_host_platform_device_count`` — same API as real chips, so every
+sharding/collective path is exercised.
+
+Env vars MUST be set before jax is imported anywhere in the process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the TPU backend unconditionally;
+# override it after import so tests run on the virtual CPU cloud.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_dkv():
+    yield
+    from h2o3_tpu.utils.registry import DKV
+    DKV.clear()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
