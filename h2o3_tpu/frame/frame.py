@@ -56,14 +56,8 @@ class Frame:
                 else:
                     vecs.append(Vec.from_numpy(s.to_numpy(dtype=object)))
             elif s.dtype.kind == "M":
-                # pandas >=3.0 defaults to datetime64[us]; normalize to ns first
-                ns = s.to_numpy().astype("datetime64[ns]").astype(np.int64)
-                ms = ns.astype(np.float64) / 1e6
-                ms = np.where(s.isna().to_numpy(), np.nan, ms)
-                offset = float(np.nanmin(ms)) if np.isfinite(ms).any() else 0.0
-                from h2o3_tpu.frame.vec import _upload
-                data = _upload((ms - offset).astype(np.float32), len(s), np.nan)
-                vecs.append(Vec(data, VecType.TIME, len(s), host_values=ms, time_offset=offset))
+                # pandas >=3.0 defaults to datetime64[us]; Vec normalizes to ns
+                vecs.append(Vec.from_numpy(s.to_numpy(), type=VecType.TIME))
             elif s.dtype.kind == "b":
                 vecs.append(Vec.from_numpy(s.to_numpy().astype(np.float32), type=VecType.INT))
             else:
@@ -121,6 +115,8 @@ class Frame:
     def add(self, name: str, vec: Vec) -> "Frame":
         if vec.nrows != self.nrows and self.vecs:
             raise ValueError("row count mismatch")
+        if name in self.names:
+            raise ValueError(f"duplicate column name: {name!r}")
         self.names.append(name)
         self.vecs.append(vec)
         return self

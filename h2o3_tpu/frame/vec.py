@@ -76,6 +76,13 @@ class Vec:
         nrows = len(values)
         if type is None:
             type = _guess_type(values)
+        if type is VecType.TIME and np.asarray(values).dtype.kind == "M":
+            ns = np.asarray(values).astype("datetime64[ns]")
+            ms = ns.astype(np.int64).astype(np.float64) / 1e6
+            ms = np.where(np.isnat(ns), np.nan, ms)
+            offset = float(np.nanmin(ms)) if np.isfinite(ms).any() else 0.0
+            data = _upload((ms - offset).astype(np.float32), nrows, np.nan)
+            return Vec(data, VecType.TIME, nrows, host_values=ms, time_offset=offset)
         if type in (VecType.STR, VecType.UUID):
             return Vec(None, type, nrows, host_values=np.asarray(values, dtype=object))
         if type is VecType.CAT:

@@ -1,0 +1,13 @@
+"""Model framework + algorithms (reference: ``hex/`` in h2o-core and h2o-algos).
+
+Estimators follow the h2o-py naming so users of the reference find the same
+surface: ``H2OGeneralizedLinearEstimator``-like classes live here as ``GLM``,
+``GBM``, ``DeepLearning``, ``KMeans``, etc., each a ``ModelBuilder`` subclass
+producing a ``Model`` with metrics, prediction, and export.
+"""
+
+from h2o3_tpu.models.model_base import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.glm import GLM, GLMModel
+
+__all__ = ["Model", "ModelBuilder", "ModelParameters", "Job", "GLM", "GLMModel"]

@@ -1,0 +1,126 @@
+"""Distribution families and link functions.
+
+Reference: ``hex/Distribution.java``, ``DistributionFactory.java``,
+``LinkFunction*.java`` (bernoulli, quasibinomial, multinomial, gaussian,
+poisson, gamma, tweedie, laplace, quantile, huber) and the GLM family/link
+tables in ``hex/glm/GLMModel.java`` (GLMParameters.Family/Link).
+
+All functions are pure jnp and jit-safe; IRLS needs (link, inverse link,
+d mu/d eta, variance function), boosting needs (deviance, gradient, hessian).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+_EPS = 1e-10
+
+
+def _clip01(p):
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """GLM family: link pair + variance + deviance (reference: GLMParameters.Family)."""
+
+    name: str
+    link: Callable          # eta = g(mu)
+    linkinv: Callable       # mu = g^-1(eta)
+    dmu_deta: Callable      # mu'(eta)
+    variance: Callable      # Var(y|mu) up to dispersion
+    deviance: Callable      # per-row deviance d(y, mu)
+
+    def initialize_mu(self, y):
+        """Starting mu for IRLS (reference: GLM.java initialization)."""
+        if self.name == "binomial":
+            return (y + 0.5) / 2.0
+        if self.name in ("poisson", "gamma", "tweedie"):
+            return jnp.maximum(y, 0.1)
+        return y
+
+
+def _gaussian():
+    return Family(
+        "gaussian",
+        link=lambda mu: mu,
+        linkinv=lambda eta: eta,
+        dmu_deta=lambda eta: jnp.ones_like(eta),
+        variance=lambda mu: jnp.ones_like(mu),
+        deviance=lambda y, mu: (y - mu) ** 2,
+    )
+
+
+def _binomial():
+    def linkinv(eta):
+        return _clip01(jnp.where(eta >= 0, 1.0 / (1.0 + jnp.exp(-eta)),
+                                 jnp.exp(eta) / (1.0 + jnp.exp(eta))))
+
+    return Family(
+        "binomial",
+        link=lambda mu: jnp.log(_clip01(mu) / (1.0 - _clip01(mu))),
+        linkinv=linkinv,
+        dmu_deta=lambda eta: _clip01(linkinv(eta)) * (1.0 - _clip01(linkinv(eta))),
+        variance=lambda mu: _clip01(mu) * (1.0 - _clip01(mu)),
+        deviance=lambda y, mu: -2.0 * (y * jnp.log(_clip01(mu)) + (1 - y) * jnp.log(1 - _clip01(mu))),
+    )
+
+
+def _poisson():
+    return Family(
+        "poisson",
+        link=lambda mu: jnp.log(jnp.maximum(mu, _EPS)),
+        linkinv=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        dmu_deta=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        variance=lambda mu: jnp.maximum(mu, _EPS),
+        deviance=lambda y, mu: 2.0 * (jnp.where(y > 0, y * jnp.log(jnp.maximum(y, _EPS) / jnp.maximum(mu, _EPS)), 0.0) - (y - mu)),
+    )
+
+
+def _gamma():
+    return Family(
+        "gamma",
+        link=lambda mu: jnp.log(jnp.maximum(mu, _EPS)),   # log link default (H2O allows inverse)
+        linkinv=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        dmu_deta=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        variance=lambda mu: jnp.maximum(mu, _EPS) ** 2,
+        deviance=lambda y, mu: -2.0 * (jnp.log(jnp.maximum(y, _EPS) / jnp.maximum(mu, _EPS)) - (y - mu) / jnp.maximum(mu, _EPS)),
+    )
+
+
+def _tweedie(p: float = 1.5):
+    def deviance(y, mu):
+        mu = jnp.maximum(mu, _EPS)
+        y1 = jnp.maximum(y, _EPS)
+        return 2.0 * (y1 ** (2 - p) / ((1 - p) * (2 - p))
+                      - y * mu ** (1 - p) / (1 - p) + mu ** (2 - p) / (2 - p))
+
+    return Family(
+        "tweedie",
+        link=lambda mu: jnp.log(jnp.maximum(mu, _EPS)),
+        linkinv=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        dmu_deta=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        variance=lambda mu: jnp.maximum(mu, _EPS) ** p,
+        deviance=deviance,
+    )
+
+
+_FAMILIES: dict[str, Callable[[], Family]] = {
+    "gaussian": _gaussian,
+    "binomial": _binomial,
+    "bernoulli": _binomial,
+    "poisson": _poisson,
+    "gamma": _gamma,
+    "tweedie": _tweedie,
+}
+
+
+def get_family(name: str, **kw) -> Family:
+    try:
+        f = _FAMILIES[name]
+    except KeyError:
+        raise ValueError(f"unknown family {name!r}; have {sorted(_FAMILIES)}") from None
+    return f(**kw) if kw else f()

@@ -1,0 +1,97 @@
+"""Job — async work units with progress/cancel.
+
+Reference: ``water/Job.java`` (556 LoC): a keyed DKV object with start/update/
+stop, progress fraction, status polling via REST ``/3/Jobs``. Here a Job wraps a
+Python callable run either synchronously (library use) or on a worker thread
+(REST use); device work is already async under JAX dispatch, so the Job's role
+is bookkeeping: status, progress, timing, cancellation flag, exception capture.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable
+
+from h2o3_tpu.utils.registry import DKV
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job:
+    CREATED, RUNNING, DONE, FAILED, CANCELLED = "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED"
+
+    def __init__(self, description: str, key: str | None = None):
+        self.key = key or f"job_{uuid.uuid4().hex[:12]}"
+        self.description = description
+        self.status = Job.CREATED
+        self.progress = 0.0
+        self.progress_msg = ""
+        self.start_time: float | None = None
+        self.end_time: float | None = None
+        self.exception: BaseException | None = None
+        self.result: Any = None
+        self._cancel_requested = threading.Event()
+        self._done = threading.Event()
+        DKV.put(self.key, self)
+
+    # -- driver side ---------------------------------------------------------
+
+    def run(self, fn: Callable[["Job"], Any], background: bool = False) -> "Job":
+        """Execute ``fn(job)``; fn should call ``job.update`` and check
+        ``job.cancelled`` periodically (reference: ``Job.update``)."""
+        if background:
+            threading.Thread(target=self._exec, args=(fn,), daemon=True).start()
+        else:
+            self._exec(fn)
+        return self
+
+    def _exec(self, fn):
+        self.status = Job.RUNNING
+        self.start_time = time.time()
+        try:
+            self.result = fn(self)
+            self.status = Job.CANCELLED if self._cancel_requested.is_set() else Job.DONE
+            self.progress = 1.0
+        except JobCancelled:
+            self.status = Job.CANCELLED
+        except BaseException as e:
+            # Job is the error carrier (REST/background polls read it); the
+            # synchronous caller re-raises from job.exception after run().
+            self.status = Job.FAILED
+            self.exception = e
+            self.traceback = traceback.format_exc()
+        finally:
+            self.end_time = time.time()
+            self._done.set()
+
+    def update(self, progress: float, msg: str = "") -> None:
+        self.progress = float(progress)
+        self.progress_msg = msg
+        if self._cancel_requested.is_set():
+            raise JobCancelled(self.key)
+
+    # -- client side ---------------------------------------------------------
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel_requested.is_set()
+
+    def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def join(self, timeout: float | None = None) -> "Job":
+        self._done.wait(timeout)
+        return self
+
+    @property
+    def run_time(self) -> float:
+        end = self.end_time or time.time()
+        return (end - self.start_time) if self.start_time else 0.0
+
+    def __repr__(self) -> str:
+        return f"Job({self.key}, {self.status}, {self.progress:.0%}, {self.description!r})"

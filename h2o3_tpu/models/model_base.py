@@ -1,0 +1,285 @@
+"""ModelBuilder / Model — the algorithm framework.
+
+Reference: ``hex/ModelBuilder.java`` (2,171 LoC: param validation, train/valid
+adaptation, Driver lifecycle, n-fold CV orchestration ``computeCrossValidation``
+``:608``) and ``hex/Model.java`` (3,482 LoC: ``adaptTestForTrain``,
+``score(Frame)`` → BigScore MRTask ``:1866-1959``, metrics hookup).
+
+TPU-first redesign decisions:
+
+- **CV and holdout masking via weights, not sub-frames.** The reference carves
+  physical train/holdout frames per fold. Here every algorithm trains against a
+  per-row weight vector (0 = excluded), so all folds share one device-resident
+  design matrix and every fold's program has identical static shapes — XLA
+  compiles once, folds differ only in an input array. (The reference itself
+  routes user weights through ``DataInfo._weights``; we promote that to the
+  universal mechanism.)
+- **Scoring is a jitted batch program**, not a per-row ``score0`` virtual call:
+  ``Model._score_raw`` maps the design matrix to predictions on-device.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.metrics import (
+    binomial_metrics,
+    multinomial_metrics,
+    regression_metrics,
+)
+from h2o3_tpu.utils.registry import DKV
+
+
+class ModelParameters(dict):
+    """Parameter bag with attribute access and declared defaults.
+
+    Reference: per-algo ``Model.Parameters`` Iced classes with ``@API`` fields;
+    here a dict so the REST schema layer can serialize uniformly.
+    """
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k) from None
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class Model:
+    """A trained model: artifacts + scoring + metrics (reference: ``hex.Model``)."""
+
+    algo = "model"
+
+    def __init__(self, key: str, params: ModelParameters, data_info: DataInfo | None,
+                 response_column: str | None, response_domain: tuple[str, ...] | None,
+                 output: dict[str, Any]):
+        self.key = key
+        self.params = params
+        self.data_info = data_info
+        self.response_column = response_column
+        self.response_domain = response_domain  # None for regression
+        self.output = output                    # algo artifacts (device arrays ok)
+        self.training_metrics = None
+        self.validation_metrics = None
+        self.cross_validation_metrics = None
+        self.run_time_ms: int = 0
+
+    # -- problem type --------------------------------------------------------
+
+    @property
+    def nclasses(self) -> int:
+        return len(self.response_domain) if self.response_domain else 0
+
+    @property
+    def is_classifier(self) -> bool:
+        return self.nclasses >= 2
+
+    # -- scoring -------------------------------------------------------------
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        """Device predictions: [plen] for regression, [plen, nclasses] probs
+        for classification. Implemented per algorithm."""
+        raise NotImplementedError
+
+    def predict(self, frame: Frame) -> Frame:
+        """Score a frame (reference: ``Model.score`` → prediction frame)."""
+        raw = self._score_raw(frame)
+        n = frame.nrows
+        if not self.is_classifier:
+            return Frame(["predict"], [Vec.from_device(raw, n, VecType.NUM)])
+        labels = jnp.argmax(raw, axis=1).astype(jnp.int32)
+        names = ["predict"] + [f"p{d}" for d in self.response_domain]
+        vecs = [Vec.from_device(labels, n, VecType.CAT, domain=self.response_domain)]
+        for k in range(self.nclasses):
+            vecs.append(Vec.from_device(raw[:, k], n, VecType.NUM))
+        return Frame(names, vecs)
+
+    def model_performance(self, frame: Frame):
+        """Compute metrics on a (possibly new) frame (reference:
+        ``ModelMetrics`` builders run inside BigScore)."""
+        if self.response_column not in frame:
+            raise ValueError(f"frame lacks response column {self.response_column!r}")
+        raw = self._score_raw(frame)
+        yvec = frame.vec(self.response_column)
+        mask = frame.row_mask()
+        if self.is_classifier and yvec.domain != self.response_domain:
+            from h2o3_tpu.models.data_info import _remap_codes
+            y = _remap_codes(yvec.data, yvec.domain or (), self.response_domain).astype(jnp.float32)
+        else:
+            y = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
+        mask = mask & ~jnp.isnan(y) if not yvec.is_categorical else mask & (y >= 0)
+        return compute_metrics(raw, y, mask, self.nclasses)
+
+    # -- persistence hooks (filled in by h2o3_tpu.persist) -------------------
+
+    def __repr__(self) -> str:
+        lines = [f"{type(self).__name__}(key={self.key!r})"]
+        if self.training_metrics:
+            lines.append(f"  train: {self.training_metrics!r}")
+        if self.validation_metrics:
+            lines.append(f"  valid: {self.validation_metrics!r}")
+        if self.cross_validation_metrics:
+            lines.append(f"  cv:    {self.cross_validation_metrics!r}")
+        return "\n".join(lines)
+
+
+def compute_metrics(raw: jax.Array, y: jax.Array, mask: jax.Array, nclasses: int):
+    if nclasses == 0:
+        return regression_metrics(raw, y, mask)
+    if nclasses == 2:
+        return binomial_metrics(raw[:, 1], y, mask)
+    return multinomial_metrics(raw, y, mask, nclasses)
+
+
+class ModelBuilder:
+    """Algorithm driver base (reference: ``hex.ModelBuilder`` lifecycle:
+    validate params → Driver → CV → metrics)."""
+
+    algo = "base"
+    supports_classification = True
+    supports_regression = True
+
+    def __init__(self, **params):
+        self.params = ModelParameters(self.defaults())
+        unknown = set(params) - set(self.params) - {"model_id"}
+        if unknown:
+            raise ValueError(f"{type(self).__name__}: unknown parameters {sorted(unknown)}; "
+                             f"valid: {sorted(self.params)}")
+        self.params.update(params)
+        self.model_id = params.get("model_id")
+        self.job: Job | None = None
+        self.model: Model | None = None
+
+    # -- subclass contract ---------------------------------------------------
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            seed=-1,
+            nfolds=0,
+            fold_assignment="Modulo",   # Modulo | Random (reference FoldAssignment)
+            weights_column=None,
+            ignored_columns=None,
+            max_runtime_secs=0.0,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x: list[str], y: str | None,
+             weights: jax.Array) -> Model:
+        """Train on rows where weights>0; must honor job.update/cancel."""
+        raise NotImplementedError
+
+    # -- public train API (mirrors h2o-py estimator.train) -------------------
+
+    def train(self, x: Sequence[str] | None = None, y: str | None = None,
+              training_frame: Frame | None = None, validation_frame: Frame | None = None,
+              weights: jax.Array | None = None) -> Model:
+        frame = training_frame
+        if frame is None:
+            raise ValueError("training_frame is required")
+        if y is None and not getattr(self, "unsupervised", False):
+            raise ValueError(f"{self.algo} is supervised: y is required")
+        ignored = set(self.params.get("ignored_columns") or [])
+        if self.params.get("weights_column"):
+            ignored.add(self.params["weights_column"])
+        x = [c for c in (x if x is not None else frame.names)
+             if c != y and c not in ignored and frame.vec(c).type.on_device]
+        if not x:
+            raise ValueError("no usable feature columns")
+        self._validate(frame, x, y)
+
+        base_w = frame.row_mask().astype(jnp.float32)
+        if self.params.get("weights_column"):
+            base_w = base_w * frame.vec(self.params["weights_column"]).data
+        if weights is not None:
+            base_w = base_w * weights
+
+        self.job = Job(f"{self.algo} on {frame.key or 'frame'}")
+        t0 = time.time()
+
+        def driver(job: Job) -> Model:
+            model = self._fit(job, frame, x, y, base_w)
+            model.run_time_ms = int((time.time() - t0) * 1000)
+            if y is not None:
+                model.training_metrics = self._holdout_metrics(model, frame, y, base_w)
+            if validation_frame is not None and y is not None:
+                model.validation_metrics = model.model_performance(validation_frame)
+            nfolds = int(self.params.get("nfolds") or 0)
+            if nfolds >= 2 and y is not None:
+                model.cross_validation_metrics = self._cross_validate(
+                    job, frame, x, y, base_w, nfolds)
+            DKV.put(model.key, model)
+            return model
+
+        self.model = self.job.run(driver)
+        if self.job.status == Job.FAILED:
+            raise self.job.exception
+        return self.job.result
+
+    # -- helpers -------------------------------------------------------------
+
+    def _validate(self, frame: Frame, x: list[str], y: str | None) -> None:
+        if y is not None:
+            yv = frame.vec(y)
+            if yv.is_categorical and not self.supports_classification:
+                raise ValueError(f"{self.algo} does not support a categorical response")
+            if not yv.is_categorical and not self.supports_regression:
+                raise ValueError(f"{self.algo} requires a categorical response")
+
+    def _holdout_metrics(self, model: Model, frame: Frame, y: str, w: jax.Array):
+        raw = model._score_raw(frame)
+        yvec = frame.vec(y)
+        yy = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
+        mask = (w > 0) & (yy >= 0 if yvec.is_categorical else ~jnp.isnan(yy))
+        return compute_metrics(raw, yy, mask, model.nclasses)
+
+    def _fold_ids(self, frame: Frame, nfolds: int) -> jax.Array:
+        """Fold assignment vector (reference: ``hex/FoldAssignment.java``)."""
+        plen = frame.plen
+        if self.params.get("fold_assignment", "Modulo") == "Random":
+            seed = int(self.params.get("seed") or -1)
+            key = jax.random.PRNGKey(seed if seed >= 0 else 907)
+            return jax.random.randint(key, (plen,), 0, nfolds)
+        return jnp.arange(plen) % nfolds
+
+    def _cross_validate(self, job: Job, frame: Frame, x: list[str], y: str,
+                        base_w: jax.Array, nfolds: int):
+        """K-fold CV: same compiled program per fold, weights differ
+        (reference: ``ModelBuilder.computeCrossValidation`` builds physical
+        sub-frames; see module docstring for why masking replaces that)."""
+        folds = self._fold_ids(frame, nfolds)
+        yvec = frame.vec(y)
+        yy = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
+        raws, masks = [], []
+        for k in range(nfolds):
+            w_train = base_w * (folds != k)
+            cv_builder = type(self)(**{**self.params, "nfolds": 0})
+            cv_model = cv_builder._fit(job, frame, x, y, w_train)
+            raw_k = cv_model._score_raw(frame)
+            hold = (base_w > 0) & (folds == k) & \
+                   ((yy >= 0) if yvec.is_categorical else ~jnp.isnan(yy))
+            raws.append(raw_k)
+            masks.append(hold)
+        # pool holdout predictions into one metrics pass (reference: CV main
+        # metrics are computed on merged holdout predictions)
+        nclass = len(yvec.domain) if yvec.is_categorical else 0
+        pooled = sum(jnp.where((m[:, None] if r.ndim == 2 else m), r, 0.0)
+                     for r, m in zip(raws, masks))
+        any_mask = jnp.stack(masks).any(axis=0)
+        return compute_metrics(pooled, yy, any_mask, nclass)
+
+
+def make_model_key(algo: str, model_id: str | None) -> str:
+    return model_id or f"{algo}_{uuid.uuid4().hex[:10]}"

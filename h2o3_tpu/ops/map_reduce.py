@@ -44,9 +44,21 @@ except ImportError:  # pragma: no cover
 
 # Compiled-program cache: jit executables are tied to the wrapper instance, so
 # re-wrapping per call would recompile every invocation (deadly in iterative
-# algorithms like tree building). Keyed by (fn, mesh, arg ranks, donate);
-# jax.jit's own cache handles shape/dtype specialization underneath.
+# algorithms like tree building). Keyed by (weakref(fn), mesh, arg ranks,
+# donate) — entries are evicted when the user's function is collected, so
+# fresh-lambda callers don't leak executables (they also get no cache hits:
+# pass a module-level function or a stable partial to benefit). jax.jit's own
+# cache handles shape/dtype specialization underneath.
+import weakref
+
 _compiled: dict = {}
+
+
+def _cache_key(tag, fn, rest):
+    def _evict(ref, _tag=tag, _rest=rest):
+        _compiled.pop((_tag, ref, _rest), None)
+
+    return (tag, weakref.ref(fn, _evict), rest)
 
 
 def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
@@ -59,7 +71,7 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     """
     mesh = get_mesh()
     ndims = tuple(c.ndim for c in cols)
-    key = ("mr", map_fn, mesh, ndims, donate)
+    key = _cache_key("mr", map_fn, (mesh, ndims, donate))
     fn = _compiled.get(key)
     if fn is None:
         in_specs = tuple(P(ROWS, *([None] * (nd - 1))) for nd in ndims)
@@ -81,7 +93,7 @@ def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:
     provided as a named entry point for symmetry and for fusing multi-column
     expressions in one compiled program.
     """
-    key = ("mc", fn)
+    key = _cache_key("mc", fn, ())
     jfn = _compiled.get(key)
     if jfn is None:
         jfn = _compiled[key] = jax.jit(fn)

@@ -122,3 +122,17 @@ def test_sigma_large_mean(rng):
     """float32 naive sum-of-squares would give ~3x error here (review finding)."""
     v = Vec.from_numpy(rng.normal(10000.0, 1.0, 10000))
     assert abs(v.sigma() - 1.0) < 0.05
+
+
+def test_datetime_via_from_numpy():
+    """Vec.from_numpy on raw datetime64 must hit the TIME path (review regression)."""
+    v = Vec.from_numpy(np.array(["2020-01-01", "2020-01-02"], dtype="datetime64[ns]"))
+    assert v.type is VecType.TIME
+    ms = v.to_numpy()
+    assert ms[1] - ms[0] == 86400_000.0
+
+
+def test_frame_add_duplicate_rejected(rng):
+    f = Frame.from_arrays({"a": np.arange(5)})
+    with pytest.raises(ValueError, match="duplicate"):
+        f.add("a", Vec.from_numpy(np.arange(5)))

@@ -1,0 +1,182 @@
+"""GLM tests — golden-metric parity against sklearn/statsmodels-style closed
+forms (reference test model: h2o-py ``pyunit_*`` GLM suites under
+``h2o-py/tests/testdir_algos/glm/``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GLM
+
+
+def _regression_data(rng, n=2000):
+    X = rng.normal(size=(n, 4))
+    beta = np.array([1.5, -2.0, 0.5, 0.0])
+    y = X @ beta + 3.0 + rng.normal(scale=0.1, size=n)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = y
+    return Frame.from_arrays(cols), beta
+
+
+def test_glm_gaussian_recovers_coefficients(rng):
+    f, beta = _regression_data(rng)
+    m = GLM(family="gaussian").train(y="y", training_frame=f)
+    coef = m.coef()
+    for i, b in enumerate(beta):
+        assert abs(coef[f"x{i}"] - b) < 0.02, coef
+    assert abs(coef["Intercept"] - 3.0) < 0.02
+    assert m.training_metrics.rmse < 0.12
+    assert m.training_metrics.r2 > 0.99
+
+
+def test_glm_gaussian_matches_lstsq(rng):
+    f, _ = _regression_data(rng)
+    m = GLM(family="gaussian", standardize=False).train(y="y", training_frame=f)
+    X = np.column_stack([f.vec(c).to_numpy() for c in ["x0", "x1", "x2", "x3"]])
+    A = np.column_stack([X, np.ones(len(X))])
+    ref = np.linalg.lstsq(A, f.vec("y").to_numpy(), rcond=None)[0]
+    got = [m.coef()[c] for c in ["x0", "x1", "x2", "x3", "Intercept"]]
+    np.testing.assert_allclose(got, ref, atol=5e-3)
+
+
+def test_glm_binomial_vs_sklearn(rng):
+    n = 4000
+    X = rng.normal(size=(n, 3))
+    logits = 0.8 * X[:, 0] - 1.2 * X[:, 1] + 0.3
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(int)
+    f = Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.array(["yes" if v else "no" for v in y], dtype=object),
+    })
+    m = GLM(family="binomial").train(y="y", training_frame=f)
+
+    from sklearn.linear_model import LogisticRegression
+    sk = LogisticRegression(penalty=None, tol=1e-8, max_iter=200).fit(X, y)
+    coef = m.coef()
+    np.testing.assert_allclose(
+        [coef["a"], coef["b"], coef["c"]], sk.coef_[0], atol=2e-3)
+    np.testing.assert_allclose(coef["Intercept"], sk.intercept_[0], atol=2e-3)
+
+    from sklearn.metrics import roc_auc_score, log_loss
+    p = sk.predict_proba(X)[:, 1]
+    assert abs(m.training_metrics.auc - roc_auc_score(y, p)) < 0.005
+    assert abs(m.training_metrics.logloss - log_loss(y, p)) < 1e-3
+
+
+def test_glm_categorical_features(rng):
+    n = 3000
+    g = rng.choice(["u", "v", "w"], size=n)
+    eff = {"u": 0.0, "v": 1.0, "w": -2.0}
+    y = np.array([eff[c] for c in g]) + rng.normal(scale=0.05, size=n)
+    f = Frame.from_arrays({"g": g.astype(object), "y": y})
+    m = GLM(family="gaussian").train(y="y", training_frame=f)
+    coef = m.coef()
+    # reference layout: first level is the base when use_all_factor_levels=False
+    assert abs(coef["Intercept"] - 0.0) < 0.01
+    assert abs(coef["g.v"] - 1.0) < 0.02
+    assert abs(coef["g.w"] - (-2.0)) < 0.02
+
+
+def test_glm_poisson(rng):
+    n = 5000
+    x = rng.normal(size=n)
+    lam = np.exp(0.5 * x + 1.0)
+    y = rng.poisson(lam).astype(float)
+    f = Frame.from_arrays({"x": x, "y": y})
+    m = GLM(family="poisson", standardize=False).train(y="y", training_frame=f)
+    coef = m.coef()
+    assert abs(coef["x"] - 0.5) < 0.05
+    assert abs(coef["Intercept"] - 1.0) < 0.05
+
+
+def test_glm_ridge_shrinks(rng):
+    f, _ = _regression_data(rng)
+    m0 = GLM(family="gaussian", lambda_=0.0).train(y="y", training_frame=f)
+    m1 = GLM(family="gaussian", lambda_=10.0).train(y="y", training_frame=f)
+    b0 = np.array([m0.coef_norm()[f"x{i}"] for i in range(4)])
+    b1 = np.array([m1.coef_norm()[f"x{i}"] for i in range(4)])
+    assert np.linalg.norm(b1) < 0.5 * np.linalg.norm(b0)  # strong shrinkage at lambda=10
+
+
+def test_glm_predict_and_valid(rng):
+    f, _ = _regression_data(rng, n=1000)
+    f2, _ = _regression_data(rng, n=500)
+    m = GLM().train(y="y", training_frame=f, validation_frame=f2)
+    assert m.validation_metrics.r2 > 0.98
+    pred = m.predict(f2)
+    assert pred.names == ["predict"]
+    assert pred.nrows == 500
+
+
+def test_glm_binomial_predict_frame(rng):
+    n = 800
+    x = rng.normal(size=n)
+    y = np.where(x + rng.normal(scale=0.5, size=n) > 0, "pos", "neg")
+    f = Frame.from_arrays({"x": x, "y": y.astype(object)})
+    m = GLM(family="binomial").train(y="y", training_frame=f)
+    pred = m.predict(f)
+    assert pred.names == ["predict", "pneg", "ppos"]
+    df = pred.to_pandas()
+    assert set(df["predict"].unique()) <= {"neg", "pos"}
+    np.testing.assert_allclose(df["pneg"] + df["ppos"], 1.0, atol=1e-5)
+
+
+def test_glm_cv(rng):
+    f, _ = _regression_data(rng)
+    m = GLM(nfolds=3).train(y="y", training_frame=f)
+    assert m.cross_validation_metrics is not None
+    assert m.cross_validation_metrics.r2 > 0.98
+
+
+def test_glm_na_handling(rng):
+    x = rng.normal(size=500)
+    y = 2 * x + 1
+    x_na = x.copy()
+    x_na[::7] = np.nan
+    f = Frame.from_arrays({"x": x_na, "y": y})
+    m = GLM().train(y="y", training_frame=f)
+    assert np.isfinite(m.training_metrics.rmse)
+
+
+def test_glm_unknown_param():
+    with pytest.raises(ValueError, match="unknown parameters"):
+        GLM(bogus=1)
+
+
+def test_glm_missing_response(rng):
+    f, _ = _regression_data(rng, n=100)
+    with pytest.raises(ValueError, match="supervised"):
+        GLM().train(training_frame=f)
+
+
+def test_glm_auto_family(rng):
+    f, _ = _regression_data(rng, n=300)
+    m = GLM(family="AUTO").train(y="y", training_frame=f)
+    assert m.params["family"] == "gaussian"
+
+
+def test_glm_max_iterations_validated(rng):
+    f, _ = _regression_data(rng, n=100)
+    with pytest.raises(ValueError, match="max_iterations"):
+        GLM(max_iterations=0).train(y="y", training_frame=f)
+
+
+def test_glm_impute_without_standardize(rng):
+    """NaNs must impute to the column mean even with standardize=False (review regression)."""
+    x = np.array([1.0, 2.0, 3.0, np.nan, 4.0] * 20)
+    y = np.nan_to_num(x, nan=2.5) * 2.0
+    f = Frame.from_arrays({"x": x, "y": y})
+    m = GLM(standardize=False).train(y="y", training_frame=f)
+    assert m.training_metrics.rmse < 1e-3  # exact fit only if NaN->mean(2.5)
+
+
+def test_glm_tweedie_power_passthrough(rng):
+    n = 2000
+    x = rng.normal(size=n)
+    mu = np.exp(0.4 * x + 0.5)
+    y = rng.poisson(mu) * rng.gamma(2.0, 0.5, size=n)
+    f = Frame.from_arrays({"x": x, "y": y})
+    m11 = GLM(family="tweedie", tweedie_variance_power=1.1, standardize=False).train(y="y", training_frame=f)
+    m19 = GLM(family="tweedie", tweedie_variance_power=1.9, standardize=False).train(y="y", training_frame=f)
+    # different variance powers must give different fits (was silently ignored)
+    assert abs(m11.coef()["x"] - m19.coef()["x"]) > 1e-4

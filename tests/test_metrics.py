@@ -1,0 +1,59 @@
+"""Metrics parity tests (reference: hex/AUC2, ModelMetrics* semantics)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.metrics import binomial_metrics, multinomial_metrics, regression_metrics
+
+
+def _device(rng, arr):
+    return Vec.from_numpy(np.asarray(arr, np.float32)).data
+
+
+def test_auc_matches_sklearn(rng):
+    n = 5000
+    y = (rng.uniform(size=n) < 0.4).astype(np.float32)
+    p = np.clip(y * 0.3 + rng.uniform(size=n) * 0.7, 0, 1).astype(np.float32)
+    pd_, yd = _device(rng, p), _device(rng, y)
+    mask = jnp.arange(pd_.shape[0]) < n
+    m = binomial_metrics(pd_, yd, mask)
+    from sklearn.metrics import roc_auc_score, log_loss
+    # 400-bin histogram AUC is exact to ~1/400 (reference accepts this too)
+    assert abs(m.auc - roc_auc_score(y, p)) < 0.004
+    assert abs(m.logloss - log_loss(y, np.clip(p, 1e-15, 1 - 1e-15))) < 1e-5
+    assert m.nobs == n
+    assert m.confusion_matrix.sum() == n
+
+
+def test_regression_metrics(rng):
+    n = 3000
+    y = rng.normal(size=n).astype(np.float32)
+    pred = y + rng.normal(scale=0.5, size=n).astype(np.float32)
+    yd, pd_ = _device(rng, y), _device(rng, pred)
+    mask = jnp.arange(yd.shape[0]) < n
+    m = regression_metrics(pd_, yd, mask)
+    np.testing.assert_allclose(m.mse, ((pred - y) ** 2).mean(), rtol=1e-4)
+    np.testing.assert_allclose(m.mae, np.abs(pred - y).mean(), rtol=1e-4)
+    ss_res = ((pred - y) ** 2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    np.testing.assert_allclose(m.r2, 1 - ss_res / ss_tot, atol=1e-4)
+
+
+def test_multinomial_metrics(rng):
+    n, k = 2000, 4
+    y = rng.integers(0, k, size=n).astype(np.float32)
+    logits = rng.normal(size=(n, k)).astype(np.float32)
+    logits[np.arange(n), y.astype(int)] += 2.0
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    from h2o3_tpu.frame.vec import padded_len
+    plen = padded_len(n)
+    P = np.zeros((plen, k), np.float32)
+    P[:n] = probs
+    yd = _device(rng, y)
+    mask = jnp.arange(plen) < n
+    m = multinomial_metrics(jnp.asarray(P), yd, mask, k)
+    from sklearn.metrics import log_loss, confusion_matrix
+    np.testing.assert_allclose(m.logloss, log_loss(y, probs, labels=list(range(k))), rtol=1e-4)
+    np.testing.assert_array_equal(m.confusion_matrix, confusion_matrix(y, probs.argmax(1)))
+    assert m.accuracy > 0.7
