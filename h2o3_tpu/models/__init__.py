@@ -9,5 +9,9 @@ producing a ``Model`` with metrics, prediction, and export.
 from h2o3_tpu.models.model_base import Model, ModelBuilder, ModelParameters
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.glm import GLM, GLMModel
+from h2o3_tpu.models.gbm import GBM, GBMModel, DRF, DRFModel
+from h2o3_tpu.models.xgboost import XGBoost, XGBoostModel
 
-__all__ = ["Model", "ModelBuilder", "ModelParameters", "Job", "GLM", "GLMModel"]
+__all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
+           "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
+           "XGBoost", "XGBoostModel"]

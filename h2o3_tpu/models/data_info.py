@@ -105,6 +105,15 @@ class DataInfo:
         return v.data, 0
 
 
+def response_as_float(vec) -> tuple[jax.Array, jax.Array]:
+    """Response as f32 + per-row validity mask — THE canonical NA semantics for
+    supervised training/metrics (cat code -1 and numeric NaN are missing).
+    Single home so trainers, holdout metrics, and CV masks cannot diverge."""
+    yy = vec.data.astype(jnp.float32) if vec.is_categorical else vec.data
+    valid = (vec.data >= 0) if vec.is_categorical else ~jnp.isnan(vec.data)
+    return yy, valid
+
+
 def _remap_codes(codes: jax.Array, src_dom: tuple[str, ...], dst_dom: tuple[str, ...]) -> jax.Array:
     """Align test categorical codes to the train domain (unseen → NA).
 

@@ -62,6 +62,18 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2):
 
 
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
+def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2):
+    """Per-coefficient proximal threshold lam1*nobs/(gram_jj + lam2*nobs)."""
+    fam = _fam(family, tweedie_p)
+    eta = X @ beta[:-1] + beta[-1]
+    d = fam.dmu_deta(eta)
+    W = w * d * d / jnp.maximum(fam.variance(fam.linkinv(eta)), 1e-12)
+    nobs = jnp.maximum(w.sum(), 1.0)
+    gram_diag = (W[:, None] * X * X).sum(axis=0) + lam2 * nobs
+    return lam1 * nobs / jnp.maximum(gram_diag, 1e-12)
+
+
+@partial(jax.jit, static_argnames=("family", "tweedie_p"))
 def _null_deviance(family: str, tweedie_p: float, y, w):
     fam = _fam(family, tweedie_p)
     mu0 = jnp.full_like(y, (w * y).sum() / jnp.maximum(w.sum(), 1e-30))
@@ -138,8 +150,9 @@ class GLM(ModelBuilder):
         di = DataInfo.make(frame, x, standardize=params["standardize"],
                            use_all_factor_levels=params["use_all_factor_levels"])
         X = di.expand(frame)
-        yy = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
-        w = weights * ((yy >= 0) if yvec.is_categorical else ~jnp.isnan(yy))
+        from h2o3_tpu.models.data_info import response_as_float
+        yy, valid = response_as_float(yvec)
+        w = weights * valid
         yy = jnp.where(w > 0, yy, 0.0)
 
         fam = _fam(family, tw)
@@ -179,9 +192,12 @@ class GLM(ModelBuilder):
             coef[-1] = b[-1] - float((b[di.ncats_expanded:di.ncats_expanded + nnum] * mul * sub).sum())
 
         null_dev = float(jax.device_get(_null_deviance(family, tw, yy, w)))
+        from h2o3_tpu.models.model_base import ModelParameters
+        mparams = ModelParameters(self.params)   # snapshot: builder stays reusable
+        mparams["family"] = family
         model = GLMModel(
             key=make_model_key(self.algo, self.model_id),
-            params=self.params,
+            params=mparams,
             data_info=di,
             response_column=y,
             response_domain=yvec.domain if yvec.is_categorical else None,
@@ -189,16 +205,21 @@ class GLM(ModelBuilder):
                         residual_deviance=dev, null_deviance=null_dev,
                         iterations=it + 1, family=family),
         )
-        model.params["family"] = family
         return model
 
     def _admm_l1(self, family, tw, X, yy, w, beta, params):
         """L1 via proximal IRLS (simplified ADMM, reference hex/optimization/ADMM.java):
-        iterate IRLS steps then soft-threshold non-intercept coefficients."""
+        iterate IRLS steps then soft-threshold non-intercept coefficients.
+
+        Units: the IRLS normal equations carry an L2 term scaled by nobs
+        (matching the per-observation lambda convention), so the proximal
+        threshold for coefficient j is lam1 * nobs / gram_jj — dividing by the
+        curvature keeps L1 and L2 in the same per-observation units."""
         lam1 = float(params["lambda_"]) * float(params["alpha"])
         lam2 = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
         for _ in range(10):
             beta, _ = _irls_step(family, tw, X, yy, w, beta, lam2)
+            thr = _l1_threshold(family, tw, X, yy, w, beta, lam1, lam2)
             mag = jnp.abs(beta[:-1])
-            beta = beta.at[:-1].set(jnp.sign(beta[:-1]) * jnp.maximum(mag - lam1, 0.0))
+            beta = beta.at[:-1].set(jnp.sign(beta[:-1]) * jnp.maximum(mag - thr, 0.0))
         return beta

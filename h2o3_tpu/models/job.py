@@ -34,6 +34,7 @@ class Job:
         self.start_time: float | None = None
         self.end_time: float | None = None
         self.exception: BaseException | None = None
+        self.traceback: str | None = None
         self.result: Any = None
         self._cancel_requested = threading.Event()
         self._done = threading.Event()

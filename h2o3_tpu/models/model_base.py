@@ -117,11 +117,12 @@ class Model:
         mask = frame.row_mask()
         if self.is_classifier and yvec.domain != self.response_domain:
             from h2o3_tpu.models.data_info import _remap_codes
-            y = _remap_codes(yvec.data, yvec.domain or (), self.response_domain).astype(jnp.float32)
+            codes = _remap_codes(yvec.data, yvec.domain or (), self.response_domain)
+            y, valid = codes.astype(jnp.float32), codes >= 0
         else:
-            y = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
-        mask = mask & ~jnp.isnan(y) if not yvec.is_categorical else mask & (y >= 0)
-        return compute_metrics(raw, y, mask, self.nclasses)
+            from h2o3_tpu.models.data_info import response_as_float
+            y, valid = response_as_float(yvec)
+        return compute_metrics(raw, y, mask & valid, self.nclasses)
 
     # -- persistence hooks (filled in by h2o3_tpu.persist) -------------------
 
@@ -239,11 +240,10 @@ class ModelBuilder:
                 raise ValueError(f"{self.algo} requires a categorical response")
 
     def _holdout_metrics(self, model: Model, frame: Frame, y: str, w: jax.Array):
+        from h2o3_tpu.models.data_info import response_as_float
         raw = model._score_raw(frame)
-        yvec = frame.vec(y)
-        yy = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
-        mask = (w > 0) & (yy >= 0 if yvec.is_categorical else ~jnp.isnan(yy))
-        return compute_metrics(raw, yy, mask, model.nclasses)
+        yy, valid = response_as_float(frame.vec(y))
+        return compute_metrics(raw, yy, (w > 0) & valid, model.nclasses)
 
     def _fold_ids(self, frame: Frame, nfolds: int) -> jax.Array:
         """Fold assignment vector (reference: ``hex/FoldAssignment.java``)."""
@@ -259,17 +259,17 @@ class ModelBuilder:
         """K-fold CV: same compiled program per fold, weights differ
         (reference: ``ModelBuilder.computeCrossValidation`` builds physical
         sub-frames; see module docstring for why masking replaces that)."""
+        from h2o3_tpu.models.data_info import response_as_float
         folds = self._fold_ids(frame, nfolds)
         yvec = frame.vec(y)
-        yy = yvec.data.astype(jnp.float32) if yvec.is_categorical else yvec.data
+        yy, valid = response_as_float(yvec)
         raws, masks = [], []
         for k in range(nfolds):
             w_train = base_w * (folds != k)
             cv_builder = type(self)(**{**self.params, "nfolds": 0})
             cv_model = cv_builder._fit(job, frame, x, y, w_train)
             raw_k = cv_model._score_raw(frame)
-            hold = (base_w > 0) & (folds == k) & \
-                   ((yy >= 0) if yvec.is_categorical else ~jnp.isnan(yy))
+            hold = (base_w > 0) & (folds == k) & valid
             raws.append(raw_k)
             masks.append(hold)
         # pool holdout predictions into one metrics pass (reference: CV main

@@ -1,0 +1,51 @@
+"""XGBoost-parity booster.
+
+Reference: ``h2o-extensions/xgboost`` wraps libxgboost (CUDA ``gpu_hist``,
+``XGBoostModel.java:396-398``) over a rabit allreduce ring
+(``RabitTrackerH2O.java:14``). The TPU replacement (SURVEY.md §2.9) is the same
+histogram tree algorithm implemented natively: global-quantile binning,
+(g, h) gradient-pair histograms all-reduced over ICI by XLA, exact XGBoost gain
+``0.5*(GL²/(HL+λ)+GR²/(HR+λ)−G²/(H+λ))−γ`` with learned default direction for
+missing values — which is precisely what :mod:`h2o3_tpu.models.tree` computes.
+So "XGBoost" here is the shared tree engine with XGBoost's parameterization
+(eta/lambda/gamma/alpha naming, 256 bins, depth 6) rather than a second engine;
+rabit's ring allreduce has no user-visible equivalent to port — XLA emits the
+collective.
+"""
+
+from __future__ import annotations
+
+from h2o3_tpu.models.gbm import GBM, GBMModel
+
+
+class XGBoostModel(GBMModel):
+    algo = "xgboost"
+
+
+class XGBoost(GBM):
+    """h2o-py surface: ``H2OXGBoostEstimator`` (tree_method=hist semantics)."""
+
+    algo = "xgboost"
+
+    @classmethod
+    def defaults(cls) -> dict:
+        d = super().defaults()
+        d.update(
+            ntrees=50,
+            max_depth=6,
+            learn_rate=0.3,        # eta
+            reg_lambda=1.0,        # lambda
+            reg_alpha=0.0,         # alpha (leaf L1; applied as soft threshold)
+            gamma=0.0,             # min_split_loss
+            min_rows=1.0,          # min_child_weight
+            nbins=256,             # max_bin
+            sample_rate=1.0,       # subsample
+            col_sample_rate=1.0,   # colsample_bylevel
+            col_sample_rate_per_tree=1.0,  # colsample_bytree
+        )
+        return d
+
+    def _fit(self, job, frame, x, y, weights):
+        model = super()._fit(job, frame, x, y, weights)
+        model.__class__ = XGBoostModel
+        return model

@@ -44,21 +44,25 @@ except ImportError:  # pragma: no cover
 
 # Compiled-program cache: jit executables are tied to the wrapper instance, so
 # re-wrapping per call would recompile every invocation (deadly in iterative
-# algorithms like tree building). Keyed by (weakref(fn), mesh, arg ranks,
-# donate) — entries are evicted when the user's function is collected, so
-# fresh-lambda callers don't leak executables (they also get no cache hits:
-# pass a module-level function or a stable partial to benefit). jax.jit's own
-# cache handles shape/dtype specialization underneath.
-import weakref
+# algorithms like tree building). Keyed by (fn, mesh, arg ranks, donate) with
+# FIFO eviction: fresh-lambda callers get no hits but can't grow the dict
+# unboundedly (evicted entries simply recompile on reuse). Pass a module-level
+# function or a stable partial to benefit from caching. jax.jit's own cache
+# handles shape/dtype specialization underneath.
+from collections import OrderedDict
 
-_compiled: dict = {}
+_COMPILED_MAX = 256
+_compiled: OrderedDict = OrderedDict()
 
 
 def _cache_key(tag, fn, rest):
-    def _evict(ref, _tag=tag, _rest=rest):
-        _compiled.pop((_tag, ref, _rest), None)
+    return (tag, fn, rest)
 
-    return (tag, weakref.ref(fn, _evict), rest)
+
+def _cache_put(key, value):
+    _compiled[key] = value
+    while len(_compiled) > _COMPILED_MAX:
+        _compiled.popitem(last=False)
 
 
 def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
@@ -81,7 +85,7 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
 
         fn = jax.jit(_shard_map(shard_body, mesh=mesh, in_specs=in_specs, out_specs=P()),
                      donate_argnums=tuple(range(len(cols))) if donate else ())
-        _compiled[key] = fn
+        _cache_put(key, fn)
     return fn(*cols)
 
 
@@ -96,7 +100,8 @@ def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:
     key = _cache_key("mc", fn, ())
     jfn = _compiled.get(key)
     if jfn is None:
-        jfn = _compiled[key] = jax.jit(fn)
+        jfn = jax.jit(fn)
+        _cache_put(key, jfn)
     return jfn(*cols)
 
 

@@ -180,3 +180,28 @@ def test_glm_tweedie_power_passthrough(rng):
     m19 = GLM(family="tweedie", tweedie_variance_power=1.9, standardize=False).train(y="y", training_frame=f)
     # different variance powers must give different fits (was silently ignored)
     assert abs(m11.coef()["x"] - m19.coef()["x"]) > 1e-4
+
+
+def test_glm_builder_reusable_after_auto(rng):
+    """Builder params must not be mutated by training (review regression)."""
+    f, _ = _regression_data(rng, n=200)
+    b = GLM(family="AUTO")
+    n2 = 200
+    x = rng.normal(size=n2)
+    yb = np.where(x > 0, "p", "n").astype(object)
+    fb = Frame.from_arrays({"x": x, "y": yb})
+    m1 = b.train(y="y", training_frame=fb)
+    assert m1.params["family"] == "binomial"
+    assert b.params["family"] == "AUTO"
+    m2 = b.train(y="y", training_frame=f)  # numeric response: AUTO -> gaussian
+    assert m2.params["family"] == "gaussian"
+
+
+def test_glm_lasso_sparsifies(rng):
+    """Elastic-net L1 with proper units: moderate lambda zeroes the null coef
+    but keeps real signals."""
+    f, beta = _regression_data(rng)  # true beta [1.5, -2, 0.5, 0]
+    m = GLM(alpha=1.0, lambda_=0.05).train(y="y", training_frame=f)
+    bn = m.coef_norm()
+    assert abs(bn["x3"]) < 1e-6, bn          # pure-noise coef zeroed
+    assert abs(bn["x0"]) > 0.5 and abs(bn["x1"]) > 0.5

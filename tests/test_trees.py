@@ -1,0 +1,160 @@
+"""GBM/DRF tests — quality parity vs sklearn on synthetic tasks (reference
+model: h2o-py pyunit GBM/DRF suites)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GBM, DRF
+
+
+def _friedman(rng, n=3000, noise=0.1):
+    X = rng.uniform(size=(n, 5))
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4] + rng.normal(scale=noise, size=n))
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    cols["y"] = y
+    return Frame.from_arrays(cols), X, y
+
+
+def _classif(rng, n=4000):
+    X = rng.normal(size=(n, 5))
+    logit = 2 * X[:, 0] - 1.5 * X[:, 1] * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    cols["y"] = np.where(y == 1, "Y", "N").astype(object)
+    return Frame.from_arrays(cols), X, y
+
+
+def test_gbm_regression_quality(rng):
+    f, X, y = _friedman(rng)
+    m = GBM(ntrees=50, max_depth=5, learn_rate=0.2, seed=1).train(y="y", training_frame=f)
+    assert m.training_metrics.r2 > 0.97, m.training_metrics
+
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    sk = HistGradientBoostingRegressor(max_iter=50, max_depth=5, learning_rate=0.2).fit(X, y)
+    sk_r2 = sk.score(X, y)
+    # within a few points of sklearn's hist-GBM on train R2
+    assert m.training_metrics.r2 > sk_r2 - 0.05
+
+
+def test_gbm_binomial_quality(rng):
+    f, X, y = _classif(rng)
+    m = GBM(ntrees=40, max_depth=4, learn_rate=0.2, seed=1).train(y="y", training_frame=f)
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    from sklearn.metrics import roc_auc_score
+    sk = HistGradientBoostingClassifier(max_iter=40, max_depth=4, learning_rate=0.2).fit(X, y)
+    sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    assert m.training_metrics.auc > sk_auc - 0.02, (m.training_metrics, sk_auc)
+
+    pred = m.predict(f)
+    assert pred.names == ["predict", "pN", "pY"]
+    p = pred.to_pandas()
+    np.testing.assert_allclose(p["pN"] + p["pY"], 1.0, atol=1e-5)
+
+
+def test_gbm_predict_new_frame_matches_train_path(rng):
+    """Raw-threshold traversal on a fresh frame must equal binned traversal."""
+    f, X, y = _friedman(rng, n=1000)
+    m = GBM(ntrees=10, max_depth=4, seed=3).train(y="y", training_frame=f)
+    again = Frame.from_arrays({**{f"x{i}": X[:, i] for i in range(5)}, "y": y})
+    p1 = m.predict(f).vec("predict").to_numpy()
+    p2 = m.predict(again).vec("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_gbm_na_routing(rng):
+    n = 2000
+    x = rng.uniform(size=n)
+    x[: n // 4] = np.nan
+    y = np.where(np.isnan(x), 5.0, 2.0 * (x > 0.5))
+    f = Frame.from_arrays({"x": x, "y": y})
+    m = GBM(ntrees=20, max_depth=3, learn_rate=0.3, seed=1).train(y="y", training_frame=f)
+    pred = m.predict(f).vec("predict").to_numpy()
+    # NA rows must learn their own direction → near-5 predictions
+    assert abs(pred[: n // 4].mean() - 5.0) < 0.3
+    assert m.training_metrics.r2 > 0.95
+
+
+def test_gbm_categorical_feature(rng):
+    n = 3000
+    g = rng.choice(["a", "b", "c", "d"], size=n)
+    eff = {"a": 0.0, "b": 3.0, "c": -2.0, "d": 7.0}
+    y = np.array([eff[v] for v in g]) + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({"g": g.astype(object), "y": y})
+    m = GBM(ntrees=30, max_depth=3, learn_rate=0.3, seed=1).train(y="y", training_frame=f)
+    assert m.training_metrics.r2 > 0.98
+
+
+def test_gbm_sampling_params(rng):
+    f, X, y = _friedman(rng, n=1500)
+    m = GBM(ntrees=30, sample_rate=0.7, col_sample_rate_per_tree=0.8, seed=5).train(
+        y="y", training_frame=f)
+    assert m.training_metrics.r2 > 0.9
+
+
+def test_drf_regression(rng):
+    f, X, y = _friedman(rng, n=2000)
+    m = DRF(ntrees=30, max_depth=12, seed=1).train(y="y", training_frame=f)
+    assert m.training_metrics.r2 > 0.85, m.training_metrics
+
+
+def test_drf_binomial(rng):
+    f, X, y = _classif(rng, n=2000)
+    m = DRF(ntrees=30, max_depth=10, seed=1).train(y="y", training_frame=f)
+    assert m.training_metrics.auc > 0.9, m.training_metrics
+    pred = m.predict(f).to_pandas()
+    assert ((pred["pY"] >= 0) & (pred["pY"] <= 1)).all()
+
+
+def test_gbm_validation_frame(rng):
+    f, _, _ = _friedman(rng, n=2000)
+    fv, _, _ = _friedman(rng, n=500)
+    m = GBM(ntrees=30, seed=1).train(y="y", training_frame=f, validation_frame=fv)
+    assert m.validation_metrics.r2 > 0.9
+
+
+def test_xgboost_vs_real_xgboost_semantics(rng):
+    """Our XGBoost estimator vs sklearn HistGradientBoosting with matched
+    lambda — quality parity on held-out data."""
+    from h2o3_tpu.models import XGBoost
+    f, X, y = _friedman(rng, n=3000)
+    fv, Xv, yv = _friedman(rng, n=1000)
+    m = XGBoost(ntrees=50, max_depth=6, learn_rate=0.3, seed=2).train(
+        y="y", training_frame=f, validation_frame=fv)
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    sk = HistGradientBoostingRegressor(max_iter=50, max_depth=6, learning_rate=0.3,
+                                       l2_regularization=1.0).fit(X, y)
+    sk_r2 = sk.score(Xv, yv)
+    assert m.validation_metrics.r2 > sk_r2 - 0.03, (m.validation_metrics, sk_r2)
+
+
+def test_xgboost_regularization_params(rng):
+    from h2o3_tpu.models import XGBoost
+    f, X, y = _friedman(rng, n=1500)
+    m_hi = XGBoost(ntrees=10, gamma=1000.0, seed=1).train(y="y", training_frame=f)
+    m_lo = XGBoost(ntrees=10, gamma=0.0, seed=1).train(y="y", training_frame=f)
+    # huge gamma must prune aggressively -> worse train fit
+    assert m_hi.training_metrics.mse > m_lo.training_metrics.mse
+
+
+def test_gbm_bad_distribution(rng):
+    f, _, _ = _friedman(rng, n=200)
+    with pytest.raises(ValueError, match="unsupported distribution"):
+        GBM(distribution="gamma").train(y="y", training_frame=f)
+    with pytest.raises(ValueError, match="categorical"):
+        GBM(distribution="bernoulli").train(y="y", training_frame=f)
+
+
+def test_drf_sample_rate_honored(rng):
+    f, X, y = _friedman(rng, n=800)
+    m_lo = DRF(ntrees=5, max_depth=6, sample_rate=0.05, seed=9).train(y="y", training_frame=f)
+    m_hi = DRF(ntrees=5, max_depth=6, sample_rate=1.0, seed=9).train(y="y", training_frame=f)
+    # tiny subsample -> visibly weaker fit (was silently ignored before)
+    assert m_lo.training_metrics.mse != m_hi.training_metrics.mse
+
+
+def test_drf_depth_validated(rng):
+    f, _, _ = _friedman(rng, n=100)
+    with pytest.raises(ValueError, match="max_depth"):
+        DRF(max_depth=20).train(y="y", training_frame=f)
