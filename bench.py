@@ -1,0 +1,73 @@
+"""Headline benchmark: GBM histogram-tree training throughput (rows/sec/chip).
+
+Mirrors the reference's north-star config (BASELINE.json: "GBM on HIGGS 11M,
+hex.tree.gbm histogram aggregation on TPU"). Data is synthetic HIGGS-shaped
+(28 float features, binary response) because the 11M-row dataset is not
+shipped in-image; throughput is feature-count/row-count bound, not
+data-distribution bound, so the synthetic proxy is faithful for rows/sec.
+
+vs_baseline anchor: the reference has no committed GBM rows/sec (BASELINE.md);
+we anchor against 1.0M rows/sec/device — the order of magnitude of XGBoost
+`gpu_hist` on HIGGS-class data on a modern accelerator, which BASELINE.json
+names as the parity target ("XGBoost-TPU matching gpu_hist A100 rows/sec").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+NFEAT = 28
+NTREES = 20
+DEPTH = 6
+NBINS = 64
+ANCHOR_ROWS_PER_SEC = 1.0e6  # gpu_hist-class anchor (see module docstring)
+
+
+def main() -> None:
+    import jax
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import GBM
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(ROWS, NFEAT)).astype(np.float32)
+    logit = X[:, :4] @ np.array([1.2, -0.8, 0.5, 0.3], np.float32) + 0.2 * X[:, 4] * X[:, 5]
+    y = (rng.random(ROWS) < 1.0 / (1.0 + np.exp(-logit))).astype(np.int32)
+
+    cols = {f"x{i}": X[:, i] for i in range(NFEAT)}
+    cols["y"] = np.where(y == 1, "s", "b")
+    fr = Frame.from_arrays(cols)
+
+    def train():
+        return GBM(ntrees=NTREES, max_depth=DEPTH, nbins=NBINS,
+                   learn_rate=0.1, seed=42).train(y="y", training_frame=fr)
+
+    train()  # warm-up: compile every level program
+    jax.effects_barrier()
+    t0 = time.perf_counter()
+    model = train()
+    jax.effects_barrier()
+    dt = time.perf_counter() - t0
+
+    ndev = max(1, len(jax.devices()))
+    rows_per_sec_chip = ROWS * NTREES / dt / ndev
+    print(json.dumps({
+        "metric": "gbm_hist_train_rows_per_sec_per_chip",
+        "value": round(rows_per_sec_chip, 1),
+        "unit": "rows*trees/sec/chip",
+        "vs_baseline": round(rows_per_sec_chip / ANCHOR_ROWS_PER_SEC, 3),
+    }))
+    # secondary detail on stderr (not parsed by the driver)
+    auc = getattr(model.training_metrics, "auc", None)
+    print(f"# trained {NTREES} trees depth {DEPTH} on {ROWS} rows in {dt:.2f}s; "
+          f"train AUC={auc}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

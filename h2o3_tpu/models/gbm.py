@@ -23,7 +23,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
-from h2o3_tpu.frame.types import VecType
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
@@ -91,7 +90,15 @@ class SharedTreeBuilder(ModelBuilder):
             stopping_rounds=0,
         )
 
+    # Dense-heap trees cap depth at 16 (2^17 nodes); the reference's default 20
+    # assumes sparse node storage.
+    MAX_TREE_DEPTH = 16
+
     def _prepare(self, frame: Frame, x: list[str], y: str):
+        depth = int(self.params["max_depth"])
+        if depth > self.MAX_TREE_DEPTH:
+            raise ValueError(f"max_depth={depth} exceeds the dense-heap limit "
+                             f"{self.MAX_TREE_DEPTH}")
         yvec = frame.vec(y)
         X = tree_matrix(frame, x, {})
         sample = sample_rows_host(X, frame.nrows)
@@ -105,9 +112,10 @@ class SharedTreeBuilder(ModelBuilder):
     def _feat_mask(self, key, F: int, rate: float) -> jax.Array:
         if rate >= 1.0:
             return jnp.ones(F, bool)
-        m = jax.random.uniform(key, (F,)) < rate
+        ku, kf = jax.random.split(key)
+        m = jax.random.uniform(ku, (F,)) < rate
         # guarantee at least one feature
-        return m.at[jax.random.randint(key, (), 0, F)].set(True)
+        return m.at[jax.random.randint(kf, (), 0, F)].set(True)
 
     def _row_weights(self, key, w, rate: float, bootstrap: bool):
         if bootstrap:
@@ -216,10 +224,6 @@ class DRF(SharedTreeBuilder):
 
     algo = "drf"
 
-    # Dense-heap trees cap depth at 16 (2^17 nodes); the reference's default 20
-    # assumes sparse node storage, so the default here is 14.
-    MAX_TREE_DEPTH = 16
-
     @classmethod
     def defaults(cls) -> dict:
         d = dict(super().defaults(), mtries=-1)
@@ -241,11 +245,7 @@ class DRF(SharedTreeBuilder):
         mtries = int(p["mtries"])
         if mtries <= 0:
             mtries = max(1, int(np.sqrt(F)) if binomial else max(F // 3, 1))
-        depth = int(p["max_depth"])
-        if depth > self.MAX_TREE_DEPTH:
-            raise ValueError(f"max_depth={depth} exceeds the dense-heap limit "
-                             f"{self.MAX_TREE_DEPTH}")
-        tp = TreeParams(max_depth=depth, nbins=int(p["nbins"]),
+        tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
                         min_rows=float(p["min_rows"]), reg_lambda=0.0,
                         min_split_improvement=float(p["min_split_improvement"]))
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42

@@ -67,7 +67,9 @@ class Model:
                  response_column: str | None, response_domain: tuple[str, ...] | None,
                  output: dict[str, Any]):
         self.key = key
-        self.params = params
+        # snapshot: the builder's live params dict must not alias into the
+        # trained model (builder stays reusable / mutable after train)
+        self.params = ModelParameters(params)
         self.data_info = data_info
         self.response_column = response_column
         self.response_domain = response_domain  # None for regression

@@ -220,10 +220,12 @@ def grow_tree(binned: jax.Array, edges: jax.Array, g: jax.Array, h: jax.Array,
         off = N - 1
         lmask = feat_mask
         if col_rate < 1.0 and key is not None:
-            key, kd = jax.random.split(key)
+            key, kd, kf = jax.random.split(key, 3)
             sub = jax.random.uniform(kd, (F,)) < col_rate
-            sub = sub.at[jax.random.randint(kd, (), 0, F)].set(True)
+            sub = sub.at[jax.random.randint(kf, (), 0, F)].set(True)
             lmask = feat_mask & sub
+            # the forced index may miss feat_mask; never let the level go empty
+            lmask = jnp.where(lmask.any(), lmask, feat_mask)
         hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
         gain, feat, t, na_left, G, H, W = _find_splits(
             hists, B, jnp.float32(params.min_rows), jnp.float32(params.reg_lambda),

@@ -59,6 +59,13 @@ def _cache_key(tag, fn, rest):
     return (tag, fn, rest)
 
 
+def _cache_get(key):
+    value = _compiled.get(key)
+    if value is not None:
+        _compiled.move_to_end(key)  # LRU: hot entries survive fresh-lambda churn
+    return value
+
+
 def _cache_put(key, value):
     _compiled[key] = value
     while len(_compiled) > _COMPILED_MAX:
@@ -76,7 +83,7 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     mesh = get_mesh()
     ndims = tuple(c.ndim for c in cols)
     key = _cache_key("mr", map_fn, (mesh, ndims, donate))
-    fn = _compiled.get(key)
+    fn = _cache_get(key)
     if fn is None:
         in_specs = tuple(P(ROWS, *([None] * (nd - 1))) for nd in ndims)
 
@@ -98,7 +105,7 @@ def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:
     expressions in one compiled program.
     """
     key = _cache_key("mc", fn, ())
-    jfn = _compiled.get(key)
+    jfn = _cache_get(key)
     if jfn is None:
         jfn = jax.jit(fn)
         _cache_put(key, jfn)

@@ -61,5 +61,4 @@ def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
 def sample_rows_host(X: jax.Array, nrows: int, max_sample: int = 100_000) -> np.ndarray:
     """Strided row sample fetched to host for edge computation."""
     stride = max(1, nrows // max_sample)
-    idx = np.arange(0, nrows, stride)
     return np.asarray(jax.device_get(X[: nrows][:: stride]))
