@@ -11,7 +11,19 @@ from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.glm import GLM, GLMModel
 from h2o3_tpu.models.gbm import GBM, GBMModel, DRF, DRFModel
 from h2o3_tpu.models.xgboost import XGBoost, XGBoostModel
+from h2o3_tpu.models.deeplearning import AutoEncoder, DeepLearning, DeepLearningModel
+from h2o3_tpu.models.kmeans import KMeans, KMeansModel
+from h2o3_tpu.models.decomposition import GLRM, GLRMModel, PCA, PCAModel, SVD, SVDModel
+from h2o3_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
+from h2o3_tpu.models.isofor import (
+    ExtendedIsolationForest, ExtendedIsolationForestModel,
+    IsolationForest, IsolationForestModel)
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
-           "XGBoost", "XGBoostModel"]
+           "XGBoost", "XGBoostModel",
+           "DeepLearning", "DeepLearningModel", "AutoEncoder",
+           "KMeans", "KMeansModel", "PCA", "PCAModel", "SVD", "SVDModel",
+           "GLRM", "GLRMModel", "NaiveBayes", "NaiveBayesModel",
+           "IsolationForest", "IsolationForestModel",
+           "ExtendedIsolationForest", "ExtendedIsolationForestModel"]

@@ -1,0 +1,376 @@
+"""PCA / SVD / GLRM — matrix decompositions.
+
+Reference:
+- ``hex/pca/PCA.java`` (479 LoC): method GramSVD (default) forms the Gram
+  matrix distributed (``hex/util/LinearAlgebraUtils.java``) and eigendecomposes
+  on the leader; transforms NONE/DEMEAN/DESCALE/STANDARDIZE/NORMALIZE.
+- ``hex/svd/SVD.java``: distributed power iteration / randomized SVD over the
+  same Gram machinery.
+- ``hex/glrm/GLRM.java`` (2,603 LoC): generalized low-rank model X ≈ A·Y via
+  alternating minimization with per-column losses and regularizers on A and Y.
+
+TPU-native: the Gram contraction ``XᵀX`` is a single einsum over the
+row-sharded design matrix (XLA all-reduces per-chip partials over ICI — the
+MRTask tree reduce of the reference), and the small [K,K] eig/Cholesky runs
+replicated. GLRM's alternating updates are closed-form ridge solves, each a
+pair of MXU matmuls + a [k,k] Cholesky, jitted as one program per sweep.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _make_data_info(frame: Frame, x, transform: str,
+                    use_all_factor_levels: bool = False) -> DataInfo:
+    """Map the reference transform enum onto DataInfo's sub/mul machinery."""
+    t = str(transform).upper()
+    di = DataInfo.make(frame, x, standardize=(t == "STANDARDIZE"),
+                       use_all_factor_levels=use_all_factor_levels)
+    if t == "DEMEAN":
+        di.num_sub = di.num_means.copy()
+        di.num_mul = np.ones_like(di.num_mul)
+    elif t == "DESCALE":
+        di.num_sub = np.zeros_like(di.num_sub)
+        sigmas = np.array([frame.vec(c).sigma() for c in di.num_cols], np.float32)
+        di.num_mul = np.where((sigmas > 0) & np.isfinite(sigmas),
+                              1.0 / np.maximum(sigmas, 1e-30), 1.0).astype(np.float32)
+    elif t == "NORMALIZE":
+        sigmas = np.array([frame.vec(c).sigma() for c in di.num_cols], np.float32)
+        di.num_sub = di.num_means.copy()
+        di.num_mul = np.where((sigmas > 0) & np.isfinite(sigmas),
+                              1.0 / np.maximum(sigmas, 1e-30), 1.0).astype(np.float32)
+    elif t == "NONE":
+        di.num_sub = np.zeros_like(di.num_sub)
+        di.num_mul = np.ones_like(di.num_mul)
+    return di
+
+
+@jax.jit
+def _gram(X, w):
+    """Weighted Gram XᵀWX and weighted column means (one pass, psum-reduced)."""
+    Xw = X * w[:, None]
+    return X.T @ Xw, Xw.sum(axis=0), w.sum()
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+class PCAModel(Model):
+    algo = "pca"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        # scores are centered projections: the eigendecomposition is of the
+        # covariance, so the train-time column means must come off here too
+        X = self.data_info.expand(frame)
+        mu = jnp.asarray(self.output["mu"], jnp.float32)
+        return (X - mu[None, :]) @ self.output["eigenvectors"]
+
+    def predict(self, frame: Frame) -> Frame:
+        S = self._score_raw(frame)
+        k = S.shape[1]
+        return Frame([f"PC{i+1}" for i in range(k)],
+                     [Vec.from_device(S[:, i], frame.nrows, VecType.NUM)
+                      for i in range(k)])
+
+    def rotation(self) -> np.ndarray:
+        return np.asarray(self.output["eigenvectors"])
+
+
+class PCA(ModelBuilder):
+    """h2o-py surface: ``H2OPrincipalComponentAnalysisEstimator``."""
+
+    algo = "pca"
+    unsupervised = True
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            k=1,
+            transform="DEMEAN",        # reference PCA default
+            pca_method="GramSVD",
+            use_all_factor_levels=False,
+            compute_metrics=True,
+            max_iterations=1000,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> PCAModel:
+        p = self.params
+        k = int(p["k"])
+        di = _make_data_info(frame, x, p["transform"],
+                             bool(p.get("use_all_factor_levels", False)))
+        X = di.expand(frame)
+        K = X.shape[1]
+        if not (1 <= k <= K):
+            raise ValueError(f"k must be in [1, {K}]")
+        w = weights
+        G, colsum, wsum = _gram(X, w)
+        G = jax.device_get(G).astype(np.float64)
+        mu = jax.device_get(colsum).astype(np.float64) / max(float(jax.device_get(wsum)), 1e-12)
+        n = max(float(jax.device_get(wsum)), 2.0)
+        # covariance of the (already transformed) design matrix; PCA always
+        # centers internally (reference GramSVD centers via transform)
+        cov = (G / (n - 1.0)) - np.outer(mu, mu) * (n / (n - 1.0))
+        evals, evecs = np.linalg.eigh(cov)
+        order = np.argsort(evals)[::-1][:k]
+        evals = np.maximum(evals[order], 0.0)
+        evecs = evecs[:, order]
+        # sign convention: largest-|.| component positive (deterministic)
+        signs = np.sign(evecs[np.abs(evecs).argmax(axis=0), np.arange(k)])
+        evecs = evecs * np.where(signs == 0, 1.0, signs)[None, :]
+
+        sdev = np.sqrt(evals)
+        tot_var = float(np.trace(cov))
+        prop = evals / tot_var if tot_var > 0 else np.zeros_like(evals)
+        from h2o3_tpu.models.model_base import ModelParameters
+        return PCAModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=di,
+            response_column=None, response_domain=None,
+            output=dict(eigenvectors=jnp.asarray(evecs, jnp.float32),
+                        mu=mu.astype(np.float32),
+                        std_deviation=sdev,
+                        eigenvalues=evals,
+                        prop_var=prop, cum_var=np.cumsum(prop),
+                        coef_names=di.coef_names, total_variance=tot_var),
+        )
+
+
+# ---------------------------------------------------------------------------
+# SVD
+# ---------------------------------------------------------------------------
+
+class SVDModel(Model):
+    algo = "svd"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        X = self.data_info.expand(frame)
+        # U = X V D^-1
+        V = self.output["v"]
+        d = jnp.asarray(self.output["d"], jnp.float32)
+        return (X @ V) / jnp.maximum(d[None, :], 1e-30)
+
+    def predict(self, frame: Frame) -> Frame:
+        U = self._score_raw(frame)
+        k = U.shape[1]
+        return Frame([f"u{i+1}" for i in range(k)],
+                     [Vec.from_device(U[:, i], frame.nrows, VecType.NUM)
+                      for i in range(k)])
+
+
+class SVD(ModelBuilder):
+    """h2o-py surface: ``H2OSingularValueDecompositionEstimator``
+    (method GramSVD: eig of XᵀX, reference ``hex/svd/SVD.java``)."""
+
+    algo = "svd"
+    unsupervised = True
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            nv=1,
+            transform="NONE",
+            svd_method="GramSVD",
+            use_all_factor_levels=True,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> SVDModel:
+        p = self.params
+        di = _make_data_info(frame, x, p["transform"],
+                             bool(p.get("use_all_factor_levels", False)))
+        X = di.expand(frame)
+        K = X.shape[1]
+        nv = int(p["nv"])
+        if not (1 <= nv <= K):
+            raise ValueError(f"nv must be in [1, {K}]")
+        G, _, _ = _gram(X, weights)
+        G = jax.device_get(G).astype(np.float64)
+        evals, evecs = np.linalg.eigh(G)
+        order = np.argsort(evals)[::-1][:nv]
+        d = np.sqrt(np.maximum(evals[order], 0.0))
+        V = evecs[:, order]
+        signs = np.sign(V[np.abs(V).argmax(axis=0), np.arange(nv)])
+        V = V * np.where(signs == 0, 1.0, signs)[None, :]
+        from h2o3_tpu.models.model_base import ModelParameters
+        return SVDModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=di,
+            response_column=None, response_domain=None,
+            output=dict(v=jnp.asarray(V, jnp.float32), d=d,
+                        coef_names=di.coef_names),
+        )
+
+
+# ---------------------------------------------------------------------------
+# GLRM
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _glrm_update_A(X, M, Y, gamma_x):
+    """Exact masked ridge solve per row: (Y·diag(mᵢ)·Yᵀ + γI)aᵢ = Y·diag(mᵢ)·xᵢ.
+
+    The [rows, k, k] Gram batch is one einsum (MXU) followed by a batched
+    [k,k] solve — rows stay sharded, each chip solves its own rows."""
+    k = Y.shape[0]
+    G = jnp.einsum("ak,nk,bk->nab", Y, M, Y) \
+        + (gamma_x + 1e-6) * jnp.eye(k, dtype=X.dtype)[None]
+    r = jnp.einsum("ak,nk->na", Y, X * M)
+    return jnp.linalg.solve(G, r[..., None])[..., 0]
+
+
+@jax.jit
+def _glrm_update_Y(X, M, A, gamma_y):
+    """Exact masked ridge solve per column (same shape trick, [cols, k, k])."""
+    k = A.shape[1]
+    G = jnp.einsum("na,nj,nb->jab", A, M, A) \
+        + (gamma_y + 1e-6) * jnp.eye(k, dtype=X.dtype)[None]
+    r = jnp.einsum("na,nj->ja", A, X * M)
+    return jnp.linalg.solve(G, r[..., None])[..., 0].T
+
+
+@jax.jit
+def _glrm_objective(X, M, A, Y, gamma_x, gamma_y):
+    R = (X - A @ Y) * M
+    return (R * R).sum() + gamma_x * (A * A).sum() + gamma_y * (Y * Y).sum()
+
+
+def _apply_reg(Z, kind: str):
+    if kind == "NonNegative":
+        return jnp.maximum(Z, 0.0)
+    return Z
+
+
+def _expand_masked(di: DataInfo, frame: Frame, row_ok) -> tuple[jax.Array, jax.Array]:
+    """Expanded design + observation mask M (1=observed cell). ``expand()``
+    mean-imputes NAs, so the NA positions must be read off the raw columns
+    (a cat NA zeroes its whole one-hot block)."""
+    X = di.expand(frame)
+    plen, K = X.shape
+    M = jnp.broadcast_to(jnp.asarray(row_ok)[:, None], (plen, K)).astype(jnp.float32)
+    col = 0
+    for ci, c in enumerate(di.cat_cols):
+        width = len(di.cat_domains[ci]) - (0 if di.use_all_factor_levels else 1)
+        if width > 0:
+            ok = (frame.vec(c).data >= 0)
+            M = M.at[:, col:col + width].set(M[:, col:col + width] * ok[:, None])
+            col += width
+    for ni, c in enumerate(di.num_cols):
+        ok = ~jnp.isnan(frame.vec(c).data)
+        M = M.at[:, col + ni].set(M[:, col + ni] * ok)
+    return X * M, M
+
+
+class GLRMModel(Model):
+    algo = "glrm"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        # project new rows onto the archetypes Y: A_new = masked ridge solve
+        Xc, M = _expand_masked(self.data_info, frame, frame.row_mask())
+        A = _glrm_update_A(Xc, M, self.output["archetypes"],
+                           jnp.float32(self.output["gamma_x"]))
+        return A @ self.output["archetypes"]
+
+    def transform_frame(self, frame: Frame) -> Frame:
+        """Low-rank representation A of new rows (reference: GLRM x-factor)."""
+        Xc, M = _expand_masked(self.data_info, frame, frame.row_mask())
+        A = _glrm_update_A(Xc, M, self.output["archetypes"],
+                           jnp.float32(self.output["gamma_x"]))
+        k = A.shape[1]
+        return Frame([f"Arch{i+1}" for i in range(k)],
+                     [Vec.from_device(A[:, i], frame.nrows, VecType.NUM)
+                      for i in range(k)])
+
+    def predict(self, frame: Frame) -> Frame:
+        R = self._score_raw(frame)
+        names = [f"reconstr_{n}" for n in self.data_info.coef_names]
+        return Frame(names, [Vec.from_device(R[:, i], frame.nrows, VecType.NUM)
+                             for i in range(R.shape[1])])
+
+    def archetypes(self) -> np.ndarray:
+        return np.asarray(self.output["archetypes"])
+
+
+class GLRM(ModelBuilder):
+    """h2o-py surface: ``H2OGeneralizedLowRankEstimator`` (quadratic loss,
+    L2/NonNegative regularizers; alternating ridge solves)."""
+
+    algo = "glrm"
+    unsupervised = True
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            k=1,
+            transform="NONE",
+            loss="Quadratic",
+            regularization_x="None",     # None | Quadratic | NonNegative
+            regularization_y="None",
+            gamma_x=0.0,
+            gamma_y=0.0,
+            max_iterations=100,
+            init="SVD",                  # SVD | Random
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLRMModel:
+        p = self.params
+        k = int(p["k"])
+        if str(p["loss"]).lower() != "quadratic":
+            raise ValueError("only Quadratic loss implemented")
+        di = _make_data_info(frame, x, p["transform"],
+                             bool(p.get("use_all_factor_levels", False)))
+        Xc, M = _expand_masked(di, frame, weights > 0)
+        plen, K = Xc.shape
+        if not (1 <= k <= min(plen, K)):
+            raise ValueError(f"k must be in [1, {min(plen, K)}]")
+
+        seed = int(p.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 271828)
+        if str(p["init"]).upper() == "SVD":
+            G = jax.device_get(Xc.T @ Xc).astype(np.float64)
+            evals, evecs = np.linalg.eigh(G)
+            Y = jnp.asarray(evecs[:, np.argsort(evals)[::-1][:k]].T, jnp.float32)
+        else:
+            Y = 0.1 * jax.random.normal(key, (k, K), jnp.float32)
+        gx, gy = jnp.float32(p["gamma_x"]), jnp.float32(p["gamma_y"])
+
+        obj_prev = np.inf
+        for it in range(max(int(p["max_iterations"]), 1)):
+            A = _apply_reg(_glrm_update_A(Xc, M, Y, gx), p["regularization_x"])
+            Y = _apply_reg(_glrm_update_Y(Xc, M, A, gy), p["regularization_y"])
+            obj = float(jax.device_get(_glrm_objective(Xc, M, A, Y, gx, gy)))
+            job.update((it + 1) / max(int(p["max_iterations"]), 1),
+                       f"iter {it+1} objective {obj:.5f}")
+            if np.isfinite(obj_prev) and abs(obj_prev - obj) <= 1e-6 * max(obj_prev, 1.0):
+                break
+            obj_prev = obj
+        # re-solve A against the final Y so x_factor matches archetypes
+        A = _apply_reg(_glrm_update_A(Xc, M, Y, gx), p["regularization_x"])
+        obj = float(jax.device_get(_glrm_objective(Xc, M, A, Y, gx, gy)))
+
+        from h2o3_tpu.models.model_base import ModelParameters
+        return GLRMModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=di,
+            response_column=None, response_domain=None,
+            output=dict(archetypes=Y, x_factor=A, objective=obj,
+                        gamma_x=float(p["gamma_x"]), gamma_y=float(p["gamma_y"]),
+                        iterations=it + 1, coef_names=di.coef_names),
+        )

@@ -1,0 +1,413 @@
+"""DeepLearning — feed-forward MLP (classification / regression / autoencoder).
+
+Reference: ``hex/deeplearning/`` (5.8 kLoC). The reference trains with
+**Hogwild! lock-free intra-node SGD + per-iteration cross-node model
+averaging** (``hex/deeplearning/DeepLearningTask.java:17-90``,
+``DeepLearning.java:379-478``): threads race on shared per-node weights,
+then nodes average. Forward/backward math, ADADELTA, momentum ramp, dropout
+and maxout live in ``hex/deeplearning/Neurons.java`` (``bpropMiniBatch:135``).
+
+TPU-first redesign (SURVEY.md §7 step 7): Hogwild is a CPU-cache trick with
+no accelerator analog — the same statistical contract (stochastic minibatch
+updates whose gradient is averaged across the cluster each step) is expressed
+as **synchronous data-parallel minibatch SGD**: the design matrix is
+row-sharded across the mesh, each step consumes one shuffled minibatch, XLA
+inserts the gradient all-reduce over ICI (replacing per-iteration model
+averaging with per-step exact averaging — strictly less stale). The whole
+epoch is one jitted ``lax.scan`` over minibatches: zero host round-trips in
+the hot loop, weights live in HBM, matmuls hit the MXU in bf16-friendly f32.
+
+Supported reference options: activations Tanh/Rectifier/Maxout (+WithDropout),
+``adaptive_rate`` ADADELTA(rho, epsilon) or annealed-rate momentum SGD with
+Nesterov, ``input_dropout_ratio``/``hidden_dropout_ratios`` (inverted dropout),
+``l1``/``l2``, ``max_w2`` per-unit norm constraint, loss CrossEntropy/
+Quadratic/Absolute/Huber, ``initial_weight_distribution`` UniformAdaptive/
+Uniform/Normal, ``autoencoder`` with reconstruction-error anomaly scoring
+(reference ``DlInput``/``Neurons`` semantics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import DataInfo, response_as_float
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+# ---------------------------------------------------------------------------
+# forward / backward
+# ---------------------------------------------------------------------------
+
+def _act_kind(activation: str) -> tuple[str, bool]:
+    """Map reference activation enum → (base activation, hidden dropout on)."""
+    a = activation.lower()
+    drop = a.endswith("withdropout")
+    base = a.replace("withdropout", "")
+    if base not in ("tanh", "rectifier", "maxout"):
+        raise ValueError(f"unknown activation {activation!r}")
+    return base, drop
+
+
+def _forward(params, X, act: str, train: bool, key, in_drop: float,
+             hid_drops: tuple[float, ...]):
+    """MLP forward pass. Maxout layers hold W of width 2*units and take the
+    pairwise max (reference: 2-channel Maxout, ``Neurons.java``). Dropout is
+    inverted (scale at train time) so scoring needs no rescale."""
+    h = X
+    if train and in_drop > 0:
+        key, sub = jax.random.split(key)
+        keep = jax.random.bernoulli(sub, 1.0 - in_drop, h.shape)
+        h = jnp.where(keep, h / (1.0 - in_drop), 0.0)
+    n_hidden = len(params["W"]) - 1
+    for i in range(n_hidden):
+        z = h @ params["W"][i] + params["b"][i]
+        if act == "tanh":
+            h = jnp.tanh(z)
+        elif act == "rectifier":
+            h = jnp.maximum(z, 0.0)
+        else:  # maxout: [B, 2u] → max over channel pairs → [B, u]
+            u = z.shape[-1] // 2
+            h = jnp.maximum(z[..., :u], z[..., u:])
+        p = hid_drops[i] if i < len(hid_drops) else 0.0
+        if train and p > 0:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, h.shape)
+            h = jnp.where(keep, h / (1.0 - p), 0.0)
+    return h @ params["W"][-1] + params["b"][-1]   # linear output (logits / preds)
+
+
+def _row_loss(out, y, w, loss: str, nclasses: int, huber_delta: float):
+    """Weighted per-row loss summed over the batch (reference loss enum)."""
+    if nclasses >= 2:
+        logp = jax.nn.log_softmax(out, axis=-1)
+        yi = y.astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, yi[:, None], axis=1)[:, 0]
+        return (w * nll).sum()
+    err = out - (y if out.ndim == 1 else y.reshape(out.shape))
+    if loss == "absolute":
+        e = jnp.abs(err)
+    elif loss == "huber":
+        a = jnp.abs(err)
+        e = jnp.where(a <= huber_delta, 0.5 * a * a,
+                      huber_delta * (a - 0.5 * huber_delta))
+    else:  # quadratic
+        e = 0.5 * err * err
+    if e.ndim == 2:            # autoencoder / multi-output: sum over outputs
+        e = e.sum(axis=1)
+    return (w * e).sum()
+
+
+# ---------------------------------------------------------------------------
+# one jitted training "iteration": scan over minibatches
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("act", "loss", "nclasses", "cfg"))
+def _train_epoch(params, opt, Xb, yb, wb, key, samples0,
+                 act: str, loss: str, nclasses: int, cfg: tuple):
+    """Scan all minibatches of one (shuffled) epoch.
+
+    Xb: [nb, B, K] minibatched design matrix, yb: [nb, B], wb: [nb, B].
+    cfg is a hashable tuple of hyperparameters (see _fit for layout).
+    """
+    (adaptive, rho, eps, rate, rate_annealing, rate_decay,
+     mom_start, mom_ramp, mom_stable, nesterov,
+     l1, l2, max_w2, in_drop, hid_drops, huber_delta) = cfg
+
+    def grad_fn(p, X, y, w, k):
+        out = _forward(p, X, act, True, k, in_drop, hid_drops)
+        if nclasses == 0 and out.shape[-1] == 1 and y.ndim == 1:
+            out = out[:, 0]
+        lsum = _row_loss(out, y, w, loss, nclasses, huber_delta)
+        return lsum / jnp.maximum(w.sum(), 1e-8)
+
+    def apply_l1l2(g, p):
+        return jax.tree.map(lambda gi, pi: gi + l2 * pi + l1 * jnp.sign(pi), g, p)
+
+    def constrain(p):
+        # reference default max_w2 = Float.MAX_VALUE means "disabled"; values
+        # that big also overflow bf16/f32 intermediates on TPU, so gate here
+        if max_w2 <= 0 or not np.isfinite(max_w2) or max_w2 >= 1e30:
+            return p
+        # per-unit incoming squared-norm cap (reference Neurons max_w2)
+        def cap(W):
+            if W.ndim != 2:
+                return W
+            ss = (W * W).sum(axis=0, keepdims=True)
+            return W * jnp.sqrt(max_w2 / jnp.maximum(ss, max_w2))
+        return {"W": [cap(W) for W in p["W"]], "b": p["b"]}
+
+    def step(carry, xs):
+        p, o, k, samples = carry
+        X, y, w = xs
+        k, sub = jax.random.split(k)
+        lossv, g = jax.value_and_grad(grad_fn)(p, X, y, w, sub)
+        g = apply_l1l2(g, p)
+        if adaptive:
+            # ADADELTA (reference Neurons.java adaDelta branch)
+            Eg = jax.tree.map(lambda e, gi: rho * e + (1 - rho) * gi * gi, o["Eg"], g)
+            dx = jax.tree.map(
+                lambda ed, eg, gi: -jnp.sqrt(ed + eps) / jnp.sqrt(eg + eps) * gi,
+                o["Edx"], Eg, g)
+            Edx = jax.tree.map(lambda e, d: rho * e + (1 - rho) * d * d, o["Edx"], dx)
+            p = jax.tree.map(jnp.add, p, dx)
+            o = {"Eg": Eg, "Edx": Edx, "v": o["v"]}
+        else:
+            lr0 = rate / (1.0 + rate_annealing * samples)
+            # per-layer rate decay (reference DeepLearningParameters.rate_decay:
+            # layer i trains at rate * rate_decay^i)
+            lrs = [lr0 * (rate_decay ** i) for i in range(len(p["W"]))]
+            mom = jnp.where(
+                mom_ramp > 0,
+                jnp.minimum(mom_stable,
+                            mom_start + samples * (mom_stable - mom_start)
+                            / jnp.maximum(mom_ramp, 1.0)),
+                mom_stable)
+            v = {kk: [mom * vi - lrs[i] * gi
+                      for i, (vi, gi) in enumerate(zip(o["v"][kk], g[kk]))]
+                 for kk in ("W", "b")}
+            if nesterov:
+                p = {kk: [pi + mom * vi - lrs[i] * gi
+                          for i, (pi, vi, gi) in enumerate(zip(p[kk], v[kk], g[kk]))]
+                     for kk in ("W", "b")}
+            else:
+                p = jax.tree.map(jnp.add, p, v)
+            o = {"Eg": o["Eg"], "Edx": o["Edx"], "v": v}
+        p = constrain(p)
+        samples = samples + w.sum()
+        return (p, o, k, samples), lossv
+
+    (params, opt, key, samples), losses = jax.lax.scan(
+        step, (params, opt, key, samples0), (Xb, yb, wb))
+    return params, opt, key, samples, losses.mean()
+
+
+@partial(jax.jit, static_argnames=("act",))
+def _dl_forward_score(params, X, act: str):
+    return _forward(params, X, act, False, jax.random.PRNGKey(0), 0.0, ())
+
+
+@partial(jax.jit, static_argnames=("act",))
+def _dl_reconstruction_mse(params, X, act: str):
+    out = _forward(params, X, act, False, jax.random.PRNGKey(0), 0.0, ())
+    return ((out - X) ** 2).mean(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Model / Builder
+# ---------------------------------------------------------------------------
+
+class DeepLearningModel(Model):
+    algo = "deeplearning"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        X = self.data_info.expand(frame)
+        out = _dl_forward_score(self.output["params"], X, self.output["act"])
+        if self.is_classifier:
+            return jax.nn.softmax(out, axis=-1)
+        if self.params.get("autoencoder"):
+            return out
+        return out[:, 0]
+
+    def anomaly(self, frame: Frame) -> Frame:
+        """Per-row reconstruction MSE (reference: ``DeepLearningModel
+        .scoreAutoEncoder``, anomaly detection use of autoencoders)."""
+        if not self.params.get("autoencoder"):
+            raise ValueError("anomaly() requires autoencoder=True")
+        X = self.data_info.expand(frame)
+        mse = _dl_reconstruction_mse(self.output["params"], X, self.output["act"])
+        return Frame(["Reconstruction.MSE"],
+                     [Vec.from_device(mse, frame.nrows, VecType.NUM)])
+
+    def predict(self, frame: Frame) -> Frame:
+        if self.params.get("autoencoder"):
+            # reconstruction in the expanded space, named after coefficients
+            out = self._score_raw(frame)
+            names = [f"reconstr_{n}" for n in self.data_info.coef_names]
+            vecs = [Vec.from_device(out[:, i], frame.nrows, VecType.NUM)
+                    for i in range(out.shape[1])]
+            return Frame(names, vecs)
+        return super().predict(frame)
+
+
+class DeepLearning(ModelBuilder):
+    """h2o-py surface: ``H2ODeepLearningEstimator``."""
+
+    algo = "deeplearning"
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            hidden=[200, 200],
+            epochs=10.0,
+            activation="Rectifier",
+            adaptive_rate=True,
+            rho=0.99,
+            epsilon=1e-8,
+            rate=0.005,
+            rate_annealing=1e-6,
+            rate_decay=1.0,
+            momentum_start=0.0,
+            momentum_ramp=1e6,
+            momentum_stable=0.0,
+            nesterov_accelerated_gradient=True,
+            input_dropout_ratio=0.0,
+            hidden_dropout_ratios=None,     # default 0.5 when *WithDropout
+            l1=0.0,
+            l2=0.0,
+            max_w2=3.4028235e38,
+            loss="Automatic",               # CrossEntropy|Quadratic|Absolute|Huber
+            huber_alpha=0.9,                # kept for API parity (delta fixed = 1)
+            mini_batch_size=32,             # reference default 1 (Hogwild row-at-
+                                            # a-time); vectorized minibatch here
+            standardize=True,
+            use_all_factor_levels=True,
+            initial_weight_distribution="UniformAdaptive",
+            initial_weight_scale=1.0,
+            autoencoder=False,
+            score_each_iteration=False,
+        )
+
+    unsupervised = False
+
+    def train(self, x=None, y=None, training_frame=None, validation_frame=None,
+              weights=None):
+        self.unsupervised = bool(self.params.get("autoencoder"))
+        return super().train(x=x, y=y, training_frame=training_frame,
+                             validation_frame=validation_frame, weights=weights)
+
+    def _init_params(self, key, sizes: list[int], act: str):
+        dist = str(self.params["initial_weight_distribution"]).lower()
+        scale = float(self.params["initial_weight_scale"])
+        Ws, bs = [], []
+        n_layers = len(sizes) - 1
+        for i in range(n_layers):
+            fan_in, fan_out = sizes[i], sizes[i + 1]
+            width = fan_out
+            if act == "maxout" and i < n_layers - 1:
+                width = 2 * fan_out
+            key, sub = jax.random.split(key)
+            if dist == "uniformadaptive":
+                lim = np.sqrt(6.0 / (fan_in + fan_out))
+                W = jax.random.uniform(sub, (fan_in, width), jnp.float32, -lim, lim)
+            elif dist == "uniform":
+                W = jax.random.uniform(sub, (fan_in, width), jnp.float32, -scale, scale)
+            else:  # normal
+                W = scale * jax.random.normal(sub, (fan_in, width), jnp.float32)
+            Ws.append(W)
+            bs.append(jnp.zeros(width, jnp.float32))
+        return {"W": Ws, "b": bs}
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> DeepLearningModel:
+        p = self.params
+        act, act_dropout = _act_kind(p["activation"])
+        autoenc = bool(p["autoencoder"])
+
+        di = DataInfo.make(frame, x, standardize=p["standardize"],
+                           use_all_factor_levels=p["use_all_factor_levels"])
+        X = di.expand(frame)
+        K = X.shape[1]
+
+        if autoenc:
+            yy, w = jnp.zeros(X.shape[0], jnp.float32), weights
+            nclasses, loss = 0, "quadratic"
+            domain = None
+        else:
+            yvec = frame.vec(y)
+            yy, valid = response_as_float(yvec)
+            w = weights * valid
+            nclasses = yvec.cardinality() if yvec.is_categorical else 0
+            domain = yvec.domain if yvec.is_categorical else None
+            loss = str(p["loss"]).lower()
+            if loss == "automatic":
+                loss = "crossentropy" if nclasses else "quadratic"
+            if nclasses and loss != "crossentropy":
+                raise ValueError("classification requires CrossEntropy loss")
+        yy = jnp.where(w > 0, yy, 0.0)
+
+        hidden = [int(h) for h in p["hidden"]]
+        out_dim = K if autoenc else (nclasses if nclasses >= 2 else 1)
+        sizes = [K] + hidden + [out_dim]
+        seed = int(p.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 5318008)
+        key, init_key = jax.random.split(key)
+        params = self._init_params(init_key, sizes, act)
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        opt = {"Eg": zeros, "Edx": jax.tree.map(jnp.zeros_like, params),
+               "v": jax.tree.map(jnp.zeros_like, params)}
+
+        hid_drops = p["hidden_dropout_ratios"]
+        if hid_drops is None:
+            hid_drops = [0.5] * len(hidden) if act_dropout else [0.0] * len(hidden)
+        cfg = (bool(p["adaptive_rate"]), float(p["rho"]), float(p["epsilon"]),
+               float(p["rate"]), float(p["rate_annealing"]), float(p["rate_decay"]),
+               float(p["momentum_start"]), float(p["momentum_ramp"]),
+               float(p["momentum_stable"]), bool(p["nesterov_accelerated_gradient"]),
+               float(p["l1"]), float(p["l2"]), float(p["max_w2"]),
+               float(p["input_dropout_ratio"]), tuple(float(d) for d in hid_drops),
+               1.0)
+
+        plen = X.shape[0]
+        B = min(max(int(p["mini_batch_size"]), 1), plen)
+        nb = plen // B
+        used = nb * B
+        epochs = float(p["epochs"])
+        n_epochs = max(int(np.ceil(epochs)), 1)
+
+        samples = jnp.float32(0.0)
+        score_history = []
+        for ep in range(n_epochs):
+            key, pk = jax.random.split(key)
+            perm = jax.random.permutation(pk, plen)[:used]
+            Xb = jnp.take(X, perm, axis=0).reshape(nb, B, K)
+            wb = jnp.take(w, perm, axis=0).reshape(nb, B)
+            if autoenc:
+                ybt = Xb
+            else:
+                ybt = jnp.take(yy, perm, axis=0).reshape(nb, B)
+            key, ek = jax.random.split(key)
+            params, opt, _, samples, mloss = _train_epoch(
+                params, opt, Xb, ybt, wb, ek, samples,
+                act, loss, nclasses, cfg)
+            ml = float(jax.device_get(mloss))
+            score_history.append({"epoch": ep + 1, "train_loss": ml})
+            job.update((ep + 1) / n_epochs, f"epoch {ep + 1} loss {ml:.5f}")
+            if job.cancelled:
+                break
+
+        from h2o3_tpu.models.model_base import ModelParameters
+        model = DeepLearningModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=di,
+            response_column=None if autoenc else y,
+            response_domain=domain,
+            output=dict(params=params, act=act, sizes=sizes,
+                        score_history=score_history,
+                        samples_trained=float(jax.device_get(samples))),
+        )
+        return model
+
+    def _validate(self, frame, x, y):
+        if not self.params.get("autoencoder"):
+            super()._validate(frame, x, y)
+
+
+class AutoEncoder(DeepLearning):
+    """Convenience alias (h2o-py: H2OAutoEncoderEstimator)."""
+
+    @classmethod
+    def defaults(cls) -> dict:
+        d = super().defaults()
+        d["autoencoder"] = True
+        d["hidden"] = [20]
+        return d

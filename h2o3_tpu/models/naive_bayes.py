@@ -1,0 +1,169 @@
+"""NaiveBayes — count/Gaussian conditional probability classifier.
+
+Reference: ``hex/naivebayes/NaiveBayes.java`` (538 LoC): one MRTask pass
+(``NBTask``) accumulates per-class counts, per-(class, level) counts for
+categoricals and per-(class, col) sum/sumsq for numerics, reduced across
+nodes; prediction multiplies log conditionals with Laplace smoothing and
+``min_sdev``/``eps_sdev`` floors for numeric Gaussians.
+
+TPU-native: all sufficient statistics are one-hot matmuls on the row-sharded
+design — ``onehot(y)ᵀ · onehot(x)`` for categorical count tables and
+``onehot(y)ᵀ · [x, x²]`` for Gaussian moments — so the whole training pass is
+a single jitted program whose per-shard partial tables XLA all-reduces over
+ICI (the MRTask reduce).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import response_as_float
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+@partial(jax.jit, static_argnames=("nclass", "cards"))
+def _nb_train(y, w, cat_stack, num_stack, nclass: int, cards: tuple[int, ...]):
+    """Sufficient statistics in one pass."""
+    yi = y.astype(jnp.int32)
+    Yoh = (yi[:, None] == jnp.arange(nclass)[None, :]).astype(jnp.float32) * w[:, None]
+    class_counts = Yoh.sum(axis=0)                       # [C]
+    cat_tables = []
+    for j, card in enumerate(cards):
+        c = cat_stack[:, j]
+        ok = (c >= 0).astype(jnp.float32)
+        Xoh = (c[:, None] == jnp.arange(card)[None, :]).astype(jnp.float32)
+        cat_tables.append((Yoh * ok[:, None]).T @ Xoh)   # [C, card]
+    if num_stack.shape[1]:
+        ok = (~jnp.isnan(num_stack)).astype(jnp.float32)
+        xs = jnp.nan_to_num(num_stack)
+        cnt = Yoh.T @ ok                                  # [C, P]
+        s1 = Yoh.T @ (xs * ok)
+        s2 = Yoh.T @ (xs * xs * ok)
+    else:
+        cnt = s1 = s2 = jnp.zeros((nclass, 0), jnp.float32)
+    return class_counts, cat_tables, cnt, s1, s2
+
+
+@partial(jax.jit, static_argnames=("nclass", "cards"))
+def _nb_score(cat_stack, num_stack, log_prior, cat_logp, mu, sd,
+              nclass: int, cards: tuple[int, ...]):
+    plen = cat_stack.shape[0] if cards else num_stack.shape[0]
+    ll = jnp.broadcast_to(log_prior[None, :], (plen, nclass))
+    for j, card in enumerate(cards):
+        c = cat_stack[:, j]
+        tbl = cat_logp[j]                                  # [C, card]
+        safe = jnp.clip(c, 0, card - 1)
+        contrib = tbl.T[safe]                              # [plen, C]
+        ll = ll + jnp.where((c >= 0)[:, None], contrib, 0.0)
+    if num_stack.shape[1]:
+        x = num_stack[:, :, None]                          # [plen, P, 1]
+        m = mu.T[None, :, :]                               # [1, P, C]
+        s = sd.T[None, :, :]
+        logpdf = -0.5 * jnp.log(2 * jnp.pi * s * s) - 0.5 * ((x - m) / s) ** 2
+        logpdf = jnp.where(jnp.isnan(x), 0.0, logpdf)
+        ll = ll + logpdf.sum(axis=1)
+    return jax.nn.softmax(ll, axis=1)
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        o = self.output
+        cats, nums = _stack_features(frame, o["cat_cols"], o["num_cols"],
+                                     o["cat_domains"])
+        return _nb_score(cats, nums, o["log_prior"], tuple(o["cat_logp"]),
+                         o["mu"], o["sd"], self.nclasses, o["cards"])
+
+
+def _stack_features(frame: Frame, cat_cols, num_cols, train_domains):
+    from h2o3_tpu.models.data_info import _remap_codes
+    cats = []
+    for col, dom in zip(cat_cols, train_domains):
+        v = frame.vec(col)
+        codes = v.data
+        if v.domain != dom:
+            codes = _remap_codes(codes, v.domain, dom)
+        cats.append(codes)
+    nums = [frame.vec(c).data for c in num_cols]
+    cat_stack = jnp.stack(cats, axis=1) if cats else jnp.zeros((frame.plen, 0), jnp.int32)
+    num_stack = jnp.stack(nums, axis=1) if nums else jnp.zeros((frame.plen, 0), jnp.float32)
+    return cat_stack, num_stack
+
+
+class NaiveBayes(ModelBuilder):
+    """h2o-py surface: ``H2ONaiveBayesEstimator``."""
+
+    algo = "naivebayes"
+    supports_regression = False
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            laplace=0.0,
+            min_sdev=0.001,
+            eps_sdev=0.0,
+            min_prob=0.001,
+            eps_prob=0.0,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> NaiveBayesModel:
+        p = self.params
+        yvec = frame.vec(y)
+        if not yvec.is_categorical:
+            raise ValueError("NaiveBayes requires a categorical response")
+        nclass = yvec.cardinality()
+        yy, valid = response_as_float(yvec)
+        w = weights * valid
+        yy = jnp.where(w > 0, yy, 0.0)
+
+        cat_cols = [c for c in x if frame.vec(c).is_categorical]
+        num_cols = [c for c in x if not frame.vec(c).is_categorical]
+        cat_domains = [frame.vec(c).domain for c in cat_cols]
+        cards = tuple(len(d) for d in cat_domains)
+        cats, nums = _stack_features(frame, cat_cols, num_cols, cat_domains)
+
+        class_counts, cat_tables, cnt, s1, s2 = _nb_train(yy, w, cats, nums,
+                                                          nclass, cards)
+        lap = float(p["laplace"])
+        total = jnp.maximum(class_counts.sum(), 1e-12)
+        log_prior = jnp.log(jnp.maximum(class_counts / total, 1e-30))
+        cat_logp = []
+        min_prob, eps_prob = float(p["min_prob"]), float(p["eps_prob"])
+        for j, card in enumerate(cards):
+            tbl = cat_tables[j] + lap
+            probs = tbl / jnp.maximum(tbl.sum(axis=1, keepdims=True), 1e-30)
+            # reference: probs below the eps_prob cutoff snap to min_prob,
+            # and min_prob is also the absolute floor
+            probs = jnp.where(probs < eps_prob, min_prob,
+                              jnp.maximum(probs, min_prob))
+            cat_logp.append(jnp.log(probs))
+        if num_cols:
+            n = jnp.maximum(cnt, 1e-12)
+            mu = s1 / n
+            var = jnp.maximum(s2 / n - mu * mu, 0.0) * n / jnp.maximum(n - 1.0, 1.0)
+            min_sdev, eps_sdev = float(p["min_sdev"]), float(p["eps_sdev"])
+            sd = jnp.sqrt(var)
+            sd = jnp.where(sd < eps_sdev, min_sdev, jnp.maximum(sd, min_sdev))
+        else:
+            mu = sd = jnp.zeros((nclass, 0), jnp.float32)
+
+        from h2o3_tpu.models.model_base import ModelParameters
+        return NaiveBayesModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=None,
+            response_column=y,
+            response_domain=yvec.domain,
+            output=dict(log_prior=log_prior, cat_logp=cat_logp, mu=mu, sd=sd,
+                        cat_cols=cat_cols, num_cols=num_cols,
+                        cat_domains=cat_domains, cards=cards,
+                        class_counts=np.asarray(jax.device_get(class_counts))),
+        )

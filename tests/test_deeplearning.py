@@ -1,0 +1,124 @@
+"""DeepLearning tests (reference test model: h2o-py
+``testdir_algos/deeplearning/pyunit_*`` — smoke + accuracy contracts)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import AutoEncoder, DeepLearning
+
+
+def _blobs(rng, n=1500, nclass=3):
+    # fixed well-separated centers (pairwise distance 6·√2 ≫ unit noise)
+    centers = 6.0 * np.eye(4)[:nclass]
+    yi = rng.integers(0, nclass, size=n)
+    X = centers[yi] + rng.normal(size=(n, 4))
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = np.array([f"c{c}" for c in yi], dtype=object)
+    return Frame.from_arrays(cols)
+
+
+def test_dl_multinomial_accuracy(rng):
+    f = _blobs(rng)
+    m = DeepLearning(hidden=[16], epochs=20, seed=7,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    assert m.training_metrics.accuracy > 0.95, m.training_metrics
+    assert m.training_metrics.logloss < 0.3
+    pred = m.predict(f)
+    assert pred.names[0] == "predict"
+    assert pred.ncols == 4  # predict + 3 class probs
+
+
+def test_dl_binomial_auc(rng):
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    p = 1 / (1 + np.exp(-(2 * X[:, 0] - X[:, 1])))
+    y = (rng.uniform(size=n) < p).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[y]
+    f = Frame.from_arrays(cols)
+    m = DeepLearning(hidden=[8], epochs=15, seed=3,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    assert m.training_metrics.auc > 0.85
+
+
+def test_dl_regression(rng):
+    n = 1500
+    X = rng.normal(size=(n, 3))
+    y = np.sin(X[:, 0]) + 0.5 * X[:, 1] ** 2 + 0.1 * rng.normal(size=n)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = y
+    f = Frame.from_arrays(cols)
+    m = DeepLearning(hidden=[32, 32], epochs=40, seed=1,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    # nonlinear fn a linear model can't fit: check well below response variance
+    assert m.training_metrics.rmse < 0.5 * np.std(y)
+
+
+def test_dl_momentum_sgd_path(rng):
+    f = _blobs(rng, n=900)
+    m = DeepLearning(hidden=[16], epochs=15, seed=7, adaptive_rate=False,
+                     rate=0.05, momentum_start=0.5, momentum_stable=0.9,
+                     momentum_ramp=5000, mini_batch_size=64,
+                     ).train(y="y", training_frame=f)
+    assert m.training_metrics.accuracy > 0.9
+
+
+def test_dl_dropout_and_maxout(rng):
+    f = _blobs(rng, n=900)
+    m = DeepLearning(hidden=[32], epochs=15, seed=7,
+                     activation="MaxoutWithDropout",
+                     hidden_dropout_ratios=[0.2], input_dropout_ratio=0.05,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    assert m.training_metrics.accuracy > 0.85
+
+
+def test_dl_l2_and_max_w2_constrain_weights(rng):
+    f = _blobs(rng, n=600)
+    m = DeepLearning(hidden=[16], epochs=10, seed=7, l2=1e-3, max_w2=1.0,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    W0 = np.asarray(m.output["params"]["W"][0])
+    assert (W0 * W0).sum(axis=0).max() <= 1.0 + 1e-4
+
+
+def test_dl_categorical_features(rng):
+    n = 1000
+    g = rng.integers(0, 4, size=n)
+    x = rng.normal(size=n)
+    y = np.array([0.0, 2.0, -1.0, 4.0])[g] + x + 0.1 * rng.normal(size=n)
+    f = Frame.from_arrays({
+        "g": np.array([f"g{i}" for i in g], dtype=object),
+        "x": x, "y": y})
+    m = DeepLearning(hidden=[16], epochs=30, seed=2,
+                     mini_batch_size=64).train(y="y", training_frame=f)
+    assert m.training_metrics.rmse < 0.5
+
+
+def test_autoencoder_anomaly(rng):
+    n = 800
+    X = rng.normal(size=(n, 6))
+    X[:5] += 12.0  # planted outliers
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(6)})
+    m = AutoEncoder(hidden=[3], epochs=30, seed=4,
+                    mini_batch_size=64).train(training_frame=f)
+    mse = m.anomaly(f).vec("Reconstruction.MSE").to_numpy()
+    # outliers must rank in the top by reconstruction error
+    top = np.argsort(mse)[-5:]
+    assert len(set(top) & set(range(5))) >= 4
+    recon = m.predict(f)
+    assert recon.ncols == 6
+
+
+def test_dl_validation_frame(rng):
+    f = _blobs(rng, n=1200)
+    tr = Frame.from_arrays({n: f.vec(n).to_numpy()[:800] if not f.vec(n).is_categorical
+                            else np.asarray(f.to_pandas()[n][:800], dtype=object)
+                            for n in f.names})
+    va = Frame.from_arrays({n: f.vec(n).to_numpy()[800:] if not f.vec(n).is_categorical
+                            else np.asarray(f.to_pandas()[n][800:], dtype=object)
+                            for n in f.names})
+    m = DeepLearning(hidden=[16], epochs=15, seed=7,
+                     mini_batch_size=64).train(y="y", training_frame=tr,
+                                               validation_frame=va)
+    assert m.validation_metrics is not None
+    assert m.validation_metrics.accuracy > 0.9

@@ -1,0 +1,187 @@
+"""KMeans / PCA / SVD / GLRM / NaiveBayes tests (reference test model:
+h2o-py ``testdir_algos/{kmeans,pca,svd,glrm,naivebayes}/pyunit_*``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GLRM, KMeans, NaiveBayes, PCA, SVD
+
+
+def _cluster_data(rng, n=900):
+    centers = np.array([[0, 0], [10, 0], [0, 10]], float)
+    yi = rng.integers(0, 3, size=n)
+    X = centers[yi] + rng.normal(size=(n, 2))
+    return Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1]}), X, yi, centers
+
+
+# -- KMeans ------------------------------------------------------------------
+
+def test_kmeans_recovers_centers(rng):
+    f, X, yi, centers = _cluster_data(rng)
+    m = KMeans(k=3, standardize=False, seed=1, max_iterations=20,
+               ).train(training_frame=f)
+    got = np.sort(m.centers(), axis=0)
+    want = np.sort(centers, axis=0)
+    np.testing.assert_allclose(got, want, atol=0.3)
+    assert m.tot_withinss() < m.totss()
+    assert abs(m.totss() - (m.tot_withinss() + m.betweenss())) < 1e-3 * m.totss()
+
+
+def test_kmeans_predict_partitions(rng):
+    f, X, yi, _ = _cluster_data(rng)
+    m = KMeans(k=3, seed=1).train(training_frame=f)
+    pred = m.predict(f).vec("predict").to_numpy()
+    # each true cluster maps to one predicted label (purity ~ 1)
+    purity = 0
+    for c in range(3):
+        labs, cnts = np.unique(pred[yi == c], return_counts=True)
+        purity += cnts.max()
+    assert purity / len(yi) > 0.98
+
+
+@pytest.mark.parametrize("init", ["Random", "PlusPlus", "Furthest"])
+def test_kmeans_inits(rng, init):
+    f, *_ = _cluster_data(rng, n=600)
+    m = KMeans(k=3, init=init, seed=5).train(training_frame=f)
+    assert m.tot_withinss() / m.totss() < 0.1
+
+
+def test_kmeans_standardize_destandardizes_centers(rng):
+    n = 500
+    x0 = rng.normal(scale=100.0, size=n)
+    x1 = rng.normal(scale=0.01, size=n)
+    f = Frame.from_arrays({"x0": x0, "x1": x1})
+    m = KMeans(k=2, standardize=True, seed=1).train(training_frame=f)
+    c = m.centers()
+    assert np.abs(c[:, 0]).max() > 1.0  # back on the raw scale
+
+
+# -- PCA ---------------------------------------------------------------------
+
+def test_pca_matches_numpy(rng):
+    n = 400
+    Z = rng.normal(size=(n, 3)) @ np.array([[3, 0, 0], [1, 1, 0], [0, 0, 0.2]])
+    f = Frame.from_arrays({f"x{i}": Z[:, i] for i in range(3)})
+    m = PCA(k=3, transform="DEMEAN").train(training_frame=f)
+    Zc = Z - Z.mean(axis=0)
+    cov = Zc.T @ Zc / (n - 1)
+    evals = np.sort(np.linalg.eigvalsh(cov))[::-1]
+    np.testing.assert_allclose(m.output["eigenvalues"], evals, rtol=0.02)
+    # scores should reproduce the variance structure
+    S = m.predict(f)
+    s0 = S.vec("PC1").to_numpy()
+    assert abs(np.var(s0, ddof=1) - evals[0]) / evals[0] < 0.05
+
+
+def test_pca_transform_standardize(rng):
+    n = 300
+    Z = np.column_stack([rng.normal(scale=100, size=n), rng.normal(size=n)])
+    f = Frame.from_arrays({"a": Z[:, 0], "b": Z[:, 1]})
+    m = PCA(k=2, transform="STANDARDIZE").train(training_frame=f)
+    # standardized: total variance = #cols
+    assert abs(m.output["total_variance"] - 2.0) < 0.1
+
+
+# -- SVD ---------------------------------------------------------------------
+
+def test_svd_matches_numpy(rng):
+    n = 300
+    Z = rng.normal(size=(n, 4))
+    f = Frame.from_arrays({f"x{i}": Z[:, i] for i in range(4)})
+    m = SVD(nv=4, transform="NONE").train(training_frame=f)
+    # singular values of the padded device matrix equal those of Z
+    ref = np.linalg.svd(Z, compute_uv=False)
+    np.testing.assert_allclose(np.sort(m.output["d"]), np.sort(ref), rtol=0.01)
+    U = m.predict(f)
+    u1 = U.vec("u1").to_numpy()
+    assert abs(np.linalg.norm(u1) - 1.0) < 0.05
+
+
+# -- GLRM --------------------------------------------------------------------
+
+def test_glrm_low_rank_reconstruction(rng):
+    n, k = 400, 2
+    A = rng.normal(size=(n, k))
+    Y = rng.normal(size=(k, 5))
+    X = A @ Y + 0.01 * rng.normal(size=(n, 5))
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(5)})
+    m = GLRM(k=2, max_iterations=50, seed=3).train(training_frame=f)
+    R = m.predict(f)
+    rec = np.column_stack([R.vec(i).to_numpy() for i in range(5)])[:n]
+    rel = np.linalg.norm(rec - X) / np.linalg.norm(X)
+    assert rel < 0.05, rel
+    arch = m.archetypes()
+    assert arch.shape == (2, 5)
+    T = m.transform_frame(f)
+    assert T.ncols == 2
+
+
+def test_glrm_missing_values_imputation(rng):
+    n, k = 300, 2
+    A = rng.normal(size=(n, k))
+    Y = rng.normal(size=(k, 4))
+    X = A @ Y
+    Xo = X.copy()
+    miss = rng.uniform(size=X.shape) < 0.2
+    Xo[miss] = np.nan
+    f = Frame.from_arrays({f"x{i}": Xo[:, i] for i in range(4)})
+    m = GLRM(k=2, max_iterations=80, seed=3).train(training_frame=f)
+    R = m.predict(f)
+    rec = np.column_stack([R.vec(i).to_numpy() for i in range(4)])[:n]
+    # imputed cells should approximate the true low-rank values
+    err = np.abs(rec[miss] - X[miss]).mean()
+    scale = np.abs(X[miss]).mean()
+    assert err < 0.2 * scale, (err, scale)
+
+
+def test_glrm_nonneg_regularizer(rng):
+    X = np.abs(rng.normal(size=(200, 4)))
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(4)})
+    m = GLRM(k=2, regularization_x="NonNegative", regularization_y="NonNegative",
+             gamma_x=0.01, gamma_y=0.01, max_iterations=30, init="Random",
+             seed=3).train(training_frame=f)
+    assert m.archetypes().min() >= 0.0
+    assert np.asarray(m.output["x_factor"]).min() >= 0.0
+
+
+# -- NaiveBayes --------------------------------------------------------------
+
+def test_naive_bayes_gaussian(rng):
+    n = 1200
+    yi = rng.integers(0, 2, size=n)
+    X = np.where(yi[:, None] == 1, 2.5, -2.5) + rng.normal(size=(n, 3))
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.array(["a", "b"], dtype=object)[yi]
+    f = Frame.from_arrays(cols)
+    m = NaiveBayes().train(y="y", training_frame=f)
+    assert m.training_metrics.auc > 0.99
+
+
+def test_naive_bayes_categorical_laplace(rng):
+    n = 1000
+    yi = rng.integers(0, 2, size=n)
+    # feature correlated with class
+    g = np.where(rng.uniform(size=n) < 0.8, yi, 1 - yi)
+    f = Frame.from_arrays({
+        "g": np.array(["u", "v"], dtype=object)[g],
+        "y": np.array(["a", "b"], dtype=object)[yi]})
+    m = NaiveBayes(laplace=1.0).train(y="y", training_frame=f)
+    acc = (m.predict(f).vec("predict").to_numpy()[:n] == yi).mean()
+    assert acc > 0.75
+    probs = np.exp(np.asarray(m.output["cat_logp"][0]))
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=0.01)
+
+
+def test_naive_bayes_mixed_with_missing(rng):
+    n = 800
+    yi = rng.integers(0, 2, size=n)
+    x = np.where(yi == 1, 2.0, -2.0) + rng.normal(size=n)
+    x[rng.uniform(size=n) < 0.1] = np.nan
+    g = np.where(rng.uniform(size=n) < 0.7, yi, 1 - yi)
+    garr = np.array(["u", "v"], dtype=object)[g]
+    garr[rng.uniform(size=n) < 0.1] = None
+    f = Frame.from_arrays({"x": x, "g": garr,
+                           "y": np.array(["a", "b"], dtype=object)[yi]})
+    m = NaiveBayes().train(y="y", training_frame=f)
+    assert m.training_metrics.auc > 0.9
