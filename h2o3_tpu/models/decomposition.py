@@ -18,8 +18,6 @@ pair of MXU matmuls + a [k,k] Cholesky, jitted as one program per sweep.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,10 +45,12 @@ def _make_data_info(frame: Frame, x, transform: str,
         di.num_mul = np.where((sigmas > 0) & np.isfinite(sigmas),
                               1.0 / np.maximum(sigmas, 1e-30), 1.0).astype(np.float32)
     elif t == "NORMALIZE":
-        sigmas = np.array([frame.vec(c).sigma() for c in di.num_cols], np.float32)
+        # (x - mean) / (max - min), per DataInfo.java TransformType.NORMALIZE
+        rng = np.array([frame.vec(c).max() - frame.vec(c).min()
+                        for c in di.num_cols], np.float32)
         di.num_sub = di.num_means.copy()
-        di.num_mul = np.where((sigmas > 0) & np.isfinite(sigmas),
-                              1.0 / np.maximum(sigmas, 1e-30), 1.0).astype(np.float32)
+        di.num_mul = np.where((rng > 0) & np.isfinite(rng),
+                              1.0 / np.maximum(rng, 1e-30), 1.0).astype(np.float32)
     elif t == "NONE":
         di.num_sub = np.zeros_like(di.num_sub)
         di.num_mul = np.ones_like(di.num_mul)
@@ -109,6 +109,9 @@ class PCA(ModelBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> PCAModel:
         p = self.params
+        if str(p["pca_method"]) != "GramSVD":
+            raise NotImplementedError(
+                f"pca_method={p['pca_method']!r} not implemented (have GramSVD)")
         k = int(p["k"])
         di = _make_data_info(frame, x, p["transform"],
                              bool(p.get("use_all_factor_levels", False)))
@@ -191,6 +194,9 @@ class SVD(ModelBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> SVDModel:
         p = self.params
+        if str(p["svd_method"]) != "GramSVD":
+            raise NotImplementedError(
+                f"svd_method={p['svd_method']!r} not implemented (have GramSVD)")
         di = _make_data_info(frame, x, p["transform"],
                              bool(p.get("use_all_factor_levels", False)))
         X = di.expand(frame)
@@ -267,7 +273,12 @@ def _expand_masked(di: DataInfo, frame: Frame, row_ok) -> tuple[jax.Array, jax.A
     for ci, c in enumerate(di.cat_cols):
         width = len(di.cat_domains[ci]) - (0 if di.use_all_factor_levels else 1)
         if width > 0:
-            ok = (frame.vec(c).data >= 0)
+            v = frame.vec(c)
+            codes = v.data
+            if v.domain != di.cat_domains[ci]:
+                from h2o3_tpu.models.data_info import _remap_codes
+                codes = _remap_codes(codes, v.domain or (), di.cat_domains[ci])
+            ok = codes >= 0
             M = M.at[:, col:col + width].set(M[:, col:col + width] * ok[:, None])
             col += width
     for ni, c in enumerate(di.num_cols):

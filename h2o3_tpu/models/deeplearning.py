@@ -331,6 +331,10 @@ class DeepLearning(ModelBuilder):
                 loss = "crossentropy" if nclasses else "quadratic"
             if nclasses and loss != "crossentropy":
                 raise ValueError("classification requires CrossEntropy loss")
+            if not nclasses and loss == "crossentropy":
+                raise ValueError("CrossEntropy loss requires a categorical "
+                                 "response (reference: DeepLearningParameters "
+                                 "validation)")
         yy = jnp.where(w > 0, yy, 0.0)
 
         hidden = [int(h) for h in p["hidden"]]
@@ -346,8 +350,14 @@ class DeepLearning(ModelBuilder):
                "v": jax.tree.map(jnp.zeros_like, params)}
 
         hid_drops = p["hidden_dropout_ratios"]
+        if hid_drops is not None and not act_dropout:
+            raise ValueError("hidden_dropout_ratios require a *WithDropout "
+                             "activation (reference: DeepLearningParameters "
+                             "validation)")
         if hid_drops is None:
             hid_drops = [0.5] * len(hidden) if act_dropout else [0.0] * len(hidden)
+        if len(hid_drops) != len(hidden):
+            raise ValueError("hidden_dropout_ratios must match hidden length")
         cfg = (bool(p["adaptive_rate"]), float(p["rho"]), float(p["epsilon"]),
                float(p["rate"]), float(p["rate_annealing"]), float(p["rate_decay"]),
                float(p["momentum_start"]), float(p["momentum_ramp"]),

@@ -1,0 +1,319 @@
+"""Isolation Forest + Extended Isolation Forest — anomaly detection.
+
+Reference: ``hex/tree/isofor/IsolationForest.java`` (random-split trees on
+per-tree subsamples, anomaly score normalized by the min/max path length seen
+in training) and ``hex/tree/isoforextended/ExtendedIsolationForest.java``
+(non-axis-parallel hyperplane splits, Liu et al. anomaly score
+``2^(-E[h]/c(psi))``).
+
+TPU-native redesign: unlike GBM there are no histograms — splits are *random*,
+so each level of every tree is a tiny vectorized program over the subsample
+(per-node ``segment_min``/``segment_max`` for the split range, uniform draws,
+gather-routing). Axis-parallel trees reuse the dense-heap ``Tree`` layout of
+``tree.py`` with leaf values = path length (depth + c(n) tail correction), so
+scoring a full frame is the same stacked-gather traversal as GBM — one fused
+XLA program, no per-row recursion. Extended trees store per-node hyperplane
+normals ``[heap, F]`` and traverse by masked dot products.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.gbm import tree_matrix
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.models.tree import Tree, predict_raw
+
+EULER_GAMMA = 0.5772156649015329
+
+
+def _avg_path_norm(n):
+    """c(n): expected unsuccessful-search path length in a BST of n points."""
+    n = np.asarray(n, np.float64)
+    c = 2.0 * (np.log(np.maximum(n - 1, 1)) + EULER_GAMMA) - 2.0 * (n - 1) / np.maximum(n, 1)
+    return np.where(n > 2, c, np.where(n == 2, 1.0, 0.0))
+
+
+class IsolationForestModel(Model):
+    algo = "isolationforest"
+
+    def _mean_length(self, frame: Frame) -> jax.Array:
+        X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
+        total = predict_raw(X, self.output["trees"])
+        return total / max(self.output["ntrees"], 1)
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        return self._mean_length(frame)
+
+    def predict(self, frame: Frame) -> Frame:
+        """Columns ``predict`` (normalized anomaly score) and ``mean_length``
+        (reference: IsolationForestModel score0 normalizes by the train-time
+        min/max path length)."""
+        mean_len = self._mean_length(frame)
+        lo, hi = self.output["min_path_length"], self.output["max_path_length"]
+        score = jnp.clip((hi - mean_len) / max(hi - lo, 1e-12), 0.0, 1.0)
+        n = frame.nrows
+        return Frame(["predict", "mean_length"],
+                     [Vec.from_device(score, n, VecType.NUM),
+                      Vec.from_device(mean_len, n, VecType.NUM)])
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+class _IsoForBase(ModelBuilder):
+    unsupervised = True
+    supports_classification = False
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(super().defaults(), ntrees=50, sample_size=256, max_depth=8)
+
+    def _matrix(self, frame: Frame, x: list[str], weights):
+        X = tree_matrix(frame, x, {})
+        valid = np.asarray(jax.device_get(weights > 0)).nonzero()[0]
+        if len(valid) == 0:
+            raise ValueError("no rows with positive weight")
+        domains = {c: frame.vec(c).domain for c in x if frame.vec(c).is_categorical}
+        return X, valid, domains
+
+
+def _grow_iso_tree(Xs: np.ndarray, max_depth: int, rng: np.random.Generator) -> Tree:
+    """One random-split tree over the subsample, level-synchronous on host.
+
+    The subsample is tiny (default 256 rows), so growth runs in numpy; the
+    expensive part — scoring millions of rows — stays on device via
+    ``predict_raw``. NaNs route to a per-node random side."""
+    n, F = Xs.shape
+    heap = 2 ** (max_depth + 1) - 1
+    hf = np.full(heap, -1, np.int32)
+    htv = np.zeros(heap, np.float32)
+    hna = np.zeros(heap, bool)
+    hsp = np.zeros(heap, bool)
+    hlf = np.zeros(heap, np.float32)
+
+    node = np.zeros(n, np.int64)  # heap position per row; -1 = frozen
+    for d in range(max_depth + 1):
+        off = 2 ** d - 1
+        N = 2 ** d
+        live = node >= 0
+        if not live.any():
+            break
+        ids = np.where(live, node - off, 0)
+        counts = np.bincount(ids[live], minlength=N)
+        if d == max_depth:
+            hlf[off:off + N] = d + _avg_path_norm(counts)
+            break
+        feats = rng.integers(0, F, N)
+        fv = Xs[np.arange(n), feats[ids]]
+        fv_ok = live & ~np.isnan(fv)
+        big = np.where(fv_ok, fv, np.inf)
+        small = np.where(fv_ok, fv, -np.inf)
+        mins = np.full(N, np.inf)
+        maxs = np.full(N, -np.inf)
+        np.minimum.at(mins, ids[live], big[live])
+        np.maximum.at(maxs, ids[live], small[live])
+        can = (counts > 1) & np.isfinite(mins) & np.isfinite(maxs) & (maxs > mins)
+        lo = np.where(can, mins, 0.0)
+        hi = np.where(can, maxs, 0.0)
+        thr = (rng.uniform(0, 1, N) * (hi - lo) + lo).astype(np.float32)
+        na_left = rng.integers(0, 2, N).astype(bool)
+        hf[off:off + N] = np.where(can, feats, -1)
+        htv[off:off + N] = thr
+        hna[off:off + N] = na_left
+        hsp[off:off + N] = can
+        hlf[off:off + N] = np.where(can, 0.0, d + _avg_path_norm(counts))
+        # route rows of splitting nodes to children
+        go = live & can[ids]
+        left = np.where(np.isnan(fv), na_left[ids], fv < thr[ids])
+        child = (off + ids) * 2 + np.where(left, 1, 2)
+        node = np.where(go, child, -1)
+
+    return Tree(feat=jnp.asarray(hf), thresh_bin=jnp.zeros(heap, jnp.int32),
+                thresh_val=jnp.asarray(htv), na_left=jnp.asarray(hna),
+                is_split=jnp.asarray(hsp), leaf=jnp.asarray(hlf))
+
+
+class IsolationForest(_IsoForBase):
+    """h2o-py surface: ``H2OIsolationForestEstimator``."""
+
+    algo = "isolationforest"
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> IsolationForestModel:
+        p = self.params
+        X, valid, domains = self._matrix(frame, x, weights)
+        Xh = np.asarray(jax.device_get(X))
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xC0FFEE
+        rng = np.random.default_rng(seed)
+        ntrees = int(p["ntrees"])
+        trees: list[Tree] = []
+        for m in range(ntrees):
+            sub = rng.choice(valid, size=min(int(p["sample_size"]), len(valid)),
+                             replace=False)
+            trees.append(_grow_iso_tree(Xh[sub], int(p["max_depth"]), rng))
+            job.update((m + 1) / ntrees, f"tree {m + 1}/{ntrees}")
+
+        model = IsolationForestModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=None,
+            response_domain=None,
+            output=dict(trees=trees, ntrees=len(trees), x_cols=list(x),
+                        feat_domains=domains, min_path_length=0.0,
+                        max_path_length=1.0))
+        # train-time path-length range for score normalization (reference:
+        # IsolationForest driver records _min/_max path length over training rows)
+        mean_len = np.asarray(jax.device_get(model._mean_length(frame)))[valid]
+        model.output["min_path_length"] = float(mean_len.min())
+        model.output["max_path_length"] = float(mean_len.max())
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Extended Isolation Forest
+# ---------------------------------------------------------------------------
+
+class ExtendedIsolationForestModel(Model):
+    algo = "extendedisolationforest"
+
+    def _mean_length(self, frame: Frame) -> jax.Array:
+        X = jnp.nan_to_num(
+            tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"]))
+        o = self.output
+        return _eif_path_lengths(X, o["normals"], o["offsets"], o["is_split"],
+                                 o["leaf"]) / max(o["ntrees"], 1)
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        return self._mean_length(frame)
+
+    def predict(self, frame: Frame) -> Frame:
+        """Columns ``anomaly_score`` (2^(-E[h]/c(psi))) and ``mean_length``
+        (reference: ExtendedIsolationForestModel.score0)."""
+        mean_len = self._mean_length(frame)
+        score = jnp.exp2(-mean_len / max(self.output["cn"], 1e-12))
+        n = frame.nrows
+        return Frame(["anomaly_score", "mean_length"],
+                     [Vec.from_device(score, n, VecType.NUM),
+                      Vec.from_device(mean_len, n, VecType.NUM)])
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+@jax.jit
+def _eif_path_lengths(X, normals, offsets, is_split, leaf):
+    """Sum of per-tree path lengths. normals: [T, heap, F]; X: [rows, F]."""
+    rows = X.shape[0]
+    depth = int(np.log2(normals.shape[1] + 1)) - 1
+
+    def one_tree(acc, tr):
+        nv, off, sp, lf = tr
+        idx = jnp.zeros(rows, jnp.int32)
+        for _ in range(depth):
+            proj = jnp.einsum("rf,rf->r", X, nv[idx]) - off[idx]
+            nxt = idx * 2 + jnp.where(proj <= 0, 1, 2)
+            idx = jnp.where(sp[idx], nxt, idx)
+        return acc + lf[idx], None
+
+    acc, _ = jax.lax.scan(one_tree, jnp.zeros(rows, jnp.float32),
+                          (normals, offsets, is_split, leaf))
+    return acc
+
+
+def _grow_eif_tree(Xs: np.ndarray, max_depth: int, ext_level: int,
+                   rng: np.random.Generator):
+    """One extended tree: per-node random hyperplane (normal with
+    ``ext_level+1`` non-zero coords, intercept uniform in the node's bounding
+    box). Reference: ExtendedIsolationForestSplitter semantics."""
+    n, F = Xs.shape
+    heap = 2 ** (max_depth + 1) - 1
+    normals = np.zeros((heap, F), np.float32)
+    offsets = np.zeros(heap, np.float32)
+    hsp = np.zeros(heap, bool)
+    hlf = np.zeros(heap, np.float32)
+
+    node = np.zeros(n, np.int64)
+    for d in range(max_depth + 1):
+        off = 2 ** d - 1
+        N = 2 ** d
+        live = node >= 0
+        if not live.any():
+            break
+        ids = np.where(live, node - off, 0)
+        counts = np.bincount(ids[live], minlength=N)
+        if d == max_depth:
+            hlf[off:off + N] = d + _avg_path_norm(counts)
+            break
+        # bounding box per node
+        mins = np.full((N, F), np.inf)
+        maxs = np.full((N, F), -np.inf)
+        np.minimum.at(mins, ids[live], Xs[live])
+        np.maximum.at(maxs, ids[live], Xs[live])
+        can = counts > 1
+        # normal vectors: N(0,1) with F-1-ext_level coords zeroed
+        nv = rng.normal(size=(N, F)).astype(np.float32)
+        keep = np.argsort(rng.uniform(size=(N, F)), axis=1) <= ext_level
+        nv = nv * keep
+        box = np.where(np.isfinite(mins) & np.isfinite(maxs), maxs - mins, 0.0)
+        p = np.where(np.isfinite(mins), mins, 0.0) + rng.uniform(size=(N, F)) * box
+        ofs = np.einsum("nf,nf->n", nv, p).astype(np.float32)
+        normals[off:off + N] = np.where(can[:, None], nv, 0.0)
+        offsets[off:off + N] = np.where(can, ofs, 0.0)
+        hsp[off:off + N] = can
+        hlf[off:off + N] = np.where(can, 0.0, d + _avg_path_norm(counts))
+        proj = np.einsum("rf,rf->r", Xs, nv[ids]) - ofs[ids]
+        go = live & can[ids]
+        child = (off + ids) * 2 + np.where(proj <= 0, 1, 2)
+        node = np.where(go, child, -1)
+
+    return normals, offsets, hsp, hlf
+
+
+class ExtendedIsolationForest(_IsoForBase):
+    """h2o-py surface: ``H2OExtendedIsolationForestEstimator``."""
+
+    algo = "extendedisolationforest"
+
+    @classmethod
+    def defaults(cls) -> dict:
+        d = dict(super().defaults(), extension_level=0)
+        d["ntrees"] = 100
+        # reference EIF has no max_depth param: depth is ceil(log2(sample_size))
+        del d["max_depth"]
+        return d
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> ExtendedIsolationForestModel:
+        p = self.params
+        X, valid, domains = self._matrix(frame, x, weights)
+        Xh = np.nan_to_num(np.asarray(jax.device_get(X)))
+        F = Xh.shape[1]
+        ext = int(p["extension_level"])
+        if not 0 <= ext <= F - 1:
+            raise ValueError(f"extension_level must be in [0, {F - 1}]")
+        sample_size = min(int(p["sample_size"]), len(valid))
+        max_depth = int(np.ceil(np.log2(max(sample_size, 2))))
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 0xC0FFEE
+        rng = np.random.default_rng(seed)
+        ntrees = int(p["ntrees"])
+        parts = []
+        for m in range(ntrees):
+            sub = rng.choice(valid, size=sample_size, replace=False)
+            parts.append(_grow_eif_tree(Xh[sub], max_depth, ext, rng))
+            job.update((m + 1) / ntrees, f"tree {m + 1}/{ntrees}")
+
+        return ExtendedIsolationForestModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=None,
+            response_domain=None,
+            output=dict(
+                normals=jnp.asarray(np.stack([t[0] for t in parts])),
+                offsets=jnp.asarray(np.stack([t[1] for t in parts])),
+                is_split=jnp.asarray(np.stack([t[2] for t in parts])),
+                leaf=jnp.asarray(np.stack([t[3] for t in parts])),
+                ntrees=ntrees, x_cols=list(x), feat_domains=domains,
+                cn=float(_avg_path_norm(sample_size))))

@@ -15,8 +15,6 @@ Only the scalar convergence test crosses to host per iteration.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -30,7 +28,7 @@ from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
 
 
 @jax.jit
-def _sq_dists(X, C, w):
+def _sq_dists(X, C):
     """[rows, k] squared distances (rows with w=0 still computed, masked later)."""
     x2 = (X * X).sum(axis=1, keepdims=True)
     c2 = (C * C).sum(axis=1)[None, :]
@@ -40,7 +38,7 @@ def _sq_dists(X, C, w):
 @jax.jit
 def _lloyd_step(X, w, C):
     """One Lloyd iteration → (new centers, within-SS, assignment counts)."""
-    d2 = _sq_dists(X, C, w)
+    d2 = _sq_dists(X, C)
     assign = jnp.argmin(d2, axis=1)
     wss = (w * jnp.min(d2, axis=1)).sum()
     onehot = (assign[:, None] == jnp.arange(C.shape[0])[None, :]).astype(X.dtype) \
@@ -55,7 +53,7 @@ def _lloyd_step(X, w, C):
 
 @jax.jit
 def _assign(X, C):
-    d2 = _sq_dists(X, C, jnp.ones(X.shape[0], X.dtype))
+    d2 = _sq_dists(X, C)
     return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
 
 
@@ -76,7 +74,8 @@ class KMeansModel(Model):
 
     def predict(self, frame: Frame) -> Frame:
         assign = self._score_raw(frame).astype(jnp.int32)
-        dom = tuple(str(i) for i in range(self.params["k"]))
+        # estimate_k may settle on fewer clusters than params["k"]
+        dom = tuple(str(i) for i in range(self.output["centers_std"].shape[0]))
         return Frame(["predict"],
                      [Vec.from_device(assign, frame.nrows, VecType.CAT, domain=dom)])
 
@@ -140,7 +139,7 @@ class KMeans(ModelBuilder):
         centers = [X[first]]
         for _ in range(1, k):
             C = jnp.stack(centers)
-            d2 = _sq_dists(X, C, w).min(axis=1)
+            d2 = _sq_dists(X, C).min(axis=1)
             if mode == "furthest":
                 nxt = jnp.argmax(jnp.where(w > 0, d2, -jnp.inf))
             else:  # plusplus: sample ∝ D²
@@ -148,6 +147,20 @@ class KMeans(ModelBuilder):
                 nxt = _weighted_row_choice(sub, d2, w)
             centers.append(X[nxt])
         return jnp.stack(centers)
+
+    def _run_lloyd(self, job: Job, X, w, C) -> tuple[jax.Array, float, int]:
+        """Lloyd to convergence; returns (centers, tot_withinss, iters)."""
+        wss_v, wss_prev, iters = np.inf, np.inf, 0
+        for it in range(max(int(self.params["max_iterations"]), 1)):
+            C, wss, _ = _lloyd_step(X, w, C)
+            wss_v = float(jax.device_get(wss))
+            iters = it + 1
+            job.update(iters / max(int(self.params["max_iterations"]), 1),
+                       f"k={C.shape[0]} iter {iters} within-SS {wss_v:.4f}")
+            if np.isfinite(wss_prev) and abs(wss_prev - wss_v) <= 1e-7 * max(wss_prev, 1.0):
+                break
+            wss_prev = wss_v
+        return C, wss_v, iters
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> KMeansModel:
         p = self.params
@@ -162,20 +175,30 @@ class KMeans(ModelBuilder):
         seed = int(p.get("seed") or -1)
         key = jax.random.PRNGKey(seed if seed >= 0 else 1234)
 
-        mode = str(p["init"]).lower()
-        C = self._init_centers(key, X, w, k, mode)
-
-        wss_prev = np.inf
-        iters = 0
-        for it in range(max(int(p["max_iterations"]), 1)):
-            C, wss, counts = _lloyd_step(X, w, C)
-            wss_v = float(jax.device_get(wss))
-            iters = it + 1
-            job.update(iters / max(int(p["max_iterations"]), 1),
-                       f"iter {iters} within-SS {wss_v:.4f}")
-            if np.isfinite(wss_prev) and abs(wss_prev - wss_v) <= 1e-7 * max(wss_prev, 1.0):
-                break
-            wss_prev = wss_v
+        if bool(p["estimate_k"]):
+            if p["user_points"] is not None:
+                raise ValueError("Cannot estimate k if user_points are provided.")
+            # reference KMeans.java:284-420: deterministic growth from k=1,
+            # accept each added centroid while the relative within-SS
+            # improvement beats min(0.02 + 10/nrows + 2.5/nfeatures^2, 0.8)
+            nrows = max(float(jax.device_get(w.sum())), 1.0)
+            cutoff = min(0.02 + 10.0 / nrows + 2.5 / max(X.shape[1], 1) ** 2, 0.8)
+            C = ((w[:, None] * X).sum(axis=0) / jnp.maximum(w.sum(), 1e-12))[None, :]
+            C, wss_best, iters = self._run_lloyd(job, X, w, C)
+            for k_try in range(2, k + 1):
+                d2 = _sq_dists(X, C).min(axis=1)
+                nxt = jnp.argmax(jnp.where(w > 0, d2, -jnp.inf))
+                Cand = jnp.concatenate([C, X[nxt][None, :]], axis=0)
+                Cand, wss_now, it2 = self._run_lloyd(job, X, w, Cand)
+                rel = (wss_best - wss_now) / max(wss_best, 1e-30)
+                if rel < cutoff:
+                    break
+                C, wss_best, iters = Cand, wss_now, it2
+            k = C.shape[0]
+        else:
+            mode = str(p["init"]).lower()
+            C = self._init_centers(key, X, w, k, mode)
+            C, _, iters = self._run_lloyd(job, X, w, C)
 
         # final stats on converged centers
         assign, d2 = _assign(X, C)

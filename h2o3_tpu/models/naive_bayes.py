@@ -140,18 +140,19 @@ class NaiveBayes(ModelBuilder):
         for j, card in enumerate(cards):
             tbl = cat_tables[j] + lap
             probs = tbl / jnp.maximum(tbl.sum(axis=1, keepdims=True), 1e-30)
-            # reference: probs below the eps_prob cutoff snap to min_prob,
-            # and min_prob is also the absolute floor
-            probs = jnp.where(probs < eps_prob, min_prob,
-                              jnp.maximum(probs, min_prob))
+            # reference: min_prob substitutes ONLY when prob <= eps_prob
+            # (NaiveBayesModel.java:94); legitimately small probs are kept
+            probs = jnp.where(probs <= eps_prob, min_prob, jnp.maximum(probs, 1e-30))
             cat_logp.append(jnp.log(probs))
         if num_cols:
             n = jnp.maximum(cnt, 1e-12)
             mu = s1 / n
             var = jnp.maximum(s2 / n - mu * mu, 0.0) * n / jnp.maximum(n - 1.0, 1.0)
             min_sdev, eps_sdev = float(p["min_sdev"]), float(p["eps_sdev"])
+            # reference: min_sdev substitutes ONLY when sd <= eps_sdev
+            # (NaiveBayesModel.java:103)
             sd = jnp.sqrt(var)
-            sd = jnp.where(sd < eps_sdev, min_sdev, jnp.maximum(sd, min_sdev))
+            sd = jnp.where(sd <= eps_sdev, min_sdev, sd)
         else:
             mu = sd = jnp.zeros((nclass, 0), jnp.float32)
 

@@ -122,3 +122,18 @@ def test_dl_validation_frame(rng):
                                                validation_frame=va)
     assert m.validation_metrics is not None
     assert m.validation_metrics.accuracy > 0.9
+
+
+def test_dl_rejects_crossentropy_for_regression(rng):
+    n = 200
+    f = Frame.from_arrays({"x": rng.normal(size=n), "y": rng.normal(size=n)})
+    with pytest.raises(ValueError, match="CrossEntropy"):
+        DeepLearning(hidden=[8], epochs=1, loss="CrossEntropy",
+                     ).train(y="y", training_frame=f)
+
+
+def test_dl_rejects_dropout_ratios_without_dropout_activation(rng):
+    f = _blobs(rng, n=200)
+    with pytest.raises(ValueError, match="WithDropout"):
+        DeepLearning(hidden=[8], epochs=1, activation="Rectifier",
+                     hidden_dropout_ratios=[0.5]).train(y="y", training_frame=f)

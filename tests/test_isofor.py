@@ -1,0 +1,59 @@
+"""IsolationForest / ExtendedIsolationForest tests (reference test model:
+h2o-py ``testdir_algos/isoforest/pyunit_*``, ``isoforextended/pyunit_*``)."""
+
+import numpy as np
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import ExtendedIsolationForest, IsolationForest
+
+
+def _anomaly_data(rng, n=800, n_out=20):
+    X = rng.normal(size=(n, 4))
+    X[:n_out] += 8.0  # planted outliers
+    return Frame.from_arrays({f"x{j}": X[:, j] for j in range(4)}), n_out
+
+
+def test_isofor_flags_outliers(rng):
+    f, n_out = _anomaly_data(rng)
+    m = IsolationForest(ntrees=60, seed=7).train(training_frame=f)
+    pred = m.predict(f)
+    assert pred.names == ["predict", "mean_length"]
+    score = pred.vec("predict").to_numpy()
+    assert score.min() >= 0.0 and score.max() <= 1.0
+    # the planted outliers should dominate the top-scoring rows
+    top = np.argsort(-score)[:n_out]
+    assert len(set(top) & set(range(n_out))) >= n_out * 3 // 4
+    # outliers isolate faster: shorter mean path length
+    ml = pred.vec("mean_length").to_numpy()
+    assert ml[:n_out].mean() < ml[n_out:].mean()
+
+
+def test_isofor_sample_size_and_depth(rng):
+    f, _ = _anomaly_data(rng, n=300)
+    m = IsolationForest(ntrees=10, sample_size=64, max_depth=5, seed=1,
+                        ).train(training_frame=f)
+    assert m.output["ntrees"] == 10
+    assert m.output["max_path_length"] > m.output["min_path_length"]
+
+
+def test_eif_flags_outliers(rng):
+    f, n_out = _anomaly_data(rng)
+    m = ExtendedIsolationForest(ntrees=80, extension_level=1, seed=7,
+                                ).train(training_frame=f)
+    pred = m.predict(f)
+    assert pred.names == ["anomaly_score", "mean_length"]
+    score = pred.vec("anomaly_score").to_numpy()
+    assert (score > 0).all() and (score < 1).all()
+    top = np.argsort(-score)[:n_out]
+    assert len(set(top) & set(range(n_out))) >= n_out * 3 // 4
+
+
+def test_eif_extension_level_0_matches_axis_parallel_semantics(rng):
+    f, _ = _anomaly_data(rng, n=200)
+    m = ExtendedIsolationForest(ntrees=20, extension_level=0, seed=3,
+                                ).train(training_frame=f)
+    # every split normal has exactly one non-zero coordinate
+    normals = np.asarray(m.output["normals"])
+    sp = np.asarray(m.output["is_split"])
+    nz = (normals != 0).sum(axis=2)
+    assert (nz[sp] == 1).all()

@@ -185,3 +185,35 @@ def test_naive_bayes_mixed_with_missing(rng):
                            "y": np.array(["a", "b"], dtype=object)[yi]})
     m = NaiveBayes().train(y="y", training_frame=f)
     assert m.training_metrics.auc > 0.9
+
+
+def test_kmeans_estimate_k_finds_three_clusters(rng):
+    # 6-D so the reference cutoff min(0.02 + 10/n + 2.5/F^2, 0.8) ~ 0.10;
+    # in 2-D even perfectly separated symmetric clusters cannot beat it
+    n = 900
+    centers = np.zeros((3, 6))
+    centers[0, 0] = centers[1, 1] = centers[2, 2] = 20.0
+    yi = rng.integers(0, 3, size=n)
+    X = centers[yi] + rng.normal(size=(n, 6))
+    f = Frame.from_arrays({f"x{j}": X[:, j] for j in range(6)})
+    m = KMeans(k=8, estimate_k=True, standardize=False, max_iterations=20,
+               ).train(training_frame=f)
+    assert m.output["centers_std"].shape[0] == 3
+
+
+def test_pca_normalize_uses_range_not_sigma(rng):
+    n = 400
+    x0 = rng.uniform(-1, 1, size=n)
+    x1 = rng.uniform(-100, 100, size=n)
+    f = Frame.from_arrays({"x0": x0, "x1": x1})
+    m = PCA(k=2, transform="NORMALIZE").train(training_frame=f)
+    di = m.data_info
+    rng0 = x0.max() - x0.min()
+    rng1 = x1.max() - x1.min()
+    np.testing.assert_allclose(di.num_mul, [1 / rng0, 1 / rng1], rtol=1e-5)
+
+
+def test_pca_unsupported_method_raises(rng):
+    f, *_ = _cluster_data(rng, n=120)
+    with pytest.raises(NotImplementedError):
+        PCA(k=1, pca_method="Power").train(training_frame=f)
