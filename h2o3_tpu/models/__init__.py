@@ -18,6 +18,9 @@ from h2o3_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from h2o3_tpu.models.isofor import (
     ExtendedIsolationForest, ExtendedIsolationForestModel,
     IsolationForest, IsolationForestModel)
+from h2o3_tpu.models.isotonic import IsotonicRegression, IsotonicRegressionModel
+from h2o3_tpu.models.coxph import CoxPH, CoxPHModel
+from h2o3_tpu.models.word2vec import Word2Vec, Word2VecModel
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
@@ -26,4 +29,6 @@ __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "KMeans", "KMeansModel", "PCA", "PCAModel", "SVD", "SVDModel",
            "GLRM", "GLRMModel", "NaiveBayes", "NaiveBayesModel",
            "IsolationForest", "IsolationForestModel",
-           "ExtendedIsolationForest", "ExtendedIsolationForestModel"]
+           "ExtendedIsolationForest", "ExtendedIsolationForestModel",
+           "IsotonicRegression", "IsotonicRegressionModel",
+           "CoxPH", "CoxPHModel", "Word2Vec", "Word2VecModel"]

@@ -26,7 +26,8 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
-from h2o3_tpu.models.tree import Tree, TreeParams, grow_tree, predict_binned, predict_raw
+from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree,
+                                  grow_trees_batched, predict_binned, predict_raw)
 from h2o3_tpu.ops.quantile import bin_features, compute_bin_edges, sample_rows_host
 
 
@@ -54,16 +55,35 @@ def _grad_hess(dist: str, F, y, w):
     return w * (F - y), w  # gaussian
 
 
+@jax.jit
+def _grad_hess_multinomial(F, y, w):
+    """Softmax gradients for all K classes at once (reference: GBM.java
+    multinomial pseudo-residuals). F: [rows, K]; y: int class ids."""
+    p = jax.nn.softmax(F, axis=1)
+    yoh = jax.nn.one_hot(y.astype(jnp.int32), F.shape[1], dtype=F.dtype)
+    return w[:, None] * (p - yoh), w[:, None] * jnp.maximum(p * (1 - p), 1e-10)
+
+
 class SharedTreeModel(Model):
     def _tree_raw_sum(self, frame: Frame) -> jax.Array:
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
         return predict_raw(X, self.output["trees"])
+
+    def _tree_raw_sum_per_class(self, frame: Frame) -> jax.Array:
+        """[rows, K] per-class sums for multinomial (trees_multi[k] = class k)."""
+        X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
+        return jnp.stack([predict_raw(X, ts) for ts in self.output["trees_multi"]],
+                         axis=1)
 
 
 class GBMModel(SharedTreeModel):
     algo = "gbm"
 
     def _score_raw(self, frame: Frame) -> jax.Array:
+        if self.output["distribution"] == "multinomial":
+            f = jnp.asarray(self.output["f0_multi"])[None, :] \
+                + self.output["learn_rate"] * self._tree_raw_sum_per_class(frame)
+            return jax.nn.softmax(f, axis=1)
         f = self.output["f0"] + self.output["learn_rate"] * self._tree_raw_sum(frame)
         if self.output["distribution"] == "bernoulli":
             p = jax.nn.sigmoid(f)
@@ -146,11 +166,12 @@ class GBM(SharedTreeBuilder):
         X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
         dist = str(p["distribution"])
         if yvec.is_categorical:
-            if yvec.cardinality() != 2:
-                raise ValueError("multinomial GBM not yet supported (binary or regression)")
-            if dist not in ("AUTO", "bernoulli"):
+            if dist not in ("AUTO", "bernoulli", "multinomial"):
                 raise ValueError(f"distribution {dist!r} requires a numeric response")
-            dist = "bernoulli"
+            if dist == "bernoulli" and yvec.cardinality() != 2:
+                raise ValueError("Binomial requires the response to be a 2-class "
+                                 "categorical")
+            dist = "bernoulli" if yvec.cardinality() == 2 else "multinomial"
         else:
             if dist == "AUTO":
                 dist = "gaussian"
@@ -161,6 +182,10 @@ class GBM(SharedTreeBuilder):
                                  "have gaussian, bernoulli, poisson, AUTO")
         w = weights * valid
         yc = jnp.where(w > 0, yy, 0.0)
+
+        if dist == "multinomial":
+            return self._fit_multinomial(job, frame, x, y, w, yc, yvec,
+                                         X, edges, binned, domains)
 
         ybar = float(jax.device_get((w * yc).sum() / jnp.maximum(w.sum(), 1e-30)))
         if dist == "bernoulli":
@@ -188,10 +213,12 @@ class GBM(SharedTreeBuilder):
             g, h = _grad_hess(dist, Fcur, yc, wt)
             key, k3 = jax.random.split(key)
             fmask = self._feat_mask(k2, X.shape[1], float(p["col_sample_rate_per_tree"]))
-            tree = grow_tree(binned, edges, g, h, wt, tp, fmask,
-                             col_rate=float(p["col_sample_rate"]), key=k3)
-            trees.append(tree)
-            Fcur = Fcur + lr * predict_binned(binned, [tree], tp.nbins)
+            new, preds = grow_trees_batched(binned, edges, g[None], h[None],
+                                            wt[None], tp, fmask,
+                                            col_rate=float(p["col_sample_rate"]),
+                                            key=k3)
+            trees.append(new[0])
+            Fcur = Fcur + lr * preds[0]
             job.update((m + 1) / ntrees, f"tree {m + 1}/{ntrees}")
 
         return GBMModel(
@@ -203,11 +230,62 @@ class GBM(SharedTreeBuilder):
                         ntrees=len(trees)),
         )
 
+    def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,
+                         X, edges, binned, domains) -> GBMModel:
+        """K one-vs-rest trees per round on softmax gradients (reference:
+        GBM.java multinomial — one DTree per class per iteration)."""
+        p = self.params
+        K = yvec.cardinality()
+        yoh = jax.nn.one_hot(yc.astype(jnp.int32), K) * w[:, None]
+        prior = np.asarray(jax.device_get(yoh.sum(axis=0)), np.float64)
+        prior = np.maximum(prior / max(prior.sum(), 1e-30), 1e-10)
+        f0 = np.log(prior).astype(np.float32)
+
+        tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
+                        min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
+                        reg_alpha=float(p.get("reg_alpha", 0.0)),
+                        gamma=float(p.get("gamma", 0.0)),
+                        min_split_improvement=float(p["min_split_improvement"]))
+        lr = float(p["learn_rate"])
+        seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
+        key = jax.random.PRNGKey(seed)
+        Fcur = jnp.broadcast_to(jnp.asarray(f0)[None, :], (X.shape[0], K)).astype(jnp.float32)
+        trees_multi: list[list[Tree]] = [[] for _ in range(K)]
+        ntrees = int(p["ntrees"])
+        for m in range(ntrees):
+            key, k1, k2, k3 = jax.random.split(key, 4)
+            wt = self._row_weights(k1, w, float(p["sample_rate"]), False)
+            G, H = _grad_hess_multinomial(Fcur, yc, wt)
+            fmask = self._feat_mask(k2, X.shape[1], float(p["col_sample_rate_per_tree"]))
+            wt_b = jnp.broadcast_to(wt[None, :], (K, wt.shape[0]))
+            # all K class trees of the round grow in ONE device dispatch
+            new, preds = grow_trees_batched(binned, edges, G.T, H.T, wt_b, tp,
+                                            fmask,
+                                            col_rate=float(p["col_sample_rate"]),
+                                            key=k3)
+            for k in range(K):
+                trees_multi[k].append(new[k])
+            Fcur = Fcur + lr * preds.T
+            job.update((m + 1) / ntrees, f"round {m + 1}/{ntrees} ({K} trees)")
+
+        return GBMModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=y,
+            response_domain=yvec.domain,
+            output=dict(trees_multi=trees_multi, edges=edges, f0_multi=f0,
+                        learn_rate=lr, distribution="multinomial",
+                        x_cols=list(x), feat_domains=domains, ntrees=ntrees),
+        )
+
 
 class DRFModel(SharedTreeModel):
     algo = "drf"
 
     def _score_raw(self, frame: Frame) -> jax.Array:
+        if self.output.get("trees_multi") is not None:
+            probs = jnp.clip(self._tree_raw_sum_per_class(frame)
+                             / max(self.output["ntrees"], 1), 0.0, 1.0)
+            return probs / jnp.maximum(probs.sum(axis=1, keepdims=True), 1e-30)
         mean = self._tree_raw_sum(frame) / max(self.output["ntrees"], 1)
         if self.output["binomial"]:
             pmean = jnp.clip(mean, 0.0, 1.0)
@@ -235,24 +313,48 @@ class DRF(SharedTreeBuilder):
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DRFModel:
         p = self.params
         X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
-        binomial = yvec.is_categorical
-        if binomial and yvec.cardinality() != 2:
-            raise ValueError("multinomial DRF not yet supported (binary or regression)")
+        classifier = yvec.is_categorical
+        nclass = yvec.cardinality() if classifier else 0
         w = weights * valid
         yc = jnp.where(w > 0, yy, 0.0)
 
         F = X.shape[1]
         mtries = int(p["mtries"])
         if mtries <= 0:
-            mtries = max(1, int(np.sqrt(F)) if binomial else max(F // 3, 1))
+            mtries = max(1, int(np.sqrt(F)) if classifier else max(F // 3, 1))
         tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
                         min_rows=float(p["min_rows"]), reg_lambda=0.0,
                         min_split_improvement=float(p["min_split_improvement"]))
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
-        trees: list[Tree] = []
         ntrees = int(p["ntrees"])
         fmask = jnp.ones(F, bool)
+
+        if nclass > 2:
+            # one class-indicator tree per class per round; leaf = in-node
+            # class fraction (reference: DRF.java multinomial ktrees)
+            yoh = jax.nn.one_hot(yc.astype(jnp.int32), nclass)
+            trees_multi: list[list[Tree]] = [[] for _ in range(nclass)]
+            for m in range(ntrees):
+                key, k1, k3 = jax.random.split(key, 3)
+                wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
+                wt_b = jnp.broadcast_to(wt[None, :], (nclass, wt.shape[0]))
+                new, _ = grow_trees_batched(binned, edges, -(yoh * wt[:, None]).T,
+                                            wt_b, wt_b, tp, fmask,
+                                            col_rate=mtries / F, key=k3)
+                for k in range(nclass):
+                    trees_multi[k].append(new[k])
+                job.update((m + 1) / ntrees, f"round {m + 1}/{ntrees}")
+            return DRFModel(
+                key=make_model_key(self.algo, self.model_id),
+                params=self.params, data_info=None, response_column=y,
+                response_domain=yvec.domain,
+                output=dict(trees_multi=trees_multi, edges=edges, ntrees=ntrees,
+                            binomial=False, x_cols=list(x), feat_domains=domains,
+                            f0=0.0, learn_rate=1.0, distribution="multinomial"),
+            )
+
+        trees: list[Tree] = []
         for m in range(ntrees):
             key, k1, k2 = jax.random.split(key, 3)
             wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
@@ -264,8 +366,8 @@ class DRF(SharedTreeBuilder):
         return DRFModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
-            response_domain=yvec.domain if binomial else None,
-            output=dict(trees=trees, edges=edges, ntrees=len(trees), binomial=binomial,
-                        x_cols=list(x), feat_domains=domains, f0=0.0, learn_rate=1.0,
-                        distribution="gaussian"),
+            response_domain=yvec.domain if classifier else None,
+            output=dict(trees=trees, edges=edges, ntrees=len(trees),
+                        binomial=classifier, x_cols=list(x), feat_domains=domains,
+                        f0=0.0, learn_rate=1.0, distribution="gaussian"),
         )

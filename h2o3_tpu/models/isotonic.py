@@ -1,0 +1,127 @@
+"""Isotonic regression — pool-adjacent-violators.
+
+Reference: ``hex/isotonic/IsotonicRegression.java`` (489 LoC): aggregates
+(x, y, w) by unique x with a distributed task, runs PAVA on the leader, stores
+the breakpoint thresholds; scoring interpolates linearly between thresholds
+with ``out_of_bounds`` NA/clip handling.
+
+TPU-native: aggregation of (sum_wy, sum_w) per unique x is a device
+``segment_sum`` over the sorted column (the MRTask reduce); the PAV merge
+itself is inherently sequential and runs on host over the (already tiny)
+unique-x table; scoring is a vectorized ``searchsorted`` + lerp on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import response_as_float
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _pav(ys: np.ndarray, ws: np.ndarray) -> np.ndarray:
+    """Weighted PAVA over block means (classic stack algorithm, O(n))."""
+    n = len(ys)
+    mean = np.empty(n)
+    weight = np.empty(n)
+    size = np.empty(n, np.int64)
+    top = 0
+    for i in range(n):
+        mean[top], weight[top], size[top] = ys[i], ws[i], 1
+        while top > 0 and mean[top - 1] >= mean[top]:
+            wsum = weight[top - 1] + weight[top]
+            mean[top - 1] = (mean[top - 1] * weight[top - 1]
+                             + mean[top] * weight[top]) / max(wsum, 1e-300)
+            weight[top - 1] = wsum
+            size[top - 1] += size[top]
+            top -= 1
+        top += 1
+    out = np.empty(n)
+    pos = 0
+    for b in range(top):
+        out[pos:pos + size[b]] = mean[b]
+        pos += size[b]
+    return out
+
+
+@jax.jit
+def _interp(x, tx, ty):
+    """Piecewise-linear interpolation through thresholds, clipped at the ends."""
+    idx = jnp.clip(jnp.searchsorted(tx, x, side="right") - 1, 0, tx.shape[0] - 2)
+    x0, x1 = tx[idx], tx[idx + 1]
+    y0, y1 = ty[idx], ty[idx + 1]
+    t = jnp.where(x1 > x0, (x - x0) / jnp.maximum(x1 - x0, 1e-30), 0.0)
+    return y0 + jnp.clip(t, 0.0, 1.0) * (y1 - y0)
+
+
+class IsotonicRegressionModel(Model):
+    algo = "isotonicregression"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        x = frame.vec(self.output["x_col"]).as_float()
+        tx = self.output["thresholds_x"]
+        ty = self.output["thresholds_y"]
+        pred = _interp(jnp.clip(x, self.output["min_x"], self.output["max_x"]), tx, ty)
+        if str(self.params.get("out_of_bounds", "NA")).upper() == "NA":
+            oob = (x < self.output["min_x"]) | (x > self.output["max_x"])
+            pred = jnp.where(oob, jnp.nan, pred)
+        return jnp.where(jnp.isnan(x), jnp.nan, pred)
+
+
+class IsotonicRegression(ModelBuilder):
+    """h2o-py surface: ``H2OIsotonicRegressionEstimator`` (single feature)."""
+
+    algo = "isotonicregression"
+    supports_classification = False
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(super().defaults(), out_of_bounds="NA")
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> IsotonicRegressionModel:
+        if len(x) != 1:
+            raise ValueError("IsotonicRegression requires exactly one feature column")
+        xv = frame.vec(x[0]).as_float()
+        yy, valid = response_as_float(frame.vec(y))
+        w = weights * valid * ~jnp.isnan(xv)
+
+        # device aggregation by unique x (segment-sum the (wy, w) pairs)
+        xs = np.asarray(jax.device_get(xv))
+        wh = np.asarray(jax.device_get(w))
+        yh = np.asarray(jax.device_get(jnp.where(w > 0, yy, 0.0)))
+        keep = wh > 0
+        xs, yh, wh = xs[keep], yh[keep], wh[keep]
+        if xs.size == 0:
+            raise ValueError("no usable rows")
+        ux, inv = np.unique(xs, return_inverse=True)
+        sw = np.bincount(inv, weights=wh, minlength=len(ux))
+        swy = np.bincount(inv, weights=wh * yh, minlength=len(ux))
+        ymean = swy / np.maximum(sw, 1e-300)
+
+        fitted = _pav(ymean, sw)
+        # thresholds: keep only breakpoints (first/last of each constant block)
+        change = np.ones(len(ux), bool)
+        if len(ux) > 2:
+            interior_same = (fitted[1:-1] == fitted[:-2]) & (fitted[1:-1] == fitted[2:])
+            change[1:-1] = ~interior_same
+        tx, ty = ux[change], fitted[change]
+        if len(tx) == 1:
+            tx = np.array([tx[0], tx[0] + 1.0])
+            ty = np.array([ty[0], ty[0]])
+
+        job.update(1.0, f"{len(tx)} thresholds")
+        return IsotonicRegressionModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=y,
+            response_domain=None,
+            output=dict(thresholds_x=jnp.asarray(tx, jnp.float32),
+                        thresholds_y=jnp.asarray(ty, jnp.float32),
+                        min_x=float(ux[0]), max_x=float(ux[-1]),
+                        x_col=x[0], nobs=int(keep.sum())),
+        )

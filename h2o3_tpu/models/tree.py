@@ -9,13 +9,16 @@ split. The XGBoost extension does the same with (grad, hess) stats and
 gain = 0.5*(GL²/(HL+λ)+GR²/(HR+λ)−G²/(H+λ))−γ.
 
 TPU-native redesign (the "hard part #1" of SURVEY.md §7): growth is
-**level-synchronous with static shapes** — every level is one compiled
-program: a feature-scanned ``segment_sum`` builds all node histograms at once
-(XLA reduces per-chip partials over ICI), split finding is a vectorized
-cumsum+argmax over [F, nodes, bins, dir], and row routing is a gather. No
-per-node recursion, no dynamic shapes; leaves freeze rows by setting their
-node id to -1 (dropped by the masked segment_sum). Trees are stored as dense
-heaps (arrays indexed 2i+1/2i+2), so prediction is D gather steps.
+**level-synchronous with static shapes**, and — unlike the reference's
+per-level driver round-trips — the ENTIRE tree grows inside one compiled XLA
+program: the level loop is unrolled at trace time (depth is static), each
+level being a feature-scanned ``segment_sum`` histogram build (XLA reduces
+per-chip partials over ICI), a vectorized cumsum+argmax split search over
+[F, nodes, bins, dir], and a gather re-route of rows. One tree = one device
+dispatch; a whole K-class round = one ``vmap``-ed dispatch
+(:func:`grow_trees_batched`). This matters doubly on TPU where host↔device
+round-trips ride a high-latency link. Trees are stored as dense heaps (arrays
+indexed 2i+1/2i+2), so prediction is D gather steps.
 
 Uses (g, h) gradient-pair stats — the XGBoost formulation — for GBM too;
 with h = w this reduces exactly to H2O GBM's (w, wY) mean-leaf semantics.
@@ -54,7 +57,6 @@ class Tree:
     leaf: jax.Array         # f32 leaf values (valid where !is_split)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins_tot"))
 def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int):
     """All node histograms for one level: [F, n_nodes*n_bins_tot, 3] of (G,H,W).
 
@@ -73,7 +75,6 @@ def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int
     return hists
 
 
-@partial(jax.jit, static_argnames=("n_bins",))
 def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, feat_mask):
     """Vectorized split search (reference: DTree.findBestSplitPoint).
 
@@ -119,7 +120,6 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, fea
     return best_gain, best_feat, best_t, na_left, G, H, W
 
 
-@partial(jax.jit, static_argnames=("n_bins",))
 def _route_rows(binned, node_local, feat, t, na_left, do_split, n_bins: int):
     """Advance rows to next-level node ids; frozen (leaf) rows get -1."""
     active = node_local >= 0
@@ -131,6 +131,125 @@ def _route_rows(binned, node_local, feat, t, na_left, do_split, n_bins: int):
     left = jnp.where(is_na, na_left[nl], b < t[nl])
     child = nl * 2 + jnp.where(left, 0, 1)
     return jnp.where(split, child, -1)
+
+
+def _leaf_value(G, H, W, reg_lambda, reg_alpha):
+    Gt = jnp.sign(G) * jnp.maximum(jnp.abs(G) - reg_alpha, 0.0)
+    return jnp.where(W > 0, -Gt / jnp.maximum(H + reg_lambda, 1e-30), 0.0)
+
+
+def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
+                      depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
+                      gamma, min_split_improvement, col_rate: float):
+    """Grow one whole tree on device; the level loop unrolls at trace time.
+
+    Returns heap arrays + per-row training predictions (leaf of each row).
+    """
+    B = n_bins
+    Bt = B + 1
+    F = binned.shape[1]
+    node_local = jnp.zeros(binned.shape[0], jnp.int32)
+
+    lv_feat, lv_t, lv_tv, lv_na, lv_sp, lv_leaf = [], [], [], [], [], []
+    row_leaf = jnp.zeros(binned.shape[0], jnp.float32)
+
+    for d in range(depth):
+        N = 2 ** d
+        lmask = feat_mask
+        if col_rate < 1.0:
+            key, kd, kf = jax.random.split(key, 3)
+            sub = jax.random.uniform(kd, (F,)) < col_rate
+            sub = sub.at[jax.random.randint(kf, (), 0, F)].set(True)
+            lmask = feat_mask & sub
+            # the forced index may miss feat_mask; never let the level go empty
+            lmask = jnp.where(lmask.any(), lmask, feat_mask)
+        hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
+        gain, feat, t, na_left, G, H, W = _find_splits(
+            hists, B, min_rows, reg_lambda, reg_alpha, gamma, lmask)
+        do = (gain > min_split_improvement) & jnp.isfinite(gain) & (W > 0)
+        leaf = jnp.where(do, 0.0, _leaf_value(G, H, W, reg_lambda, reg_alpha))
+        lv_feat.append(jnp.where(do, feat, -1))
+        lv_t.append(jnp.where(do, t, 0))
+        lv_tv.append(jnp.where(do, edges[feat, jnp.maximum(t - 1, 0)], 0.0))
+        lv_na.append(do & na_left)
+        lv_sp.append(do)
+        lv_leaf.append(leaf)
+        # rows whose node froze at this level take its leaf value
+        active = node_local >= 0
+        nl = jnp.where(active, node_local, 0)
+        row_leaf = jnp.where(active & ~do[nl], leaf[nl], row_leaf)
+        node_local = _route_rows(binned, node_local, lv_feat[-1], lv_t[-1],
+                                 na_left, do, B)
+
+    # final level: all surviving nodes become leaves
+    N = 2 ** depth
+    hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
+    tot = hists[0].reshape(N, Bt, 3).sum(axis=1)   # stats are feature-independent
+    leaf = _leaf_value(tot[:, 0], tot[:, 1], tot[:, 2], reg_lambda, reg_alpha)
+    lv_feat.append(jnp.full(N, -1, jnp.int32))
+    lv_t.append(jnp.zeros(N, jnp.int32))
+    lv_tv.append(jnp.zeros(N, jnp.float32))
+    lv_na.append(jnp.zeros(N, bool))
+    lv_sp.append(jnp.zeros(N, bool))
+    lv_leaf.append(leaf)
+    active = node_local >= 0
+    nl = jnp.where(active, node_local, 0)
+    row_leaf = jnp.where(active, leaf[nl], row_leaf)
+
+    return (jnp.concatenate(lv_feat), jnp.concatenate(lv_t),
+            jnp.concatenate(lv_tv), jnp.concatenate(lv_na),
+            jnp.concatenate(lv_sp), jnp.concatenate(lv_leaf), row_leaf)
+
+
+@partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate"))
+def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
+                  depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
+                  gamma, min_split_improvement, col_rate: float):
+    """K trees in ONE dispatch: vmap over the stats axis (class trees of a
+    multinomial round, or K=1). binned/edges are shared (in_axes=None)."""
+    fn = lambda gk, hk, wk, mk, kk: _grow_tree_device(
+        binned, edges, gk, hk, wk, mk, kk, depth, n_bins, min_rows,
+        reg_lambda, reg_alpha, gamma, min_split_improvement, col_rate)
+    return jax.vmap(fn)(g, h, w, feat_mask, keys)
+
+
+def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
+                       col_rate: float = 1.0, key: jax.Array | None = None
+                       ) -> tuple[list[Tree], jax.Array]:
+    """Grow K trees (leading axis of g/h/w) in one compiled program.
+
+    Returns (trees, preds[K, rows]) where preds are each tree's training-row
+    leaf values (what the boosting driver adds to F).
+
+    ``col_rate`` < 1 resamples the feature mask every level — the TPU stand-in
+    for the reference's per-split mtries/col_sample_rate (per-node sampling
+    would break the single-batched-argmax split search; per-level is the
+    standard compromise, cf. LightGBM feature_fraction granularity)."""
+    K = g.shape[0]
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, K)
+    if feat_mask.ndim == 1:
+        feat_mask = jnp.broadcast_to(feat_mask[None, :], (K, feat_mask.shape[0]))
+    hf, ht, htv, hna, hsp, hlf, preds = _grow_batched(
+        binned, edges, g, h, w, feat_mask, keys,
+        params.max_depth, params.nbins, jnp.float32(params.min_rows),
+        jnp.float32(params.reg_lambda), jnp.float32(params.reg_alpha),
+        jnp.float32(params.gamma), jnp.float32(params.min_split_improvement),
+        float(col_rate))
+    trees = [Tree(feat=hf[k], thresh_bin=ht[k], thresh_val=htv[k],
+                  na_left=hna[k], is_split=hsp[k], leaf=hlf[k])
+             for k in range(K)]
+    return trees, preds
+
+
+def grow_tree(binned: jax.Array, edges: jax.Array, g: jax.Array, h: jax.Array,
+              w: jax.Array, params: TreeParams, feat_mask: jax.Array,
+              col_rate: float = 1.0, key: jax.Array | None = None) -> Tree:
+    """Grow one tree (K=1 batched growth); see :func:`grow_trees_batched`."""
+    trees, _ = grow_trees_batched(binned, edges, g[None], h[None], w[None],
+                                  params, feat_mask, col_rate, key)
+    return trees[0]
 
 
 def predict_binned(binned, trees: list[Tree], n_bins: int) -> jax.Array:
@@ -188,79 +307,3 @@ def predict_raw(X, trees: list[Tree]) -> jax.Array:
     stack = lambda attr: jnp.stack([getattr(t, attr) for t in trees])
     return _predict_raw_impl(X, stack("feat"), stack("thresh_val"),
                              stack("na_left"), stack("is_split"), stack("leaf"))
-
-
-def grow_tree(binned: jax.Array, edges: jax.Array, g: jax.Array, h: jax.Array,
-              w: jax.Array, params: TreeParams, feat_mask: jax.Array,
-              col_rate: float = 1.0, key: jax.Array | None = None) -> Tree:
-    """Grow one tree level-synchronously. All heavy steps are cached jits;
-    only tiny per-level heap slices move to host.
-
-    ``col_rate`` < 1 resamples the feature mask every level — the TPU stand-in
-    for the reference's per-split mtries/col_sample_rate (per-node sampling
-    would break the single-batched-argmax split search; per-level is the
-    standard compromise, cf. LightGBM feature_fraction_bynode granularity)."""
-    D = params.max_depth
-    B = params.nbins
-    Bt = B + 1
-    heap = 2 ** (D + 1) - 1
-    hf = np.full(heap, -1, np.int32)
-    ht = np.zeros(heap, np.int32)
-    htv = np.zeros(heap, np.float32)
-    hna = np.zeros(heap, bool)
-    hsp = np.zeros(heap, bool)
-    hlf = np.zeros(heap, np.float32)
-
-    edges_np = np.asarray(jax.device_get(edges))
-    node_local = jnp.zeros(binned.shape[0], jnp.int32)
-
-    F = binned.shape[1]
-    for d in range(D):
-        N = 2 ** d
-        off = N - 1
-        lmask = feat_mask
-        if col_rate < 1.0 and key is not None:
-            key, kd, kf = jax.random.split(key, 3)
-            sub = jax.random.uniform(kd, (F,)) < col_rate
-            sub = sub.at[jax.random.randint(kf, (), 0, F)].set(True)
-            lmask = feat_mask & sub
-            # the forced index may miss feat_mask; never let the level go empty
-            lmask = jnp.where(lmask.any(), lmask, feat_mask)
-        hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
-        gain, feat, t, na_left, G, H, W = _find_splits(
-            hists, B, jnp.float32(params.min_rows), jnp.float32(params.reg_lambda),
-            jnp.float32(params.reg_alpha), jnp.float32(params.gamma), lmask)
-        gain_h, feat_h, t_h, nal_h, G_h, H_h, W_h = (
-            np.asarray(jax.device_get(v)) for v in (gain, feat, t, na_left, G, H, W))
-        do = (gain_h > params.min_split_improvement) & np.isfinite(gain_h) & (W_h > 0)
-        # record splits and leaves for this level
-        idxs = off + np.arange(N)
-        hf[idxs] = np.where(do, feat_h, -1)
-        ht[idxs] = np.where(do, t_h, 0)
-        htv[idxs] = np.where(do, edges_np[feat_h, np.maximum(t_h - 1, 0)], 0.0)
-        hna[idxs] = np.where(do, nal_h, False)
-        hsp[idxs] = do
-        Gt = np.sign(G_h) * np.maximum(np.abs(G_h) - params.reg_alpha, 0.0)
-        hlf[idxs] = np.where(do | (W_h <= 0), 0.0,
-                             -Gt / np.maximum(H_h + params.reg_lambda, 1e-30))
-        if not do.any():
-            break
-        node_local = _route_rows(binned, node_local, jnp.asarray(feat_h),
-                                 jnp.asarray(t_h), jnp.asarray(nal_h),
-                                 jnp.asarray(do), B)
-    else:
-        # final level: all surviving nodes become leaves
-        N = 2 ** D
-        off = N - 1
-        hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
-        tot = jnp.asarray(hists)[0].reshape(N, Bt, 3).sum(axis=1)
-        tot_h = np.asarray(jax.device_get(tot))
-        # NOTE: feature-0 histogram covers all stats; totals are feature-independent
-        G_h, H_h, W_h = tot_h[:, 0], tot_h[:, 1], tot_h[:, 2]
-        idxs = off + np.arange(N)
-        Gt = np.sign(G_h) * np.maximum(np.abs(G_h) - params.reg_alpha, 0.0)
-        hlf[idxs] = np.where(W_h > 0, -Gt / np.maximum(H_h + params.reg_lambda, 1e-30), 0.0)
-
-    return Tree(feat=jnp.asarray(hf), thresh_bin=jnp.asarray(ht),
-                thresh_val=jnp.asarray(htv), na_left=jnp.asarray(hna),
-                is_split=jnp.asarray(hsp), leaf=jnp.asarray(hlf))

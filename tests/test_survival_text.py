@@ -1,0 +1,165 @@
+"""Isotonic / CoxPH / Word2Vec tests (reference test model: h2o-py
+``testdir_algos/{isotonic,coxph,word2vec}/pyunit_*``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.models import CoxPH, IsotonicRegression, Word2Vec
+
+
+# -- Isotonic ----------------------------------------------------------------
+
+def test_isotonic_matches_sklearn(rng):
+    n = 500
+    x = rng.uniform(0, 10, n)
+    y = np.sin(x / 3.5) * 2 + x * 0.5 + rng.normal(scale=0.4, size=n)
+    f = Frame.from_arrays({"x": x, "y": y})
+    m = IsotonicRegression().train(x=["x"], y="y", training_frame=f)
+    pred = m.predict(f).vec("predict").to_numpy()
+
+    from sklearn.isotonic import IsotonicRegression as SkIso
+    sk = SkIso(out_of_bounds="clip").fit(x, y)
+    np.testing.assert_allclose(pred, sk.predict(x), atol=1e-4)
+
+
+def test_isotonic_monotone_and_oob(rng):
+    n = 300
+    x = rng.uniform(0, 1, n)
+    y = x ** 2 + rng.normal(scale=0.05, size=n)
+    f = Frame.from_arrays({"x": x, "y": y})
+    m = IsotonicRegression(out_of_bounds="NA").train(x=["x"], y="y", training_frame=f)
+    xs = np.sort(x)
+    fs = Frame.from_arrays({"x": xs})
+    ps = m.predict(fs).vec("predict").to_numpy()
+    assert (np.diff(ps) >= -1e-6).all()
+    # out-of-range rows → NA
+    f2 = Frame.from_arrays({"x": np.array([-1.0, 2.0])})
+    p2 = m.predict(f2).vec("predict").to_numpy()
+    assert np.isnan(p2).all()
+    m2 = IsotonicRegression(out_of_bounds="clip").train(x=["x"], y="y",
+                                                        training_frame=f)
+    p3 = m2.predict(f2).vec("predict").to_numpy()
+    assert np.isfinite(p3).all()
+
+
+def test_isotonic_weighted(rng):
+    # two duplicated x values with conflicting y: weights decide the level
+    x = np.array([1.0, 1.0, 2.0, 2.0])
+    y = np.array([0.0, 10.0, 20.0, 0.0])
+    w = np.array([9.0, 1.0, 1.0, 9.0])
+    f = Frame.from_arrays({"x": x, "y": y, "w": w})
+    m = IsotonicRegression(weights_column="w").train(x=["x"], y="y",
+                                                     training_frame=f)
+    pred = m.predict(Frame.from_arrays({"x": np.array([1.0, 2.0])}))
+    p = pred.vec("predict").to_numpy()
+    # weighted means: x=1 → 1.0, x=2 → 2.0 (already isotonic)
+    np.testing.assert_allclose(p, [1.0, 2.0], atol=1e-5)
+
+
+# -- CoxPH -------------------------------------------------------------------
+
+def _cox_data(rng, n=800, beta=(0.8, -0.5)):
+    X = rng.normal(size=(n, 2))
+    lam = 0.1 * np.exp(X @ np.array(beta))
+    t = rng.exponential(1.0 / lam)
+    c = rng.exponential(1.0 / 0.05, size=n)   # censoring times
+    time = np.minimum(t, c)
+    event = (t <= c).astype(float)
+    return Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1],
+                              "time": time, "event": event}), X, time, event
+
+
+def test_coxph_recovers_coefficients(rng):
+    f, *_ = _cox_data(rng)
+    m = CoxPH(stop_column="time", ties="breslow").train(
+        x=["x0", "x1"], y="event", training_frame=f)
+    coef = m.coefficients()
+    assert abs(coef["x0"] - 0.8) < 0.15
+    assert abs(coef["x1"] + 0.5) < 0.15
+    assert np.isfinite(m.output["loglik"])
+
+
+def test_coxph_efron_close_to_breslow_without_ties(rng):
+    f, *_ = _cox_data(rng, n=400)
+    mb = CoxPH(stop_column="time", ties="breslow").train(
+        x=["x0", "x1"], y="event", training_frame=f)
+    me = CoxPH(stop_column="time", ties="efron").train(
+        x=["x0", "x1"], y="event", training_frame=f)
+    # continuous times → essentially no ties → identical estimates
+    np.testing.assert_allclose(
+        np.asarray(me.output["coef"]), np.asarray(mb.output["coef"]), atol=1e-3)
+
+
+def test_coxph_vs_lifelines_style_check(rng):
+    # higher-risk rows should get larger linear predictors
+    f, X, time, event = _cox_data(rng, n=600)
+    m = CoxPH(stop_column="time").train(x=["x0", "x1"], y="event",
+                                        training_frame=f)
+    lp = m.predict(f).vec("lp").to_numpy()
+    true_lp = X @ np.array([0.8, -0.5])
+    assert np.corrcoef(lp, true_lp)[0, 1] > 0.97
+
+
+# -- Word2Vec ----------------------------------------------------------------
+
+def _toy_corpus(rng, n_sent=300):
+    """Two topic clusters: {cat,dog,pet} and {car,bus,road} co-occur."""
+    topics = [["cat", "dog", "pet", "fur", "paw"],
+              ["car", "bus", "road", "wheel", "fuel"]]
+    words = []
+    for _ in range(n_sent):
+        t = topics[rng.integers(0, 2)]
+        for _ in range(rng.integers(4, 9)):
+            words.append(t[rng.integers(0, len(t))])
+        words.append(None)   # sentence delimiter
+    return Frame.from_arrays({"words": np.array(words, dtype=object)},
+                             types={"words": VecType.STR})
+
+
+def test_word2vec_learns_topics(rng):
+    f = _toy_corpus(rng)
+    m = Word2Vec(vec_size=16, min_word_freq=2, epochs=25, window_size=3,
+                 seed=11).train(training_frame=f)
+    syn = m.find_synonyms("cat", 3)
+    assert len(syn) == 3
+    assert set(syn) <= {"dog", "pet", "fur", "paw"}
+
+
+def test_word2vec_transform_average(rng):
+    f = _toy_corpus(rng, n_sent=100)
+    m = Word2Vec(vec_size=8, min_word_freq=2, epochs=5, seed=11,
+                 ).train(training_frame=f)
+    doc = m.transform(f, aggregate_method="AVERAGE")
+    assert doc.names[0] == "C1"
+    assert doc.nrows >= 100          # one row per sentence
+    tab = m.to_frame()
+    assert tab.names[0] == "Word"
+    assert tab.nrows == len(m.output["vocab"])
+
+
+def test_word2vec_transform_no_spurious_trailing_row(rng):
+    f = _toy_corpus(rng, n_sent=20)   # corpus ends with the NA delimiter
+    m = Word2Vec(vec_size=8, min_word_freq=2, epochs=3, seed=1,
+                 ).train(training_frame=f)
+    doc = m.transform(f, aggregate_method="AVERAGE")
+    assert doc.nrows == 20
+
+
+def test_gbm_explicit_bernoulli_multiclass_raises(rng):
+    from h2o3_tpu.models import GBM
+    n = 120
+    f = Frame.from_arrays({"x": rng.normal(size=n),
+                           "y": np.array(["a", "b", "c"], dtype=object)[
+                               rng.integers(0, 3, n)]})
+    with pytest.raises(ValueError, match="2-class"):
+        GBM(distribution="bernoulli", ntrees=2).train(y="y", training_frame=f)
+
+
+def test_coxph_builder_reusable(rng):
+    f, *_ = _cox_data(rng, n=300)
+    b = CoxPH(stop_column="time")
+    b.train(x=["x0", "x1"], y="event", training_frame=f)
+    b.train(x=["x0", "x1"], y="event", training_frame=f)
+    assert b.params["ignored_columns"] is None

@@ -158,3 +158,34 @@ def test_drf_depth_validated(rng):
     f, _, _ = _friedman(rng, n=100)
     with pytest.raises(ValueError, match="max_depth"):
         DRF(max_depth=20).train(y="y", training_frame=f)
+
+
+def test_gbm_multinomial(rng):
+    n = 900
+    centers = np.array([[0, 0], [6, 0], [0, 6]])
+    yi = rng.integers(0, 3, size=n)
+    X = centers[yi] + rng.normal(size=(n, 2))
+    f = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1],
+                           "y": np.array(["a", "b", "c"], dtype=object)[yi]})
+    m = GBM(ntrees=20, max_depth=3, seed=1).train(y="y", training_frame=f)
+    assert m.nclasses == 3
+    pred = m.predict(f)
+    assert pred.names == ["predict", "pa", "pb", "pc"]
+    acc = (pred.vec("predict").to_numpy() == yi).mean()
+    assert acc > 0.95
+    assert m.training_metrics.logloss < 0.3
+
+
+def test_drf_multinomial(rng):
+    n = 900
+    centers = np.array([[0, 0], [6, 0], [0, 6]])
+    yi = rng.integers(0, 3, size=n)
+    X = centers[yi] + rng.normal(size=(n, 2))
+    f = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1],
+                           "y": np.array(["a", "b", "c"], dtype=object)[yi]})
+    m = DRF(ntrees=20, max_depth=8, seed=1).train(y="y", training_frame=f)
+    pred = m.predict(f)
+    probs = np.stack([pred.vec(c).to_numpy() for c in ("pa", "pb", "pc")], axis=1)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    acc = (pred.vec("predict").to_numpy() == yi).mean()
+    assert acc > 0.95
