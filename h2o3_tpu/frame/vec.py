@@ -155,6 +155,18 @@ class Vec:
             return self.host_values[: self.nrows]
         return np.asarray(jax.device_get(self.data))[: self.nrows]
 
+    def labels(self) -> np.ndarray:
+        """Categorical column as its level strings (NA → None); the view the
+        h2o-py client renders for CAT columns (``as_data_frame``)."""
+        if not self.is_categorical:
+            raise ValueError("labels() requires a categorical Vec")
+        codes = self.to_numpy()
+        dom = np.array(self.domain, dtype=object)
+        out = np.full(len(codes), None, dtype=object)
+        ok = codes >= 0
+        out[ok] = dom[codes[ok]]
+        return out
+
     def as_float(self) -> jax.Array:
         """Device column as float32 with NaN for missing (cats → code floats)."""
         if self.type is VecType.CAT:

@@ -33,9 +33,52 @@ def _fam(family: str, tweedie_p: float):
     return get_family(family, p=tweedie_p) if family == "tweedie" else get_family(family)
 
 
-@partial(jax.jit, static_argnames=("family", "tweedie_p"))
-def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2):
-    """One IRLS iteration: weighted Gram + Cholesky solve (all on device)."""
+def _weighted_gram(X, W, z, l2, nobs, jitter):
+    """Normal equations for weighted LS with an unpenalized intercept column:
+    gram = [X,1]'W[X,1] + l2*nobs*diag(1..1,0) + jitter*I, rhs = [X,1]'Wz.
+    One contraction over the row-sharded X — XLA reduces per-chip partials over
+    ICI (the reference's ``GLMIterationTask`` Gram reduce)."""
+    k = X.shape[1]
+    Xw = X * W[:, None]
+    gram = jnp.empty((k + 1, k + 1), X.dtype)
+    gram = gram.at[:k, :k].set(Xw.T @ X)
+    xw_sum = Xw.sum(axis=0)
+    gram = gram.at[:k, k].set(xw_sum).at[k, :k].set(xw_sum).at[k, k].set(W.sum())
+    rhs = jnp.concatenate([Xw.T @ z, (W * z).sum()[None]])
+    penalty = l2 * nobs * jnp.concatenate([jnp.ones(k), jnp.zeros(1)])
+    gram = gram + jnp.diag(penalty) + jitter * jnp.eye(k + 1)
+    return gram, rhs
+
+
+def _nn_solve(gram, rhs, beta0, tol: float = 1e-7, max_passes: int = 100):
+    """Non-negative solve of the penalized normal equations by cyclic projected
+    coordinate descent (reference: ADMM.java solves the same bound-constrained
+    QP; for a convex quadratic, projected CD converges to the NNLS optimum).
+    The intercept (last coordinate) stays unconstrained; sweeps stop once the
+    largest coordinate move falls below ``tol``."""
+    k = gram.shape[0] - 1
+
+    def coord(j, b):
+        r = rhs[j] - gram[j] @ b
+        bj = b[j] + r / jnp.maximum(gram[j, j], 1e-12)
+        return b.at[j].set(jnp.where(j < k, jnp.maximum(bj, 0.0), bj))
+
+    def body(state):
+        i, b, _ = state
+        nb = jax.lax.fori_loop(0, k + 1, coord, b)
+        return i + 1, nb, jnp.max(jnp.abs(nb - b))
+
+    _, beta, _ = jax.lax.while_loop(
+        lambda s: (s[0] < max_passes) & (s[2] > tol), body,
+        (0, beta0, jnp.asarray(jnp.inf, beta0.dtype)))
+    return beta
+
+
+@partial(jax.jit, static_argnames=("family", "tweedie_p", "non_negative"))
+def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
+               non_negative: bool = False):
+    """One IRLS iteration: weighted Gram + Cholesky solve (all on device);
+    under ``non_negative`` the same system is solved with projected CD."""
     fam = _fam(family, tweedie_p)
     eta = X @ beta[:-1] + beta[-1]
     mu = fam.linkinv(eta)
@@ -43,20 +86,13 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2):
     var = fam.variance(mu)
     W = w * d * d / jnp.maximum(var, 1e-12)
     z = eta + (y - mu) / jnp.maximum(d, 1e-12)
-
-    Xw = X * W[:, None]
-    k = X.shape[1]
-    gram = jnp.empty((k + 1, k + 1), X.dtype)
-    gram = gram.at[:k, :k].set(Xw.T @ X)
-    xw_sum = Xw.sum(axis=0)
-    gram = gram.at[:k, k].set(xw_sum).at[k, :k].set(xw_sum).at[k, k].set(W.sum())
-    rhs = jnp.concatenate([Xw.T @ z, (W * z).sum()[None]])
-
     nobs = jnp.maximum(w.sum(), 1.0)
-    penalty = l2 * nobs * jnp.concatenate([jnp.ones(k), jnp.zeros(1)])  # no intercept penalty
-    gram = gram + jnp.diag(penalty) + 1e-8 * jnp.eye(k + 1)
-    chol = jax.scipy.linalg.cho_factor(gram, lower=True)
-    new_beta = jax.scipy.linalg.cho_solve(chol, rhs)
+    gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-8)
+    if non_negative:
+        new_beta = _nn_solve(gram, rhs, jnp.maximum(beta, 0.0).at[-1].set(beta[-1]))
+    else:
+        chol = jax.scipy.linalg.cho_factor(gram, lower=True)
+        new_beta = jax.scipy.linalg.cho_solve(chol, rhs)
     dev = (w * fam.deviance(y, mu)).sum()
     return new_beta, dev
 
@@ -82,11 +118,46 @@ def _null_deviance(family: str, tweedie_p: float, y, w):
 
 @partial(jax.jit, static_argnames=("family", "nclasses", "tweedie_p"))
 def _glm_score(family: str, nclasses: int, tweedie_p: float, X, beta):
+    if family == "multinomial":
+        return jax.nn.softmax(X @ beta[:-1, :] + beta[-1, :][None, :], axis=1)
     fam = _fam(family, tweedie_p)
     mu = fam.linkinv(X @ beta[:-1] + beta[-1])
     if nclasses == 2:
         return jnp.stack([1.0 - mu, mu], axis=1)
     return mu
+
+
+@partial(jax.jit, static_argnames=("nclasses", "non_negative"))
+def _multinomial_step(nclasses: int, X, yoh, w, B, l2, l1, non_negative: bool = False):
+    """One sweep of per-class quadratic (IRLS) updates for softmax regression.
+
+    Reference: GLM.java multinomial solves class-blocks cyclically with the
+    binomial-style working response per class (``GLMTask.GLMMultinomial*``).
+    B: [P+1, K] (last row = intercepts). The class loop unrolls in the jit.
+    L1 is applied as a per-class proximal soft-threshold with the same
+    lam1*nobs/gram_jj units as the binomial ``_admm_l1`` path.
+    """
+    k_feat = X.shape[1]
+    nobs = jnp.maximum(w.sum(), 1.0)
+    for c in range(nclasses):
+        eta = X @ B[:-1, :] + B[-1, :][None, :]
+        p = jax.nn.softmax(eta, axis=1)
+        pc = p[:, c]
+        W = w * jnp.maximum(pc * (1 - pc), 1e-10)
+        z = eta[:, c] + (yoh[:, c] - pc) / jnp.maximum(pc * (1 - pc), 1e-10)
+        gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-6)
+        if non_negative:
+            bc = _nn_solve(gram, rhs, jnp.maximum(B[:, c], 0.0).at[-1].set(B[-1, c]))
+        else:
+            chol = jax.scipy.linalg.cho_factor(gram, lower=True)
+            bc = jax.scipy.linalg.cho_solve(chol, rhs)
+        thr = l1 * nobs / jnp.maximum(jnp.diag(gram)[:k_feat], 1e-12)
+        bc = bc.at[:-1].set(jnp.sign(bc[:-1]) * jnp.maximum(jnp.abs(bc[:-1]) - thr, 0.0))
+        B = B.at[:, c].set(bc)
+    eta = X @ B[:-1, :] + B[-1, :][None, :]
+    logp = jax.nn.log_softmax(eta, axis=1)
+    dev = -2.0 * (w * (yoh * logp).sum(axis=1)).sum()
+    return B, dev
 
 
 class GLMModel(Model):
@@ -97,14 +168,22 @@ class GLMModel(Model):
         return _glm_score(self.params["family"], self.nclasses or 0,
                           float(self.params["tweedie_variance_power"]), X, self.output["beta"])
 
-    def coef(self) -> dict[str, float]:
-        """Coefficients on the original scale (reference: GLMModel.coefficients())."""
-        return dict(zip(self.output["coef_names"] + ["Intercept"], self.output["coef"]))
+    def coef(self):
+        """Coefficients on the original scale (reference: GLMModel.coefficients()).
+        Multinomial models return a per-class nested dict keyed
+        ``coefs_class_K`` (the h2o-py multinomial ``coef()`` shape)."""
+        return self._coef_dict(np.asarray(self.output["coef"]))
 
-    def coef_norm(self) -> dict[str, float]:
-        """Standardized coefficients."""
-        beta = np.asarray(jax.device_get(self.output["beta"]))
-        return dict(zip(self.output["coef_names"] + ["Intercept"], beta))
+    def coef_norm(self):
+        """Standardized coefficients (same multinomial nesting as ``coef``)."""
+        return self._coef_dict(np.asarray(jax.device_get(self.output["beta"])))
+
+    def _coef_dict(self, mat: np.ndarray):
+        names = self.output["coef_names"] + ["Intercept"]
+        if mat.ndim == 1:
+            return dict(zip(names, mat))
+        return {f"coefs_class_{k}": dict(zip(names, mat[:, k]))
+                for k in range(mat.shape[1])}
 
 
 class GLM(ModelBuilder):
@@ -124,6 +203,7 @@ class GLM(ModelBuilder):
             standardize=True,
             use_all_factor_levels=False,
             intercept=True,
+            non_negative=False,
             max_iterations=50,
             beta_epsilon=1e-4,
             objective_epsilon=1e-6,
@@ -137,14 +217,21 @@ class GLM(ModelBuilder):
         yvec = frame.vec(y)
         family = params["family"]
         if yvec.is_categorical:
-            if yvec.cardinality() != 2:
-                raise ValueError("multinomial GLM not yet supported; response must be binary")
+            # multinomial family is honored even for 2-level responses
+            # (reference: GLM.java accepts multinomial on a binary y)
+            if family == "multinomial" or yvec.cardinality() != 2:
+                if family not in ("AUTO", "gaussian", "multinomial"):
+                    raise ValueError(f"family {family!r} requires a binary or "
+                                     "numeric response")
+                return self._fit_multinomial_glm(job, frame, x, y, weights, yvec)
             family = "binomial" if family in ("gaussian", "AUTO") else family
         else:
             if family == "AUTO":
                 family = "gaussian"
             if family in ("binomial", "bernoulli"):
                 raise ValueError("binomial family requires a categorical (2-level) response")
+            if family == "multinomial":
+                raise ValueError("multinomial family requires a categorical response")
         tw = float(params["tweedie_variance_power"])
 
         di = DataInfo.make(frame, x, standardize=params["standardize"],
@@ -164,13 +251,15 @@ class GLM(ModelBuilder):
 
         lam = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
         dev_prev = np.inf
+        nn = bool(params.get("non_negative"))
         for it in range(int(params["max_iterations"])):
-            beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam)
+            beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
+                                       non_negative=nn)
             dev = float(jax.device_get(dev))
             delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
             beta = beta_new
             job.update((it + 1) / int(params["max_iterations"]), f"iter {it} deviance {dev:.4f}")
-            if family == "gaussian" and it >= 1:
+            if family == "gaussian" and not params.get("non_negative") and it >= 1:
                 break
             if delta < float(params["beta_epsilon"]):
                 break
@@ -207,6 +296,60 @@ class GLM(ModelBuilder):
         )
         return model
 
+    def _fit_multinomial_glm(self, job: Job, frame: Frame, x, y, weights, yvec
+                             ) -> GLMModel:
+        """Softmax regression via cyclic per-class IRLS blocks (reference:
+        GLM.java multinomial path)."""
+        params = self.params
+        di = DataInfo.make(frame, x, standardize=params["standardize"],
+                           use_all_factor_levels=params["use_all_factor_levels"])
+        X = di.expand(frame)
+        from h2o3_tpu.models.data_info import response_as_float
+        yy, valid = response_as_float(yvec)
+        w = weights * valid
+        K = yvec.cardinality()
+        yoh = jax.nn.one_hot(jnp.where(w > 0, yy, 0.0).astype(jnp.int32), K)
+        yoh = yoh * (w > 0)[:, None]
+
+        P = X.shape[1]
+        B = jnp.zeros((P + 1, K), jnp.float32)
+        lam = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
+        lam1 = float(params["lambda_"]) * float(params["alpha"])
+        dev_prev = np.inf
+        nn = bool(params.get("non_negative"))
+        for it in range(int(params["max_iterations"])):
+            B, dev = _multinomial_step(K, X, yoh, w, B, jnp.float32(lam),
+                                       jnp.float32(lam1), nn)
+            dev = float(jax.device_get(dev))
+            job.update((it + 1) / int(params["max_iterations"]),
+                       f"iter {it} deviance {dev:.4f}")
+            if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
+                    float(params["objective_epsilon"]) * max(abs(dev_prev), 1.0):
+                break
+            dev_prev = dev
+
+        # destandardized per-class coefficients
+        b = np.asarray(jax.device_get(B), np.float64)
+        coef = b.copy()
+        if params["standardize"] and di.num_cols:
+            nnum = len(di.num_cols)
+            s = di.ncats_expanded
+            mul, sub = di.num_mul.astype(np.float64), di.num_sub.astype(np.float64)
+            coef[s:s + nnum, :] = b[s:s + nnum, :] * mul[:, None]
+            coef[-1, :] = b[-1, :] - (b[s:s + nnum, :] * (mul * sub)[:, None]).sum(axis=0)
+
+        from h2o3_tpu.models.model_base import ModelParameters
+        mparams = ModelParameters(self.params)
+        mparams["family"] = "multinomial"
+        return GLMModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=mparams, data_info=di, response_column=y,
+            response_domain=yvec.domain,
+            output=dict(beta=B, coef=coef, coef_names=di.coef_names,
+                        residual_deviance=dev, null_deviance=float("nan"),
+                        iterations=it + 1, family="multinomial"),
+        )
+
     def _admm_l1(self, family, tw, X, yy, w, beta, params):
         """L1 via proximal IRLS (simplified ADMM, reference hex/optimization/ADMM.java):
         iterate IRLS steps then soft-threshold non-intercept coefficients.
@@ -217,8 +360,9 @@ class GLM(ModelBuilder):
         curvature keeps L1 and L2 in the same per-observation units."""
         lam1 = float(params["lambda_"]) * float(params["alpha"])
         lam2 = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
+        nn = bool(params.get("non_negative"))
         for _ in range(10):
-            beta, _ = _irls_step(family, tw, X, yy, w, beta, lam2)
+            beta, _ = _irls_step(family, tw, X, yy, w, beta, lam2, non_negative=nn)
             thr = _l1_threshold(family, tw, X, yy, w, beta, lam1, lam2)
             mag = jnp.abs(beta[:-1])
             beta = beta.at[:-1].set(jnp.sign(beta[:-1]) * jnp.maximum(mag - thr, 0.0))

@@ -77,6 +77,8 @@ class Model:
         self.training_metrics = None
         self.validation_metrics = None
         self.cross_validation_metrics = None
+        self.cv_holdout_predictions = None   # [plen] or [plen, K] OOF preds
+        self.cv_holdout_mask = None
         self.run_time_ms: int = 0
 
     # -- problem type --------------------------------------------------------
@@ -177,6 +179,7 @@ class ModelBuilder:
             weights_column=None,
             ignored_columns=None,
             max_runtime_secs=0.0,
+            keep_cross_validation_predictions=False,
         )
 
     def _fit(self, job: Job, frame: Frame, x: list[str], y: str | None,
@@ -222,7 +225,7 @@ class ModelBuilder:
             nfolds = int(self.params.get("nfolds") or 0)
             if nfolds >= 2 and y is not None:
                 model.cross_validation_metrics = self._cross_validate(
-                    job, frame, x, y, base_w, nfolds)
+                    job, frame, x, y, base_w, nfolds, model)
             DKV.put(model.key, model)
             return model
 
@@ -257,7 +260,7 @@ class ModelBuilder:
         return jnp.arange(plen) % nfolds
 
     def _cross_validate(self, job: Job, frame: Frame, x: list[str], y: str,
-                        base_w: jax.Array, nfolds: int):
+                        base_w: jax.Array, nfolds: int, model: Model | None = None):
         """K-fold CV: same compiled program per fold, weights differ
         (reference: ``ModelBuilder.computeCrossValidation`` builds physical
         sub-frames; see module docstring for why masking replaces that)."""
@@ -280,6 +283,11 @@ class ModelBuilder:
         pooled = sum(jnp.where((m[:, None] if r.ndim == 2 else m), r, 0.0)
                      for r, m in zip(raws, masks))
         any_mask = jnp.stack(masks).any(axis=0)
+        if model is not None and self.params.get("keep_cross_validation_predictions"):
+            # out-of-fold predictions feed the StackedEnsemble metalearner
+            # (reference: keep_cross_validation_predictions + holdout frames)
+            model.cv_holdout_predictions = pooled
+            model.cv_holdout_mask = any_mask
         return compute_metrics(pooled, yy, any_mask, nclass)
 
 

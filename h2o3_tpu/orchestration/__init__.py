@@ -1,0 +1,15 @@
+"""Orchestration layer: grid search, leaderboard, stacked ensembles, AutoML.
+
+Reference: ``hex/grid/``, ``hex/leaderboard/``, ``hex/ensemble/``,
+``ai/h2o/automl/`` (SURVEY.md §2.3, §2.5).
+"""
+
+from h2o3_tpu.orchestration.automl import AutoML, EventLog
+from h2o3_tpu.orchestration.grid import Grid, GridSearch
+from h2o3_tpu.orchestration.leaderboard import Leaderboard
+from h2o3_tpu.orchestration.stacked_ensemble import StackedEnsemble, StackedEnsembleModel
+
+__all__ = [
+    "AutoML", "EventLog", "Grid", "GridSearch", "Leaderboard",
+    "StackedEnsemble", "StackedEnsembleModel",
+]

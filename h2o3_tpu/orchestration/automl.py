@@ -1,0 +1,235 @@
+"""AutoML — automatic model search with a modeling plan.
+
+Reference: ``ai/h2o/automl/AutoML.java:49`` and
+``modeling/{GLM,DRF,GBM,DeepLearning,StackedEnsemble,XGBoost}StepsProvider.java``:
+a job executes a sequence of ModelingSteps (defaults → grids → ensembles)
+under time/model budgets (``WorkAllocations.java``), ranks everything on a
+Leaderboard, and logs to an EventLog. The default plan trains: GLM defaults,
+XGBoost/GBM fixed sets, DRF + extremely-randomized trees, DeepLearning,
+random grids for the tree algos, then StackedEnsembles (BestOfFamily + All).
+
+This driver mirrors that plan with the same step families and budget
+semantics; every model is built with ``nfolds`` CV and kept OOF predictions
+so the ensemble steps can stack them (the reference does exactly this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import Model
+from h2o3_tpu.orchestration.grid import GridSearch, default_metric, metric_higher_is_better
+from h2o3_tpu.orchestration.leaderboard import Leaderboard
+
+
+class EventLog:
+    """Timestamped AutoML event record (reference: ai/h2o/automl/events/)."""
+
+    def __init__(self):
+        self.events: list[tuple[float, str, str]] = []
+
+    def log(self, stage: str, message: str) -> None:
+        self.events.append((time.time(), stage, message))
+
+    def as_list(self) -> list[str]:
+        return [f"[{time.strftime('%H:%M:%S', time.localtime(t))}] {s}: {m}"
+                for t, s, m in self.events]
+
+
+class AutoML:
+    """h2o-py surface: ``H2OAutoML(max_models=…, max_runtime_secs=…)``."""
+
+    def __init__(self, max_models: int = 0, max_runtime_secs: float = 0.0,
+                 seed: int = -1, nfolds: int = 5, sort_metric: str | None = None,
+                 exclude_algos: Sequence[str] = (), include_algos: Sequence[str] | None = None,
+                 project_name: str | None = None):
+        if not max_models and not max_runtime_secs:
+            max_runtime_secs = 3600.0   # reference default budget
+        self.max_models = int(max_models)
+        self.max_runtime_secs = float(max_runtime_secs)
+        self.seed = int(seed)
+        self.nfolds = int(nfolds)
+        self.sort_metric = sort_metric
+        self.exclude_algos = {a.upper() for a in exclude_algos}
+        self.include_algos = ({a.upper() for a in include_algos}
+                              if include_algos is not None else None)
+        self.project_name = project_name or f"automl_{int(time.time())}"
+        self.leaderboard: Leaderboard | None = None
+        self.event_log = EventLog()
+        self._t0 = 0.0
+        self._n_built = 0
+
+    # -- budget --------------------------------------------------------------
+
+    def _budget_left(self) -> bool:
+        if self.max_models and self._n_built >= self.max_models:
+            return False
+        if self.max_runtime_secs and time.time() - self._t0 > self.max_runtime_secs:
+            return False
+        return True
+
+    def _algo_enabled(self, algo: str) -> bool:
+        algo = algo.upper()
+        if self.include_algos is not None:
+            return algo in self.include_algos
+        return algo not in self.exclude_algos
+
+    # -- plan ----------------------------------------------------------------
+
+    def _steps(self):
+        """(algo, builder_cls, params) sequence — the reference's default
+        modeling plan order (ModelingPlans.java); the same families run for
+        classification and regression (each builder adapts to the response)."""
+        from h2o3_tpu.models.deeplearning import DeepLearning
+        from h2o3_tpu.models.gbm import DRF, GBM
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.xgboost import XGBoost
+
+        steps: list[tuple[str, type, dict]] = []
+        steps.append(("GLM", GLM, dict(lambda_=1e-4, alpha=0.5)))
+        # XGBoost fixed set (XGBoostStepsProvider defaults 1-3)
+        for d, sr in ((6, 0.8), (9, 0.6), (3, 0.8)):
+            steps.append(("XGBOOST", XGBoost,
+                          dict(ntrees=50, max_depth=d, sample_rate=sr,
+                               col_sample_rate_per_tree=0.8, learn_rate=0.3)))
+        # GBM fixed set (GBMStepsProvider: 5 fixed configs)
+        for d in (6, 7, 8, 10, 13):
+            steps.append(("GBM", GBM,
+                          dict(ntrees=50, max_depth=min(d, 13), learn_rate=0.1,
+                               sample_rate=0.8, col_sample_rate=0.8)))
+        steps.append(("DRF", DRF, dict(ntrees=50)))
+        # XRT: extremely-randomized variant (DRF with deeper trees, full rows)
+        steps.append(("DRF", DRF, dict(ntrees=50, sample_rate=1.0, max_depth=16)))
+        steps.append(("DEEPLEARNING", DeepLearning,
+                      dict(hidden=[64, 64], epochs=10, mini_batch_size=32)))
+        return steps
+
+    def _grids(self):
+        from h2o3_tpu.models.gbm import GBM
+        from h2o3_tpu.models.xgboost import XGBoost
+        rng_seed = self.seed if self.seed >= 0 else 42
+        return [
+            ("GBM", GBM,
+             dict(ntrees=50),
+             {"max_depth": [3, 5, 7, 9], "learn_rate": [0.05, 0.1, 0.2],
+              "sample_rate": [0.6, 0.8, 1.0], "col_sample_rate": [0.4, 0.7, 1.0]},
+             rng_seed),
+            ("XGBOOST", XGBoost,
+             dict(ntrees=50),
+             {"max_depth": [4, 6, 8], "learn_rate": [0.1, 0.3],
+              "reg_lambda": [0.1, 1.0, 10.0], "sample_rate": [0.6, 0.8, 1.0]},
+             rng_seed + 1),
+        ]
+
+    # -- driver --------------------------------------------------------------
+
+    def train(self, x: Sequence[str] | None = None, y: str | None = None,
+              training_frame: Frame | None = None,
+              leaderboard_frame: Frame | None = None) -> Model | None:
+        if y is None or training_frame is None:
+            raise ValueError("y and training_frame are required")
+        self._t0 = time.time()
+        yvec = training_frame.vec(y)
+        classification = yvec.is_categorical
+        self.leaderboard = Leaderboard(self.sort_metric, leaderboard_frame)
+        log = self.event_log
+        log.log("init", f"AutoML {self.project_name}: y={y!r} "
+                        f"{'classification' if classification else 'regression'}, "
+                        f"budget max_models={self.max_models} "
+                        f"max_runtime_secs={self.max_runtime_secs}")
+
+        common = dict(nfolds=self.nfolds, seed=self.seed,
+                      keep_cross_validation_predictions=True)
+        base_models: list[Model] = []
+
+        for algo, cls, params in self._steps():
+            if not self._budget_left():
+                break
+            if not self._algo_enabled(algo):
+                continue
+            try:
+                t = time.time()
+                m = cls(**{**params, **common}).train(x=x, y=y,
+                                                      training_frame=training_frame)
+                self._n_built += 1
+                base_models.append(m)
+                self.leaderboard.add(m)
+                log.log("model", f"{m.key} ({algo}) in {time.time() - t:.1f}s")
+            except Exception as e:
+                log.log("error", f"{algo} failed: {type(e).__name__}: {e}")
+
+        # random grid phase under the remaining budget
+        for algo, cls, fixed, hyper, gseed in self._grids():
+            if not self._budget_left():
+                break
+            if not self._algo_enabled(algo):
+                continue
+            remaining_models = (self.max_models - self._n_built
+                                if self.max_models else 5)
+            remaining_secs = (self.max_runtime_secs - (time.time() - self._t0)
+                              if self.max_runtime_secs else 0.0)
+            gs = GridSearch(cls, hyper,
+                            search_criteria=dict(strategy="RandomDiscrete",
+                                                 max_models=max(remaining_models, 0),
+                                                 max_runtime_secs=max(remaining_secs, 0.0),
+                                                 seed=gseed),
+                            **{**fixed, **common})
+            grid = gs.train(x=x, y=y, training_frame=training_frame)
+            for m in grid.models:
+                self._n_built += 1
+                base_models.append(m)
+                self.leaderboard.add(m)
+                log.log("model", f"{m.key} ({algo} grid)")
+
+        # ensemble phase (reference: StackedEnsembleStepsProvider — BestOfFamily + All)
+        if self._algo_enabled("STACKEDENSEMBLE") and len(base_models) >= 2:
+            from h2o3_tpu.orchestration.stacked_ensemble import StackedEnsemble
+            stackable = [m for m in base_models if m.cv_holdout_predictions is not None]
+            metric = self.sort_metric or (default_metric(stackable[0]) if stackable else "rmse")
+            dec = metric_higher_is_better(metric)
+
+            def mval(m):
+                mm = m.cross_validation_metrics or m.training_metrics
+                v = getattr(mm, metric, np.nan)
+                return float(v() if callable(v) else v)
+
+            best_of_family: dict[str, Model] = {}
+            for m in stackable:
+                v = mval(m)
+                if np.isnan(v):
+                    continue   # a model without the sort metric can't represent its family
+                cur = best_of_family.get(m.algo)
+                if cur is None or np.isnan(mval(cur)) or \
+                        ((v > mval(cur)) if dec else (v < mval(cur))):
+                    best_of_family[m.algo] = m
+            for name, group in (("BestOfFamily", list(best_of_family.values())),
+                                ("AllModels", stackable)):
+                if len(group) < 2:
+                    continue
+                try:
+                    se = StackedEnsemble(base_models=group,
+                                         model_id=f"StackedEnsemble_{name}_{self.project_name}",
+                                         ).train(y=y, training_frame=training_frame)
+                    # rank the ensemble by the metalearner's metrics on the
+                    # OOF level-one frame — out-of-fold w.r.t. the base models,
+                    # hence comparable to their CV metrics (training_metrics
+                    # would re-score base models in-sample and inflate the AUC)
+                    se.cross_validation_metrics = \
+                        se.output["metalearner"].training_metrics
+                    self.leaderboard.add(se)
+                    log.log("model", f"{se.key} over {len(group)} base models")
+                except Exception as e:
+                    log.log("error", f"StackedEnsemble {name} failed: "
+                                     f"{type(e).__name__}: {e}")
+
+        log.log("done", f"{len(self.leaderboard)} models in "
+                        f"{time.time() - self._t0:.1f}s")
+        return self.leader
+
+    @property
+    def leader(self) -> Model | None:
+        return self.leaderboard.leader if self.leaderboard else None

@@ -1,0 +1,83 @@
+"""Leaderboard — ranked model container.
+
+Reference: ``hex/leaderboard/Leaderboard.java`` (+8 extension-column files):
+ranks models by a sort metric chosen from the problem type, computes all
+metrics on a shared leaderboard frame (or CV/valid metrics), and exposes an
+extensible column set (training time, per-row scoring time, algo).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import Model
+from h2o3_tpu.orchestration.grid import default_metric, metric_higher_is_better
+
+
+class Leaderboard:
+    def __init__(self, sort_metric: str | None = None,
+                 leaderboard_frame: Frame | None = None):
+        self.sort_metric = sort_metric
+        self.leaderboard_frame = leaderboard_frame
+        self._rows: list[dict] = []
+
+    def add(self, model: Model) -> None:
+        if self.leaderboard_frame is not None and model.response_column in self.leaderboard_frame:
+            mm = model.model_performance(self.leaderboard_frame)
+        else:
+            mm = (model.cross_validation_metrics or model.validation_metrics
+                  or model.training_metrics)
+        if mm is None:
+            return
+        row = {"model_id": model.key, "algo": model.algo,
+               "training_time_ms": model.run_time_ms, "_model": model}
+        for f in ("auc", "pr_auc", "logloss", "mean_per_class_error", "rmse",
+                  "mse", "mae", "r2", "accuracy"):
+            if hasattr(mm, f):
+                v = getattr(mm, f)
+                row[f] = float(v() if callable(v) else v)
+        self._rows.append(row)
+
+    def _sorted(self) -> list[dict]:
+        if not self._rows:
+            return []
+        metric = self.sort_metric or default_metric(self._rows[0]["_model"])
+        dec = metric_higher_is_better(metric)
+        return sorted(self._rows,
+                      key=lambda r: (np.isnan(r.get(metric, np.nan)),
+                                     -r.get(metric, np.nan) if dec
+                                     else r.get(metric, np.nan)))
+
+    @property
+    def models(self) -> list[Model]:
+        return [r["_model"] for r in self._sorted()]
+
+    @property
+    def leader(self) -> Model | None:
+        ms = self.models
+        return ms[0] if ms else None
+
+    def as_frame(self) -> Frame:
+        """Leaderboard as a Frame (reference: Leaderboard.toTwoDimTable)."""
+        rows = self._sorted()
+        if not rows:
+            return Frame([], [])
+        cols = [k for k in rows[0] if k != "_model"]
+        data = {c: np.array([r.get(c, np.nan) for r in rows],
+                            dtype=object if c in ("model_id", "algo") else float)
+                for c in cols}
+        return Frame.from_arrays(data)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __repr__(self) -> str:
+        rows = self._sorted()
+        metric = self.sort_metric or (default_metric(rows[0]["_model"]) if rows else "")
+        lines = [f"Leaderboard({len(rows)} models, sort={metric})"]
+        for r in rows[:10]:
+            lines.append(f"  {r['model_id']}: {r.get(metric, float('nan')):.5f}")
+        return "\n".join(lines)

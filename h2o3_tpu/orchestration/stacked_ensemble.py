@@ -1,0 +1,131 @@
+"""StackedEnsemble — metalearner over base-model out-of-fold predictions.
+
+Reference: ``hex/ensemble/StackedEnsemble.java`` (1.8 kLoC): collects the
+base models' cross-validation holdout predictions into a "levelone" frame,
+trains a metalearner (default GLM with non-negative weights) on it, and
+scores by running every base model then the metalearner.
+
+TPU-native: the levelone matrix is assembled directly from the device-resident
+OOF prediction arrays each base model kept
+(``keep_cross_validation_predictions``) — no frame materialization — and the
+metalearner sees it as a plain Frame of numeric columns.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _base_columns(model: Model, raw) -> list:
+    """Columns a base model contributes to the levelone frame: p(class) for
+    classifiers (dropping the last, redundant class), the prediction for
+    regression."""
+    if model.nclasses == 2:
+        return [raw[:, 1]]
+    if model.nclasses > 2:
+        return [raw[:, k] for k in range(model.nclasses - 1)]
+    return [raw]
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def _score_raw(self, frame: Frame):
+        cols = []
+        for bm in self.output["base_models"]:
+            raw = bm._score_raw(frame)
+            cols.extend(_base_columns(bm, raw))
+        lvl1 = Frame(list(self.output["levelone_names"]),
+                     [Vec.from_device(c, frame.nrows, VecType.NUM) for c in cols])
+        return self.output["metalearner"]._score_raw(lvl1)
+
+
+class StackedEnsemble(ModelBuilder):
+    """h2o-py surface: ``H2OStackedEnsembleEstimator``."""
+
+    algo = "stackedensemble"
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            base_models=[],
+            metalearner_algorithm="AUTO",   # AUTO → GLM (reference default)
+            metalearner_params=None,
+        )
+
+    def train(self, x=None, y=None, training_frame=None, **kw):
+        base = self.params["base_models"]
+        if not base:
+            raise ValueError("base_models is required")
+        if any(m.cv_holdout_predictions is None for m in base):
+            raise ValueError("all base models need "
+                             "keep_cross_validation_predictions=True and nfolds>=2")
+        return super().train(x=x, y=y, training_frame=training_frame, **kw)
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> StackedEnsembleModel:
+        p = self.params
+        base: list[Model] = list(p["base_models"])
+        yvec = frame.vec(y)
+        for m in base:
+            if m.response_column != y:
+                raise ValueError(f"base model {m.key} trained on response "
+                                 f"{m.response_column!r}, not {y!r}")
+
+        # levelone frame from the kept OOF predictions
+        cols, names = [], []
+        hold = None
+        for m in base:
+            raw = m.cv_holdout_predictions
+            for i, c in enumerate(_base_columns(m, raw)):
+                cols.append(c)
+                names.append(f"{m.key}_{i}")
+            hmask = m.cv_holdout_mask
+            hold = hmask if hold is None else (hold & hmask)
+        lvl1_names = names + [y]
+        lvl1 = Frame(lvl1_names,
+                     [Vec.from_device(c, frame.nrows, VecType.NUM) for c in cols]
+                     + [frame.vec(y)])
+
+        algo = str(p["metalearner_algorithm"]).upper()
+        mparams = dict(p["metalearner_params"] or {})
+        if algo in ("AUTO", "GLM"):
+            from h2o3_tpu.models.glm import GLM
+            if algo == "AUTO":
+                # reference default metalearner: GLM, non-negative weights
+                mparams.setdefault("non_negative", True)
+                mparams.setdefault("lambda_", 0.0)
+            family = ("binomial" if yvec.cardinality() == 2 else
+                      "multinomial" if yvec.is_categorical else "gaussian")
+            mparams.setdefault("family", family)
+            mbuilder = GLM(**mparams)
+        elif algo == "GBM":
+            from h2o3_tpu.models.gbm import GBM
+            mbuilder = GBM(**mparams)
+        elif algo == "DRF":
+            from h2o3_tpu.models.gbm import DRF
+            mbuilder = DRF(**mparams)
+        elif algo == "DEEPLEARNING":
+            from h2o3_tpu.models.deeplearning import DeepLearning
+            mbuilder = DeepLearning(**mparams)
+        else:
+            raise ValueError(f"unsupported metalearner_algorithm {algo!r}")
+
+        # train only on rows that are OOF-covered for every base model
+        meta_w = weights * hold
+        meta = mbuilder.train(x=names, y=y, training_frame=lvl1, weights=meta_w)
+
+        return StackedEnsembleModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=y,
+            response_domain=yvec.domain if yvec.is_categorical else None,
+            output=dict(base_models=base, metalearner=meta,
+                        levelone_names=names),
+        )

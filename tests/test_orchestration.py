@@ -1,0 +1,156 @@
+"""Orchestration tests — grid search, leaderboard, stacked ensemble, AutoML
+(reference test model: ``h2o-py/tests/testdir_algos/grid``,
+``testdir_algos/stackedensemble``, ``testdir_algos/automl``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GBM, GLM
+from h2o3_tpu.orchestration import AutoML, GridSearch, Leaderboard, StackedEnsemble
+
+
+def _binom_frame(rng, n=1200):
+    X = rng.normal(size=(n, 4))
+    logits = 1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(int)
+    cols = {f"x{i}": X[:, i] for i in range(4)}
+    cols["y"] = np.array(["yes" if v else "no" for v in y], dtype=object)
+    return Frame.from_arrays(cols)
+
+
+def _multi_frame(rng, n=1500):
+    X = rng.normal(size=(n, 3))
+    scores = np.stack([0.9 * X[:, 0], -0.7 * X[:, 1], 0.8 * X[:, 2]], axis=1)
+    y = scores.argmax(axis=1)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.array([f"c{v}" for v in y], dtype=object)
+    return Frame.from_arrays(cols), X, y
+
+
+def test_glm_multinomial(rng):
+    f, X, y = _multi_frame(rng)
+    m = GLM(family="multinomial", lambda_=0.0).train(y="y", training_frame=f)
+    assert m.nclasses == 3
+    pred = m.predict(f)
+    assert pred.vec("predict").labels()[0] in ("c0", "c1", "c2")
+    acc = (pred.vec("predict").to_numpy() == y).mean()
+
+    from sklearn.linear_model import LogisticRegression
+    sk = LogisticRegression(max_iter=300).fit(X, y)
+    sk_acc = (sk.predict(X) == y).mean()
+    assert acc > sk_acc - 0.02, (acc, sk_acc)
+    assert m.training_metrics.logloss < 0.6
+
+
+def test_glm_non_negative_matches_nnls(rng):
+    # correlated predictors: clipping the OLS solution is NOT the NNLS optimum,
+    # so this catches a projected-IRLS that fails to re-solve
+    n = 1000
+    base = rng.normal(size=n)
+    X = np.stack([base + 0.05 * rng.normal(size=n),
+                  base + 0.05 * rng.normal(size=n),
+                  rng.normal(size=n)], axis=1)
+    y = 1.0 * X[:, 0] - 0.3 * X[:, 1] + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y})
+    m = GLM(family="gaussian", non_negative=True, standardize=False,
+            max_iterations=50).train(y="y", training_frame=f)
+    coef = m.coef()
+    assert all(coef[k] >= 0.0 for k in ("a", "b", "c"))
+
+    from scipy.optimize import nnls
+    A = np.column_stack([X, np.ones(n)])
+    # intercept unconstrained: shift so the reference solve is pure NNLS
+    ref, _ = nnls(np.column_stack([X, np.ones(n), -np.ones(n)]),
+                  y)
+    ref_coefs = ref[:3]
+    np.testing.assert_allclose([coef["a"], coef["b"], coef["c"]], ref_coefs,
+                               atol=5e-3)
+
+
+def test_glm_multinomial_binary_response(rng):
+    n = 800
+    X = rng.normal(size=(n, 2))
+    y = (X[:, 0] - X[:, 1] > 0).astype(int)
+    f = Frame.from_arrays({"a": X[:, 0], "b": X[:, 1],
+                           "y": np.array(["n", "p"], dtype=object)[y]})
+    m = GLM(family="multinomial").train(y="y", training_frame=f)
+    assert m.nclasses == 2
+    acc = (m.predict(f).vec("predict").to_numpy() == y).mean()
+    assert acc > 0.95
+
+
+def test_grid_search_cartesian(rng):
+    f = _binom_frame(rng)
+    gs = GridSearch(GBM, {"max_depth": [2, 4], "learn_rate": [0.1, 0.3]},
+                    ntrees=5)
+    grid = gs.train(y="y", training_frame=f)
+    assert len(grid.models) == 4
+    depths = sorted(m.output["hyper_values"]["max_depth"] for m in grid.models)
+    assert depths == [2, 2, 4, 4]
+    ranked = grid.sorted_models("auc")
+    aucs = [m.training_metrics.auc for m in ranked]
+    assert aucs == sorted(aucs, reverse=True)
+
+
+def test_grid_search_random_budget(rng):
+    f = _binom_frame(rng, n=600)
+    gs = GridSearch(GBM, {"max_depth": [2, 3, 4, 5], "learn_rate": [0.1, 0.2, 0.3]},
+                    search_criteria={"strategy": "RandomDiscrete",
+                                     "max_models": 3, "seed": 7},
+                    ntrees=3)
+    grid = gs.train(y="y", training_frame=f)
+    assert len(grid.models) == 3
+
+
+def test_leaderboard_ranks(rng):
+    f = _binom_frame(rng)
+    lb = Leaderboard()
+    m1 = GBM(ntrees=15, max_depth=4).train(y="y", training_frame=f)
+    m2 = GLM(family="binomial").train(y="y", training_frame=f)
+    lb.add(m1)
+    lb.add(m2)
+    assert len(lb) == 2
+    # GBM captures the interaction term; GLM cannot
+    assert lb.leader.algo == "gbm"
+    lf = lb.as_frame()
+    assert "auc" in lf.names and lf.nrows == 2
+
+
+def test_stacked_ensemble_binomial(rng):
+    f = _binom_frame(rng, n=1500)
+    common = dict(nfolds=3, keep_cross_validation_predictions=True)
+    m1 = GBM(ntrees=15, max_depth=4, **common).train(y="y", training_frame=f)
+    m2 = GLM(family="binomial", **common).train(y="y", training_frame=f)
+    se = StackedEnsemble(base_models=[m1, m2]).train(y="y", training_frame=f)
+    assert se.training_metrics.auc >= min(m1.training_metrics.auc,
+                                          m2.training_metrics.auc) - 0.01
+    pred = se.predict(f)
+    assert set(pred.names) == {"predict", "pno", "pyes"}
+    meta_coef = se.output["metalearner"].coef()
+    # AUTO metalearner is non-negative GLM (reference default)
+    assert all(v >= 0 for k, v in meta_coef.items() if k != "Intercept")
+
+
+def test_stacked_ensemble_requires_cv(rng):
+    f = _binom_frame(rng, n=400)
+    m = GBM(ntrees=3).train(y="y", training_frame=f)
+    with pytest.raises(ValueError, match="keep_cross_validation_predictions"):
+        StackedEnsemble(base_models=[m]).train(y="y", training_frame=f)
+
+
+def test_automl_small(rng):
+    f = _binom_frame(rng, n=800)
+    aml = AutoML(max_models=4, nfolds=3, seed=1,
+                 include_algos=["GLM", "GBM", "DRF", "STACKEDENSEMBLE"])
+    leader = aml.train(y="y", training_frame=f)
+    assert leader is not None
+    assert len(aml.leaderboard) >= 4
+    algos = {m.algo for m in aml.leaderboard.models}
+    assert "gbm" in algos and "glm" in algos
+    assert any("model" == s for _, s, _ in aml.event_log.events)
+    # leaderboard sorted by AUC descending
+    aucs = []
+    for r in aml.leaderboard._sorted():
+        aucs.append(r["auc"])
+    assert aucs == sorted(aucs, reverse=True)
