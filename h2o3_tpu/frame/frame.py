@@ -107,6 +107,8 @@ class Frame:
         if isinstance(sel, (list, tuple)):
             idxs = [self._index(c) for c in sel]
             return Frame([self.names[i] for i in idxs], [self.vecs[i] for i in idxs])
+        if isinstance(sel, Vec):           # boolean row filter (rapids AstRowSlice)
+            return self.filter(sel)
         raise TypeError(f"unsupported selector {sel!r}")
 
     def __contains__(self, name: str) -> bool:
@@ -176,6 +178,58 @@ class Frame:
 
     def head(self, n: int = 10):
         return self.to_pandas().head(n)
+
+    # -- munging surface (rapids layer; mirrors h2o-py H2OFrame methods) -----
+
+    def sort(self, by, ascending=True) -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.sort(self, by, ascending)
+
+    def group_by(self, by):
+        from h2o3_tpu.rapids import GroupBy
+        return GroupBy(self, by)
+
+    def merge(self, other: "Frame", by=None, all_x: bool = False,
+              all_y: bool = False) -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.merge(self, other, by=by, all_x=all_x, all_y=all_y)
+
+    def filter(self, mask) -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.filter_rows(self, mask)
+
+    def rbind(self, *others: "Frame") -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.rbind(self, *others)
+
+    def cbind(self, *others: "Frame") -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.cbind(self, *others)
+
+    def unique(self, cols=None) -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.unique(self, cols)
+
+    def pivot(self, index: str, column: str, value: str, agg: str = "mean") -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.pivot(self, index, column, value, agg)
+
+    def melt(self, id_vars, value_vars=None, **kw) -> "Frame":
+        from h2o3_tpu.rapids import munge
+        return munge.melt(self, id_vars, value_vars, **kw)
+
+    def quantile(self, probs=(0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667,
+                              0.75, 0.9, 0.99, 0.999)) -> "Frame":
+        from h2o3_tpu.rapids import ops
+        return ops.quantile(self, probs)
+
+    def impute(self, column: str, method: str = "mean", by=None) -> "Frame":
+        from h2o3_tpu.rapids import ops
+        return ops.impute(self, column, method, by)
+
+    def scale(self, center: bool = True, scale: bool = True) -> "Frame":
+        from h2o3_tpu.rapids import ops
+        return ops.scale(self, center, scale)
 
     def __len__(self) -> int:
         return self.nrows

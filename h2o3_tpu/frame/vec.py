@@ -180,6 +180,111 @@ class Vec:
         dom = f", card={self.cardinality()}" if self.is_categorical else ""
         return f"Vec({self.type}, nrows={self.nrows}{dom})"
 
+    # -- elementwise operators (reference: water/rapids/ast/prims/operators/) --
+    #
+    # Results are NUM Vecs; NA propagates through NaN arithmetic for free
+    # (padding is NaN too, so padded slots stay invalid). Comparisons yield
+    # 0.0/1.0 with NaN for NA operands, matching the reference's binary ops.
+
+    def _operand(self, other):
+        if isinstance(other, Vec):
+            if other.nrows != self.nrows:
+                raise ValueError("Vec length mismatch")
+            o = other.as_float()
+            # TIME device data is offset-relative (see __init__); align the
+            # operand into THIS column's frame so differences/compares are
+            # exact regardless of each column's own offset
+            if self.type is VecType.TIME or other.type is VecType.TIME:
+                o = o + (other.time_offset - self.time_offset)
+            return o
+        if isinstance(other, str):
+            if not self.is_categorical:
+                raise TypeError("string comparand requires a categorical Vec")
+            try:
+                return float(self.domain.index(other))
+            except ValueError:
+                return float("nan")   # unknown level: matches nothing
+        o = float(other)
+        if self.type is VecType.TIME:
+            o = o - self.time_offset   # scalars are absolute epoch ms
+        return o
+
+    def _time_pair_host(self, other):
+        """Both-TIME operand pair as exact float64 host ms, or None. A 25-year
+        offset difference overflows the f32 relative representation, so
+        TIME⋅TIME arithmetic runs on the exact host payload."""
+        if (isinstance(other, Vec) and self.type is VecType.TIME
+                and other.type is VecType.TIME
+                and self.host_values is not None
+                and other.host_values is not None):
+            return (self.host_values[: self.nrows].astype(np.float64),
+                    other.host_values[: other.nrows].astype(np.float64))
+        return None
+
+    def _ew(self, other, fn, swap: bool = False):
+        pair = self._time_pair_host(other)
+        if pair is not None:
+            a, o = pair
+            # numpy twin of the jnp ufunc: jnp would downcast the exact f64
+            # epoch values to f32 (x64 is disabled)
+            fn = getattr(np, getattr(fn, "__name__", ""), fn)
+            out = np.asarray(fn(o, a) if swap else fn(a, o), np.float32)
+            return Vec.from_numpy(out, type=VecType.NUM)
+        o = self._operand(other)
+        a = self.as_float()
+        out = fn(o, a) if swap else fn(a, o)
+        return Vec(out.astype(jnp.float32), VecType.NUM, self.nrows)
+
+    def __add__(self, o): return self._ew(o, jnp.add)
+    def __radd__(self, o): return self._ew(o, jnp.add)
+    def __sub__(self, o): return self._ew(o, jnp.subtract)
+    def __rsub__(self, o): return self._ew(o, jnp.subtract, swap=True)
+    def __mul__(self, o): return self._ew(o, jnp.multiply)
+    def __rmul__(self, o): return self._ew(o, jnp.multiply)
+    def __truediv__(self, o): return self._ew(o, jnp.divide)
+    def __rtruediv__(self, o): return self._ew(o, jnp.divide, swap=True)
+    def __pow__(self, o): return self._ew(o, jnp.power)
+    def __rpow__(self, o): return self._ew(o, jnp.power, swap=True)
+    def __mod__(self, o): return self._ew(o, jnp.mod)
+    def __rmod__(self, o): return self._ew(o, jnp.mod, swap=True)
+    def __floordiv__(self, o): return self._ew(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._ew(o, jnp.floor_divide, swap=True)
+    def __neg__(self): return self._ew(-1.0, jnp.multiply)
+
+    def _cmp(self, other, fn):
+        pair = self._time_pair_host(other)
+        if pair is not None:
+            a, o = pair
+            fn = getattr(np, getattr(fn, "__name__", ""), fn)   # keep f64 exact
+            out = np.where(np.isnan(a) | np.isnan(o), np.nan,
+                           np.asarray(fn(a, o), np.float32))
+            return Vec.from_numpy(out.astype(np.float32), type=VecType.NUM)
+        o = self._operand(other)
+        a = self.as_float()
+        valid = ~jnp.isnan(a)
+        if isinstance(o, jax.Array):
+            valid = valid & ~jnp.isnan(o)
+        out = jnp.where(valid, fn(a, o).astype(jnp.float32), jnp.nan)
+        return Vec(out, VecType.NUM, self.nrows)
+
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+    def __eq__(self, o): return self._cmp(o, lambda a, b: a == b)
+    def __ne__(self, o): return self._cmp(o, lambda a, b: a != b)
+    __hash__ = object.__hash__   # __eq__ returns a Vec, not a bool
+
+    def __and__(self, o): return self._cmp(o, lambda a, b: (a != 0) & (b != 0))
+    def __or__(self, o): return self._cmp(o, lambda a, b: (a != 0) | (b != 0))
+    def __invert__(self): return self._cmp(0.0, lambda a, b: a == b)
+
+    def isna(self) -> "Vec":
+        """1.0 where the value is missing (works on padded slots too — they
+        read as NA but are excluded by the frame row mask downstream)."""
+        return Vec(jnp.isnan(self.as_float()).astype(jnp.float32),
+                   VecType.NUM, self.nrows)
+
 
 def _guess_type(values: np.ndarray) -> VecType:
     values = np.asarray(values)

@@ -1,0 +1,220 @@
+"""Rapids expression engine — lisp-like AST over frames.
+
+Reference: ``water/rapids/Rapids.java`` (parser), ``Env.java`` (scopes),
+``Session.java`` (temp-frame lifecycle). The h2o-py client never sends raw
+Java; every lazy ``H2OFrame`` expression compiles to one of these s-expressions
+and POSTs it to ``/99/Rapids`` — so this module is what makes a client shim
+possible. Grammar (Rapids.java header): ``(op args…)``, numbers, ``"strings"``,
+``[num-list]``, identifiers (DKV keys / special ops).
+
+Evaluation is eager here (the laziness lives client-side), each primitive
+dispatching to the XLA-backed ops in :mod:`h2o3_tpu.rapids`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.rapids import munge, ops
+from h2o3_tpu.utils.registry import DKV
+
+# ---------------------------------------------------------------------------
+# parser
+
+
+def _tokenize(s: str) -> list[str]:
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c.isspace():
+            i += 1
+        elif c in "()[]":
+            out.append(c)
+            i += 1
+        elif c in "\"'":
+            j = s.index(c, i + 1)
+            out.append(s[i:j + 1])
+            i = j + 1
+        else:
+            j = i
+            while j < n and not s[j].isspace() and s[j] not in "()[]":
+                j += 1
+            out.append(s[i:j])
+            i = j
+    return out
+
+
+def _parse(tokens: list[str]) -> Any:
+    tok = tokens.pop(0)
+    if tok == "(":
+        expr = []
+        while tokens[0] != ")":
+            expr.append(_parse(tokens))
+        tokens.pop(0)
+        return expr
+    if tok == "[":
+        lst = []
+        while tokens[0] != "]":
+            lst.append(_parse(tokens))
+        tokens.pop(0)
+        return np.array(lst, dtype=np.float64)
+    if tok[0] in "\"'":
+        return ("str", tok[1:-1])
+    try:
+        return float(tok)
+    except ValueError:
+        return ("id", tok)
+
+
+# ---------------------------------------------------------------------------
+# evaluator
+
+_BINOPS = {"+": "__add__", "-": "__sub__", "*": "__mul__", "/": "__truediv__",
+           "^": "__pow__", "%": "__mod__",
+           "<": "__lt__", "<=": "__le__", ">": "__gt__", ">=": "__ge__",
+           "==": "__eq__", "!=": "__ne__", "&": "__and__", "|": "__or__"}
+
+import operator as _op_mod
+
+_PYOPS = {"+": _op_mod.add, "-": _op_mod.sub, "*": _op_mod.mul,
+          "/": _op_mod.truediv, "^": _op_mod.pow, "%": _op_mod.mod,
+          "<": _op_mod.lt, "<=": _op_mod.le, ">": _op_mod.gt,
+          ">=": _op_mod.ge, "==": _op_mod.eq, "!=": _op_mod.ne,
+          "&": lambda a, b: float(bool(a) and bool(b)),
+          "|": lambda a, b: float(bool(a) or bool(b))}
+
+_REDUCERS = {"sum": ops.vsum, "mean": ops.vmean, "min": ops.vmin,
+             "max": ops.vmax, "sd": ops.vsd, "var": ops.vvar,
+             "median": ops.vmedian, "any": ops.vany, "all": ops.vall,
+             "prod": ops.vprod}
+
+
+def _as_vec(x) -> Vec:
+    if isinstance(x, Frame):
+        if x.ncols != 1:
+            raise ValueError("expected a single-column frame")
+        return x.vecs[0]
+    if isinstance(x, Vec):
+        return x
+    raise TypeError(f"expected a column, got {type(x).__name__}")
+
+
+def _colwise(frame_or_vec, fn) -> Frame:
+    if isinstance(frame_or_vec, Frame):
+        return Frame(list(frame_or_vec.names), [fn(v) for v in frame_or_vec.vecs])
+    return Frame(["C1"], [fn(frame_or_vec)])
+
+
+class Session:
+    """Temp-frame scope (reference: ``water/rapids/Session.java``)."""
+
+    def __init__(self):
+        self._tmp: dict[str, Frame] = {}
+
+    def lookup(self, name: str):
+        if name in self._tmp:
+            return self._tmp[name]
+        return DKV.get(name)
+
+    def assign(self, name: str, value: Frame):
+        self._tmp[name] = value
+        return value
+
+    def end(self):
+        self._tmp.clear()
+
+
+def rapids(expr: str, session: Session | None = None):
+    """Parse and evaluate one Rapids expression (reference: ``Rapids.exec``)."""
+    session = session or Session()
+    return _eval(_parse(_tokenize(expr)), session)
+
+
+def _eval(node, s: Session):
+    if isinstance(node, float) or isinstance(node, np.ndarray):
+        return node
+    if isinstance(node, tuple):
+        kind, val = node
+        if kind == "str":
+            return val
+        obj = s.lookup(val)
+        if obj is None:
+            raise KeyError(f"unknown identifier {val!r}")
+        return obj
+    op = node[0]
+    op = op[1] if isinstance(op, tuple) else op
+
+    if op in ("tmp=", "assign"):
+        name = node[1][1] if isinstance(node[1], tuple) else str(node[1])
+        return s.assign(name, _eval(node[2], s))
+
+    args = [_eval(a, s) for a in node[1:]]
+
+    if op in _BINOPS:
+        a, b = args
+        if isinstance(a, Frame) and isinstance(b, Frame):
+            return Frame(list(a.names),
+                         [getattr(x, _BINOPS[op])(y)
+                          for x, y in zip(a.vecs, b.vecs)])
+        if isinstance(a, Frame):
+            return _colwise(a, lambda v: getattr(v, _BINOPS[op])(b))
+        if isinstance(b, Frame):
+            swapped = {"__add__": "__radd__", "__mul__": "__rmul__",
+                       "__sub__": "__rsub__", "__truediv__": "__rtruediv__",
+                       "__pow__": "__rpow__"}
+            m = swapped.get(_BINOPS[op])
+            if m:
+                return _colwise(b, lambda v: getattr(v, m)(a))
+            inverse = {"<": "__gt__", "<=": "__ge__", ">": "__lt__",
+                       ">=": "__le__", "==": "__eq__", "!=": "__ne__",
+                       "&": "__and__", "|": "__or__"}
+            return _colwise(b, lambda v: getattr(v, inverse[op])(a))
+        return float(_PYOPS[op](a, b))   # scalar ⋅ scalar
+
+    if op in ops._UNARY:
+        return _colwise(args[0], lambda v: ops.math_op(op, v))
+    if op in _REDUCERS:
+        return _REDUCERS[op](_as_vec(args[0]))
+    if op == "ifelse":
+        c, yes, no = args
+        return _colwise(c, lambda v: ops.ifelse(
+            v, _as_vec(yes) if isinstance(yes, Frame) else yes,
+            _as_vec(no) if isinstance(no, Frame) else no))
+    if op == "cols":
+        fr, sel = args
+        names = [sel] if isinstance(sel, str) else \
+            [fr.names[int(i)] for i in np.atleast_1d(sel)]
+        return fr[names]
+    if op == "rows":
+        fr, sel = args
+        if isinstance(sel, Frame):
+            return munge.filter_rows(fr, sel.vecs[0])
+        return munge.gather_rows(fr, np.atleast_1d(sel).astype(np.int64))
+    if op == "nrow":
+        return float(args[0].nrows)
+    if op == "ncol":
+        return float(args[0].ncols)
+    if op == "rbind":
+        return munge.rbind(*args)
+    if op == "cbind":
+        return munge.cbind(*args)
+    if op == "unique":
+        return munge.unique(args[0])
+    if op == "sort":
+        fr, sel = args[0], args[1]
+        cols = [sel] if isinstance(sel, str) else \
+            [fr.names[int(i)] for i in np.atleast_1d(sel)]
+        asc = [bool(a) for a in np.atleast_1d(args[2])] if len(args) > 2 else True
+        return munge.sort(fr, cols, asc)
+    if op == "merge":
+        return munge.merge(args[0], args[1])
+    if op == "h2o.runif":
+        fr, seed = args
+        rng = np.random.default_rng(int(seed) if seed >= 0 else None)
+        return Frame(["rnd"], [Vec.from_numpy(
+            rng.random(fr.nrows).astype(np.float32))])
+    raise ValueError(f"unknown rapids op {op!r}")

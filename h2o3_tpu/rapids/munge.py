@@ -1,0 +1,496 @@
+"""Frame mungers: sort, group-by, merge/join, rbind/cbind, pivot, melt, unique.
+
+Reference: ``water/rapids/ast/prims/mungers/`` (``AstGroup``, ``AstMerge``,
+``AstSort``, ``AstPivot``, ``AstMelt``, ``AstRBind``/``AstCBind``, ``AstUnique``)
+and the distributed sort/merge engine (``water/rapids/RadixOrder.java:20-105``,
+``BinaryMerge.java``, ``Merge.java``, ``SortCombine.java``).
+
+TPU-native redesign: the reference's MSB-radix distributed sort + chunked
+binary merge becomes **one XLA lexsort over the row-sharded columns** (XLA sort
+is a distributed bitonic network over ICI) and group identification becomes
+sorted-boundary cumsum + ``segment_sum`` reductions — the standard accelerator
+database idiom. Join plans (which output row pairs exist) are control-flow
+heavy and sized dynamically, so they are computed with numpy on the host from
+the device-computed group ids; the actual data movement is device gathers.
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import CAT_NA, VecType
+from h2o3_tpu.frame.vec import Vec, padded_len
+from h2o3_tpu.parallel.mesh import row_sharding
+
+# ---------------------------------------------------------------------------
+# gather plumbing
+
+
+def _put(arr: np.ndarray | jax.Array) -> jax.Array:
+    return jax.device_put(jnp.asarray(arr), row_sharding(1))
+
+
+def _pad_to(arr: jax.Array, plen: int, fill) -> jax.Array:
+    if arr.shape[0] == plen:
+        return arr
+    if arr.shape[0] > plen:
+        return arr[:plen]
+    return jnp.concatenate([arr, jnp.full(plen - arr.shape[0], fill, arr.dtype)])
+
+
+def _gather_vec(v: Vec, idx_dev: jax.Array, idx_host: np.ndarray, new_nrows: int) -> Vec:
+    """New Vec of ``v``'s values at source rows ``idx`` (−1 → NA)."""
+    if v.type is VecType.TIME and v.host_values is not None:
+        ms = np.full(new_nrows, np.nan)
+        ok = idx_host >= 0
+        ms[ok] = v.host_values[idx_host[ok]]
+        from h2o3_tpu.rapids.timeops import ms_to_datetime64
+        return Vec.from_numpy(ms_to_datetime64(ms), type=VecType.TIME)
+    if not v.type.on_device:
+        out = np.full(new_nrows, None, dtype=object)
+        ok = idx_host >= 0
+        out[ok] = v.host_values[idx_host[ok]]
+        return Vec(None, v.type, new_nrows, host_values=out)
+    safe = jnp.clip(idx_dev, 0, v.plen - 1)
+    g = v.data[safe]
+    fill = CAT_NA if v.type is VecType.CAT else jnp.nan
+    g = jnp.where(idx_dev < 0, jnp.asarray(fill, g.dtype), g)
+    return Vec(_put(g), v.type, new_nrows, domain=v.domain)
+
+
+def gather_rows(frame: Frame, idx: np.ndarray) -> Frame:
+    """Frame of ``frame``'s rows at host indices ``idx`` (−1 → all-NA row).
+    This is the reference's row-slice / merge materialization step."""
+    idx = np.asarray(idx, np.int32)
+    n = len(idx)
+    idx_dev = _put(_pad_host(idx, padded_len(n)))
+    return Frame(list(frame.names),
+                 [_gather_vec(v, idx_dev, idx, n) for v in frame.vecs],
+                 key=None)
+
+
+def _pad_host(idx: np.ndarray, plen: int) -> np.ndarray:
+    out = np.full(plen, -1, np.int32)
+    out[: len(idx)] = idx
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sort
+
+
+def _float_keys(frame: Frame, by: Sequence[str], ascending: Sequence[bool]):
+    keys = []
+    for col, asc in zip(by, ascending):
+        k = frame.vec(col).as_float()
+        if not asc:
+            k = -k
+        keys.append(jnp.where(jnp.isnan(k), jnp.inf, k))   # NAs sort last
+    return keys
+
+
+def sort_perm(frame: Frame, by: Sequence[str], ascending) -> np.ndarray:
+    """Host permutation of logical rows ordering ``frame`` by ``by``."""
+    if isinstance(ascending, bool):
+        ascending = [ascending] * len(by)
+    keys = _float_keys(frame, by, ascending)
+    is_pad = (jnp.arange(frame.plen) >= frame.nrows).astype(jnp.int32)
+    # lexsort: LAST key is primary — padding first, then by[0], by[1], ...
+    perm = jnp.lexsort(tuple(reversed(keys)) + (is_pad,))
+    return np.asarray(jax.device_get(perm))[: frame.nrows]
+
+
+def sort(frame: Frame, by: str | Sequence[str], ascending=True) -> Frame:
+    """Reference: ``AstSort`` / ``Merge.sort`` — stable multi-column sort,
+    NAs last."""
+    by = [by] if isinstance(by, str) else list(by)
+    return gather_rows(frame, sort_perm(frame, by, ascending))
+
+
+# ---------------------------------------------------------------------------
+# group ids (shared by group_by / merge / pivot / unique)
+
+
+def _group_ids(key_cols: list[jax.Array], valid: jax.Array):
+    """(gid [plen] int32 in original row order — invalid rows get id ngroups,
+    ngroups, rep_idx [ngroups] host int32 of one source row per group).
+
+    Sorted-boundary trick: lexsort keys (invalid rows forced last), boundary
+    where any key differs from the previous row, cumsum → dense group ids.
+    """
+    plen = key_cols[0].shape[0]
+    keys = [jnp.where(jnp.isnan(k), jnp.inf, k) for k in key_cols]
+    inval = (~valid).astype(jnp.int32)
+    perm = jnp.lexsort(tuple(reversed(keys)) + (inval,))
+    skeys = [k[perm] for k in keys]
+    svalid = valid[perm]
+    differs = reduce(jnp.logical_or,
+                     [jnp.concatenate([jnp.zeros(1, bool), k[1:] != k[:-1]])
+                      for k in skeys])
+    gid_sorted = jnp.cumsum(differs.astype(jnp.int32))
+    nvalid = int(jax.device_get(valid.sum()))
+    if nvalid == 0:
+        return jnp.zeros(plen, jnp.int32), 0, np.empty(0, np.int32)
+    ngroups = int(jax.device_get(gid_sorted[nvalid - 1])) + 1
+    gid = jnp.zeros(plen, jnp.int32).at[perm].set(gid_sorted)
+    gid = jnp.where(valid, gid, ngroups).astype(jnp.int32)
+    # representative source row per group = min original index
+    rep = jax.ops.segment_min(jnp.arange(plen, dtype=jnp.int32), gid,
+                              num_segments=ngroups + 1)[:ngroups]
+    return gid, ngroups, np.asarray(jax.device_get(rep))
+
+
+def frame_group_ids(frame: Frame, by: Sequence[str]):
+    cols = [frame.vec(c).as_float() for c in by]
+    return _group_ids(cols, frame.row_mask())
+
+
+# ---------------------------------------------------------------------------
+# group-by
+
+_AGG_OPS = ("count", "nrow", "sum", "mean", "min", "max", "var", "sd",
+            "median", "first", "last")
+
+
+def group_by(frame: Frame, by: str | Sequence[str],
+             aggs: Mapping[str, Sequence[str]] | Sequence[tuple[str, str]]) -> Frame:
+    """Grouped aggregation (reference: ``AstGroup``; h2o-py ``H2OFrame.group_by``).
+
+    ``aggs``: ``{"col": ["mean", "sum"], ...}`` or ``[("mean", "col"), ...]``.
+    NAs in aggregated columns are ignored (reference ``na="rm"`` default);
+    NA key rows form their own group (reference groups NAs together).
+    """
+    by = [by] if isinstance(by, str) else list(by)
+    if isinstance(aggs, Mapping):
+        pairs = [(op, col) for col, ops in aggs.items()
+                 for op in ([ops] if isinstance(ops, str) else ops)]
+    else:
+        pairs = [(op, col) for op, col in aggs]
+    for op, col in pairs:
+        if op not in _AGG_OPS:
+            raise ValueError(f"unknown agg {op!r}; have {_AGG_OPS}")
+        frame.vec(col)   # raises on missing column
+
+    gid, ng, rep = frame_group_ids(frame, by)
+    nseg = ng + 1   # junk bucket for padding/invalid rows
+    out_names: list[str] = []
+    out_vals: list[np.ndarray] = []
+
+    for op, col in pairs:
+        x = frame.vec(col).as_float()
+        valid = ~jnp.isnan(x) & frame.row_mask()
+        xv = jnp.where(valid, x, 0.0)
+        cnt = jax.ops.segment_sum(valid.astype(jnp.float32), gid, nseg)
+        if op in ("count", "nrow"):
+            # row count per group: rows with an NA aggregate value (or an NA
+            # key — the NA group) still count (reference AstGroup.nrow)
+            agg = jax.ops.segment_sum(
+                frame.row_mask().astype(jnp.float32), gid, nseg)
+        elif op == "sum":
+            agg = jax.ops.segment_sum(xv, gid, nseg)
+        elif op == "mean":
+            agg = jax.ops.segment_sum(xv, gid, nseg) / jnp.maximum(cnt, 1.0)
+        elif op == "min":
+            agg = jax.ops.segment_min(jnp.where(valid, x, jnp.inf), gid, nseg)
+        elif op == "max":
+            agg = jax.ops.segment_max(jnp.where(valid, x, -jnp.inf), gid, nseg)
+        elif op in ("var", "sd"):
+            s = jax.ops.segment_sum(xv, gid, nseg)
+            ss = jax.ops.segment_sum(xv * xv, gid, nseg)
+            var = (ss - s * s / jnp.maximum(cnt, 1.0)) / jnp.maximum(cnt - 1.0, 1.0)
+            agg = jnp.sqrt(jnp.maximum(var, 0.0)) if op == "sd" else var
+        elif op in ("first", "last"):
+            seg = jax.ops.segment_min if op == "first" else jax.ops.segment_max
+            sentinel = jnp.iinfo(jnp.int32).max if op == "first" else -1
+            ridx = seg(jnp.where(valid, jnp.arange(x.shape[0], dtype=jnp.int32),
+                                 sentinel), gid, nseg)
+            safe = jnp.clip(ridx, 0, x.shape[0] - 1)
+            agg = jnp.where((ridx >= 0) & (ridx < x.shape[0]), x[safe], jnp.nan)
+        elif op == "median":
+            # median needs values ordered within each group: one extra lexsort
+            # with the value as the minor key (reference AstGroup medians also
+            # re-sort)
+            agg = _group_median(frame, col, gid, nseg)
+        agg = jnp.where(cnt > 0, agg, jnp.nan) if op not in ("count", "nrow") else agg
+        out_names.append(f"{op}_{col}" if op != "nrow" else "nrow")
+        out_vals.append(np.asarray(jax.device_get(agg))[:ng])
+
+    # key columns: representative source row per group
+    out = gather_rows(frame[by], rep)
+    for n, v in zip(out_names, out_vals):
+        name = n
+        while name in out.names:
+            name += "_"
+        out.add(name, Vec.from_numpy(v.astype(np.float64)))
+    return sort(out, by)
+
+
+def _group_median(frame: Frame, col, gid, nseg):
+    x = frame.vec(col).as_float()
+    valid = ~jnp.isnan(x) & frame.row_mask()
+    plen = x.shape[0]
+    # sort by (gid, value); invalid rows last
+    perm = jnp.lexsort((jnp.where(valid, x, jnp.inf),
+                        jnp.where(valid, gid, nseg)))
+    sx = x[perm]
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gid, nseg)
+    start = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                             jnp.cumsum(cnt)[:-1].astype(jnp.int32)])
+    lo = start + (jnp.maximum(cnt, 1) - 1) // 2
+    hi = start + jnp.maximum(cnt, 1) // 2
+    lo = jnp.clip(lo, 0, plen - 1)
+    hi = jnp.clip(hi, 0, plen - 1)
+    return (sx[lo] + sx[hi]) / 2.0
+
+
+# ---------------------------------------------------------------------------
+# merge / join
+
+
+def merge(left: Frame, right: Frame, by: Sequence[str] | None = None,
+          all_x: bool = False, all_y: bool = False) -> Frame:
+    """Equi-join on key columns (reference: ``AstMerge`` over
+    ``BinaryMerge``; h2o-py ``H2OFrame.merge(all_x=, all_y=)``).
+
+    Group ids are computed over the concatenated key columns of both frames
+    (one shared sort), then the join plan (left row, right row) pairs is
+    assembled on the host and materialized with two device gathers.
+    """
+    if by is None:
+        by = [c for c in left.names if c in right.names]
+    by = list(by)
+    if not by:
+        raise ValueError("no common key columns to merge on")
+
+    # shared dense group ids across both frames' keys
+    kl = [left.vec(c).as_float() for c in by]
+    kr = [_align_key(left.vec(c), right.vec(c)) for c in by]
+    keys = [jnp.concatenate([a, b]) for a, b in zip(kl, kr)]
+    valid = jnp.concatenate([left.row_mask(), right.row_mask()])
+    gid, ng, _ = _group_ids(keys, valid)
+    g = np.asarray(jax.device_get(gid))
+    gl, gr = g[: left.plen][: left.nrows], g[left.plen:][: right.nrows]
+
+    order_r = np.argsort(gr, kind="stable")
+    grs = gr[order_r]
+    starts = np.searchsorted(grs, gl, "left")
+    ends = np.searchsorted(grs, gl, "right")
+    cnt = (ends - starts).astype(np.int64)
+
+    keep = cnt > 0
+    out_cnt = np.where(keep, cnt, 1 if all_x else 0)
+    tot = int(out_cnt.sum())
+    left_plan = np.repeat(np.arange(left.nrows, dtype=np.int64), out_cnt)
+    cum = np.cumsum(out_cnt) - out_cnt
+    pos = np.arange(tot, dtype=np.int64) - np.repeat(cum, out_cnt)
+    rp_base = np.repeat(np.where(keep, starts, -1), out_cnt)
+    right_plan = np.where(rp_base >= 0, order_r[np.clip(rp_base + pos, 0, max(len(order_r) - 1, 0))], -1)
+    right_plan = np.where(np.repeat(keep, out_cnt), right_plan, -1)
+
+    if all_y:
+        matched = np.zeros(right.nrows, bool)
+        matched[right_plan[right_plan >= 0]] = True
+        extra = np.nonzero(~matched)[0]
+        left_plan = np.concatenate([left_plan, np.full(len(extra), -1, np.int64)])
+        right_plan = np.concatenate([right_plan, extra])
+
+    lf = gather_rows(left, left_plan)
+    right_rest = [c for c in right.names if c not in by]
+    rf = gather_rows(right[right_rest], right_plan) if right_rest else None
+    if all_y and len(right_plan):
+        # key values for right-only rows come from the right frame; rebuild
+        # the key columns host-side so differing categorical domains union
+        # cleanly (the device codes are not comparable across frames)
+        rk = gather_rows(right[by], right_plan)
+        miss = left_plan < 0
+        for c in by:
+            lv, rv = lf.vec(c), rk.vec(c)
+            if lv.is_categorical:
+                vals = lv.labels()
+                vals[miss] = rv.labels()[miss]
+                lf.vecs[lf._index(c)] = Vec.from_numpy(vals, type=VecType.CAT)
+            else:
+                vals = lv.to_numpy().copy()
+                vals[miss] = rv.to_numpy()[miss]
+                lf.vecs[lf._index(c)] = Vec.from_numpy(vals, type=lv.type)
+    if rf is not None:
+        for c in right_rest:
+            name = c if c not in lf.names else c + "_y"
+            lf.add(name, rf.vec(c))
+    return lf
+
+
+def _align_key(lv: Vec, rv: Vec) -> jax.Array:
+    """Right key column as floats comparable with the left's: categorical
+    levels are remapped onto the left's domain (unknown levels → NaN+offset
+    sentinel so they join nothing but stay valid rows); TIME columns are
+    shifted into the left's offset frame (their device data is relative)."""
+    if lv.is_categorical != rv.is_categorical:
+        raise TypeError("merge key type mismatch (categorical vs numeric)")
+    if lv.type is VecType.TIME or rv.type is VecType.TIME:
+        return rv.as_float() + (rv.time_offset - lv.time_offset)
+    if not rv.is_categorical or lv.domain == rv.domain:
+        return rv.as_float()
+    lut = np.full(len(rv.domain) + 1, -2.0, np.float32)
+    ldom = {s: i for i, s in enumerate(lv.domain)}
+    for i, s in enumerate(rv.domain):
+        lut[i] = ldom.get(s, -2.0)
+    mapped = jnp.asarray(lut)[jnp.clip(rv.data, -1, len(rv.domain) - 1)]
+    mapped = jnp.where(rv.data < 0, jnp.nan, mapped)
+    # unknown levels: distinct finite sentinel per level so they never equal a
+    # left key (-2 - code keeps them unique and < any real code)
+    return jnp.where(mapped < -1.5, -2.0 - rv.data.astype(jnp.float32), mapped)
+
+
+# ---------------------------------------------------------------------------
+# rbind / cbind
+
+
+def rbind(*frames: Frame) -> Frame:
+    """Stack frames by rows (reference: ``AstRBind``); categorical domains are
+    unioned and codes remapped (the parser's ``PackedDomains`` merge)."""
+    if len(frames) == 1:
+        return frames[0]
+    base = frames[0]
+    for f in frames[1:]:
+        if f.names != base.names:
+            raise ValueError("rbind: column names differ")
+    total = sum(f.nrows for f in frames)
+    out_vecs = []
+    for ci, name in enumerate(base.names):
+        vs = [f.vecs[ci] for f in frames]
+        t = vs[0].type
+        if any(v.type is not t for v in vs):
+            raise ValueError(f"rbind: column {name!r} types differ")
+        if t is VecType.CAT:
+            dom = sorted(set().union(*(v.domain for v in vs)))
+            lut = {s: i for i, s in enumerate(dom)}
+            parts = []
+            for v in vs:
+                m = np.array([lut[s] for s in v.domain] + [CAT_NA], np.int32)
+                codes = np.asarray(jax.device_get(v.data))[: v.nrows]
+                parts.append(m[np.where(codes >= 0, codes, len(m) - 1)])
+            out_vecs.append(Vec.from_numpy(np.concatenate(parts), type=t,
+                                           domain=dom))
+        elif t.on_device and t is not VecType.TIME:
+            parts = [np.asarray(jax.device_get(v.data))[: v.nrows] for v in vs]
+            host = np.concatenate(parts)
+            out_vecs.append(Vec.from_numpy(host, type=t))
+        elif t is VecType.TIME:
+            from h2o3_tpu.rapids.timeops import ms_to_datetime64
+            ms = np.concatenate([v.host_values[: v.nrows] for v in vs])
+            out_vecs.append(Vec.from_numpy(ms_to_datetime64(ms), type=t))
+        else:
+            host = np.concatenate([v.host_values[: v.nrows] for v in vs])
+            out_vecs.append(Vec(None, t, total, host_values=host))
+    return Frame(list(base.names), out_vecs)
+
+
+def cbind(*frames: Frame) -> Frame:
+    """Bind frames by columns (reference: ``AstCBind``); duplicate names get
+    numeric suffixes like the reference."""
+    names: list[str] = []
+    vecs: list[Vec] = []
+    nrows = frames[0].nrows
+    for f in frames:
+        if f.nrows != nrows:
+            raise ValueError("cbind: row counts differ")
+        for n, v in zip(f.names, f.vecs):
+            name, i = n, 0
+            while name in names:
+                name = f"{n}{i}"
+                i += 1
+            names.append(name)
+            vecs.append(v)
+    return Frame(names, vecs)
+
+
+# ---------------------------------------------------------------------------
+# unique / table / pivot / melt
+
+
+def unique(frame: Frame, cols: Sequence[str] | None = None) -> Frame:
+    """Distinct rows of the selected columns (reference: ``AstUnique``)."""
+    cols = list(cols) if cols is not None else list(frame.names)
+    _, ng, rep = frame_group_ids(frame, cols)
+    return sort(gather_rows(frame[cols], rep), cols)
+
+
+def table(frame: Frame, cols: Sequence[str] | None = None) -> Frame:
+    """Level-combination counts (reference: ``AstTable``)."""
+    cols = list(cols) if cols is not None else list(frame.names)
+    first = cols[0]
+    return group_by(frame, cols, [("nrow", first)])
+
+
+def pivot(frame: Frame, index: str, column: str, value: str,
+          agg: str = "mean") -> Frame:
+    """Long→wide (reference: ``AstPivot``): one row per ``index`` group, one
+    output column per level of categorical ``column``."""
+    cv = frame.vec(column)
+    if not cv.is_categorical:
+        raise TypeError("pivot column must be categorical")
+    K = cv.cardinality()
+    gid, ng, rep = frame_group_ids(frame, [index])
+    nseg = ng + 1
+    x = frame.vec(value).as_float()
+    code = cv.data
+    valid = frame.row_mask() & ~jnp.isnan(x) & (code >= 0)
+    comb = jnp.where(valid, gid * K + jnp.clip(code, 0, K - 1), nseg * K)
+    xv = jnp.where(valid, x, 0.0)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.float32), comb, nseg * K + 1)
+    if agg == "count":
+        cells = cnt
+    elif agg == "sum":
+        cells = jax.ops.segment_sum(xv, comb, nseg * K + 1)
+    elif agg == "mean":
+        cells = jax.ops.segment_sum(xv, comb, nseg * K + 1) / jnp.maximum(cnt, 1.0)
+    elif agg == "min":
+        cells = jax.ops.segment_min(jnp.where(valid, x, jnp.inf), comb, nseg * K + 1)
+    elif agg == "max":
+        cells = jax.ops.segment_max(jnp.where(valid, x, -jnp.inf), comb, nseg * K + 1)
+    else:
+        raise ValueError(f"unknown pivot agg {agg!r}")
+    cells = jnp.where(cnt > 0, cells, jnp.nan) if agg != "count" else cells
+    host = np.asarray(jax.device_get(cells))[: ng * K].reshape(ng, K)
+    out = gather_rows(frame[[index]], rep)
+    for k, lev in enumerate(cv.domain):
+        out.add(str(lev), Vec.from_numpy(host[:, k].astype(np.float64)))
+    return sort(out, [index])
+
+
+def melt(frame: Frame, id_vars: Sequence[str], value_vars: Sequence[str] | None = None,
+         var_name: str = "variable", value_name: str = "value") -> Frame:
+    """Wide→long (reference: ``AstMelt``)."""
+    id_vars = list(id_vars)
+    value_vars = list(value_vars) if value_vars is not None else \
+        [c for c in frame.names if c not in id_vars]
+    blocks = []
+    for var in value_vars:
+        b = Frame(list(id_vars), [frame.vec(c) for c in id_vars])
+        b.add(var_name, Vec.from_numpy(
+            np.full(frame.nrows, var, dtype=object), type=VecType.CAT))
+        b.add(value_name, Vec(frame.vec(var).as_float(), VecType.NUM, frame.nrows))
+        blocks.append(b)
+    return rbind(*blocks)
+
+
+# ---------------------------------------------------------------------------
+# row filtering
+
+
+def filter_rows(frame: Frame, mask: Vec | jax.Array) -> Frame:
+    """Rows where ``mask`` is truthy (reference: boolean row slice
+    ``AstRowSlice``); NA mask values drop the row."""
+    m = mask.as_float() if isinstance(mask, Vec) else jnp.asarray(mask)
+    if m.dtype == bool:
+        m = m.astype(jnp.float32)
+    keep = (m > 0) & ~jnp.isnan(m) & frame.row_mask()
+    idx = np.nonzero(np.asarray(jax.device_get(keep)))[0]
+    return gather_rows(frame, idx)

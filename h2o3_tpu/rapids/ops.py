@@ -1,0 +1,286 @@
+"""Elementwise math, reducers, cumulative ops, and frame utilities.
+
+Reference: ``water/rapids/ast/prims/math/`` (36 files), ``reducers/`` (26),
+``advmath/`` (18) — each a tiny AST node wrapping a scalar loop over chunks.
+Here each op is one XLA elementwise kernel over the padded row-sharded column
+(padding is NaN, so it never contaminates reductions, which mask by row).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+# -- elementwise math --------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "sqrt": jnp.sqrt,
+    "floor": jnp.floor, "ceiling": jnp.ceil, "trunc": jnp.trunc,
+    "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "exp": jnp.exp, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "logistic": jax.nn.sigmoid,
+    "not": lambda x: (x == 0).astype(jnp.float32),
+}
+
+
+def math_op(name: str, vec: Vec) -> Vec:
+    """Apply a named unary math op (reference: one ``AstUniOp`` per name)."""
+    try:
+        fn = _UNARY[name]
+    except KeyError:
+        raise ValueError(f"unknown math op {name!r}; have {sorted(_UNARY)}") from None
+    return Vec(fn(vec.as_float()).astype(jnp.float32), VecType.NUM, vec.nrows)
+
+
+def __getattr__(name):   # ops.log(v), ops.exp(v), ... without 30 defs
+    if name in _UNARY:
+        return lambda vec: math_op(name, vec)
+    raise AttributeError(name)
+
+
+def round_(vec: Vec, digits: int = 0) -> Vec:
+    s = 10.0 ** digits
+    return Vec(jnp.round(vec.as_float() * s) / s, VecType.NUM, vec.nrows)
+
+
+def signif(vec: Vec, digits: int = 6) -> Vec:
+    x = vec.as_float()
+    mag = jnp.power(10.0, digits - 1 - jnp.floor(jnp.log10(jnp.abs(x))))
+    out = jnp.where(x == 0, 0.0, jnp.round(x * mag) / mag)
+    return Vec(out.astype(jnp.float32), VecType.NUM, vec.nrows)
+
+
+def ifelse(cond: Vec, yes, no) -> Vec:
+    """Vectorized conditional (reference: ``AstIfElse``); NA test → NA."""
+    c = cond.as_float()
+    yv = yes.as_float() if isinstance(yes, Vec) else float(yes)
+    nv = no.as_float() if isinstance(no, Vec) else float(no)
+    out = jnp.where(jnp.isnan(c), jnp.nan, jnp.where(c != 0, yv, nv))
+    return Vec(out.astype(jnp.float32), VecType.NUM, cond.nrows)
+
+
+# -- reducers (host scalars; padding is NaN so nan-reductions skip it) -------
+
+
+def _valid(vec: Vec):
+    x = vec.as_float()
+    return x, ~jnp.isnan(x)
+
+
+def vsum(vec: Vec) -> float:
+    x, ok = _valid(vec)
+    return float(jax.device_get(jnp.where(ok, x, 0.0).sum()))
+
+
+def vmean(vec: Vec) -> float:
+    x, ok = _valid(vec)
+    return float(jax.device_get(jnp.where(ok, x, 0.0).sum() /
+                                jnp.maximum(ok.sum(), 1)))
+
+
+def vmin(vec: Vec) -> float:
+    return float(jax.device_get(jnp.nanmin(vec.as_float())))
+
+
+def vmax(vec: Vec) -> float:
+    return float(jax.device_get(jnp.nanmax(vec.as_float())))
+
+
+def vvar(vec: Vec) -> float:
+    x, ok = _valid(vec)
+    cnt = ok.sum()
+    n = jnp.maximum(cnt, 2)
+    s = jnp.where(ok, x, 0.0).sum()
+    ss = jnp.where(ok, x * x, 0.0).sum()
+    var = jnp.where(cnt >= 2, (ss - s * s / n) / (n - 1), jnp.nan)
+    return float(jax.device_get(var))   # NaN: sample variance needs n>=2
+
+
+def vsd(vec: Vec) -> float:
+    return float(np.sqrt(max(vvar(vec), 0.0)))
+
+
+def vprod(vec: Vec) -> float:
+    x, ok = _valid(vec)
+    return float(jax.device_get(jnp.where(ok, x, 1.0).prod()))
+
+
+def vmedian(vec: Vec) -> float:
+    return float(jax.device_get(jnp.nanmedian(vec.as_float())))
+
+
+def vany(vec: Vec) -> bool:
+    x, ok = _valid(vec)
+    return bool(jax.device_get((jnp.where(ok, x, 0.0) != 0).any()))
+
+
+def vall(vec: Vec) -> bool:
+    x, ok = _valid(vec)
+    return bool(jax.device_get(jnp.where(ok, x != 0, True).all()))
+
+
+def quantile(frame: Frame, probs: Sequence[float] = (0.001, 0.01, 0.1, 0.25, 0.333,
+                                                     0.5, 0.667, 0.75, 0.9, 0.99, 0.999)
+             ) -> Frame:
+    """Per-column quantiles (reference: ``hex/quantile/Quantile.java`` —
+    TYPE_7 linear interpolation; one device sort per column via nanquantile,
+    padding NaN is skipped for free)."""
+    probs = list(probs)
+    p = jnp.asarray(probs, jnp.float32)
+    cols = {"Probs": np.asarray(probs, np.float64)}
+    for n, v in zip(frame.names, frame.vecs):
+        if v.type.on_device and not v.is_categorical:
+            q = jnp.nanquantile(v.as_float(), p)
+            cols[n] = np.asarray(jax.device_get(q), np.float64)
+    return Frame.from_arrays(cols)
+
+
+# -- cumulative --------------------------------------------------------------
+
+
+def _cum(vec: Vec, fn, neutral) -> Vec:
+    x = vec.as_float()
+    filled = jnp.where(jnp.isnan(x), neutral, x)
+    out = jnp.where(jnp.isnan(x), jnp.nan, fn(filled))
+    return Vec(out.astype(jnp.float32), VecType.NUM, vec.nrows)
+
+
+def cumsum(vec: Vec) -> Vec: return _cum(vec, jnp.cumsum, 0.0)
+def cumprod(vec: Vec) -> Vec: return _cum(vec, jnp.cumprod, 1.0)
+def cummin(vec: Vec) -> Vec: return _cum(vec, jnp.minimum.accumulate, jnp.inf)
+def cummax(vec: Vec) -> Vec: return _cum(vec, jnp.maximum.accumulate, -jnp.inf)
+
+
+# -- advmath utilities -------------------------------------------------------
+
+
+def cut(vec: Vec, breaks: Sequence[float], labels: Sequence[str] | None = None,
+        include_lowest: bool = False, right: bool = True) -> Vec:
+    """Numeric → categorical binning (reference: ``AstCut``)."""
+    br = np.asarray(breaks, np.float64)
+    x = vec.as_float()
+    code = jnp.searchsorted(jnp.asarray(br, jnp.float32), x,
+                            side="left" if right else "right") - 1
+    # right=True bins are (b[i], b[i+1]]: the lowest break itself is out of
+    # range unless include_lowest (R/reference cut semantics)
+    oob = jnp.isnan(x) | (x < br[0]) | (x > br[-1])
+    if right and not include_lowest:
+        oob = oob | (x == br[0])
+    if not right:
+        oob = oob | (x == br[-1])
+    if include_lowest and right:
+        code = jnp.where(x == br[0], 0, code)
+    code = jnp.where(oob, -1, jnp.clip(code, 0, len(br) - 2)).astype(jnp.int32)
+    if labels is None:
+        op, cl = ("(", "]") if right else ("[", ")")
+        labels = [f"{op}{br[i]:g},{br[i+1]:g}{cl}" for i in range(len(br) - 1)]
+    return Vec(code, VecType.CAT, vec.nrows, domain=tuple(labels))
+
+
+def hist(vec: Vec, breaks: int | Sequence[float] = 20):
+    """(counts, edges) histogram (reference: ``AstHist``)."""
+    x = vec.as_float()
+    if isinstance(breaks, int):
+        lo, hi = vmin(vec), vmax(vec)
+        edges = np.linspace(lo, hi, breaks + 1)
+    else:
+        edges = np.asarray(breaks, np.float64)
+    e = jnp.asarray(edges, jnp.float32)
+    idx = jnp.clip(jnp.searchsorted(e, x, side="right") - 1, 0, len(edges) - 2)
+    ok = ~jnp.isnan(x) & (x >= e[0]) & (x <= e[-1])
+    counts = jax.ops.segment_sum(ok.astype(jnp.float32),
+                                 jnp.where(ok, idx, len(edges) - 1),
+                                 len(edges))[: len(edges) - 1]
+    return np.asarray(jax.device_get(counts)), edges
+
+
+def impute(frame: Frame, column: str, method: str = "mean",
+           by: Sequence[str] | None = None) -> Frame:
+    """Fill NAs in place (reference: ``AstImpute``; h2o-py ``H2OFrame.impute``).
+
+    Numeric: method mean|median|min|max (grouped: mean|median); categorical:
+    mode (grouped or global), type and domain preserved. Grouped fills fall
+    back to the global fill for all-NA groups (reference behavior).
+    """
+    v = frame.vec(column)
+
+    if v.is_categorical:
+        if method != "mode":
+            raise ValueError("categorical impute requires method='mode'")
+        K = max(v.cardinality(), 1)
+        if by:
+            from h2o3_tpu.rapids.munge import frame_group_ids
+            gid, ng, _ = frame_group_ids(frame, list(by))
+            ok = (v.data >= 0) & frame.row_mask()
+            comb = jnp.where(ok, gid * K + jnp.clip(v.data, 0, K - 1), ng * K)
+            counts = jax.ops.segment_sum(ok.astype(jnp.float32), comb,
+                                         ng * K + 1)[: ng * K].reshape(ng, K)
+            mode_g = jnp.argmax(counts, axis=1).astype(jnp.int32)
+            has = counts.sum(axis=1) > 0
+            glob = jax.ops.segment_sum(
+                ok.astype(jnp.float32),
+                jnp.where(ok, jnp.clip(v.data, 0, K - 1), K), K + 1)[:K]
+            gmode = jnp.argmax(glob).astype(jnp.int32)
+            fill = jnp.where(has, mode_g, gmode)[jnp.clip(gid, 0, ng - 1)]
+        else:
+            counts = jax.ops.segment_sum(
+                (v.data >= 0).astype(jnp.float32),
+                jnp.clip(v.data, 0, K - 1), K)
+            fill = jnp.argmax(counts).astype(jnp.int32)
+        new = jnp.where(v.data < 0, fill, v.data).astype(jnp.int32)
+        new = jnp.where(frame.row_mask(), new, -1)
+        frame.vecs[frame._index(column)] = Vec(new, VecType.CAT, v.nrows,
+                                               domain=v.domain)
+        return frame
+
+    x = v.as_float()
+    if by:
+        if method not in ("mean", "median"):
+            raise ValueError("grouped numeric impute supports mean|median")
+        from h2o3_tpu.rapids.munge import _group_median, frame_group_ids
+        gid, ng, _ = frame_group_ids(frame, list(by))
+        ok = ~jnp.isnan(x) & frame.row_mask()
+        c = jax.ops.segment_sum(ok.astype(jnp.float32), gid, ng + 1)
+        if method == "mean":
+            s = jax.ops.segment_sum(jnp.where(ok, x, 0.0), gid, ng + 1)
+            per_group = s / jnp.maximum(c, 1.0)
+        else:
+            per_group = _group_median(frame, column, gid, ng + 1)
+        glob = vmean(v) if method == "mean" else vmedian(v)
+        fill = jnp.where(c > 0, per_group, glob)[gid]
+    else:
+        fill = {"mean": vmean, "median": vmedian, "min": vmin, "max": vmax}[method](v)
+    out = jnp.where(jnp.isnan(x) & frame.row_mask(), fill, x)
+    frame.vecs[frame._index(column)] = Vec(out.astype(jnp.float32),
+                                           VecType.NUM, v.nrows)
+    return frame
+
+
+def scale(frame: Frame, center: bool = True, scale_: bool = True) -> Frame:
+    """Standardize numeric columns (reference: ``AstScale``)."""
+    vecs = []
+    for v in frame.vecs:
+        if v.type.on_device and not v.is_categorical:
+            x = v.as_float()
+            if center:
+                x = x - vmean(v)
+            if scale_:
+                x = x / max(vsd(v), 1e-30)
+            vecs.append(Vec(x.astype(jnp.float32), VecType.NUM, v.nrows))
+        else:
+            vecs.append(v)
+    return Frame(list(frame.names), vecs)

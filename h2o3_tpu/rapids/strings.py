@@ -1,0 +1,118 @@
+"""String ops on STR (host object arrays) and CAT (domain transform) columns.
+
+Reference: ``water/rapids/ast/prims/string/`` (16 files: ``AstToUpper``,
+``AstStrSplit``, ``AstReplaceAll`` …). The reference optimizes CAT columns by
+transforming the domain once instead of every row — same trick here; STR
+columns are host-resident numpy object arrays (see ``Vec`` docstring), so the
+ops run as one vectorized host pass and never touch the device.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+
+def _apply(vec: Vec, fn) -> Vec:
+    """Apply a str→str fn: CAT → map the domain; STR → map the values."""
+    if vec.is_categorical:
+        new_dom = [fn(s) for s in vec.domain]
+        if len(set(new_dom)) == len(new_dom):
+            return Vec(vec.data, VecType.CAT, vec.nrows, domain=tuple(new_dom))
+        # collapsed levels (e.g. tolower merging "A"/"a"): refactorize
+        return Vec.from_numpy(np.array(
+            [None if c < 0 else new_dom[c] for c in vec.to_numpy()], dtype=object))
+    if vec.type is not VecType.STR:
+        raise TypeError(f"string op on {vec.type} column")
+    out = np.array([None if s is None else fn(s) for s in vec.host_values],
+                   dtype=object)
+    return Vec(None, VecType.STR, vec.nrows, host_values=out)
+
+
+def _apply_num(vec: Vec, fn) -> Vec:
+    """str→float fn; NA → NaN."""
+    if vec.is_categorical:
+        lut = np.array([fn(s) for s in vec.domain] + [np.nan], np.float64)
+        codes = vec.to_numpy()
+        vals = lut[np.where(codes >= 0, codes, len(lut) - 1)]
+    else:
+        vals = np.array([np.nan if s is None else fn(s) for s in vec.host_values])
+    return Vec.from_numpy(vals.astype(np.float32), type=VecType.NUM)
+
+
+def toupper(vec: Vec) -> Vec: return _apply(vec, str.upper)
+def tolower(vec: Vec) -> Vec: return _apply(vec, str.lower)
+def trim(vec: Vec) -> Vec: return _apply(vec, str.strip)
+def lstrip(vec: Vec, chars: str | None = None) -> Vec: return _apply(vec, lambda s: s.lstrip(chars))
+def rstrip(vec: Vec, chars: str | None = None) -> Vec: return _apply(vec, lambda s: s.rstrip(chars))
+def nchar(vec: Vec) -> Vec: return _apply_num(vec, len)
+
+
+def substring(vec: Vec, start: int, end: int | None = None) -> Vec:
+    return _apply(vec, lambda s: s[start:end])
+
+
+def sub(vec: Vec, pattern: str, replacement: str, ignore_case: bool = False) -> Vec:
+    """Replace FIRST regex match (reference: ``AstReplaceFirst``)."""
+    rx = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+    return _apply(vec, lambda s: rx.sub(replacement, s, count=1))
+
+
+def gsub(vec: Vec, pattern: str, replacement: str, ignore_case: bool = False) -> Vec:
+    """Replace ALL regex matches (reference: ``AstReplaceAll``)."""
+    rx = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+    return _apply(vec, lambda s: rx.sub(replacement, s))
+
+
+def grep(vec: Vec, pattern: str, ignore_case: bool = False, invert: bool = False) -> Vec:
+    """1.0 where the regex matches (reference: ``AstGrep``)."""
+    rx = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+    hit = lambda s: float(bool(rx.search(s)) != invert)  # noqa: E731
+    return _apply_num(vec, hit)
+
+
+def startswith(vec: Vec, prefix: str) -> Vec:
+    return _apply_num(vec, lambda s: float(s.startswith(prefix)))
+
+
+def endswith(vec: Vec, suffix: str) -> Vec:
+    return _apply_num(vec, lambda s: float(s.endswith(suffix)))
+
+
+def strsplit(vec: Vec, pattern: str) -> list[Vec]:
+    """Split into columns on a regex (reference: ``AstStrSplit`` → frame of
+    string columns, ragged rows padded with NA)."""
+    rx = re.compile(pattern)
+    if vec.is_categorical:
+        vals = [None if c < 0 else vec.domain[c] for c in vec.to_numpy()]
+    else:
+        vals = list(vec.host_values)
+    parts = [None if s is None else rx.split(s) for s in vals]
+    width = max((len(p) for p in parts if p is not None), default=0)
+    out = []
+    for i in range(width):
+        col = np.array([None if p is None or i >= len(p) else p[i]
+                        for p in parts], dtype=object)
+        out.append(Vec(None, VecType.STR, vec.nrows, host_values=col))
+    return out
+
+
+def entropy(vec: Vec) -> Vec:
+    """Per-string Shannon entropy (reference: ``AstEntropy``)."""
+    def ent(s: str) -> float:
+        if not s:
+            return 0.0
+        _, cnt = np.unique(list(s), return_counts=True)
+        p = cnt / cnt.sum()
+        return float(-(p * np.log2(p)).sum())
+    return _apply_num(vec, ent)
+
+
+def num_valid_substrings(vec: Vec, words: list[str]) -> Vec:
+    """Count of dictionary words contained in each string (reference:
+    ``AstCountSubstringsWords``)."""
+    return _apply_num(vec, lambda s: float(sum(w in s for w in words)))

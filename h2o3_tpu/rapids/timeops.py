@@ -1,0 +1,140 @@
+"""Time ops on TIME columns (epoch-ms, exact f64 host payload).
+
+Reference: ``water/rapids/ast/prims/time/`` (16 files: ``AstYear``,
+``AstMonth``, ``AstDay``, ``AstDayOfWeek``, ``AstHour`` …, ``AstAsDate``,
+``AstMktime``). TIME Vecs keep exact float64 epoch millis host-side (float32
+device data is shifted/relative — see ``Vec``), so calendar decomposition runs
+on the host payload via numpy datetime64 and returns device NUM columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+
+def _ms(vec: Vec) -> np.ndarray:
+    if vec.type is not VecType.TIME:
+        raise TypeError(f"time op on {vec.type} column")
+    return vec.to_numpy()   # float64 epoch ms, NaN for NA
+
+
+def ms_to_datetime64(ms: np.ndarray) -> np.ndarray:
+    """float64 epoch-ms (NaN = missing) → datetime64[ms] (NaT = missing);
+    the one shared conversion for TIME round-trips."""
+    out = np.full(len(ms), np.datetime64("NaT"), "datetime64[ms]")
+    ok = ~np.isnan(ms)
+    out[ok] = ms[ok].astype(np.int64).view("datetime64[ms]")
+    return out
+
+
+def _dt(vec: Vec) -> np.ndarray:
+    return ms_to_datetime64(_ms(vec))
+
+
+def _field(vec: Vec, values: np.ndarray) -> Vec:
+    vals = values.astype(np.float32)
+    return Vec.from_numpy(vals, type=VecType.NUM)
+
+
+def _decompose(vec: Vec, unit_hi: str, unit_lo: str, offset: float = 0.0) -> Vec:
+    dt = _dt(vec)
+    hi = dt.astype(f"datetime64[{unit_hi}]")
+    val = (dt - hi).astype(f"timedelta64[{unit_lo}]").astype(np.float64)
+    val[np.isnat(dt)] = np.nan
+    return _field(vec, val + offset)
+
+
+def year(vec: Vec) -> Vec:
+    dt = _dt(vec)
+    y = dt.astype("datetime64[Y]").astype(np.float64) + 1970.0
+    y[np.isnat(dt)] = np.nan
+    return _field(vec, y)
+
+
+def month(vec: Vec) -> Vec:
+    return _decompose(vec, "Y", "M", offset=1.0)        # 1..12
+
+
+def day(vec: Vec) -> Vec:
+    return _decompose(vec, "M", "D", offset=1.0)        # 1..31
+
+
+def hour(vec: Vec) -> Vec:
+    return _decompose(vec, "D", "h")
+
+
+def minute(vec: Vec) -> Vec:
+    return _decompose(vec, "h", "m")
+
+
+def second(vec: Vec) -> Vec:
+    return _decompose(vec, "m", "s")
+
+
+def millis(vec: Vec) -> Vec:
+    return _decompose(vec, "s", "ms")
+
+
+def day_of_week(vec: Vec) -> Vec:
+    """0=Mon .. 6=Sun (reference ``AstDayOfWeek`` domain Mon-first)."""
+    dt = _dt(vec)
+    days = dt.astype("datetime64[D]").astype(np.float64)
+    dow = np.mod(days + 3.0, 7.0)                        # 1970-01-01 = Thursday
+    dow[np.isnat(dt)] = np.nan
+    return _field(vec, dow)
+
+
+def week(vec: Vec) -> Vec:
+    dt = _dt(vec)
+    doy = (dt.astype("datetime64[D]") - dt.astype("datetime64[Y]")
+           ).astype(np.float64)
+    val = np.floor(doy / 7.0) + 1.0
+    val[np.isnat(dt)] = np.nan
+    return _field(vec, val)
+
+
+def as_date(vec: Vec, fmt: str) -> Vec:
+    """Parse a STR/CAT column into a TIME Vec (reference: ``AstAsDate``;
+    fmt uses Java-style yyyy/MM/dd/HH/mm/ss tokens like the reference)."""
+    import datetime as _dt_mod
+    py_fmt = (fmt.replace("yyyy", "%Y").replace("yy", "%y")
+                 .replace("MM", "%m").replace("dd", "%d")
+                 .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S"))
+    if vec.is_categorical:
+        vals = [None if c < 0 else vec.domain[c] for c in vec.to_numpy()]
+    else:
+        vals = list(vec.host_values)
+    out = np.full(len(vals), np.datetime64("NaT"), "datetime64[ms]")
+    for i, s in enumerate(vals):
+        if s is not None:
+            try:
+                out[i] = np.datetime64(_dt_mod.datetime.strptime(s, py_fmt), "ms")
+            except ValueError:
+                pass
+    return Vec.from_numpy(out, type=VecType.TIME)
+
+
+def mktime(year_v, month_v=None, day_v=None, hour_v=None, minute_v=None,
+           second_v=None) -> Vec:
+    """Build a TIME column from numeric component columns (reference:
+    ``AstMktime``; month/day are 1-based)."""
+    n = year_v.nrows
+    def arr(v, default):
+        return v.to_numpy().astype(np.float64) if v is not None \
+            else np.full(n, default, np.float64)
+    y, mo, d = arr(year_v, 1970), arr(month_v, 1), arr(day_v, 1)
+    h, mi, s = arr(hour_v, 0), arr(minute_v, 0), arr(second_v, 0)
+    ok = ~(np.isnan(y) | np.isnan(mo) | np.isnan(d) | np.isnan(h)
+           | np.isnan(mi) | np.isnan(s))
+    out = np.full(n, np.datetime64("NaT"), "datetime64[ms]")
+    yi = y[ok].astype(np.int64)
+    base = (yi - 1970).astype("timedelta64[Y]") + np.zeros(ok.sum(), "datetime64[Y]")
+    months = base.astype("datetime64[M]") + (mo[ok].astype(np.int64) - 1)
+    days = months.astype("datetime64[D]") + (d[ok].astype(np.int64) - 1)
+    ms = (days.astype("datetime64[ms]")
+          + (h[ok] * 3600_000 + mi[ok] * 60_000 + s[ok] * 1000).astype("timedelta64[ms]"))
+    out[ok] = ms
+    return Vec.from_numpy(out, type=VecType.TIME)

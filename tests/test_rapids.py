@@ -1,0 +1,333 @@
+"""Rapids layer tests — munging/math/string/time ops validated against pandas
+(reference test model: ``h2o-py/tests/testdir_munging/``)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.rapids import (cut, hist, ifelse, melt, merge, ops, pivot,
+                             rapids, rbind, sort, strings, table, timeops,
+                             unique)
+
+
+@pytest.fixture
+def df(rng):
+    n = 500
+    return pd.DataFrame({
+        "g": rng.choice(["a", "b", "c"], size=n),
+        "h": rng.choice(["x", "y"], size=n),
+        "v": rng.normal(size=n),
+        "w": rng.integers(0, 100, size=n).astype(float),
+    })
+
+
+def _frame(df):
+    return Frame.from_pandas(df)
+
+
+# -- elementwise / math ------------------------------------------------------
+
+def test_vec_arithmetic(rng):
+    a = rng.normal(size=100)
+    b = rng.normal(size=100) + 2.0
+    f = Frame.from_arrays({"a": a, "b": b})
+    va, vb = f.vec("a"), f.vec("b")
+    np.testing.assert_allclose((va + vb).to_numpy(), a + b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose((va * 2 - 1).to_numpy(), a * 2 - 1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose((1 / vb).to_numpy(), 1 / b, rtol=1e-5)
+    np.testing.assert_allclose((va > vb).to_numpy(), (a > b).astype(float))
+    np.testing.assert_allclose(ops.log(vb).to_numpy(), np.log(b), rtol=1e-5)
+    np.testing.assert_allclose(ops.cumsum(va).to_numpy()[:100],
+                               np.cumsum(a), rtol=1e-4, atol=1e-4)
+
+
+def test_vec_na_propagation():
+    f = Frame.from_arrays({"a": np.array([1.0, np.nan, 3.0])})
+    v = f.vec("a")
+    out = (v + 1).to_numpy()
+    assert out[0] == 2.0 and np.isnan(out[1]) and out[2] == 4.0
+    assert v.isna().to_numpy().tolist()[:3] == [0.0, 1.0, 0.0]
+    assert ops.vsum(v) == 4.0
+    assert ops.vmean(v) == 2.0
+
+
+def test_cat_compare():
+    f = Frame.from_arrays({"g": np.array(["a", "b", "a"], dtype=object)})
+    eq = (f.vec("g") == "a").to_numpy()
+    assert eq.tolist() == [1.0, 0.0, 1.0]
+
+
+def test_ifelse_and_cut(rng):
+    x = rng.normal(size=200)
+    f = Frame.from_arrays({"x": x})
+    v = f.vec("x")
+    out = ifelse(v > 0, v, 0.0).to_numpy()
+    np.testing.assert_allclose(out, np.maximum(x, 0.0), rtol=1e-6)
+    c = cut(v, [-10, 0, 10])
+    codes = c.to_numpy()
+    np.testing.assert_array_equal(codes, (x > 0).astype(np.int32))
+
+
+def test_quantile(rng):
+    x = rng.normal(size=4000)
+    f = Frame.from_arrays({"x": x})
+    q = f.quantile(probs=[0.25, 0.5, 0.75]).to_pandas()
+    np.testing.assert_allclose(q["x"], np.quantile(x, [0.25, 0.5, 0.75]),
+                               atol=1e-3)
+
+
+def test_hist(rng):
+    x = rng.normal(size=1000)
+    counts, edges = hist(Frame.from_arrays({"x": x}).vec("x"), breaks=10)
+    ref, _ = np.histogram(x, bins=edges)
+    np.testing.assert_allclose(counts, ref)
+
+
+# -- sort / filter -----------------------------------------------------------
+
+def test_sort(df):
+    f = _frame(df)
+    got = sort(f, ["g", "v"]).to_pandas()
+    ref = df.sort_values(["g", "v"], kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, ref, rtol=1e-5, check_dtype=False)
+
+
+def test_sort_descending(df):
+    f = _frame(df)
+    got = f.sort("v", ascending=False).to_pandas()
+    ref = df.sort_values("v", ascending=False).reset_index(drop=True)
+    np.testing.assert_allclose(got["v"], ref["v"], rtol=1e-6)
+
+
+def test_filter(df):
+    f = _frame(df)
+    got = f[f.vec("v") > 0].to_pandas()
+    ref = df[df["v"] > 0].reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, ref, rtol=1e-5, check_dtype=False)
+    assert got.shape[0] == ref.shape[0]
+
+
+# -- group-by ----------------------------------------------------------------
+
+def test_group_by(df):
+    f = _frame(df)
+    got = f.group_by("g").mean("v").sum("w").count().get_frame().to_pandas()
+    ref = df.groupby("g").agg(mean_v=("v", "mean"), sum_w=("w", "sum"),
+                              nrow=("v", "size")).reset_index()
+    np.testing.assert_array_equal(got["g"], ref["g"])
+    np.testing.assert_allclose(got["mean_v"], ref["mean_v"], rtol=1e-5)
+    np.testing.assert_allclose(got["sum_w"], ref["sum_w"], rtol=1e-5)
+    np.testing.assert_allclose(got["nrow"], ref["nrow"])
+
+
+def test_group_by_multikey_median_sd(df):
+    f = _frame(df)
+    got = f.group_by(["g", "h"]).median("v").sd("v").get_frame().to_pandas()
+    ref = df.groupby(["g", "h"])["v"].agg(["median", "std"]).reset_index()
+    np.testing.assert_allclose(got["median_v"], ref["median"], rtol=1e-5)
+    np.testing.assert_allclose(got["sd_v"], ref["std"], rtol=1e-4)
+
+
+def test_group_by_numeric_key(rng):
+    k = rng.integers(0, 5, size=300).astype(float)
+    v = rng.normal(size=300)
+    f = Frame.from_arrays({"k": k, "v": v})
+    got = f.group_by("k").mean("v").get_frame().to_pandas()
+    ref = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].mean().reset_index()
+    np.testing.assert_allclose(got["k"], ref["k"])
+    np.testing.assert_allclose(got["mean_v"], ref["v"], rtol=1e-5)
+
+
+# -- merge -------------------------------------------------------------------
+
+def test_merge_inner(rng):
+    left = pd.DataFrame({"k": rng.integers(0, 20, 200).astype(float),
+                         "a": rng.normal(size=200)})
+    right = pd.DataFrame({"k": np.arange(10).astype(float),
+                          "b": np.arange(10) * 10.0})
+    got = merge(_frame(left), _frame(right)).to_pandas()
+    ref = left.merge(right, on="k", how="inner")
+    assert got.shape[0] == ref.shape[0]
+    gs = got.sort_values(["k", "a"]).reset_index(drop=True)
+    rs = ref.sort_values(["k", "a"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(gs, rs, rtol=1e-5, check_dtype=False)
+
+
+def test_merge_left_and_duplicates(rng):
+    left = pd.DataFrame({"k": np.array(["a", "b", "c", "d"], dtype=object),
+                         "a": [1.0, 2.0, 3.0, 4.0]})
+    right = pd.DataFrame({"k": np.array(["a", "a", "b"], dtype=object),
+                          "b": [10.0, 11.0, 20.0]})
+    got = merge(_frame(left), _frame(right), all_x=True).to_pandas()
+    ref = left.merge(right, on="k", how="left")
+    assert got.shape[0] == ref.shape[0] == 5
+    gs = got.sort_values(["k", "b"]).reset_index(drop=True)
+    rs = ref.sort_values(["k", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(gs["k"], rs["k"])
+    np.testing.assert_allclose(gs["b"].to_numpy(np.float64),
+                               rs["b"].to_numpy(np.float64))
+
+
+def test_merge_outer_keys():
+    left = pd.DataFrame({"k": np.array(["a", "b"], dtype=object), "a": [1.0, 2.0]})
+    right = pd.DataFrame({"k": np.array(["b", "z"], dtype=object), "b": [5.0, 9.0]})
+    got = merge(_frame(left), _frame(right), all_x=True, all_y=True).to_pandas()
+    assert set(got["k"]) == {"a", "b", "z"}
+    row_z = got[got["k"] == "z"].iloc[0]
+    assert np.isnan(row_z["a"]) and row_z["b"] == 9.0
+
+
+# -- rbind / unique / table / pivot / melt ----------------------------------
+
+def test_rbind_domain_union():
+    f1 = Frame.from_arrays({"g": np.array(["a", "b"], dtype=object), "x": [1.0, 2.0]})
+    f2 = Frame.from_arrays({"g": np.array(["c", "a"], dtype=object), "x": [3.0, 4.0]})
+    out = rbind(f1, f2)
+    assert out.nrows == 4
+    assert out.vec("g").domain == ("a", "b", "c")
+    assert out.vec("g").labels().tolist() == ["a", "b", "c", "a"]
+    np.testing.assert_allclose(out.vec("x").to_numpy(), [1, 2, 3, 4])
+
+
+def test_unique_and_table(df):
+    f = _frame(df)
+    u = unique(f, ["g"]).to_pandas()
+    assert sorted(u["g"]) == sorted(df["g"].unique())
+    t = table(f, ["g"]).to_pandas()
+    ref = df["g"].value_counts().sort_index()
+    np.testing.assert_allclose(t.sort_values("g")["nrow"], ref.values)
+
+
+def test_pivot(df):
+    f = _frame(df)
+    got = pivot(f, index="g", column="h", value="v", agg="mean").to_pandas()
+    ref = df.pivot_table(index="g", columns="h", values="v",
+                         aggfunc="mean").reset_index()
+    for lev in ("x", "y"):
+        np.testing.assert_allclose(got[lev], ref[lev], rtol=1e-5)
+
+
+def test_melt(df):
+    f = _frame(df)
+    got = melt(f, id_vars=["g"], value_vars=["v", "w"]).to_pandas()
+    assert got.shape[0] == 2 * len(df)
+    assert set(got["variable"]) == {"v", "w"}
+    vs = got[got["variable"] == "v"]["value"].to_numpy()
+    np.testing.assert_allclose(np.sort(vs), np.sort(df["v"]), rtol=1e-6)
+
+
+def test_group_by_na_key_count():
+    f = Frame.from_arrays({"k": np.array([1.0, 1.0, np.nan, np.nan, np.nan]),
+                           "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    got = f.group_by("k").count().mean("v").get_frame().to_pandas()
+    # NA keys form their own group (reference AstGroup) and count all rows
+    assert sorted(got["nrow"]) == [2.0, 3.0]
+
+
+def test_impute_grouped_and_categorical():
+    from h2o3_tpu.rapids import impute
+    f = Frame.from_arrays({
+        "g": np.array(["a", "a", "b", "b", "b"], dtype=object),
+        "x": np.array([1.0, np.nan, 10.0, 20.0, np.nan]),
+        "c": np.array(["u", None, "v", "v", None], dtype=object),
+    })
+    impute(f, "x", method="mean", by=["g"])
+    np.testing.assert_allclose(f.vec("x").to_numpy(), [1, 1, 10, 20, 15])
+    impute(f, "c", method="mode")
+    assert f.vec("c").is_categorical and f.vec("c").domain == ("u", "v")
+    assert f.vec("c").labels().tolist() == ["u", "v", "v", "v", "v"]
+
+
+def test_impute_grouped_all_na_group_falls_back():
+    from h2o3_tpu.rapids import impute
+    f = Frame.from_arrays({
+        "g": np.array(["a", "a", "b", "b"], dtype=object),
+        "x": np.array([np.nan, np.nan, 5.0, 7.0]),
+    })
+    impute(f, "x", method="mean", by=["g"])
+    np.testing.assert_allclose(f.vec("x").to_numpy(), [6, 6, 5, 7])
+
+
+# -- strings / time ----------------------------------------------------------
+
+def test_string_ops():
+    f = Frame.from_arrays({"s": np.array(["  Foo ", "BAR", "baz qux"], dtype=object)})
+    v = f.vec("s")
+    assert v.is_categorical   # short string columns factorize to CAT
+    assert strings.toupper(v).labels().tolist() == ["  FOO ", "BAR", "BAZ QUX"]
+    assert strings.trim(v).labels().tolist() == ["Foo", "BAR", "baz qux"]
+    assert strings.nchar(v).to_numpy().tolist() == [6.0, 3.0, 7.0]
+    assert strings.gsub(v, "a", "@").labels().tolist() == ["  Foo ", "BAR", "b@z qux"]
+    assert strings.grep(v, "ba", ignore_case=True).to_numpy().tolist() == [0.0, 1.0, 1.0]
+    parts = strings.strsplit(v, r"\s+")
+    assert parts[0].host_values.tolist() == ["", "BAR", "baz"]
+
+
+def test_time_ops():
+    ts = np.array(["2024-02-29T13:45:30", "1999-12-31T23:59:59"],
+                  dtype="datetime64[ms]")
+    f = Frame.from_arrays({"t": ts}, types={"t": __import__(
+        "h2o3_tpu.frame.types", fromlist=["VecType"]).VecType.TIME})
+    v = f.vec("t")
+    assert timeops.year(v).to_numpy().tolist() == [2024.0, 1999.0]
+    assert timeops.month(v).to_numpy().tolist() == [2.0, 12.0]
+    assert timeops.day(v).to_numpy().tolist() == [29.0, 31.0]
+    assert timeops.hour(v).to_numpy().tolist() == [13.0, 23.0]
+    assert timeops.day_of_week(v).to_numpy().tolist() == [3.0, 4.0]  # Thu, Fri
+
+
+def test_time_arithmetic_cross_offsets():
+    from h2o3_tpu.frame.types import VecType
+    # two TIME columns with very different minima → different device offsets
+    s = np.array(["2024-01-01T00:00:00", "2024-01-02T00:00:00"],
+                 dtype="datetime64[ms]")
+    e = np.array(["1999-06-01T00:00:00", "2024-01-02T06:00:00"],
+                 dtype="datetime64[ms]")
+    f = Frame.from_arrays({"s": s, "e": e},
+                          types={"s": VecType.TIME, "e": VecType.TIME})
+    dur = (f.vec("e") - f.vec("s")).to_numpy()
+    expected = (e - s).astype("timedelta64[ms]").astype(np.float64)
+    np.testing.assert_allclose(dur, expected, rtol=1e-6)
+    # absolute-epoch scalar comparison
+    cutoff = float(np.datetime64("2024-01-01T12:00:00", "ms").astype(np.int64))
+    gt = (f.vec("s") > cutoff).to_numpy()
+    assert gt.tolist() == [0.0, 1.0]
+
+
+def test_merge_on_time_key():
+    from h2o3_tpu.frame.types import VecType
+    lt = np.array(["2024-01-01", "2024-03-01"], dtype="datetime64[ms]")
+    rt = np.array(["2024-03-01", "2030-01-01"], dtype="datetime64[ms]")
+    left = Frame.from_arrays({"t": lt, "a": [1.0, 2.0]}, types={"t": VecType.TIME})
+    right = Frame.from_arrays({"t": rt, "b": [10.0, 20.0]}, types={"t": VecType.TIME})
+    got = merge(left, right, by=["t"]).to_pandas()
+    assert got.shape[0] == 1
+    assert got["a"][0] == 2.0 and got["b"][0] == 10.0
+
+
+def test_as_date_and_mktime():
+    f = Frame.from_arrays({"s": np.array(["2020-01-15", "2021-06-30"], dtype=object)})
+    t = timeops.as_date(f.vec("s"), "yyyy-MM-dd")
+    assert timeops.year(t).to_numpy().tolist() == [2020.0, 2021.0]
+    assert timeops.day(t).to_numpy().tolist() == [15.0, 30.0]
+    y = Frame.from_arrays({"y": [2020.0, 2021.0], "m": [1.0, 6.0], "d": [15.0, 30.0]})
+    t2 = timeops.mktime(y.vec("y"), y.vec("m"), y.vec("d"))
+    np.testing.assert_allclose(t2.to_numpy(), t.to_numpy())
+
+
+# -- rapids expression engine ------------------------------------------------
+
+def test_rapids_exec(rng):
+    from h2o3_tpu.utils.registry import DKV
+    x = rng.normal(size=50)
+    f = Frame.from_arrays({"a": x, "b": x * 2})
+    DKV.put("fr1", f)
+    out = rapids("(+ (cols fr1 'a') 1)")
+    np.testing.assert_allclose(out.vecs[0].to_numpy(), x + 1, rtol=1e-6)
+    assert rapids("(sum (cols fr1 'a'))") == pytest.approx(x.sum(), rel=1e-4)
+    assert rapids("(nrow fr1)") == 50.0
+    sub = rapids("(rows fr1 (> (cols fr1 'a') 0))")
+    assert sub.nrows == int((x > 0).sum())
+    tmp = rapids("(tmp= t1 (* (cols fr1 'b') 2))")
+    np.testing.assert_allclose(tmp.vecs[0].to_numpy(), x * 4, rtol=1e-6)
