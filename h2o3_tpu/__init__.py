@@ -21,6 +21,8 @@ Public surface mirrors the h2o-py client (``h2o-py/h2o/h2o.py``): ``import_file`
 from h2o3_tpu.frame import Frame, Vec, VecType
 from h2o3_tpu.frame.parse import import_file, parse_raw, upload_file
 from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
+from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
+                              save_model)
 from h2o3_tpu.utils.registry import DKV
 
 __version__ = "0.1.0"
@@ -32,6 +34,11 @@ __all__ = [
     "import_file",
     "parse_raw",
     "upload_file",
+    "export_file",
+    "save_frame",
+    "load_frame",
+    "save_model",
+    "load_model",
     "get_mesh",
     "set_mesh",
     "mesh_context",

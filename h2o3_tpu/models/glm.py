@@ -37,16 +37,26 @@ def _weighted_gram(X, W, z, l2, nobs, jitter):
     """Normal equations for weighted LS with an unpenalized intercept column:
     gram = [X,1]'W[X,1] + l2*nobs*diag(1..1,0) + jitter*I, rhs = [X,1]'Wz.
     One contraction over the row-sharded X — XLA reduces per-chip partials over
-    ICI (the reference's ``GLMIterationTask`` Gram reduce)."""
+    ICI (the reference's ``GLMIterationTask`` Gram reduce).
+
+    Contractions run at HIGHEST precision: the TPU MXU's default bf16 inputs
+    lose ~1e-2 relative on the Gram, which breaks the Cholesky on
+    ill-conditioned designs (the solve is [K,K] — full f32 costs nothing).
+    """
     k = X.shape[1]
+    hi = jax.lax.Precision.HIGHEST
     Xw = X * W[:, None]
     gram = jnp.empty((k + 1, k + 1), X.dtype)
-    gram = gram.at[:k, :k].set(Xw.T @ X)
+    gram = gram.at[:k, :k].set(jnp.matmul(Xw.T, X, precision=hi))
     xw_sum = Xw.sum(axis=0)
     gram = gram.at[:k, k].set(xw_sum).at[k, :k].set(xw_sum).at[k, k].set(W.sum())
-    rhs = jnp.concatenate([Xw.T @ z, (W * z).sum()[None]])
+    rhs = jnp.concatenate([jnp.matmul(Xw.T, z, precision=hi),
+                           (W * z).sum()[None]])
     penalty = l2 * nobs * jnp.concatenate([jnp.ones(k), jnp.zeros(1)])
-    gram = gram + jnp.diag(penalty) + jitter * jnp.eye(k + 1)
+    # ridge jitter relative to the Gram scale: collinear designs (e.g. a
+    # RuleFit rule matrix with complementary 0/1 rules) stay factorizable
+    j = jitter * (jnp.trace(gram) / (k + 1) + 1.0)
+    gram = gram + jnp.diag(penalty) + j * jnp.eye(k + 1)
     return gram, rhs
 
 
@@ -87,7 +97,7 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
     W = w * d * d / jnp.maximum(var, 1e-12)
     z = eta + (y - mu) / jnp.maximum(d, 1e-12)
     nobs = jnp.maximum(w.sum(), 1.0)
-    gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-8)
+    gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-5)
     if non_negative:
         new_beta = _nn_solve(gram, rhs, jnp.maximum(beta, 0.0).at[-1].set(beta[-1]))
     else:
@@ -145,7 +155,7 @@ def _multinomial_step(nclasses: int, X, yoh, w, B, l2, l1, non_negative: bool = 
         pc = p[:, c]
         W = w * jnp.maximum(pc * (1 - pc), 1e-10)
         z = eta[:, c] + (yoh[:, c] - pc) / jnp.maximum(pc * (1 - pc), 1e-10)
-        gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-6)
+        gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-5)
         if non_negative:
             bc = _nn_solve(gram, rhs, jnp.maximum(B[:, c], 0.0).at[-1].set(B[-1, c]))
         else:

@@ -13,6 +13,7 @@ hard part (e)).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import time
 from typing import Any, Sequence
@@ -94,7 +95,8 @@ class GridSearch:
     def __init__(self, builder_cls: type[ModelBuilder] | ModelBuilder,
                  hyper_params: dict[str, Sequence[Any]],
                  grid_id: str | None = None,
-                 search_criteria: dict | None = None, **fixed_params):
+                 search_criteria: dict | None = None,
+                 recovery_dir: str | None = None, **fixed_params):
         if isinstance(builder_cls, ModelBuilder):
             fixed_params = {**builder_cls.params, **fixed_params}
             builder_cls = type(builder_cls)
@@ -103,6 +105,7 @@ class GridSearch:
         self.fixed_params = fixed_params
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.grid_id = grid_id or f"{builder_cls.algo}_grid_{int(time.time())}"
+        self.recovery_dir = recovery_dir
         self.grid: Grid | None = None
 
     def _combos(self):
@@ -141,21 +144,46 @@ class GridSearch:
         t0 = time.time()
         models: list[Model] = []
         failures: list[tuple[dict, str]] = []
+
+        recovery = None
+        if self.recovery_dir:
+            # resumable grid (reference: Recovery<Grid> + GridSearch resume)
+            from h2o3_tpu.persist.recovery import Recovery
+            recovery = Recovery(self.recovery_dir)
+            if recovery.resuming:
+                models.extend(recovery.built_models())
+            recovery.begin({"grid_id": self.grid_id,
+                            "hyper_params": self.hyper_params,
+                            "search_criteria": self.search_criteria})
+
+        exhausted = True
         for combo in self._combos():
             if max_models and len(models) >= max_models:
+                exhausted = False   # budget stop: keep the recovery resumable
                 break
             if max_secs and time.time() - t0 > max_secs:
+                exhausted = False
                 break
+            if recovery is not None and recovery.is_done(combo):
+                continue
             params = {**self.fixed_params, **combo}
-            params["model_id"] = f"{self.grid_id}_model_{len(models) + len(failures)}"
+            # id derived from the combo, stable across recovery resumes (a
+            # positional counter would collide with recovered models)
+            from h2o3_tpu.persist.recovery import combo_key
+            tag = hashlib.md5(combo_key(combo).encode()).hexdigest()[:8]
+            params["model_id"] = f"{self.grid_id}_model_{tag}"
             try:
                 b = self.builder_cls(**params)
                 m = b.train(x=x, y=y, training_frame=training_frame,
                             validation_frame=validation_frame, **kw)
                 m.output["hyper_values"] = combo
                 models.append(m)
+                if recovery is not None:
+                    recovery.model_built(combo, m)
             except Exception as e:  # reference: failed params recorded on the grid
                 failures.append((combo, f"{type(e).__name__}: {e}"))
+        if recovery is not None and exhausted:
+            recovery.done()
         self.grid = Grid(self.grid_id, models, failures,
                          metric=self.search_criteria.get("sort_metric"))
         return self.grid

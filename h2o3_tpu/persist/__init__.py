@@ -1,0 +1,14 @@
+"""Persistence: binary model/frame save-load, exports, job recovery.
+
+Reference: ``water/persist/PersistManager.java`` (URI-routed backends),
+``water/fvec/persist/FramePersist.java`` (binary frame snapshots),
+``water/api/ModelsHandler`` import/export, ``hex/faulttolerance/Recovery.java``
+(auto-resume of long grid/AutoML jobs from a recovery dir).
+"""
+
+from h2o3_tpu.persist.frame_io import export_file, load_frame, save_frame
+from h2o3_tpu.persist.model_io import load_model, save_model
+from h2o3_tpu.persist.recovery import Recovery
+
+__all__ = ["export_file", "load_frame", "save_frame",
+           "load_model", "save_model", "Recovery"]

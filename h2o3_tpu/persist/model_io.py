@@ -1,0 +1,59 @@
+"""Binary model save/load.
+
+Reference: ``hex/Model`` binary export via ``water/api/ModelsHandler``
+import/export (Iced serialization of the whole model object). Here the model
+object graph (params, DataInfo, output arrays, metrics) is pickled with every
+``jax.Array`` converted to host numpy first — scoring code uses ``jnp`` ops
+which accept numpy inputs, so a loaded model scores immediately and XLA
+re-uploads constants on first use. One file, any mesh size.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+_MAGIC = b"h2o3_tpu-model-v1\n"
+
+
+def _to_host(obj, _depth=0):
+    if _depth > 12:
+        return obj
+    if isinstance(obj, jax.Array):
+        return np.asarray(jax.device_get(obj))
+    if isinstance(obj, dict):
+        return {k: _to_host(v, _depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v, _depth + 1) for v in obj)
+    if hasattr(obj, "__dict__") and not isinstance(obj, type):
+        for k, v in vars(obj).items():
+            setattr(obj, k, _to_host(v, _depth + 1))
+        return obj
+    return obj
+
+
+def save_model(model, path: str) -> str:
+    """Write a binary model file; returns the path (h2o-py:
+    ``h2o.save_model``)."""
+    import copy
+    m = copy.deepcopy(model)
+    m = _to_host(m)
+    with open(path, "wb") as fh:
+        fh.write(_MAGIC)
+        pickle.dump(m, fh)
+    return path
+
+
+def load_model(path: str):
+    """Load a saved model and re-register it in the DKV (h2o-py:
+    ``h2o.load_model``)."""
+    with open(path, "rb") as fh:
+        if fh.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError(f"{path} is not a saved model")
+        m = pickle.load(fh)
+    from h2o3_tpu.utils.registry import DKV
+    DKV.put(m.key, m)
+    return m

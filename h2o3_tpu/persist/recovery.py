@@ -1,0 +1,104 @@
+"""Job-level auto-recovery for long-running grid/AutoML searches.
+
+Reference: ``hex/faulttolerance/Recovery.java:21-50`` — before a long job
+starts, its params and training frame are written to ``-auto_recovery_dir``;
+every model built is appended; on restart the job reloads the snapshot and
+resumes where it stopped (already-built hyperparameter points are skipped).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from h2o3_tpu.persist.frame_io import load_frame, save_frame
+from h2o3_tpu.persist.model_io import load_model, save_model
+
+
+def combo_key(combo: dict) -> str:
+    """Canonical form of a hyperparameter point — the ONE spelling shared by
+    recovery skip-detection and grid model-id tags (divergence would break
+    resume)."""
+    return json.dumps(combo, sort_keys=True, default=str)
+
+
+class Recovery:
+    """Checkpoint directory for a resumable search job.
+
+    Usage (mirrors the reference's Recovery<Grid> lifecycle)::
+
+        rec = Recovery(dir)
+        rec.begin(params={...}, training_frame=f)  # no-op if resuming
+        for combo in combos:
+            if rec.is_done(combo): continue        # already built pre-crash
+            model = build(combo)
+            rec.model_built(combo, model)
+        rec.done()
+    """
+
+    def __init__(self, recovery_dir: str):
+        self.dir = recovery_dir
+        os.makedirs(recovery_dir, exist_ok=True)
+        self._state_path = os.path.join(recovery_dir, "recovery.json")
+        self._state = self._load_state()
+
+    def _load_state(self) -> dict:
+        if os.path.exists(self._state_path):
+            with open(self._state_path) as fh:
+                return json.load(fh)
+        return {"params": None, "built": [], "done": False}
+
+    def _flush(self) -> None:
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._state, fh)
+        os.replace(tmp, self._state_path)   # atomic: crash-safe snapshot
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def resuming(self) -> bool:
+        return self._state["params"] is not None and not self._state["done"]
+
+    def begin(self, params: dict, training_frame=None) -> None:
+        if self.resuming:
+            return
+        self._state = {"params": params, "built": [], "done": False}
+        if training_frame is not None:
+            save_frame(training_frame, os.path.join(self.dir, "training_frame"))
+        self._flush()
+
+    def training_frame(self):
+        p = os.path.join(self.dir, "training_frame")
+        return load_frame(p) if os.path.exists(p) else None
+
+    @property
+    def params(self) -> dict | None:
+        return self._state["params"]
+
+    def _key(self, combo: dict) -> str:
+        return combo_key(combo)
+
+    def _done_keys(self) -> set[str]:
+        if getattr(self, "_done_cache", None) is None or \
+                len(self._done_cache) != len(self._state["built"]):
+            self._done_cache = {b["combo"] for b in self._state["built"]}
+        return self._done_cache
+
+    def is_done(self, combo: dict) -> bool:
+        return self._key(combo) in self._done_keys()
+
+    def model_built(self, combo: dict, model) -> None:
+        fname = f"model_{len(self._state['built'])}.bin"
+        save_model(model, os.path.join(self.dir, fname))
+        self._state["built"].append({"combo": self._key(combo), "file": fname})
+        self._done_cache = None
+        self._flush()
+
+    def built_models(self) -> list:
+        return [load_model(os.path.join(self.dir, b["file"]))
+                for b in self._state["built"]]
+
+    def done(self) -> None:
+        self._state["done"] = True
+        self._flush()
