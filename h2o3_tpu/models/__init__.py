@@ -21,6 +21,15 @@ from h2o3_tpu.models.isofor import (
 from h2o3_tpu.models.isotonic import IsotonicRegression, IsotonicRegressionModel
 from h2o3_tpu.models.coxph import CoxPH, CoxPHModel
 from h2o3_tpu.models.word2vec import Word2Vec, Word2VecModel
+from h2o3_tpu.models.target_encoder import TargetEncoder, TargetEncoderModel
+from h2o3_tpu.models.rulefit import RuleFit, RuleFitModel
+from h2o3_tpu.models.decision_tree import DecisionTree, DecisionTreeModel
+from h2o3_tpu.models.aggregator import Aggregator, AggregatorModel
+from h2o3_tpu.models.grep_algo import Grep, GrepModel
+from h2o3_tpu.models.gam import GAM, GAMModel
+from h2o3_tpu.models.model_selection import (ANOVAGLM, ANOVAGLMModel,
+                                             ModelSelection, ModelSelectionModel)
+from h2o3_tpu.models.uplift import UpliftDRF, UpliftDRFModel
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
@@ -31,4 +40,9 @@ __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "IsolationForest", "IsolationForestModel",
            "ExtendedIsolationForest", "ExtendedIsolationForestModel",
            "IsotonicRegression", "IsotonicRegressionModel",
-           "CoxPH", "CoxPHModel", "Word2Vec", "Word2VecModel"]
+           "CoxPH", "CoxPHModel", "Word2Vec", "Word2VecModel",
+           "TargetEncoder", "TargetEncoderModel", "RuleFit", "RuleFitModel",
+           "DecisionTree", "DecisionTreeModel",
+           "Aggregator", "AggregatorModel", "Grep", "GrepModel",
+           "GAM", "GAMModel", "ModelSelection", "ModelSelectionModel",
+           "ANOVAGLM", "ANOVAGLMModel", "UpliftDRF", "UpliftDRFModel"]

@@ -1,0 +1,109 @@
+"""Aggregator — exemplar-based dataset reduction.
+
+Reference: ``hex/aggregator/Aggregator.java`` — single pass over chunks
+collecting exemplars (a row becomes an exemplar when no existing exemplar is
+within ``radius_scale``-scaled distance), then per-chunk exemplar sets merge;
+output is the exemplar frame with a ``counts`` column.
+
+TPU-native: the sequential per-row scan is hostile to SPMD, so the exemplar
+set is selected with the same farthest-point/k-means|| style device sweep
+KMeans init uses (distance matrices on the MXU), which preserves the
+contract — a reduced frame whose exemplars cover the data within a radius,
+with member counts — while staying batched.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.rapids.munge import gather_rows
+
+
+class AggregatorModel(Model):
+    algo = "aggregator"
+
+    def _score_raw(self, frame: Frame):
+        raise NotImplementedError("Aggregator produces an output frame; use "
+                                  "aggregated_frame")
+
+    def model_performance(self, frame: Frame):
+        return None
+
+    @property
+    def aggregated_frame(self) -> Frame:
+        return self.output["output_frame"]
+
+
+class Aggregator(ModelBuilder):
+    """h2o-py surface: ``H2OAggregatorEstimator``."""
+
+    algo = "aggregator"
+    unsupervised = True
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            target_num_exemplars=100,
+            rel_tol_num_exemplars=0.5,
+            transform="NORMALIZE",
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> AggregatorModel:
+        p = self.params
+        di = DataInfo.make(frame, x, standardize=p["transform"] != "NONE",
+                           use_all_factor_levels=True)
+        X = di.expand(frame)
+        mask = (weights > 0)
+        n = frame.nrows
+        target = min(int(p["target_num_exemplars"]), n)
+
+        # farthest-point sweep: greedily add the row farthest from the current
+        # exemplar set (batched distance updates; k-means|| flavored)
+        key = jax.random.PRNGKey(int(p.get("seed") or 0) or 11)
+        # seed from the included (weight>0) rows only
+        r = jax.random.uniform(key, (X.shape[0],))
+        first = int(jax.device_get(jnp.argmax(jnp.where(mask, r, -1.0))))
+        idx = [first]
+        d2 = jnp.where(mask, ((X - X[first][None, :]) ** 2).sum(1), -jnp.inf)
+        for i in range(1, target):
+            nxt = int(jax.device_get(jnp.argmax(d2)))
+            if float(jax.device_get(d2[nxt])) <= 0:
+                break
+            idx.append(nxt)
+            d2 = jnp.minimum(d2, jnp.where(mask, ((X - X[nxt][None, :]) ** 2).sum(1),
+                                           -jnp.inf))
+            if i % 32 == 0:
+                job.update(0.8 * i / target, f"{i} exemplars")
+        exemplars = np.array(idx, np.int64)
+
+        # assign every row to its nearest exemplar → member counts; the
+        # ||x||²+||e||²−2x·e form keeps the [rows,k] distance on the MXU
+        # without a [rows,k,dims] broadcast intermediate
+        E = X[jnp.asarray(exemplars)]
+        d = ((X * X).sum(1, keepdims=True) + (E * E).sum(1)[None, :]
+             - 2.0 * X @ E.T)
+        assign = jnp.argmin(d, axis=1)
+        counts = jax.ops.segment_sum(mask.astype(jnp.float32), assign,
+                                     len(exemplars))
+
+        out = gather_rows(frame, exemplars)
+        out.add("counts", Vec.from_numpy(
+            np.asarray(jax.device_get(counts), np.float64)))
+        job.update(1.0, f"{len(exemplars)} exemplars")
+
+        return AggregatorModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=di, response_column=None,
+            response_domain=None,
+            output=dict(output_frame=out, exemplar_rows=exemplars,
+                        exemplar_assignment=assign),
+        )

@@ -1,0 +1,83 @@
+"""Grep — regex search over a text column as a model builder.
+
+Reference: ``hex/grep/Grep.java`` (174 LoC demo algo): MRTask over byte
+chunks running a regex, output = matches + offsets. Text columns are
+host-resident here (see ``Vec``), so the scan is one vectorized host pass —
+the value is API parity for the reference's demo, not device compute.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+class GrepModel(Model):
+    algo = "grep"
+
+    def model_performance(self, frame: Frame):
+        return None
+
+    @property
+    def matches(self) -> Frame:
+        return self.output["matches"]
+
+
+class Grep(ModelBuilder):
+    algo = "grep"
+    unsupervised = True
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(super().defaults(), regex=".*")
+
+    def train(self, x=None, y=None, training_frame: Frame | None = None, **kw):
+        # bypass the base feature filter: STR columns are host-resident and
+        # would be dropped as "not on device"
+        if training_frame is None:
+            raise ValueError("training_frame is required")
+        col = (x[0] if isinstance(x, (list, tuple)) else x) or training_frame.names[0]
+        self.job = Job(f"grep on {col}")
+        self.job.run(lambda j: self._fit(j, training_frame, [col], None, None))
+        if self.job.status == Job.FAILED:
+            raise self.job.exception
+        self.model = self.job.result
+        from h2o3_tpu.utils.registry import DKV
+        DKV.put(self.model.key, self.model)
+        return self.model
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> GrepModel:
+        rx = re.compile(str(self.params["regex"]))
+        col = x[0]
+        v = frame.vec(col)
+        if v.is_categorical:
+            vals = [None if c < 0 else v.domain[c] for c in v.to_numpy()]
+        elif v.type is VecType.STR:
+            vals = list(v.host_values)
+        else:
+            raise ValueError("grep requires a string or categorical column")
+        rows, matches, offsets = [], [], []
+        for i, s in enumerate(vals):
+            if s is None:
+                continue
+            for m in rx.finditer(s):
+                rows.append(float(i))
+                matches.append(m.group(0))
+                offsets.append(float(m.start()))
+        out = Frame(["row", "match", "offset"],
+                    [Vec.from_numpy(np.asarray(rows, np.float32)),
+                     Vec(None, VecType.STR, len(matches),
+                         host_values=np.array(matches, dtype=object)),
+                     Vec.from_numpy(np.asarray(offsets, np.float32))])
+        job.update(1.0, f"{len(matches)} matches")
+        return GrepModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=None,
+            response_domain=None, output=dict(matches=out))

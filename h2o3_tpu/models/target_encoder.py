@@ -1,0 +1,177 @@
+"""TargetEncoder — CV-aware categorical target encoding with blending.
+
+Reference: ``h2o-extensions/target-encoder/.../TargetEncoder.java`` +
+``TargetEncoderModel.java``: per categorical column, replace levels with the
+(blended) mean response computed from training statistics; leakage handling
+via ``data_leakage_handling`` = None | KFold | LeaveOneOut; blending shrinks
+small groups toward the prior with inflection_point/smoothing
+(``TargetEncoderHelper.java``).
+
+TPU-native: per-level (sum_y, count) statistics are one ``segment_sum`` over
+the categorical codes (the reference runs a group-by MRTask +
+``TargetEncoderBroadcastJoin``); encoding a frame is one gather through the
+level→value LUT.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.data_info import response_as_float
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _blend(sum_y, cnt, prior, inflection_point, smoothing):
+    """Blended level mean (reference ``TargetEncoderHelper.getBlendedValue``):
+    lambda = 1/(1+exp((ip - n)/s)); value = lambda*mean + (1-lambda)*prior."""
+    mean = sum_y / jnp.maximum(cnt, 1.0)
+    lam = 1.0 / (1.0 + jnp.exp((inflection_point - cnt) / jnp.maximum(smoothing, 1e-6)))
+    return jnp.where(cnt > 0, lam * mean + (1 - lam) * prior, prior)
+
+
+class TargetEncoderModel(Model):
+    algo = "targetencoder"
+
+    def transform(self, frame: Frame, as_training: bool = False) -> Frame:
+        """Append ``<col>_te`` columns (h2o-py:
+        ``H2OTargetEncoderEstimator.transform``). ``as_training`` applies the
+        leakage strategy (KFold/LOO) instead of the full-data statistics."""
+        out = Frame(list(frame.names), list(frame.vecs))
+        o = self.output
+        if as_training and o["data_leakage_handling"] != "None" \
+                and o.get("train_encoded") is not None:
+            for c in o["columns"]:
+                out.add(f"{c}_te", o["train_encoded"][c])
+            return out
+        for c in o["columns"]:
+            v = frame.vec(c)
+            lut = o["lut"][c]                    # [K+1]: per-level value + NA slot
+            if v.domain != o["domains"][c]:
+                # map this frame's levels onto the training domain
+                tdom = {s: i for i, s in enumerate(o["domains"][c])}
+                remap = np.array([tdom.get(s, len(lut) - 1) for s in v.domain]
+                                 + [len(lut) - 1], np.int32)
+                codes = jnp.asarray(remap)[jnp.clip(v.data, -1, len(v.domain) - 1)]
+                codes = jnp.where(v.data < 0, len(lut) - 1, codes)
+            else:
+                codes = jnp.where(v.data < 0, len(lut) - 1, v.data)
+            enc = jnp.asarray(lut)[codes]
+            out.add(f"{c}_te", Vec(enc.astype(jnp.float32), VecType.NUM, v.nrows))
+        return out
+
+    def _score_raw(self, frame: Frame):
+        raise NotImplementedError("TargetEncoder is a transformer; use transform()")
+
+    def model_performance(self, frame: Frame):
+        return None
+
+
+class TargetEncoder(ModelBuilder):
+    """h2o-py surface: ``H2OTargetEncoderEstimator``."""
+
+    algo = "targetencoder"
+
+    def _holdout_metrics(self, model, frame, y, w):
+        return None   # transformer: no scoring metrics (reference: TE model
+        #               metrics are the identity transform's)
+
+    def _cross_validate(self, *a, **kw):
+        return None   # nfolds configures the KFold leakage strategy, not CV
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            super().defaults(),
+            columns=None,                       # None → all categorical x
+            data_leakage_handling="None",       # None | KFold | LeaveOneOut
+            blending=False,
+            inflection_point=10.0,
+            smoothing=20.0,
+            noise=0.0,
+            fold_column=None,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> TargetEncoderModel:
+        p = self.params
+        yvec = frame.vec(y)
+        if yvec.is_categorical and yvec.cardinality() != 2:
+            raise ValueError("TargetEncoder supports binary or numeric targets")
+        yy, valid = response_as_float(yvec)
+        w = weights * valid
+        cols = p["columns"] or [c for c in x if frame.vec(c).is_categorical]
+        if not cols:
+            raise ValueError("no categorical columns to encode")
+
+        prior = float(jax.device_get((w * yy).sum() / jnp.maximum(w.sum(), 1e-30)))
+        ip, sm = float(p["inflection_point"]), float(p["smoothing"])
+        blend = bool(p["blending"])
+
+        lut, domains, train_encoded = {}, {}, {}
+        leak = str(p["data_leakage_handling"])
+        nfolds = int(p.get("nfolds") or 5)
+        fold = self._fold_ids(frame, nfolds) if leak == "KFold" else None
+        noise = float(p["noise"])
+        key = jax.random.PRNGKey(int(p.get("seed") or 0) if int(p.get("seed") or -1) >= 0 else 7)
+
+        for c in cols:
+            v = frame.vec(c)
+            K = v.cardinality()
+            domains[c] = v.domain
+            code = jnp.where(v.data < 0, K, jnp.clip(v.data, 0, K - 1))
+            sum_y = jax.ops.segment_sum(w * yy, code, K + 1)
+            cnt = jax.ops.segment_sum(w, code, K + 1)
+            if blend:
+                vals = _blend(sum_y, cnt, prior, ip, sm)
+            else:
+                vals = jnp.where(cnt > 0, sum_y / jnp.maximum(cnt, 1.0), prior)
+            # NA slot encodes to the prior (reference: NA treated as own level
+            # only when seen in training; default to prior)
+            vals = vals.at[K].set(_blend(sum_y[K], cnt[K], prior, ip, sm)
+                                  if blend and float(cnt[K]) > 0 else
+                                  (float(sum_y[K] / cnt[K]) if float(cnt[K]) > 0
+                                   else prior))
+            lut[c] = np.asarray(jax.device_get(vals), np.float32)
+
+            if leak == "KFold":
+                enc = jnp.zeros(frame.plen, jnp.float32)
+                for f in range(nfolds):
+                    out_mask = (fold == f)
+                    wf = w * (~out_mask)
+                    s_f = jax.ops.segment_sum(wf * yy, code, K + 1)
+                    c_f = jax.ops.segment_sum(wf, code, K + 1)
+                    pf = float(jax.device_get(
+                        (wf * yy).sum() / jnp.maximum(wf.sum(), 1e-30)))
+                    v_f = _blend(s_f, c_f, pf, ip, sm) if blend else \
+                        jnp.where(c_f > 0, s_f / jnp.maximum(c_f, 1.0), pf)
+                    enc = jnp.where(out_mask, v_f[code], enc)
+                train_encoded[c] = Vec(enc, VecType.NUM, frame.nrows)
+            elif leak == "LeaveOneOut":
+                s_loo = sum_y[code] - w * yy
+                c_loo = cnt[code] - w
+                v_loo = _blend(s_loo, c_loo, prior, ip, sm) if blend else \
+                    jnp.where(c_loo > 0, s_loo / jnp.maximum(c_loo, 1.0), prior)
+                train_encoded[c] = Vec(v_loo.astype(jnp.float32), VecType.NUM,
+                                       frame.nrows)
+            if noise > 0 and c in train_encoded:
+                key, kn = jax.random.split(key)
+                tv = train_encoded[c]
+                train_encoded[c] = Vec(
+                    tv.data + jax.random.uniform(kn, tv.data.shape,
+                                                 minval=-noise, maxval=noise),
+                    VecType.NUM, tv.nrows)
+            job.update(0.9, f"encoded {c}")
+
+        return TargetEncoderModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=y,
+            response_domain=None,
+            output=dict(columns=cols, lut=lut, domains=domains, prior=prior,
+                        data_leakage_handling=leak,
+                        train_encoded=train_encoded or None),
+        )

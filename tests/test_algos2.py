@@ -1,0 +1,135 @@
+"""Second algo wave: TargetEncoder, RuleFit, DecisionTree, Aggregator, Grep
+(reference test model: ``h2o-py/tests/testdir_algos/{targetencoder,rulefit,...}``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import (Aggregator, DecisionTree, Grep, RuleFit,
+                             TargetEncoder)
+
+
+@pytest.fixture
+def te_frame(rng):
+    n = 2000
+    g = rng.choice(["a", "b", "c", "d"], size=n, p=[0.4, 0.3, 0.2, 0.1])
+    base = {"a": 0.8, "b": 0.5, "c": 0.3, "d": 0.1}
+    y = (rng.uniform(size=n) < np.array([base[c] for c in g]))
+    return Frame.from_arrays({
+        "g": g.astype(object),
+        "x": rng.normal(size=n),
+        "y": np.array(["yes" if t else "no" for t in y], dtype=object),
+    }), base
+
+
+def test_target_encoder_means(te_frame):
+    f, base = te_frame
+    te = TargetEncoder(columns=["g"]).train(x=["g", "x"], y="y", training_frame=f)
+    out = te.transform(f)
+    assert "g_te" in out.names
+    enc = out.vec("g_te").to_numpy()
+    labels = f.vec("g").labels()
+    for lev, expected in base.items():
+        got = enc[labels == lev].mean()
+        assert abs(got - expected) < 0.06, (lev, got, expected)
+
+
+def test_target_encoder_blending(te_frame):
+    f, base = te_frame
+    te = TargetEncoder(columns=["g"], blending=True, inflection_point=1e6) \
+        .train(x=["g"], y="y", training_frame=f)
+    enc = te.transform(f).vec("g_te").to_numpy()
+    prior = te.output["prior"]
+    # with a huge inflection point every level shrinks to the prior
+    assert np.allclose(enc, prior, atol=1e-3)
+
+
+def test_target_encoder_kfold_loo(te_frame):
+    f, _ = te_frame
+    for leak in ("KFold", "LeaveOneOut"):
+        te = TargetEncoder(columns=["g"], data_leakage_handling=leak, nfolds=3) \
+            .train(x=["g"], y="y", training_frame=f)
+        tr = te.transform(f, as_training=True)
+        ho = te.transform(f, as_training=False)
+        a = tr.vec("g_te").to_numpy()
+        b = ho.vec("g_te").to_numpy()
+        assert not np.allclose(a, b)       # OOF stats differ from full stats
+        assert abs(a.mean() - b.mean()) < 0.05
+
+
+def test_target_encoder_unseen_level(te_frame):
+    f, _ = te_frame
+    te = TargetEncoder(columns=["g"]).train(x=["g"], y="y", training_frame=f)
+    f2 = Frame.from_arrays({"g": np.array(["a", "zzz"], dtype=object)})
+    enc = te.transform(f2).vec("g_te").to_numpy()
+    assert enc[1] == pytest.approx(te.output["prior"], abs=1e-5)
+
+
+def test_rulefit_binomial(rng):
+    n = 1500
+    X = rng.normal(size=(n, 4))
+    y = ((X[:, 0] > 0.5) & (X[:, 1] < 0.0)) | (X[:, 2] > 1.2)
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(4)}
+                          | {"y": np.array(["t" if v else "f" for v in y],
+                                           dtype=object)})
+    m = RuleFit(max_rule_length=3, rule_generation_ntrees=8, lambda_=1e-3) \
+        .train(y="y", training_frame=f)
+    assert m.training_metrics.auc > 0.9
+    imp = m.rule_importance()
+    assert len(imp) > 0
+    # the learned rules mention the truly-informative features
+    joined = " ".join(r for r, _ in imp[:10])
+    assert "x0" in joined or "x2" in joined
+
+
+def test_rulefit_regression(rng):
+    n = 1000
+    X = rng.normal(size=(n, 3))
+    y = 2.0 * (X[:, 0] > 0) + X[:, 1] + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    m = RuleFit(model_type="rules_and_linear", rule_generation_ntrees=6) \
+        .train(y="y", training_frame=f)
+    assert m.training_metrics.r2 > 0.8
+
+
+def test_decision_tree(rng):
+    n = 1000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0.3)
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)}
+                          | {"y": np.array(["p" if v else "n" for v in y],
+                                           dtype=object)})
+    m = DecisionTree(max_depth=4).train(y="y", training_frame=f)
+    acc = (m.predict(f).vec("predict").to_numpy() == y.astype(int)).mean()
+    assert acc > 0.95
+    assert m.training_metrics.auc > 0.95
+
+    # regression tree: leaf = node mean
+    fr = Frame.from_arrays({"x": X[:, 0], "y": 3.0 * (X[:, 0] > 0)})
+    mr = DecisionTree(max_depth=2).train(y="y", training_frame=fr)
+    assert mr.training_metrics.rmse < 0.4
+
+
+def test_aggregator(rng):
+    n = 2000
+    X = np.concatenate([rng.normal(size=(n // 2, 2)),
+                        rng.normal(size=(n // 2, 2)) + 8.0])
+    f = Frame.from_arrays({"a": X[:, 0], "b": X[:, 1]})
+    m = Aggregator(target_num_exemplars=50).train(training_frame=f)
+    out = m.aggregated_frame
+    assert 2 <= out.nrows <= 50
+    counts = out.vec("counts").to_numpy()
+    assert counts.sum() == pytest.approx(n)
+    # exemplars cover both clusters
+    a = out.vec("a").to_numpy()
+    assert (a < 4).any() and (a > 4).any()
+
+
+def test_grep():
+    f = Frame.from_arrays({"s": np.array(
+        ["error: disk full", "ok", "error: oom", None], dtype=object)})
+    m = Grep(regex=r"error: (\w+)").train(x=["s"], training_frame=f)
+    out = m.matches
+    assert out.nrows == 2
+    assert out.vec("row").to_numpy().tolist() == [0.0, 2.0]
+    assert list(out.vec("match").host_values) == ["error: disk", "error: oom"]

@@ -1,0 +1,106 @@
+"""Third algo wave: GAM, ModelSelection, ANOVAGLM, UpliftDRF
+(reference test model: ``h2o-py/tests/testdir_algos/{gam,modelselection,
+anovaglm,uplift}/``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models import ANOVAGLM, GAM, ModelSelection, UpliftDRF
+
+
+def test_gam_captures_nonlinearity(rng):
+    n = 2000
+    x = rng.uniform(-3, 3, size=n)
+    z = rng.normal(size=n)
+    y = np.sin(x) * 2.0 + 0.5 * z + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({"x": x, "z": z, "y": y})
+
+    from h2o3_tpu.models import GLM
+    lin = GLM(family="gaussian").train(y="y", training_frame=f)
+    gam = GAM(gam_columns=["x"], num_knots=8).train(y="y", training_frame=f)
+    # the spline must capture sin(x); a linear GLM cannot
+    assert gam.training_metrics.r2 > 0.95
+    assert gam.training_metrics.r2 > lin.training_metrics.r2 + 0.2
+    # scoring a fresh frame re-expands the basis identically
+    pred = gam.predict(f).vec("predict").to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.97
+
+
+def test_gam_binomial(rng):
+    n = 1500
+    x = rng.uniform(-3, 3, size=n)
+    p = 1 / (1 + np.exp(-3 * np.sin(x)))
+    y = rng.uniform(size=n) < p
+    f = Frame.from_arrays({"x": x,
+                           "y": np.array(["t" if v else "f" for v in y],
+                                         dtype=object)})
+    gam = GAM(gam_columns=["x"], num_knots=8, family="binomial") \
+        .train(y="y", training_frame=f)
+    assert gam.training_metrics.auc > 0.8
+
+
+def test_model_selection_maxr(rng):
+    n = 1000
+    X = rng.normal(size=(n, 4))
+    y = 3.0 * X[:, 0] + 2.0 * X[:, 1] + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(4)} | {"y": y})
+    m = ModelSelection(mode="maxr", max_predictor_number=2) \
+        .train(y="y", training_frame=f)
+    res = m.result()
+    assert res[0]["n_predictors"] == 1
+    # best 1-predictor model must pick x0 (largest coefficient)
+    assert res[0]["predictors"] == ["x0"]
+    assert set(res[1]["predictors"]) == {"x0", "x1"}
+    assert res[1]["r2"] > 0.99
+
+
+def test_model_selection_forward_backward(rng):
+    n = 800
+    X = rng.normal(size=(n, 3))
+    y = 2.0 * X[:, 0] - 1.0 * X[:, 2] + rng.normal(scale=0.1, size=n)
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    fw = ModelSelection(mode="forward", max_predictor_number=2) \
+        .train(y="y", training_frame=f)
+    assert fw.result()[0]["predictors"] == ["x0"]
+    assert set(fw.result()[1]["predictors"]) == {"x0", "x2"}
+    bw = ModelSelection(mode="backward", min_predictor_number=2) \
+        .train(y="y", training_frame=f)
+    assert set(bw.result()[-1]["predictors"]) == {"x0", "x2"}
+
+
+def test_anovaglm(rng):
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    y = 2.0 * X[:, 0] + rng.normal(scale=0.5, size=n)   # only x0 matters
+    f = Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    m = ANOVAGLM().train(y="y", training_frame=f)
+    tab = {r["predictor"]: r for r in m.anova_table()}
+    assert tab["x0"]["p_value"] < 1e-6
+    assert tab["x1"]["p_value"] > 0.01
+    assert tab["x2"]["p_value"] > 0.01
+
+
+def test_uplift_drf(rng):
+    n = 4000
+    X = rng.normal(size=(n, 3))
+    treat = rng.integers(0, 2, size=n)
+    # true uplift depends on x0: treated units with x0>0 convert much more
+    base = 0.2
+    uplift = 0.4 * (X[:, 0] > 0)
+    p = base + treat * uplift
+    y = rng.uniform(size=n) < p
+    f = Frame.from_arrays({
+        "x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+        "treat": np.array(["control", "treatment"], dtype=object)[treat],
+        "y": np.array(["no", "yes"], dtype=object)[y.astype(int)],
+    })
+    m = UpliftDRF(treatment_column="treat", ntrees=20, max_depth=4) \
+        .train(y="y", training_frame=f)
+    pred = m.predict(f).vec("uplift_predict").to_numpy()
+    # predicted uplift separates the high-uplift segment
+    hi = pred[X[:, 0] > 0].mean()
+    lo = pred[X[:, 0] <= 0].mean()
+    assert hi > lo + 0.15, (hi, lo)
+    mm = m.training_metrics
+    assert mm.auuc > 0 and mm.qini > 0
