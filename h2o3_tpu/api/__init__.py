@@ -1,0 +1,13 @@
+"""REST API (V3 schema surface) + thin Python client.
+
+Reference: ``water/api/RequestServer.java`` (~150 routes), ``water/api/Schema``
++ ``schemas3/`` (126 classes), served by Jetty (``h2o-webserver-iface``).
+Here: a stdlib threaded HTTP server (the REST plane is control-only — all data
+compute stays on-device behind the estimator API) with the high-traffic V3
+routes the h2o-py client actually uses.
+"""
+
+from h2o3_tpu.api.server import H2OServer, start_server
+from h2o3_tpu.api.client import H2OClient
+
+__all__ = ["H2OServer", "start_server", "H2OClient"]

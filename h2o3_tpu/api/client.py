@@ -1,0 +1,118 @@
+"""Thin REST client — the h2o-py connection surface over stdlib urllib.
+
+Reference: ``h2o-py/h2o/backend/connection.py:249`` (``H2OConnection.request``
+``:431-455``) — every client verb is one HTTP call to the V3 routes; training
+polls ``/3/Jobs/{id}`` until DONE (``estimator_base.py:186``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+
+class H2OClient:
+    """``H2OClient(url)`` speaks to a running :class:`H2OServer`."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    # -- transport -----------------------------------------------------------
+
+    def request(self, method: str, path: str, data: dict | None = None) -> dict:
+        url = self.url + path
+        body = None
+        headers = {}
+        if data is not None:
+            body = urllib.parse.urlencode(
+                {k: (json.dumps(v) if isinstance(v, (dict, list)) else v)
+                 for k, v in data.items()}).encode()
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode()
+            try:
+                msg = json.loads(payload).get("msg", payload)
+            except json.JSONDecodeError:
+                msg = payload
+            raise RuntimeError(f"{method} {path} → {e.code}: {msg}") from None
+
+    # -- verbs (h2o-py equivalents) ------------------------------------------
+
+    def cloud_status(self) -> dict:
+        return self.request("GET", "/3/Cloud")
+
+    def import_file(self, path: str, destination_frame: str | None = None) -> str:
+        d = {"path": path}
+        if destination_frame:
+            d["destination_frame"] = destination_frame
+        out = self.request("POST", "/3/ImportFiles", d)
+        return out["destination_frames"][0]
+
+    def frame(self, key: str) -> dict:
+        return self.request("GET", f"/3/Frames/{key}")["frames"][0]
+
+    def frames(self) -> list[dict]:
+        return self.request("GET", "/3/Frames")["frames"]
+
+    def rm(self, key: str) -> None:
+        try:
+            self.request("DELETE", f"/3/Frames/{key}")
+        except RuntimeError:
+            self.request("DELETE", f"/3/Models/{key}")
+
+    def train(self, algo: str, training_frame: str, y: str | None = None,
+              poll_secs: float = 0.2, **params) -> dict:
+        """POST /3/ModelBuilders/{algo}, poll the job, return the model JSON."""
+        d = {"training_frame": training_frame, **params}
+        if y is not None:
+            d["response_column"] = y
+        out = self.request("POST", f"/3/ModelBuilders/{algo}", d)
+        job = self._poll(out["job"]["key"]["name"], poll_secs)
+        return self.model(job["dest"]["name"])
+
+    def _poll(self, job_key: str, poll_secs: float = 0.2) -> dict:
+        while True:
+            job = self.request("GET", f"/3/Jobs/{job_key}")["jobs"][0]
+            if job["status"] in ("DONE", "FAILED", "CANCELLED"):
+                if job["status"] == "FAILED":
+                    raise RuntimeError(f"job failed: {job.get('exception')}")
+                return job
+            time.sleep(poll_secs)
+
+    def model(self, key: str) -> dict:
+        return self.request("GET", f"/3/Models/{key}")["models"][0]
+
+    def models(self) -> list[dict]:
+        return self.request("GET", "/3/Models")["models"]
+
+    def predict(self, model_key: str, frame_key: str) -> str:
+        out = self.request("POST",
+                           f"/3/Predictions/models/{model_key}/frames/{frame_key}")
+        return out["predictions_frame"]["name"]
+
+    def rapids(self, ast: str, id: str | None = None) -> dict:
+        d = {"ast": ast}
+        if id:
+            d["id"] = id
+        return self.request("POST", "/99/Rapids", d)
+
+    def grid(self, algo: str, training_frame: str, y: str,
+             hyper_parameters: dict, search_criteria: dict | None = None,
+             **params) -> dict:
+        d = {"training_frame": training_frame, "response_column": y,
+             "hyper_parameters": hyper_parameters, **params}
+        if search_criteria:
+            d["search_criteria"] = search_criteria
+        out = self.request("POST", f"/99/Grid/{algo}", d)
+        job = self._poll(out["job"]["key"]["name"])
+        return self.request("GET", f"/99/Grids/{job['dest']['name']}")
+
+    def shutdown(self) -> None:
+        self.request("POST", "/3/Shutdown")

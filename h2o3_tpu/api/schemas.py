@@ -1,0 +1,127 @@
+"""V3 schema serialization — framework objects → REST JSON.
+
+Reference: ``water/api/Schema.java`` (reflection-driven field copy via ``@API``
+annotations) and ``water/api/schemas3/*.java`` (FrameV3, ModelSchemaV3,
+JobV3, CloudV3 …). The wire format keys (``__meta.schema_type``, field names)
+follow the reference so existing h2o-py response parsing recognizes them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+
+def _clean(x: Any) -> Any:
+    """JSON-safe: numpy scalars → python, non-finite floats → None."""
+    if isinstance(x, (np.floating, float)):
+        f = float(x)
+        return f if math.isfinite(f) else None
+    if isinstance(x, (np.integer, int)):
+        return int(x)
+    if isinstance(x, np.ndarray):
+        return [_clean(v) for v in x.tolist()]
+    if isinstance(x, (list, tuple)):
+        return [_clean(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _clean(v) for k, v in x.items()}
+    if isinstance(x, (str, bool)) or x is None:
+        return x
+    return str(x)
+
+
+def _meta(schema_type: str) -> dict:
+    return {"__meta": {"schema_version": 3, "schema_name": schema_type,
+                       "schema_type": schema_type}}
+
+
+def cloud_v3(version: str) -> dict:
+    import jax
+    devs = jax.devices()
+    return {**_meta("CloudV3"), "version": version, "cloud_name": "h2o3_tpu",
+            "cloud_size": len(devs), "cloud_healthy": True,
+            "nodes": [{"h2o": str(d), "healthy": True, "num_cpus": 1}
+                      for d in devs]}
+
+
+def frame_v3(key: str, frame, rows: int = 10) -> dict:
+    cols = []
+    head = frame.to_pandas().head(rows)
+    for name, vec in zip(frame.names, frame.vecs):
+        r = vec.rollups()
+        col = {"label": name, "type": str(vec.type).lower(),
+               "missing_count": int(r.na_cnt),
+               "domain": list(vec.domain) if vec.domain else None,
+               "domain_cardinality": vec.cardinality(),
+               "data": _clean(head[name].to_numpy() if name in head else [])}
+        if vec.is_numeric:
+            col.update(mins=[_clean(r.min)], maxs=[_clean(r.max)],
+                       mean=_clean(r.mean), sigma=_clean(r.sigma))
+        cols.append(col)
+    return {**_meta("FrameV3"), "frame_id": {"name": key},
+            "rows": frame.nrows, "row_count": frame.nrows,
+            "column_count": frame.ncols, "columns": cols}
+
+
+def frames_list_v3(store) -> dict:
+    from h2o3_tpu.frame.frame import Frame
+    frames = [{"frame_id": {"name": k}, "rows": v.nrows, "column_count": v.ncols}
+              for k, v in ((k, store.get(k)) for k in store.keys())
+              if isinstance(v, Frame)]
+    return {**_meta("FramesV3"), "frames": frames}
+
+
+def metrics_v3(mm) -> dict | None:
+    if mm is None:
+        return None
+    out = {}
+    for f in ("mse", "rmse", "mae", "r2", "logloss", "auc", "pr_auc",
+              "mean_per_class_error", "residual_deviance", "null_deviance",
+              "accuracy", "mean_residual_deviance", "totss", "tot_withinss",
+              "betweenss"):
+        v = getattr(mm, f, None)
+        if v is not None and not callable(v):
+            out[f] = _clean(v)
+    return {**_meta("ModelMetricsV3"), **out}
+
+
+def model_v3(model) -> dict:
+    out = {**_meta("ModelSchemaV3"),
+           "model_id": {"name": model.key}, "algo": model.algo,
+           "algo_full_name": model.algo,
+           "response_column_name": model.response_column,
+           "parameters": [{"name": k, "actual_value": _clean(v)}
+                          for k, v in dict(model.params).items()],
+           "output": {
+               "model_category": ("Binomial" if model.nclasses == 2 else
+                                  "Multinomial" if model.nclasses > 2 else
+                                  "Regression"),
+               "training_metrics": metrics_v3(model.training_metrics),
+               "validation_metrics": metrics_v3(model.validation_metrics),
+               "cross_validation_metrics": metrics_v3(model.cross_validation_metrics),
+               "run_time_ms": model.run_time_ms,
+           }}
+    return out
+
+
+def models_list_v3(store) -> dict:
+    from h2o3_tpu.models.model_base import Model
+    models = [{"model_id": {"name": k}, "algo": v.algo}
+              for k, v in ((k, store.get(k)) for k in store.keys())
+              if isinstance(v, Model)]
+    return {**_meta("ModelsV3"), "models": models}
+
+
+def job_v3(job_id: str, job) -> dict:
+    status = {"RUNNING": "RUNNING", "DONE": "DONE", "FAILED": "FAILED",
+              "CANCELLED": "CANCELLED"}.get(job.status, job.status)
+    d = {**_meta("JobV3"), "key": {"name": job_id}, "status": status,
+         "progress": _clean(job.progress), "progress_msg": job.progress_msg,
+         "msec": int(job.run_time * 1000)}
+    if job.status == "FAILED" and job.exception is not None:
+        d["exception"] = str(job.exception)
+    if getattr(job, "dest_key", None):
+        d["dest"] = {"name": job.dest_key}
+    return d

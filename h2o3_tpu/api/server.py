@@ -1,0 +1,375 @@
+"""REST server — the V3 route surface.
+
+Reference: ``water/api/RequestServer.java:24-80`` (route tree; core routes in
+``RegisterV3Api.java``, algo routes via ``AlgoAbstractRegister``). Routes
+implemented are the ones h2o-py traffics: Cloud, ImportFiles, Parse, Frames,
+Models, ModelBuilders, Predictions, Jobs, Rapids, Grid, AutoML, Shutdown.
+
+Training runs on a background thread through the same :class:`Job` the library
+path uses (reference: ``Job.start`` → F/J pool), so clients poll ``/3/Jobs``
+exactly like against the reference server.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from h2o3_tpu import __version__
+from h2o3_tpu.api import schemas
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model
+from h2o3_tpu.utils.registry import DKV
+
+_ALGOS = None
+
+
+def _algo_registry():
+    global _ALGOS
+    if _ALGOS is None:
+        from h2o3_tpu.models import (ANOVAGLM, GAM, GBM, DRF, GLM, SVD,
+                                     Aggregator, CoxPH, DecisionTree,
+                                     DeepLearning, ExtendedIsolationForest,
+                                     GLRM, Grep, IsolationForest,
+                                     IsotonicRegression, KMeans,
+                                     ModelSelection, NaiveBayes, PCA, RuleFit,
+                                     TargetEncoder, UpliftDRF, Word2Vec,
+                                     XGBoost)
+        _ALGOS = {"gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
+                  "xgboost": XGBoost, "kmeans": KMeans, "pca": PCA, "svd": SVD,
+                  "glrm": GLRM, "naivebayes": NaiveBayes, "coxph": CoxPH,
+                  "isolationforest": IsolationForest,
+                  "extendedisolationforest": ExtendedIsolationForest,
+                  "isotonicregression": IsotonicRegression,
+                  "word2vec": Word2Vec, "targetencoder": TargetEncoder,
+                  "rulefit": RuleFit, "decisiontree": DecisionTree,
+                  "aggregator": Aggregator, "grep": Grep, "gam": GAM,
+                  "modelselection": ModelSelection, "anovaglm": ANOVAGLM,
+                  "upliftdrf": UpliftDRF}
+    return _ALGOS
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = f"h2o3_tpu/{__version__}"
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, *a):   # route logs to our logger, not stderr
+        pass
+
+    def _reply(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str):
+        self._reply({"__meta": {"schema_type": "H2OErrorV3"},
+                     "http_status": code, "msg": msg, "exception_msg": msg}, code)
+
+    def _params(self) -> dict:
+        q = urllib.parse.urlparse(self.path).query
+        out = {k: v[0] for k, v in urllib.parse.parse_qs(q).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            body = self.rfile.read(length).decode()
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                out.update(json.loads(body))
+            else:
+                out.update({k: v[0] for k, v in urllib.parse.parse_qs(body).items()})
+        return out
+
+    # -- dispatch ------------------------------------------------------------
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    def _route(self, method: str):
+        path = urllib.parse.urlparse(self.path).path
+        try:
+            for pat, m, fn in _ROUTES:
+                match = re.fullmatch(pat, path)
+                if match and m == method:
+                    fn(self, *match.groups())
+                    return
+            self._error(404, f"no route for {method} {path}")
+        except KeyError as e:
+            self._error(404, str(e))
+        except Exception as e:   # one bad request must not kill the server
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    # -- routes (reference: RequestServer route registrations) ---------------
+
+    def r_cloud(self):
+        self._reply(schemas.cloud_v3(__version__))
+
+    def r_about(self):
+        self._reply({"__meta": {"schema_type": "AboutV3"},
+                     "entries": [{"name": "Build version", "value": __version__}]})
+
+    def r_import(self):
+        p = self._params()
+        from h2o3_tpu.frame.parse import import_file
+        fr = import_file(p["path"], key=p.get("destination_frame"))
+        self._reply({"__meta": {"schema_type": "ImportFilesV3"},
+                     "destination_frames": [fr.key], "fails": []})
+
+    def r_parse(self):
+        # the reference splits guess (ParseSetup) and parse; import_file did
+        # both, so Parse is an alias that can re-key the frame
+        p = self._params()
+        src = json.loads(p["source_frames"]) if isinstance(
+            p.get("source_frames"), str) else p.get("source_frames", [])
+        src_key = (src[0] if src else p.get("source_key", ""))
+        src_key = src_key.get("name") if isinstance(src_key, dict) else src_key
+        fr = DKV[src_key]
+        dest = p.get("destination_frame") or src_key
+        fr.key = dest
+        DKV.put(dest, fr)
+        self._reply({"__meta": {"schema_type": "ParseV3"},
+                     "destination_frame": {"name": dest},
+                     "rows": fr.nrows})
+
+    def r_frames(self):
+        self._reply(schemas.frames_list_v3(DKV))
+
+    def r_frame(self, key):
+        fr = DKV[key]
+        if not isinstance(fr, Frame):
+            raise KeyError(f"{key} is not a frame")
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "frames": [schemas.frame_v3(key, fr)]})
+
+    def r_frame_delete(self, key):
+        DKV.remove(key)
+        self._reply({"__meta": {"schema_type": "FramesV3"}})
+
+    def r_models(self):
+        self._reply(schemas.models_list_v3(DKV))
+
+    def r_model(self, key):
+        m = DKV[key]
+        if not isinstance(m, Model):
+            raise KeyError(f"{key} is not a model")
+        self._reply({"__meta": {"schema_type": "ModelsV3"},
+                     "models": [schemas.model_v3(m)]})
+
+    def r_model_delete(self, key):
+        DKV.remove(key)
+        self._reply({"__meta": {"schema_type": "ModelsV3"}})
+
+    def r_train(self, algo):
+        p = self._params()
+        cls = _algo_registry().get(algo.lower())
+        if cls is None:
+            raise KeyError(f"unknown algorithm {algo!r}")
+        frame = DKV[p.pop("training_frame")]
+        y = p.pop("response_column", None)
+        x = p.pop("x", None)
+        if isinstance(x, str):
+            x = json.loads(x)
+        valid = p.pop("validation_frame", None)
+        vframe = DKV[valid] if valid else None
+        kwargs = {}
+        defaults = cls.defaults()
+        for k, v in p.items():
+            if k not in defaults:
+                continue
+            d = defaults[k]
+            if isinstance(v, str):
+                if isinstance(d, bool):
+                    v = v.lower() in ("1", "true", "yes")
+                elif isinstance(d, int) and not isinstance(d, bool):
+                    v = int(float(v))
+                elif isinstance(d, float):
+                    v = float(v)
+                elif isinstance(d, (list, tuple)) or v.startswith("["):
+                    v = json.loads(v)
+            kwargs[k] = v
+        builder = cls(**kwargs)
+
+        job = Job(f"{algo} via REST", key=f"job_{uuid.uuid4().hex[:12]}")
+
+        def driver(j: Job):
+            m = builder.train(x=x, y=y, training_frame=frame,
+                              validation_frame=vframe)
+            j.dest_key = m.key
+            return m
+
+        job.run(driver, background=True)
+        self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
+                     "job": schemas.job_v3(job.key, job)})
+
+    def r_job(self, key):
+        job = DKV[key]
+        self._reply({"__meta": {"schema_type": "JobsV3"},
+                     "jobs": [schemas.job_v3(key, job)]})
+
+    def r_job_cancel(self, key):
+        DKV[key].cancel()
+        self._reply({"__meta": {"schema_type": "JobsV3"}})
+
+    def r_predict(self, model_key, frame_key):
+        m, fr = DKV[model_key], DKV[frame_key]
+        pred = m.predict(fr)
+        dest = f"prediction_{uuid.uuid4().hex[:8]}"
+        pred.key = dest
+        DKV.put(dest, pred)
+        self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+                     "predictions_frame": {"name": dest},
+                     "model_metrics": []})
+
+    def r_rapids(self):
+        p = self._params()
+        from h2o3_tpu.rapids import rapids
+        res = rapids(p["ast"])
+        if isinstance(res, Frame):
+            key = p.get("id") or f"rapids_{uuid.uuid4().hex[:8]}"
+            res.key = key
+            DKV.put(key, res)
+            self._reply({"__meta": {"schema_type": "RapidsFrameV3"},
+                         "key": {"name": key}})
+        elif isinstance(res, (int, float)):
+            self._reply({"__meta": {"schema_type": "RapidsNumberV3"},
+                         "scalar": schemas._clean(res)})
+        else:
+            self._reply({"__meta": {"schema_type": "RapidsStringV3"},
+                         "string": str(res)})
+
+    def r_grid(self, algo):
+        p = self._params()
+        cls = _algo_registry().get(algo.lower())
+        if cls is None:
+            raise KeyError(f"unknown algorithm {algo!r}")
+        from h2o3_tpu.orchestration import GridSearch
+        hyper = p.pop("hyper_parameters")
+        if isinstance(hyper, str):
+            hyper = json.loads(hyper)
+        criteria = p.pop("search_criteria", None)
+        if isinstance(criteria, str):
+            criteria = json.loads(criteria)
+        frame = DKV[p.pop("training_frame")]
+        y = p.pop("response_column", None)
+        gs = GridSearch(cls, hyper, grid_id=p.pop("grid_id", None),
+                        search_criteria=criteria)
+        job = Job(f"grid {algo} via REST")
+
+        def driver(j: Job):
+            g = gs.train(y=y, training_frame=frame)
+            j.dest_key = g.grid_id
+            return g
+
+        job.run(driver, background=True)
+        self._reply({"__meta": {"schema_type": "GridSearchV99"},
+                     "job": schemas.job_v3(job.key, job)})
+
+    def r_grid_get(self, key):
+        g = DKV[key]
+        self._reply({"__meta": {"schema_type": "GridSchemaV99"},
+                     "grid_id": {"name": g.grid_id},
+                     "model_ids": [{"name": k} for k in g.model_ids],
+                     "failure_details": [d for _, d in g.failures]})
+
+    def r_automl(self):
+        p = self._params()
+        from h2o3_tpu.orchestration import AutoML
+        spec = p.get("build_control", {})
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        # h2o-py nests budgets under build_control.stopping_criteria; flat
+        # fields win when both are present
+        crit = dict(spec.get("stopping_criteria") or {})
+        crit.update({k: p[k] for k in ("max_models", "max_runtime_secs",
+                                       "seed") if k in p})
+        frame = DKV[p.pop("training_frame")]
+        y = p.pop("response_column", None)
+        aml = AutoML(max_models=int(crit.get("max_models", 0) or 0),
+                     max_runtime_secs=float(crit.get("max_runtime_secs", 0) or 0),
+                     nfolds=int(p.get("nfolds", spec.get("nfolds", 5)) or 5),
+                     seed=int(crit.get("seed", -1) or -1))
+        job = Job("AutoML via REST")
+
+        def driver(j: Job):
+            leader = aml.train(y=y, training_frame=frame)
+            j.dest_key = leader.key if leader else None
+            return aml
+
+        job.run(driver, background=True)
+        self._reply({"__meta": {"schema_type": "AutoMLBuilderV99"},
+                     "job": schemas.job_v3(job.key, job)})
+
+    def r_shutdown(self):
+        self._reply({"__meta": {"schema_type": "ShutdownV3"}})
+        threading.Thread(target=self.server.shutdown, daemon=True).start()
+
+    def r_gc(self):
+        import gc
+        gc.collect()
+        self._reply({"__meta": {"schema_type": "GarbageCollectV3"}})
+
+
+_ROUTES = [
+    (r"/3/Cloud", "GET", _Handler.r_cloud),
+    (r"/3/About", "GET", _Handler.r_about),
+    (r"/3/ImportFiles", "GET", _Handler.r_import),
+    (r"/3/ImportFiles", "POST", _Handler.r_import),
+    (r"/3/Parse", "POST", _Handler.r_parse),
+    (r"/3/Frames", "GET", _Handler.r_frames),
+    (r"/3/Frames/([^/]+)", "GET", _Handler.r_frame),
+    (r"/3/Frames/([^/]+)", "DELETE", _Handler.r_frame_delete),
+    (r"/3/Models", "GET", _Handler.r_models),
+    (r"/3/Models/([^/]+)", "GET", _Handler.r_model),
+    (r"/3/Models/([^/]+)", "DELETE", _Handler.r_model_delete),
+    (r"/3/ModelBuilders/([^/]+)", "POST", _Handler.r_train),
+    (r"/3/Jobs/([^/]+)", "GET", _Handler.r_job),
+    (r"/3/Jobs/([^/]+)/cancel", "POST", _Handler.r_job_cancel),
+    (r"/3/Predictions/models/([^/]+)/frames/([^/]+)", "POST", _Handler.r_predict),
+    (r"/99/Rapids", "POST", _Handler.r_rapids),
+    (r"/99/Grid/([^/]+)", "POST", _Handler.r_grid),
+    (r"/99/Grids/([^/]+)", "GET", _Handler.r_grid_get),
+    (r"/99/AutoMLBuilder", "POST", _Handler.r_automl),
+    (r"/3/Shutdown", "POST", _Handler.r_shutdown),
+    (r"/3/GarbageCollect", "POST", _Handler.r_gc),
+]
+
+
+class H2OServer:
+    """Embeddable REST server (reference: ``water.H2OApp`` + Jetty)."""
+
+    def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = host, self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "H2OServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(port: int = 54321, host: str = "127.0.0.1") -> H2OServer:
+    """h2o-py surface: ``h2o.init()`` boots a node and its REST server."""
+    return H2OServer(port=port, host=host).start()

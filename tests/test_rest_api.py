@@ -1,0 +1,104 @@
+"""REST API tests — server + client round-trips (reference test model:
+``h2o-py/tests/testdir_apis/``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OClient, H2OServer
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture
+def server():
+    s = H2OServer(port=0).start()   # ephemeral port
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def client(server):
+    return H2OClient(server.url)
+
+
+@pytest.fixture
+def bin_frame(rng):
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] - X[:, 1] > 0)
+    f = Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.array(["yes" if t else "no" for t in y], dtype=object)},
+        key="train_frame")
+    DKV.put("train_frame", f)
+    return f
+
+
+def test_cloud(client):
+    st = client.cloud_status()
+    assert st["cloud_healthy"] and st["cloud_size"] >= 1
+    assert st["__meta"]["schema_type"] == "CloudV3"
+
+
+def test_import_and_frames(client, rng, tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("x,y\n1,2\n3,4\n5,6\n")
+    key = client.import_file(str(p))
+    fr = client.frame(key)
+    assert fr["rows"] == 3 and fr["column_count"] == 2
+    cols = {c["label"]: c for c in fr["columns"]}
+    assert cols["x"]["mean"] == pytest.approx(3.0)
+    assert any(f["frame_id"]["name"] == key for f in client.frames())
+    client.rm(key)
+    with pytest.raises(RuntimeError, match="404"):
+        client.frame(key)
+
+
+def test_train_poll_predict(client, bin_frame):
+    model = client.train("gbm", "train_frame", y="y", ntrees=5, max_depth=3)
+    assert model["algo"] == "gbm"
+    auc = model["output"]["training_metrics"]["auc"]
+    assert auc > 0.8
+    key = model["model_id"]["name"]
+    pred_key = client.predict(key, "train_frame")
+    pf = DKV[pred_key]
+    assert pf.nrows == bin_frame.nrows
+    assert "predict" in pf.names
+
+
+def test_train_glm_params_coerced(client, bin_frame):
+    model = client.train("glm", "train_frame", y="y", family="binomial",
+                         lambda_=0.0, max_iterations=20)
+    assert model["output"]["training_metrics"]["auc"] > 0.9
+    pars = {p["name"]: p["actual_value"] for p in model["parameters"]}
+    assert pars["family"] == "binomial"
+    assert pars["max_iterations"] == 20
+
+
+def test_rapids_endpoint(client, bin_frame):
+    out = client.rapids("(sum (cols train_frame 'a'))")
+    ref = float(np.nansum(bin_frame.vec("a").to_numpy()))
+    assert out["scalar"] == pytest.approx(ref, rel=1e-4)
+    out = client.rapids("(+ (cols train_frame 'a') 1)", id="shifted")
+    assert out["key"]["name"] == "shifted"
+    assert DKV["shifted"].nrows == bin_frame.nrows
+
+
+def test_grid_endpoint(client, bin_frame):
+    g = client.grid("gbm", "train_frame", "y",
+                    hyper_parameters={"max_depth": [2, 3]}, ntrees=3)
+    assert len(g["model_ids"]) == 2
+
+
+def test_unknown_route_and_algo(client, bin_frame):
+    with pytest.raises(RuntimeError, match="404"):
+        client.request("GET", "/3/NoSuchThing")
+    with pytest.raises(RuntimeError, match="unknown algorithm"):
+        client.train("levenshtein", "train_frame", y="y")
+
+
+def test_error_does_not_kill_server(client, bin_frame):
+    with pytest.raises(RuntimeError):
+        client.train("glm", "train_frame", y="nope")
+    # server still alive
+    assert client.cloud_status()["cloud_healthy"]
