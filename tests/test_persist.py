@@ -1,0 +1,102 @@
+"""Persistence tests: model/frame round-trips, CSV export, grid recovery
+(reference test model: ``h2o-py/tests/testdir_misc/pyunit_save_load_model.py``,
+``h2o-core/src/test/java/hex/faulttolerance/``)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu import Frame
+from h2o3_tpu.models import GBM, GLM
+from h2o3_tpu.orchestration import GridSearch
+from h2o3_tpu.persist import Recovery
+
+
+def _frame(rng, n=600):
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.normal(size=n)) > 0
+    return Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "g": rng.choice(["u", "v"], size=n),
+        "y": np.array(["yes" if t else "no" for t in y], dtype=object),
+    })
+
+
+def test_frame_roundtrip(rng, tmp_path):
+    f = _frame(rng)
+    h2o.save_frame(f, str(tmp_path / "snap"))
+    g = h2o.load_frame(str(tmp_path / "snap"), key="restored")
+    assert g.names == f.names and g.nrows == f.nrows
+    np.testing.assert_allclose(g.vec("a").to_numpy(), f.vec("a").to_numpy())
+    assert g.vec("g").domain == f.vec("g").domain
+    np.testing.assert_array_equal(g.vec("g").to_numpy(), f.vec("g").to_numpy())
+    assert h2o.DKV.get("restored") is g
+
+
+def test_frame_roundtrip_time_str(tmp_path):
+    from h2o3_tpu.frame.types import VecType
+    ts = np.array(["2024-01-01T00:00:00", "2024-06-15T12:30:00"],
+                  dtype="datetime64[ms]")
+    f = Frame.from_arrays({"t": ts}, types={"t": VecType.TIME})
+    f.add("s", h2o.Vec(None, VecType.STR, 2,
+                       host_values=np.array(["hello", None], dtype=object)))
+    h2o.save_frame(f, str(tmp_path / "snap"))
+    g = h2o.load_frame(str(tmp_path / "snap"))
+    np.testing.assert_allclose(g.vec("t").to_numpy(), f.vec("t").to_numpy())
+    assert g.vec("s").host_values.tolist() == ["hello", None]
+
+
+def test_export_csv(rng, tmp_path):
+    f = _frame(rng, n=50)
+    p = str(tmp_path / "out.csv")
+    h2o.export_file(f, p)
+    g = h2o.import_file(p)
+    assert g.nrows == 50
+    np.testing.assert_allclose(g.vec("a").to_numpy(),
+                               f.vec("a").to_numpy(), rtol=1e-5)
+
+
+def test_model_roundtrip_glm(rng, tmp_path):
+    f = _frame(rng)
+    m = GLM(family="binomial").train(y="y", training_frame=f)
+    p = h2o.save_model(m, str(tmp_path / "glm.bin"))
+    h2o.DKV.clear()
+    m2 = h2o.load_model(p)
+    assert m2.key == m.key
+    np.testing.assert_allclose(
+        np.asarray(m2._score_raw(f)), np.asarray(m._score_raw(f)), atol=1e-6)
+    assert h2o.DKV.get(m.key) is m2
+    c1, c2 = m.coef(), m2.coef()
+    assert c1.keys() == c2.keys()
+
+
+def test_model_roundtrip_gbm(rng, tmp_path):
+    f = _frame(rng)
+    m = GBM(ntrees=5, max_depth=3).train(y="y", training_frame=f)
+    p = h2o.save_model(m, str(tmp_path / "gbm.bin"))
+    m2 = h2o.load_model(p)
+    pred1 = m.predict(f).vec("pyes").to_numpy()
+    pred2 = m2.predict(f).vec("pyes").to_numpy()
+    np.testing.assert_allclose(pred1, pred2, atol=1e-6)
+
+
+def test_grid_recovery_resume(rng, tmp_path):
+    f = _frame(rng, n=400)
+    rdir = str(tmp_path / "rec")
+    hyper = {"max_depth": [2, 3, 4]}
+
+    # simulate a crash after 2 models: budget cuts the first run short
+    gs1 = GridSearch(GBM, hyper, grid_id="g1", recovery_dir=rdir,
+                     search_criteria={"max_models": 2}, ntrees=3)
+    g1 = gs1.train(y="y", training_frame=f)
+    assert len(g1.models) == 2
+
+    # "restart": a new search over the same dir resumes, skipping built points
+    gs2 = GridSearch(GBM, hyper, grid_id="g1", recovery_dir=rdir, ntrees=3)
+    g2 = gs2.train(y="y", training_frame=f)
+    assert len(g2.models) == 3
+    depths = sorted(m.output["hyper_values"]["max_depth"] for m in g2.models)
+    assert depths == [2, 3, 4]
+
+    rec = Recovery(rdir)
+    assert not rec.resuming   # done() marked complete
