@@ -39,6 +39,12 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
     elif ext == "svmlight" or ext == "svm":
         return _parse_svmlight(path, key)
     else:
+        if ext in ("csv", "txt", "data") and na_strings is None and header == 0 \
+                and (sep is None or len(sep) == 1):
+            frame = _parse_csv_native(path, sep or ",", key)
+            if frame is not None:
+                DKV.put(frame.key, frame)
+                return frame
         kw = dict(header=header, na_values=na_strings, compression="infer")
         if sep is not None:
             kw["sep"] = sep
@@ -46,6 +52,32 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
     frame = Frame.from_pandas(df, key=key or _key_from_path(path))
     DKV.put(frame.key, frame)
     return frame
+
+
+def _parse_csv_native(path: str, sep: str, key: str | None) -> Frame | None:
+    """Fast path: the chunk-parallel C++ tokenizer (reference:
+    ``MultiFileParseTask`` + ``CsvParser``); None → caller falls back to
+    pandas."""
+    from h2o3_tpu.frame.types import VecType
+    from h2o3_tpu.frame.vec import Vec
+    from h2o3_tpu.native import parse_csv_native
+
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+        out = parse_csv_native(data, has_header=True, sep=sep)
+    except Exception:
+        return None
+    if out is None:
+        return None
+    names, cols = out
+    vecs = []
+    for col in cols:
+        if col[0] == "num":
+            vecs.append(Vec.from_numpy(col[1].astype(np.float32)))
+        else:
+            vecs.append(Vec.from_numpy(col[1], type=VecType.CAT, domain=col[2]))
+    return Frame(names, vecs, key=key or _key_from_path(path))
 
 
 def upload_file(path: str, key: str | None = None, **kw) -> Frame:

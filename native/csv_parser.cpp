@@ -1,0 +1,286 @@
+// Chunk-parallel CSV parser — the native ingest path.
+//
+// Reference: water/parser/ParseDataset.java:623 (MultiFileParseTask splits the
+// input into chunks parsed in parallel, each running the per-byte CSV state
+// machine of water/parser/CsvParser.java) and PackedDomains (categorical
+// domain merge across chunks). Same architecture here: the buffer splits at
+// newline boundaries into one chunk per thread, each thread tokenizes into
+// per-chunk column accumulators (double or interned string), and a merge pass
+// unifies types and sorts/unions categorical domains. Files containing quotes
+// fall back to a single-threaded pass so quoted embedded newlines stay
+// correct (the reference re-syncs heuristically; we prefer exactness).
+//
+// C ABI consumed via ctypes from h2o3_tpu/native/__init__.py.
+
+#include <algorithm>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <map>
+#include <unordered_map>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ChunkCol {
+  std::vector<double> nums;        // parsed value or NaN
+  std::vector<int32_t> strs;       // index into pool, -1 = NA/none
+  std::vector<int64_t> offs;       // token offset into the source buffer
+  std::vector<int32_t> lens;       // token length (-1 = NA/quoted)
+  std::vector<std::string> pool;   // chunk-local interned strings
+  std::unordered_map<std::string, int32_t> pool_idx;
+  bool any_str = false;            // saw a non-numeric, non-NA token
+
+  int32_t intern(const std::string& s) {
+    auto it = pool_idx.find(s);
+    if (it != pool_idx.end()) return it->second;
+    int32_t id = (int32_t)pool.size();
+    pool.push_back(s);
+    pool_idx.emplace(s, id);
+    return id;
+  }
+};
+
+struct Chunk {
+  std::vector<ChunkCol> cols;
+  int64_t rows = 0;
+};
+
+bool is_na_token(const char* b, size_t n) {
+  if (n == 0) return true;
+  // pandas' default NA string set (so the fast path and the fallback agree)
+  static const char* kNA[] = {"NA", "N/A", "n/a", "null", "NULL", "NaN",
+                              "nan", "-NaN", "-nan", "None", "<NA>"};
+  for (const char* s : kNA) {
+    if (strlen(s) == n && memcmp(b, s, n) == 0) return true;
+  }
+  return false;
+}
+
+bool parse_double(const char* b, size_t n, double* out) {
+  if (n && *b == '+') { ++b; --n; }   // from_chars rejects a leading '+'
+  if (n == 0) return false;
+  auto [ptr, ec] = std::from_chars(b, b + n, *out);
+  return ec == std::errc() && ptr == b + n;
+}
+
+void trim(const char*& b, size_t& n) {
+  while (n && (*b == ' ' || *b == '\t' || *b == '\r')) { ++b; --n; }
+  while (n && (b[n - 1] == ' ' || b[n - 1] == '\t' || b[n - 1] == '\r')) --n;
+}
+
+// per-byte tokenizer for one [begin,end) slab; quote=true handles RFC quoting
+// (only used single-threaded, where embedded newlines are safe)
+void parse_slab(const char* base, const char* begin, const char* end, char sep,
+                bool quotes, int ncols, Chunk* out) {
+  out->cols.assign(ncols, ChunkCol());
+  const char* p = begin;
+  std::string qbuf;
+  while (p < end) {
+    if (*p == '\n') { ++p; continue; }
+    if (*p == '\r' && p + 1 < end && p[1] == '\n') { p += 2; continue; }
+    // row extent first (memchr beats a byte loop), then memchr per field —
+    // valid only when the file has no quotes (parallel fast path)
+    const char* row_end = end;
+    if (!quotes) {
+      const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+      row_end = nl ? nl : end;
+    }
+    for (int c = 0; c < ncols; ++c) {
+      const char* tok = p;
+      size_t n = 0;
+      bool quoted = false;
+      if (quotes && p < end && *p == '"') {
+        quoted = true;
+        qbuf.clear();
+        ++p;
+        while (p < end) {
+          if (*p == '"') {
+            if (p + 1 < end && p[1] == '"') { qbuf.push_back('"'); p += 2; }
+            else { ++p; break; }
+          } else qbuf.push_back(*p++);
+        }
+        tok = qbuf.data();
+        n = qbuf.size();
+        while (p < end && *p != sep && *p != '\n') ++p;   // junk after quote
+      } else if (!quotes) {
+        const char* s = (const char*)memchr(p, sep, (size_t)(row_end - p));
+        p = s && s < row_end ? s : row_end;
+        n = (size_t)(p - tok);
+      } else {
+        while (p < end && *p != sep && *p != '\n') ++p;
+        n = (size_t)(p - tok);
+      }
+      const char* tb = tok;
+      size_t tn = n;
+      if (!quoted) trim(tb, tn);
+      ChunkCol& col = out->cols[c];
+      double v;
+      if (!quoted && parse_double(tb, tn, &v)) {
+        // numeric — but keep the exact source text reachable in case the
+        // merge pass votes this column categorical
+        col.nums.push_back(v);
+        col.strs.push_back(-1);
+        col.offs.push_back(tb - base);
+        col.lens.push_back((int32_t)tn);
+      } else if (!quoted && is_na_token(tb, tn)) {
+        col.nums.push_back(std::numeric_limits<double>::quiet_NaN());
+        col.strs.push_back(-1);
+        col.offs.push_back(-1);
+        col.lens.push_back(-1);
+      } else {
+        col.nums.push_back(std::numeric_limits<double>::quiet_NaN());
+        col.strs.push_back(col.intern(std::string(tb, tn)));
+        col.offs.push_back(-1);
+        col.lens.push_back(-1);
+        col.any_str = true;
+      }
+      if (p < end && *p == sep && c < ncols - 1) ++p;
+    }
+    while (p < end && *p != '\n') ++p;   // overflow columns dropped
+    if (p < end) ++p;
+    ++out->rows;
+  }
+}
+
+struct Result {
+  int64_t nrows = 0;
+  int32_t ncols = 0;
+  std::vector<std::string> names;
+  std::vector<int32_t> types;                    // 0=num, 1=cat
+  std::vector<std::vector<double>> data;         // value or level code (-1=NA)
+  std::vector<std::vector<std::string>> domains; // per CAT column, sorted
+};
+
+}  // namespace
+
+extern "C" {
+
+// Parse a CSV buffer. Returns an opaque handle (nullptr on failure).
+void* h2o3_parse_csv(const char* buf, int64_t len, int has_header, char sep,
+                     int nthreads) {
+  if (len <= 0) return nullptr;
+  bool has_quotes = memchr(buf, '"', (size_t)len) != nullptr;
+
+  // header + column count from the first line
+  const char* p = buf;
+  const char* bend = buf + len;
+  const char* eol = (const char*)memchr(p, '\n', (size_t)(bend - p));
+  if (!eol) eol = bend;
+  // quoted header fields may hide separators — cheaper to let the caller
+  // fall back than to special-case header quoting
+  if (has_header && memchr(p, '"', (size_t)(eol - p)) != nullptr) return nullptr;
+  std::vector<std::string> names;
+  {
+    const char* q = p;
+    while (q <= eol) {
+      const char* tok = q;
+      while (q < eol && *q != sep) ++q;
+      const char* tb = tok; size_t tn = (size_t)(q - tok);
+      trim(tb, tn);
+      if (tn >= 2 && tb[0] == '"' && tb[tn - 1] == '"') { ++tb; tn -= 2; }
+      names.emplace_back(tb, tn);
+      if (q >= eol) break;
+      ++q;
+    }
+  }
+  int ncols = (int)names.size();
+  if (ncols == 0) return nullptr;
+  const char* body = has_header ? (eol < bend ? eol + 1 : bend) : p;
+  if (!has_header)
+    for (int i = 0; i < ncols; ++i) names[i] = "C" + std::to_string(i + 1);
+
+  // chunk boundaries at newlines (reference: file-chunk split)
+  int nt = has_quotes ? 1 : std::max(1, nthreads);
+  std::vector<const char*> bounds{body};
+  int64_t blen = bend - body;
+  for (int t = 1; t < nt; ++t) {
+    const char* target = body + blen * t / nt;
+    const char* nl = (const char*)memchr(target, '\n', (size_t)(bend - target));
+    bounds.push_back(nl ? nl + 1 : bend);
+  }
+  bounds.push_back(bend);
+  std::sort(bounds.begin(), bounds.end());
+
+  std::vector<Chunk> chunks(nt);
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nt; ++t) {
+    const char* cb = bounds[t];
+    const char* ce = bounds[t + 1];
+    workers.emplace_back(parse_slab, buf, cb, ce, sep, has_quotes, ncols,
+                         &chunks[t]);
+  }
+  for (auto& w : workers) w.join();
+
+  // merge: type vote + categorical domain union (reference: PackedDomains)
+  auto* res = new Result();
+  res->ncols = ncols;
+  for (auto& ch : chunks) res->nrows += ch.rows;
+  res->names = std::move(names);
+  res->types.assign(ncols, 0);
+  res->data.resize(ncols);
+  res->domains.resize(ncols);
+  for (int c = 0; c < ncols; ++c) {
+    bool any_str = false;
+    for (auto& ch : chunks) any_str |= ch.cols[c].any_str;
+    res->types[c] = any_str ? 1 : 0;
+    auto& out = res->data[c];
+    out.reserve((size_t)res->nrows);
+    if (!any_str) {
+      for (auto& ch : chunks)
+        out.insert(out.end(), ch.cols[c].nums.begin(), ch.cols[c].nums.end());
+    } else {
+      // numeric tokens inside a categorical column become levels too
+      // (reference: the whole column re-parses as enum once any chunk votes
+      // string) — levels come from the EXACT source text via stored offsets
+      auto raw_tok = [&](const ChunkCol& col, size_t r) {
+        return std::string(buf + col.offs[r], (size_t)col.lens[r]);
+      };
+      std::map<std::string, int32_t> dom;   // sorted (parser contract)
+      for (auto& ch : chunks) {
+        for (auto& s : ch.cols[c].pool) dom.emplace(s, 0);
+        for (size_t r = 0; r < (size_t)ch.rows; ++r)
+          if (ch.cols[c].strs[r] < 0 && ch.cols[c].offs[r] >= 0)
+            dom.emplace(raw_tok(ch.cols[c], r), 0);
+      }
+      {
+        int32_t id = 0;
+        for (auto& kv : dom) kv.second = id++;
+      }
+      auto& names_out = res->domains[c];
+      names_out.reserve(dom.size());
+      for (auto& kv : dom) names_out.push_back(kv.first);
+      for (auto& ch : chunks) {
+        std::vector<int32_t> remap(ch.cols[c].pool.size());
+        for (size_t i = 0; i < ch.cols[c].pool.size(); ++i)
+          remap[i] = dom[ch.cols[c].pool[i]];
+        for (size_t r = 0; r < (size_t)ch.rows; ++r) {
+          int32_t s = ch.cols[c].strs[r];
+          if (s >= 0) out.push_back(remap[s]);
+          else if (ch.cols[c].offs[r] >= 0)
+            out.push_back(dom[raw_tok(ch.cols[c], r)]);
+          else out.push_back(-1.0);
+        }
+      }
+    }
+  }
+  return res;
+}
+
+int64_t h2o3_nrows(void* h) { return ((Result*)h)->nrows; }
+int32_t h2o3_ncols(void* h) { return ((Result*)h)->ncols; }
+const char* h2o3_col_name(void* h, int c) { return ((Result*)h)->names[c].c_str(); }
+int32_t h2o3_col_type(void* h, int c) { return ((Result*)h)->types[c]; }
+const double* h2o3_col_data(void* h, int c) { return ((Result*)h)->data[c].data(); }
+int32_t h2o3_col_card(void* h, int c) { return (int32_t)((Result*)h)->domains[c].size(); }
+const char* h2o3_col_level(void* h, int c, int i) {
+  return ((Result*)h)->domains[c][i].c_str();
+}
+void h2o3_free(void* h) { delete (Result*)h; }
+
+}  // extern "C"
