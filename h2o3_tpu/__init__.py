@@ -23,6 +23,7 @@ from h2o3_tpu.frame.parse import import_file, parse_raw, upload_file
 from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
 from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
                               save_model)
+from h2o3_tpu.genmodel import import_mojo
 from h2o3_tpu.utils.registry import DKV
 
 __version__ = "0.1.0"
@@ -39,6 +40,7 @@ __all__ = [
     "load_frame",
     "save_model",
     "load_model",
+    "import_mojo",
     "get_mesh",
     "set_mesh",
     "mesh_context",

@@ -1,0 +1,56 @@
+"""Generic — import an external scoring artifact as a first-class model.
+
+Reference: ``hex/generic/GenericModel.java`` (1.3 kLoC): wraps an imported
+MOJO so it predicts, computes metrics, and sits in grids/leaderboards like a
+trained model.
+"""
+
+from __future__ import annotations
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+class GenericModel(Model):
+    algo = "generic"
+
+    def _score_raw(self, frame: Frame):
+        return self.output["mojo"]._score_raw(frame)
+
+
+class Generic(ModelBuilder):
+    """h2o-py surface: ``H2OGenericEstimator(path=...)`` / ``h2o.import_mojo``."""
+
+    algo = "generic"
+    unsupervised = True   # response comes from the artifact, not train()
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(super().defaults(), path=None)
+
+    def train(self, x=None, y=None, training_frame=None, **kw):
+        from h2o3_tpu.genmodel.mojo import MojoModel
+        path = self.params.get("path")
+        if not path:
+            raise ValueError("path to a mojo artifact is required")
+        mojo = MojoModel.load(path)
+        inner = mojo._inner
+        model = GenericModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None,
+            response_column=inner.response_column,
+            response_domain=inner.response_domain,
+            output=dict(mojo=mojo, source_algo=mojo.algo),
+        )
+        if training_frame is not None and inner.response_column is not None \
+                and inner.response_column in training_frame:
+            model.training_metrics = model.model_performance(training_frame)
+        from h2o3_tpu.utils.registry import DKV
+        DKV.put(model.key, model)
+        self.model = model
+        return model
+
+
+def import_mojo(path: str, model_id: str | None = None) -> GenericModel:
+    """h2o-py: ``h2o.import_mojo`` — one-call artifact import."""
+    return Generic(path=path, model_id=model_id).train()

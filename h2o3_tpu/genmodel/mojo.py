@@ -1,0 +1,86 @@
+"""MOJO-style portable scoring artifacts.
+
+Reference: ``h2o-genmodel`` MOJO — a zip of ``model.ini`` metadata + binary
+payload, written by ``hex/genmodel/AbstractMojoWriter.java`` and scored by a
+standalone runtime (``MojoModel.java``) with no cluster required.
+
+This framework's artifact keeps the contract (one self-describing zip,
+loadable for scoring without the training process or the DKV) with a
+TPU-native payload: ``model.ini`` carries readable metadata (algo, columns,
+domains, key parameters) and ``payload.bin`` the pickled host-converted model
+(every array numpy — see ``persist.model_io``). It is not byte-compatible
+with the reference's Java MOJO (that format embeds a JVM scorer), which is
+why the ini advertises ``format = h2o3_tpu_mojo``.
+"""
+
+from __future__ import annotations
+
+import configparser
+import io
+import json
+import pickle
+import zipfile
+
+MOJO_FORMAT = "h2o3_tpu_mojo"
+MOJO_VERSION = "1.0"
+
+
+def write_mojo(model, path: str) -> str:
+    """Export a model as a portable artifact (h2o-py: ``download_mojo``)."""
+    from h2o3_tpu.persist.model_io import host_copy
+
+    m = host_copy(model)
+    ini = configparser.ConfigParser()
+    ini["info"] = {
+        "format": MOJO_FORMAT,
+        "version": MOJO_VERSION,
+        "algorithm": model.algo,
+        "model_key": model.key,
+        "response_column": str(model.response_column),
+        "n_classes": str(model.nclasses),
+    }
+    ini["columns"] = {"response_domain":
+                      json.dumps(list(model.response_domain or []))}
+    ini["parameters"] = {k: json.dumps(v, default=str)
+                         for k, v in dict(model.params).items()
+                         if isinstance(v, (int, float, str, bool, type(None),
+                                           list, tuple))}
+    buf = io.StringIO()
+    ini.write(buf)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", buf.getvalue())
+        z.writestr("payload.bin", pickle.dumps(m))
+    return path
+
+
+class MojoModel:
+    """Standalone scorer over an imported artifact (reference:
+    ``hex.genmodel.MojoModel``); no DKV registration, no training state."""
+
+    def __init__(self, inner, info: dict):
+        self._inner = inner
+        self.info = info
+        self.algo = info.get("algorithm", inner.algo)
+
+    @staticmethod
+    def load(path: str) -> "MojoModel":
+        with zipfile.ZipFile(path) as z:
+            ini = configparser.ConfigParser()
+            ini.read_string(z.read("model.ini").decode())
+            if ini["info"].get("format") != MOJO_FORMAT:
+                raise ValueError(f"{path} is not a {MOJO_FORMAT} artifact")
+            inner = pickle.loads(z.read("payload.bin"))
+        return MojoModel(inner, dict(ini["info"]))
+
+    def predict(self, frame):
+        return self._inner.predict(frame)
+
+    def _score_raw(self, frame):
+        return self._inner._score_raw(frame)
+
+    @property
+    def nclasses(self) -> int:
+        return int(self.info.get("n_classes", 0))
+
+    def __repr__(self) -> str:
+        return f"MojoModel(algo={self.algo!r}, key={self.info.get('model_key')!r})"

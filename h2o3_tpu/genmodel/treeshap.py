@@ -1,0 +1,159 @@
+"""Exact TreeSHAP contributions for dense-heap trees.
+
+Reference: ``h2o-genmodel/.../algos/tree/TreeSHAP.java`` (Lundberg & Lee's
+polynomial-time algorithm: a recursion over the tree carrying the subset-path
+weights, EXTEND on the way down, UNWIND to read a feature's contribution).
+
+Vectorization note: the recursion's CONTROL FLOW is static per tree (every
+node is visited; which features sit on each path is fixed), only the
+hot/cold one-fractions depend on the row. So the path state becomes a list of
+[rows] numpy arrays and one Python recursion per tree serves every row at
+once. This runs on host by design — contributions are an offline
+explainability pass, not the serving path (same split as the reference:
+TreeSHAP lives in genmodel, not in the cluster scorer).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class _Path:
+    """Subset-path state: parallel lists of static feature ids and per-row
+    fraction/weight arrays (one list entry per path element)."""
+
+    def __init__(self, rows: int):
+        self.d: list[int] = []          # feature id per path entry (-1 = root)
+        self.z: list[np.ndarray] = []   # zero (cover) fractions, [rows]
+        self.o: list[np.ndarray] = []   # one (decision) fractions, [rows]
+        self.w: list[np.ndarray] = []   # permutation weights, [rows]
+        self.rows = rows
+
+    def copy(self) -> "_Path":
+        p = _Path(self.rows)
+        p.d = list(self.d)
+        p.z = [a.copy() for a in self.z]
+        p.o = [a.copy() for a in self.o]
+        p.w = [a.copy() for a in self.w]
+        return p
+
+    def extend(self, d: int, z, o) -> None:
+        L = len(self.d)
+        self.d.append(d)
+        self.z.append(np.broadcast_to(np.asarray(z, np.float64),
+                                      (self.rows,)).copy())
+        self.o.append(np.broadcast_to(np.asarray(o, np.float64),
+                                      (self.rows,)).copy())
+        self.w.append(np.full(self.rows, 1.0 if L == 0 else 0.0))
+        for i in range(L - 1, -1, -1):
+            self.w[i + 1] += self.o[-1] * self.w[i] * (i + 1) / (L + 1)
+            self.w[i] = self.z[-1] * self.w[i] * (L - i) / (L + 1)
+
+    def unwind(self, i: int) -> None:
+        L = len(self.d) - 1
+        o, z = self.o[i], self.z[i]
+        n = self.w[L].copy()
+        for j in range(L - 1, -1, -1):
+            wj = self.w[j].copy()
+            safe_o = np.where(o != 0, o, 1.0)
+            t = n * (L + 1) / ((j + 1) * safe_o)
+            self.w[j] = np.where(o != 0, t, wj * (L + 1) / np.maximum(L - j, 1)
+                                 / np.where(z != 0, z, 1.0))
+            n = np.where(o != 0, wj - self.w[j] * z * (L - j) / (L + 1), n)
+        # element i leaves the path; the WEIGHTS shrink from the tail (they
+        # are per-path-length, not per-element — Lundberg's UNWIND)
+        for lst in (self.d, self.z, self.o):
+            del lst[i]
+        del self.w[-1]
+
+    def unwound_sum(self, i: int) -> np.ndarray:
+        """Σ over path permutations with element i removed (UNWIND without
+        mutating)."""
+        L = len(self.d) - 1
+        o, z = self.o[i], self.z[i]
+        total = np.zeros(self.rows)
+        n = self.w[L].copy()
+        for j in range(L - 1, -1, -1):
+            safe_o = np.where(o != 0, o, 1.0)
+            with_o = n * (L + 1) / ((j + 1) * safe_o)
+            without = self.w[j] * (L + 1) / np.maximum(L - j, 1) \
+                / np.where(z != 0, z, 1.0)
+            t = np.where(o != 0, with_o, without)
+            total += t
+            n = np.where(o != 0, self.w[j] - t * z * (L - j) / (L + 1), n)
+        return total
+
+
+def tree_shap(tree, X: np.ndarray) -> np.ndarray:
+    """[rows, F+1] contributions (last column = bias) of one dense-heap tree.
+
+    X uses the model's raw feature layout (cat codes as floats, NaN = NA).
+    """
+    feat = np.asarray(jax.device_get(tree.feat))
+    tv = np.asarray(jax.device_get(tree.thresh_val))
+    nal = np.asarray(jax.device_get(tree.na_left))
+    isp = np.asarray(jax.device_get(tree.is_split))
+    leaf = np.asarray(jax.device_get(tree.leaf)).astype(np.float64)
+    cover = np.asarray(jax.device_get(tree.cover)).astype(np.float64) \
+        if tree.cover is not None else None
+    if cover is None:
+        raise ValueError("tree has no cover stats (grown before gain/cover "
+                         "channels); retrain to use predict_contributions")
+    rows, F = X.shape
+    phi = np.zeros((rows, F + 1))
+    if cover[0] <= 0:
+        return phi
+
+    def go_left(node: int) -> np.ndarray:
+        x = X[:, feat[node]]
+        return np.where(np.isnan(x), nal[node], x < tv[node]).astype(bool)
+
+    def recurse(node: int, path: _Path):
+        if not isp[node]:
+            v = leaf[node]
+            for i in range(1, len(path.d)):
+                phi[:, path.d[i]] += path.unwound_sum(i) * \
+                    (path.o[i] - path.z[i]) * v
+            return
+        d = int(feat[node])
+        left, right = 2 * node + 1, 2 * node + 2
+        hot = go_left(node)   # [rows] bool: which child the row takes
+        rj = max(cover[node], 1e-12)
+        iz = np.ones(rows)
+        io = np.ones(rows)
+        for k in range(1, len(path.d)):
+            if path.d[k] == d:
+                iz, io = path.z[k].copy(), path.o[k].copy()
+                path.unwind(k)
+                break
+        for child, is_hot in ((left, hot), (right, ~hot)):
+            p = path.copy()
+            p.extend(d, iz * cover[child] / rj, io * is_hot.astype(np.float64))
+            recurse(child, p)
+
+    root = _Path(rows)
+    root.extend(-1, 1.0, 1.0)
+    recurse(0, root)
+    phi[:, F] = _expected_value(leaf, cover, isp)
+    return phi
+
+
+def _expected_value(leaf, cover, isp) -> float:
+    """Cover-weighted mean prediction (the bias term)."""
+    leaves = ~isp & (cover > 0)
+    # exclude internal-split nodes AND unreached heap slots
+    tot = cover[leaves].sum()
+    if tot <= 0:
+        return 0.0
+    return float((leaf[leaves] * cover[leaves]).sum() / tot)
+
+
+def ensemble_contributions(trees, X: np.ndarray) -> np.ndarray:
+    """Σ per-tree SHAP values (reference: ``PredictTreeSHAPTask``); the bias
+    column sums each tree's expected value so row-sums equal the raw margin."""
+    out = None
+    for t in trees:
+        c = tree_shap(t, X)
+        out = c if out is None else out + c
+    return out

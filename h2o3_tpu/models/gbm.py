@@ -69,6 +69,58 @@ class SharedTreeModel(Model):
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
         return predict_raw(X, self.output["trees"])
 
+    def varimp(self, use_pandas: bool = False):
+        """Per-feature split-gain importance (reference: ``SharedTree``
+        relative importance = accumulated squared-error reduction; h2o-py
+        ``model.varimp()`` rows = (variable, relative, scaled, percentage))."""
+        cols = self.output["x_cols"]
+        rel = np.zeros(len(cols))
+        all_trees = self.output.get("trees") or [
+            t for ts in self.output.get("trees_multi", []) for t in ts]
+        for t in all_trees:
+            if t.gain is None:
+                continue
+            feat = np.asarray(jax.device_get(t.feat))
+            gain = np.asarray(jax.device_get(t.gain))
+            ok = feat >= 0
+            np.add.at(rel, feat[ok], np.maximum(gain[ok], 0.0))
+        mx = rel.max() if rel.max() > 0 else 1.0
+        tot = rel.sum() if rel.sum() > 0 else 1.0
+        rows = sorted(zip(cols, rel, rel / mx, rel / tot),
+                      key=lambda r: -r[1])
+        if use_pandas:
+            import pandas as pd
+            return pd.DataFrame(rows, columns=["variable", "relative_importance",
+                                               "scaled_importance", "percentage"])
+        return rows
+
+    def _contrib_scale_bias(self) -> tuple[float, float]:
+        """(scale, extra_bias) mapping summed tree-leaf SHAP onto this model's
+        raw margin: margin = scale * tree_sum + extra_bias."""
+        return 1.0, 0.0
+
+    def predict_contributions(self, frame: Frame) -> Frame:
+        """Per-row SHAP contributions + BiasTerm (reference:
+        ``Model.scoreContributions`` → genmodel TreeSHAP; h2o-py
+        ``model.predict_contributions``). Row sums equal the model's raw
+        margin (logit for bernoulli, mean prediction for DRF/regression)."""
+        from h2o3_tpu.frame.types import VecType
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.genmodel.treeshap import ensemble_contributions
+        if "trees" not in self.output:
+            raise ValueError("contributions need a single-tree-set model")
+        X = np.asarray(jax.device_get(
+            tree_matrix(frame, self.output["x_cols"],
+                        self.output["feat_domains"])))[: frame.nrows]
+        phi = ensemble_contributions(self.output["trees"], X)
+        scale, bias = self._contrib_scale_bias()
+        phi *= scale
+        phi[:, -1] += bias
+        names = list(self.output["x_cols"]) + ["BiasTerm"]
+        return Frame(names, [Vec.from_numpy(phi[:, i].astype(np.float32),
+                                            type=VecType.NUM)
+                             for i in range(phi.shape[1])])
+
     def _tree_raw_sum_per_class(self, frame: Frame) -> jax.Array:
         """[rows, K] per-class sums for multinomial (trees_multi[k] = class k)."""
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
@@ -78,6 +130,9 @@ class SharedTreeModel(Model):
 
 class GBMModel(SharedTreeModel):
     algo = "gbm"
+
+    def _contrib_scale_bias(self):
+        return float(self.output["learn_rate"]), float(self.output["f0"])
 
     def _score_raw(self, frame: Frame) -> jax.Array:
         if self.output["distribution"] == "multinomial":
@@ -280,6 +335,9 @@ class GBM(SharedTreeBuilder):
 
 class DRFModel(SharedTreeModel):
     algo = "drf"
+
+    def _contrib_scale_bias(self):
+        return 1.0 / max(self.output["ntrees"], 1), 0.0
 
     def _score_raw(self, frame: Frame) -> jax.Array:
         if self.output.get("trees_multi") is not None:

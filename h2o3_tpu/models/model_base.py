@@ -128,7 +128,17 @@ class Model:
             y, valid = response_as_float(yvec)
         return compute_metrics(raw, y, mask & valid, self.nclasses)
 
-    # -- persistence hooks (filled in by h2o3_tpu.persist) -------------------
+    # -- persistence hooks ---------------------------------------------------
+
+    def download_mojo(self, path: str) -> str:
+        """Export a portable scoring artifact (h2o-py: ``download_mojo``)."""
+        from h2o3_tpu.genmodel.mojo import write_mojo
+        return write_mojo(self, path)
+
+    def save(self, path: str) -> str:
+        """Binary model save (h2o-py: ``h2o.save_model``)."""
+        from h2o3_tpu.persist.model_io import save_model
+        return save_model(self, path)
 
     def __repr__(self) -> str:
         lines = [f"{type(self).__name__}(key={self.key!r})"]

@@ -55,6 +55,8 @@ class Tree:
     na_left: jax.Array      # bool, direction for missing values
     is_split: jax.Array     # bool
     leaf: jax.Array         # f32 leaf values (valid where !is_split)
+    gain: jax.Array | None = None    # f32 split gain (0 at leaves) — varimp
+    cover: jax.Array | None = None   # f32 sum of row weights through the node
 
 
 def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int):
@@ -151,6 +153,7 @@ def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
     node_local = jnp.zeros(binned.shape[0], jnp.int32)
 
     lv_feat, lv_t, lv_tv, lv_na, lv_sp, lv_leaf = [], [], [], [], [], []
+    lv_gain, lv_cover = [], []
     row_leaf = jnp.zeros(binned.shape[0], jnp.float32)
 
     for d in range(depth):
@@ -174,6 +177,8 @@ def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
         lv_na.append(do & na_left)
         lv_sp.append(do)
         lv_leaf.append(leaf)
+        lv_gain.append(jnp.where(do, gain, 0.0))
+        lv_cover.append(W)
         # rows whose node froze at this level take its leaf value
         active = node_local >= 0
         nl = jnp.where(active, node_local, 0)
@@ -192,13 +197,16 @@ def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
     lv_na.append(jnp.zeros(N, bool))
     lv_sp.append(jnp.zeros(N, bool))
     lv_leaf.append(leaf)
+    lv_gain.append(jnp.zeros(N, jnp.float32))
+    lv_cover.append(tot[:, 2])
     active = node_local >= 0
     nl = jnp.where(active, node_local, 0)
     row_leaf = jnp.where(active, leaf[nl], row_leaf)
 
     return (jnp.concatenate(lv_feat), jnp.concatenate(lv_t),
             jnp.concatenate(lv_tv), jnp.concatenate(lv_na),
-            jnp.concatenate(lv_sp), jnp.concatenate(lv_leaf), row_leaf)
+            jnp.concatenate(lv_sp), jnp.concatenate(lv_leaf),
+            jnp.concatenate(lv_gain), jnp.concatenate(lv_cover), row_leaf)
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate"))
@@ -231,14 +239,15 @@ def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
     keys = jax.random.split(key, K)
     if feat_mask.ndim == 1:
         feat_mask = jnp.broadcast_to(feat_mask[None, :], (K, feat_mask.shape[0]))
-    hf, ht, htv, hna, hsp, hlf, preds = _grow_batched(
+    hf, ht, htv, hna, hsp, hlf, hg, hc, preds = _grow_batched(
         binned, edges, g, h, w, feat_mask, keys,
         params.max_depth, params.nbins, jnp.float32(params.min_rows),
         jnp.float32(params.reg_lambda), jnp.float32(params.reg_alpha),
         jnp.float32(params.gamma), jnp.float32(params.min_split_improvement),
         float(col_rate))
     trees = [Tree(feat=hf[k], thresh_bin=ht[k], thresh_val=htv[k],
-                  na_left=hna[k], is_split=hsp[k], leaf=hlf[k])
+                  na_left=hna[k], is_split=hsp[k], leaf=hlf[k],
+                  gain=hg[k], cover=hc[k])
              for k in range(K)]
     return trees, preds
 

@@ -78,6 +78,9 @@ def compute_auuc(uplift_pred, y, treat, mask, nbins: int = 1000):
 class UpliftDRFModel(SharedTreeModel):
     algo = "upliftdrf"
 
+    def _contrib_scale_bias(self):
+        return 1.0 / max(len(self.output["trees"]), 1), 0.0
+
     def _score_raw(self, frame: Frame):
         raw = self._tree_raw_sum(frame) / max(len(self.output["trees"]), 1)
         return raw   # predicted uplift per row

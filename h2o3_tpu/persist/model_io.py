@@ -35,12 +35,17 @@ def _to_host(obj, _depth=0):
     return obj
 
 
+def host_copy(model):
+    """Deep copy with every jax.Array converted to host numpy — the ONE
+    serializable form shared by binary saves and MOJO payloads."""
+    import copy
+    return _to_host(copy.deepcopy(model))
+
+
 def save_model(model, path: str) -> str:
     """Write a binary model file; returns the path (h2o-py:
     ``h2o.save_model``)."""
-    import copy
-    m = copy.deepcopy(model)
-    m = _to_host(m)
+    m = host_copy(model)
     with open(path, "wb") as fh:
         fh.write(_MAGIC)
         pickle.dump(m, fh)
