@@ -38,7 +38,7 @@ def _algo_registry():
                                      GLRM, Grep, IsolationForest,
                                      IsotonicRegression, KMeans,
                                      ModelSelection, NaiveBayes, PCA, RuleFit,
-                                     TargetEncoder, UpliftDRF, Word2Vec,
+                                     PSVM, TargetEncoder, UpliftDRF, Word2Vec,
                                      XGBoost)
         _ALGOS = {"gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
                   "xgboost": XGBoost, "kmeans": KMeans, "pca": PCA, "svd": SVD,
@@ -50,7 +50,7 @@ def _algo_registry():
                   "rulefit": RuleFit, "decisiontree": DecisionTree,
                   "aggregator": Aggregator, "grep": Grep, "gam": GAM,
                   "modelselection": ModelSelection, "anovaglm": ANOVAGLM,
-                  "upliftdrf": UpliftDRF}
+                  "upliftdrf": UpliftDRF, "psvm": PSVM}
     return _ALGOS
 
 

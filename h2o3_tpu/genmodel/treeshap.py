@@ -96,7 +96,7 @@ def tree_shap(tree, X: np.ndarray) -> np.ndarray:
     isp = np.asarray(jax.device_get(tree.is_split))
     leaf = np.asarray(jax.device_get(tree.leaf)).astype(np.float64)
     cover = np.asarray(jax.device_get(tree.cover)).astype(np.float64) \
-        if tree.cover is not None else None
+        if getattr(tree, "cover", None) is not None else None
     if cover is None:
         raise ValueError("tree has no cover stats (grown before gain/cover "
                          "channels); retrain to use predict_contributions")

@@ -30,6 +30,7 @@ from h2o3_tpu.models.gam import GAM, GAMModel
 from h2o3_tpu.models.model_selection import (ANOVAGLM, ANOVAGLMModel,
                                              ModelSelection, ModelSelectionModel)
 from h2o3_tpu.models.uplift import UpliftDRF, UpliftDRFModel
+from h2o3_tpu.models.psvm import PSVM, PSVMModel
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
@@ -45,4 +46,5 @@ __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "DecisionTree", "DecisionTreeModel",
            "Aggregator", "AggregatorModel", "Grep", "GrepModel",
            "GAM", "GAMModel", "ModelSelection", "ModelSelectionModel",
-           "ANOVAGLM", "ANOVAGLMModel", "UpliftDRF", "UpliftDRFModel"]
+           "ANOVAGLM", "ANOVAGLMModel", "UpliftDRF", "UpliftDRFModel",
+           "PSVM", "PSVMModel"]

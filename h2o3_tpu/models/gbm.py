@@ -78,7 +78,9 @@ class SharedTreeModel(Model):
         all_trees = self.output.get("trees") or [
             t for ts in self.output.get("trees_multi", []) for t in ts]
         for t in all_trees:
-            if t.gain is None:
+            # getattr: artifacts pickled before the gain/cover channels restore
+            # __dict__ directly, bypassing the dataclass defaults
+            if getattr(t, "gain", None) is None:
                 continue
             feat = np.asarray(jax.device_get(t.feat))
             gain = np.asarray(jax.device_get(t.gain))

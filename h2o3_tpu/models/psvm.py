@@ -1,0 +1,299 @@
+"""PSVM — kernel Support Vector Machine via ICF + primal-dual interior point.
+
+Reference: ``hex/psvm/PSVM.java`` (driver: gamma default 1/fullN
+``PSVM.java:128-130``, ICF rank default sqrt(n) ``:230``), the Google-PSVM
+algorithm ``hex/psvm/psvm/IncompleteCholeskyFactorization.java`` (pivoted ICF
+of the label-signed kernel matrix) and ``hex/psvm/psvm/PrimalDualIPM.java``
+(primal-dual IPM on the SVM dual with box constraints [0, C±] and the
+equality y'x = 0; Newton system solved through Sherman-Morrison-Woodbury on
+the rank-p ICF factor: ``icfA = H'DH + I`` then a p×p Cholesky,
+``PrimalDualIPM.java:85-99``). Support vectors thresholded at
+``sv_threshold`` (``RegulateAlphaTask``, ``PSVM.java:399-438``), bias rho
+from free SVs (``CalculateRhoTask``).
+
+TPU-native redesign: the reference streams the n×p ICF factor through MRTask
+chunk passes with host-side p-vectors. Here the factor lives as one
+row-sharded [n, p] array in HBM; every IPM iteration is a handful of
+matmuls/reductions (MXU work: ``H'(d*v)``, rank-p Cholesky solve, [n,p]×[p]
+matvec) in a single jitted step — XLA all-reduces the per-shard partials over
+ICI where the reference's MRTask reduce crossed the cloud. The ICF pivot loop
+is a ``lax.fori_loop`` with dynamic-slice pivot selection (static shapes,
+kernel columns computed on the fly — never materializing the n×n kernel).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _kernel_col(X, norms, q: jax.Array, gamma: float):
+    """Gaussian kernel column K(:, q) = exp(-gamma * ||x_i - x_q||^2)."""
+    xq = lax_dynamic_row(X, q)
+    nq = norms[q]
+    d2 = jnp.maximum(norms + nq - 2.0 * (X @ xq), 0.0)
+    return jnp.exp(-gamma * d2)
+
+
+def lax_dynamic_row(X, q):
+    return jax.lax.dynamic_slice_in_dim(X, q, 1, axis=0)[0]
+
+
+@partial(jax.jit, static_argnames=("rank", "gamma"))
+def _icf(X, y, rank: int, gamma: float, keep=None):
+    """Pivoted incomplete Cholesky of Q = diag(y) K diag(y), rank columns.
+
+    Reference: ``IncompleteCholeskyFactorization.java`` — greedy pivot on the
+    largest diagonal residual; RBF diagonal starts at 1. ``keep`` masks rows
+    excluded from training (zero weight / shard padding): they never pivot.
+    """
+    n = X.shape[0]
+    norms = jnp.sum(X * X, axis=1)
+    H0 = jnp.zeros((n, rank), jnp.float32)
+    diag0 = jnp.ones(n, jnp.float32)        # K(x,x) = 1 for RBF
+    dead0 = jnp.zeros(n, bool) if keep is None else ~keep
+
+    def body(j, carry):
+        H, diag, dead = carry
+        # exhausted (residual ~0) or excluded rows must not pivot: a duplicate
+        # re-pick would divide float32 round-off by ~1e-6 and fill H with noise
+        cand = jnp.where(dead | (diag < 1e-8), -jnp.inf, diag)
+        q = jnp.argmax(cand).astype(jnp.int32)
+        usable = jnp.isfinite(cand[q])
+        pivot = jnp.sqrt(jnp.maximum(diag[q], 1e-12))
+        kcol = _kernel_col(X, norms, q, gamma) * y * y[q]   # label-signed Q col
+        hq = lax_dynamic_row(H, q)                           # H[q, :]
+        proj = H @ hq                                        # sum_k H[i,k] H[q,k]
+        col = (kcol - proj) / pivot
+        col = col.at[q].set(pivot)
+        col = jnp.where(usable, col, 0.0)    # rank exhausted → zero column
+        H = H.at[:, j].set(col)
+        diag = jnp.maximum(diag - col * col, 0.0)
+        dead = dead.at[q].set(True)
+        return H, diag, dead
+
+    H, _, _ = jax.lax.fori_loop(0, rank, body, (H0, diag0, dead0))
+    return H
+
+
+@jax.jit
+def _smw_partial(H, d, b):
+    """Solve the p×p system of SMW: returns vz = (I + H'DH)^{-1} H'(d*b)."""
+    p = H.shape[1]
+    db = d * b
+    A = H.T @ (d[:, None] * H) + jnp.eye(p, dtype=H.dtype)
+    L = jnp.linalg.cholesky(A)
+    rhs = H.T @ db
+    z1 = jax.scipy.linalg.solve_triangular(L, rhs, lower=True)
+    return jax.scipy.linalg.solve_triangular(L.T, z1, lower=False)
+
+
+@jax.jit
+def _smw_solve(H, d, b):
+    """(Sigma + HH')^{-1} b  via SMW with D = 1/Sigma = d (elementwise)."""
+    vz = _smw_partial(H, d, b)
+    return d * b - d * (H @ vz)
+
+
+@jax.jit
+def _ipm_step(H, y, c_vec, x, xi, la, nu, t_mu_num):
+    """One primal-dual IPM Newton iteration (PrimalDualIPM.java:66-99)."""
+    eps = 1e-9
+    # surrogate gap (SurrogateGapTask): la'c + x'(xi - la)
+    eta = jnp.sum(la * c_vec) + jnp.sum(x * (xi - la))
+    t = t_mu_num / jnp.maximum(eta, 1e-30)
+
+    # z = Qx + nu*y - 1 (computePartialZ + CheckConvergenceTask)
+    z_q = H @ (H.T @ x)
+    z = z_q + nu * y - 1.0
+    resd = jnp.sqrt(jnp.sum((la - xi + z) ** 2))
+    resp = jnp.abs(jnp.sum(y * x))
+
+    # UpdateVarsTask
+    m_lx = jnp.maximum(x, eps)
+    m_ux = jnp.maximum(c_vec - x, eps)
+    tlx = 1.0 / (t * m_lx)
+    tux = 1.0 / (t * m_ux)
+    xilx = jnp.maximum(xi / m_lx, eps)
+    laux = jnp.maximum(la / m_ux, eps)
+    d = 1.0 / (xilx + laux)
+    zr = tlx - tux - z
+
+    # delta nu (DeltaNuTask): dnu = sum1/sum2 over SMW partial solves
+    vz = _smw_partial(H, d, zr)
+    vl = _smw_partial(H, d, y)
+    tw = zr - H @ vz
+    tl = y - H @ vl
+    sum1 = jnp.sum(y * (tw * d + x))
+    sum2 = jnp.sum(y * tl * d)
+    dnu = sum1 / sum2
+
+    # delta x: (Sigma + Q)^{-1} (zr - dnu*y)
+    dx = _smw_solve(H, d, zr - dnu * y)
+
+    # dxi/dla (LineSearchTask)
+    dxi = tlx - xilx * dx - xi
+    dla = tux + laux * dx - la
+
+    # step sizes: largest feasible, capped at 1, damped 0.99
+    big = jnp.float32(3.4e38)
+    ap = jnp.min(jnp.where(dx > 0, (c_vec - x) / dx,
+                 jnp.where(dx < 0, -x / dx, big)))
+    ad = jnp.min(jnp.minimum(jnp.where(dxi < 0, -xi / dxi, big),
+                             jnp.where(dla < 0, -la / dla, big)))
+    ap = jnp.minimum(ap, 1.0) * 0.99
+    ad = jnp.minimum(ad, 1.0) * 0.99
+
+    return (x + ap * dx, xi + ad * dxi, la + ad * dla, nu + ad * dnu,
+            eta, resp, resd)
+
+
+@jax.jit
+def _sv_decision(X, norms_sv, Xsv, coef, gamma, rho):
+    """f(x) = sum_j coef_j K(sv_j, x) + rho  (coef = alpha_j * y_j)."""
+    nx = jnp.sum(X * X, axis=1)
+    d2 = jnp.maximum(nx[:, None] + norms_sv[None, :] - 2.0 * (X @ Xsv.T), 0.0)
+    K = jnp.exp(-gamma * d2)
+    return K @ coef + rho
+
+
+class PSVMModel(Model):
+    algo = "psvm"
+
+    def _score_raw(self, frame: Frame) -> jax.Array:
+        o = self.output
+        X = self.data_info.expand(frame)
+        f = _sv_decision(X, o["sv_norms"], o["sv_x"], o["sv_coef"],
+                         o["gamma"], o["rho"])
+        p1 = jax.nn.sigmoid(f)   # pseudo-probability for the metrics stack
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+    def decision_function(self, frame: Frame) -> jax.Array:
+        o = self.output
+        X = self.data_info.expand(frame)
+        return _sv_decision(X, o["sv_norms"], o["sv_x"], o["sv_coef"],
+                            o["gamma"], o["rho"])
+
+
+class PSVM(ModelBuilder):
+    """Kernel SVM (binomial only, like the reference ``PSVM.can_build``)."""
+
+    algo = "psvm"
+    supports_regression = False
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            ModelBuilder.defaults(),
+            hyper_param=1.0,          # C  (PSVMModel.java:115)
+            positive_weight=1.0,
+            negative_weight=1.0,
+            kernel_type="gaussian",
+            gamma=-1.0,               # -1 → 1/fullN
+            rank_ratio=-1.0,          # -1 → sqrt(n)
+            sv_threshold=1e-4,
+            max_iterations=200,
+            mu_factor=10.0,
+            feasible_threshold=1e-3,
+            surrogate_gap_threshold=1e-3,
+        )
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> PSVMModel:
+        p = self.params
+        di = DataInfo.make(frame, x, standardize=True)
+        X = di.expand(frame)
+        yvec = frame.vec(y)
+        if not yvec.is_categorical or len(yvec.domain) != 2:
+            raise ValueError("PSVM supports only binomial classification")
+        ycode = yvec.data.astype(jnp.float32)
+        ypm = jnp.where(ycode > 0, 1.0, -1.0)       # {-1, +1}
+        keep = (weights > 0) & (ycode >= 0)
+        # zero-weight rows contribute nothing: zero their feature rows and pin
+        # their box to C=0 so alpha stays 0 (static shapes; no sub-frame carve)
+        X = jnp.where(keep[:, None], X, 0.0)
+        n = X.shape[0]
+
+        gamma = float(p["gamma"])
+        if gamma <= 0:
+            gamma = 1.0 / max(di.ncols_expanded, 1)
+        rr = float(p["rank_ratio"])
+        rank = int(np.sqrt(n)) if rr <= 0 else int(rr * n)
+        rank = max(1, min(rank, n))
+
+        H = _icf(X, ypm, rank, gamma, keep)
+        H = jnp.where(keep[:, None], H, 0.0)
+
+        c_pos = float(p["hyper_param"]) * float(p["positive_weight"])
+        c_neg = float(p["hyper_param"]) * float(p["negative_weight"])
+        c_vec = jnp.where(ypm > 0, c_pos, c_neg) * keep.astype(jnp.float32)
+        c_vec = jnp.maximum(c_vec, 1e-12)
+
+        # InitTask: la = xi = c/10, x = 0, nu = 0
+        xv = jnp.zeros(n, jnp.float32)
+        xi = c_vec / 10.0
+        la = c_vec / 10.0
+        nu = jnp.float32(0.0)
+        t_mu_num = jnp.float32(float(p["mu_factor"]) * 2.0 * n)
+
+        feas = float(p["feasible_threshold"])
+        sgap = float(p["surrogate_gap_threshold"])
+        for it in range(int(p["max_iterations"])):
+            # the step returns eta/resp/resd measured on the INCOMING iterate
+            # (reference checks convergence before stepping,
+            # PrimalDualIPM.java:66-80) — so on convergence keep the pre-step
+            # state: the extra Newton step past convergence is numerically
+            # degenerate (t → inf) in float32.
+            prev = (xv, xi, la, nu)
+            xv, xi, la, nu, eta, resp, resd = _ipm_step(
+                H, ypm, c_vec, xv, xi, la, nu, t_mu_num)
+            job.update(min(0.9, it / max(int(p["max_iterations"]), 1)),
+                       f"IPM iter {it}: sgap={float(eta):.3e}")
+            converged = (float(resp) <= feas and float(resd) <= feas
+                         and float(eta) <= sgap)
+            if converged or not bool(jnp.isfinite(xv).all()):
+                xv, xi, la, nu = prev
+                break
+
+        # RegulateAlphaTask: clamp, zero below sv_threshold, sign with label
+        alpha = np.asarray(jax.device_get(xv))
+        cv = np.asarray(jax.device_get(c_vec))
+        alpha = np.clip(alpha, 0.0, cv)
+        alpha[alpha < float(p["sv_threshold"])] = 0.0
+        sv_idx = np.nonzero(alpha > 0)[0]
+        ypm_h = np.asarray(jax.device_get(ypm))
+        coef = alpha[sv_idx] * ypm_h[sv_idx]
+
+        Xh = np.asarray(jax.device_get(X))
+        Xsv = jnp.asarray(Xh[sv_idx]) if len(sv_idx) else jnp.zeros((1, X.shape[1]), jnp.float32)
+        svcoef = jnp.asarray(coef.astype(np.float32)) if len(sv_idx) else jnp.zeros(1, jnp.float32)
+        sv_norms = jnp.sum(Xsv * Xsv, axis=1)
+
+        # rho from free SVs: mean(y_i - f0(x_i)) over 0 < alpha_i < C
+        # (reference CalculateRhoTask on a sample of SVs)
+        if len(sv_idx):
+            free = sv_idx[(alpha[sv_idx] < cv[sv_idx] - 1e-8)]
+            ref = free if len(free) else sv_idx
+            ref = ref[:1000]
+            f0 = jax.device_get(_sv_decision(jnp.asarray(Xh[ref]),
+                                             sv_norms, Xsv, svcoef,
+                                             gamma, jnp.float32(0.0)))
+            rho = float(np.mean(ypm_h[ref] - np.asarray(f0)))
+        else:
+            rho = 0.0
+
+        model = PSVMModel(
+            make_model_key(self.algo, self.model_id), self.params, di, y,
+            yvec.domain,
+            output=dict(sv_x=Xsv, sv_coef=svcoef, sv_norms=sv_norms,
+                        gamma=jnp.float32(gamma), rho=jnp.float32(rho),
+                        svs_count=int(len(sv_idx)), rank=rank,
+                        alpha=alpha))
+        return model

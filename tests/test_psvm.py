@@ -1,0 +1,62 @@
+"""PSVM tests (reference: hex/psvm — PSVMTest, PrimalDualIPMTest, ICF tests)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.psvm import PSVM, _icf
+
+
+def _two_blobs(rng, n=240, sep=2.2):
+    half = n // 2
+    X = np.concatenate([rng.normal(-sep / 2, 1.0, size=(half, 2)),
+                        rng.normal(sep / 2, 1.0, size=(n - half, 2))])
+    y = np.array(["neg"] * half + ["pos"] * (n - half))
+    idx = rng.permutation(n)
+    return X[idx], y[idx]
+
+
+def test_psvm_separable_blobs(rng):
+    X, y = _two_blobs(rng)
+    fr = Frame.from_arrays({"x0": X[:, 0].astype(np.float32),
+                            "x1": X[:, 1].astype(np.float32), "y": y})
+    m = PSVM(hyper_param=1.0, max_iterations=60, seed=1).train(y="y", training_frame=fr)
+    assert m.output["svs_count"] > 0
+    assert m.training_metrics.auc > 0.95
+    preds = m.predict(fr)
+    acc = (np.asarray(preds.vec("predict").to_numpy()) ==
+           np.asarray(fr.vec("y").to_numpy())).mean()
+    assert acc > 0.9
+
+
+def test_psvm_nonlinear_circle(rng):
+    # RBF kernel must solve a radially-separable problem a linear model can't
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    r = np.sqrt((X ** 2).sum(axis=1))
+    y = np.where(r < 1.1, "in", "out")
+    fr = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "y": y})
+    m = PSVM(hyper_param=10.0, gamma=1.0, rank_ratio=0.3, max_iterations=80).train(
+        y="y", training_frame=fr)
+    assert m.training_metrics.auc > 0.95
+
+
+def test_icf_approximates_kernel(rng):
+    import jax.numpy as jnp
+    n, d = 60, 3
+    X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    y = jnp.asarray(np.where(rng.random(n) < 0.5, -1.0, 1.0).astype(np.float32))
+    gamma = 0.5
+    H = _icf(X, y, rank=n, gamma=gamma)          # full rank → near-exact
+    d2 = ((np.asarray(X)[:, None, :] - np.asarray(X)[None, :, :]) ** 2).sum(-1)
+    Q = np.exp(-gamma * d2) * np.outer(np.asarray(y), np.asarray(y))
+    err = np.abs(np.asarray(H @ H.T) - Q).max()
+    assert err < 1e-3
+
+
+def test_psvm_rejects_regression(rng):
+    X = rng.normal(size=(50, 2)).astype(np.float32)
+    fr = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1],
+                            "y": rng.normal(size=50).astype(np.float32)})
+    with pytest.raises(ValueError):
+        PSVM().train(y="y", training_frame=fr)
