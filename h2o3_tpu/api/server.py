@@ -38,8 +38,8 @@ def _algo_registry():
                                      GLRM, Grep, IsolationForest,
                                      IsotonicRegression, KMeans,
                                      ModelSelection, NaiveBayes, PCA, RuleFit,
-                                     PSVM, TargetEncoder, UpliftDRF, Word2Vec,
-                                     XGBoost)
+                                     Infogram, PSVM, TargetEncoder, UpliftDRF,
+                                     Word2Vec, XGBoost)
         _ALGOS = {"gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
                   "xgboost": XGBoost, "kmeans": KMeans, "pca": PCA, "svd": SVD,
                   "glrm": GLRM, "naivebayes": NaiveBayes, "coxph": CoxPH,
@@ -50,7 +50,7 @@ def _algo_registry():
                   "rulefit": RuleFit, "decisiontree": DecisionTree,
                   "aggregator": Aggregator, "grep": Grep, "gam": GAM,
                   "modelselection": ModelSelection, "anovaglm": ANOVAGLM,
-                  "upliftdrf": UpliftDRF, "psvm": PSVM}
+                  "upliftdrf": UpliftDRF, "psvm": PSVM, "infogram": Infogram}
     return _ALGOS
 
 

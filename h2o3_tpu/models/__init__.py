@@ -31,6 +31,7 @@ from h2o3_tpu.models.model_selection import (ANOVAGLM, ANOVAGLMModel,
                                              ModelSelection, ModelSelectionModel)
 from h2o3_tpu.models.uplift import UpliftDRF, UpliftDRFModel
 from h2o3_tpu.models.psvm import PSVM, PSVMModel
+from h2o3_tpu.models.infogram import Infogram, InfogramModel
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
@@ -47,4 +48,4 @@ __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
            "Aggregator", "AggregatorModel", "Grep", "GrepModel",
            "GAM", "GAMModel", "ModelSelection", "ModelSelectionModel",
            "ANOVAGLM", "ANOVAGLMModel", "UpliftDRF", "UpliftDRFModel",
-           "PSVM", "PSVMModel"]
+           "PSVM", "PSVMModel", "Infogram", "InfogramModel"]

@@ -193,7 +193,30 @@ class GLMModel(Model):
         if mat.ndim == 1:
             return dict(zip(names, mat))
         return {f"coefs_class_{k}": dict(zip(names, mat[:, k]))
-                for k in range(mat.shape[1])}
+            for k in range(mat.shape[1])}
+
+    def varimp(self, use_pandas: bool = False):
+        """Standardized-coefficient magnitudes per SOURCE column (reference:
+        GLM variable importances = abs standardized coefs; one-hot levels of a
+        categorical aggregate to the parent column)."""
+        beta = np.abs(np.asarray(jax.device_get(self.output["beta"])))
+        if beta.ndim == 2:                       # multinomial: sum over classes
+            beta = beta.sum(axis=1)
+        names = self.output["coef_names"]        # excludes Intercept (last)
+        di = self.data_info
+        rel: dict[str, float] = {c: 0.0 for c in di.cat_cols + di.num_cols}
+        for name, b in zip(names, beta[:len(names)]):
+            src = name.split(".", 1)[0] if name.split(".", 1)[0] in rel else name
+            rel[src] = rel.get(src, 0.0) + float(b)
+        mx = max(rel.values()) if rel and max(rel.values()) > 0 else 1.0
+        tot = sum(rel.values()) or 1.0
+        rows = sorted(((c, v, v / mx, v / tot) for c, v in rel.items()),
+                      key=lambda r: -r[1])
+        if use_pandas:
+            import pandas as pd
+            return pd.DataFrame(rows, columns=["variable", "relative_importance",
+                                               "scaled_importance", "percentage"])
+        return rows
 
 
 class GLM(ModelBuilder):

@@ -1,0 +1,179 @@
+"""Infogram — admissible-ML feature selection (core + fair variants).
+
+Reference: ``h2o-admissibleml/src/main/java/hex/Infogram/`` —
+``Infogram.java`` (driver: builds one surrogate model per predictor,
+``buildTrainingFrames`` ``:545-570``), ``EstimateCMI.java`` (conditional
+mutual information proxy: mean log2-probability of the actual class over
+scored rows), ``InfogramUtils.calculateFinalCMI`` (core:
+``cmi_i = max(0, cmi_full - cmi_without_i)``; fair:
+``cmi_i = max(0, cmi_protected+i - cmi_protected_only)``; normalize by max).
+
+Semantics:
+
+- **Core infogram** (no ``protected_columns``): relevance = variable
+  importance of the full model (scaled to max 1); net information (CMI) of
+  ``x_i`` = drop in conditional log-likelihood when ``x_i`` is removed —
+  I(y; x_i | x_{-i}) up to estimation. Admissible features clear both
+  ``net_information_threshold`` and ``total_information_threshold`` (0.1).
+- **Fair infogram** (``protected_columns`` given): relevance from a model on
+  all predictors minus protected; safety index of ``x_i`` = information
+  about y in ``x_i`` beyond the protected set = cmi(protected ∪ {x_i}) −
+  cmi(protected). Admissible = safe AND relevant.
+
+TPU-native: every surrogate is this framework's GBM — each a fully compiled
+XLA tree-growth program; the N+1 surrogates share one device-resident frame
+and differ only in the feature list (no frame carving as in the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+
+
+def _mean_cmi(model: Model, frame: Frame, y: str) -> float:
+    """EstimateCMI.java: mean log2 p(actual class) over scorable rows."""
+    import jax
+    import jax.numpy as jnp
+    from h2o3_tpu.models.data_info import response_as_float
+
+    raw = model._score_raw(frame)           # [plen, nclass] probabilities
+    yy, valid = response_as_float(frame.vec(y))
+    mask = frame.row_mask() & valid
+    yi = jnp.clip(yy.astype(jnp.int32), 0, raw.shape[1] - 1)
+    p = jnp.take_along_axis(raw, yi[:, None], axis=1)[:, 0]
+    ok = mask & (p > 0)
+    tot = jnp.sum(jnp.where(ok, jnp.log(jnp.maximum(p, 1e-30)), 0.0))
+    cnt = jnp.maximum(jnp.sum(ok), 1)
+    return float(jax.device_get(tot / cnt)) / float(np.log(2.0))
+
+
+class InfogramModel(Model):
+    algo = "infogram"
+
+    def _score_raw(self, frame: Frame):
+        # scoring delegates to the relevance (full) surrogate model
+        return self.output["relevance_model"]._score_raw(frame)
+
+    def get_admissible_features(self) -> list[str]:
+        return list(self.output["admissible_features"])
+
+    def get_admissible_cmi(self) -> list[float]:
+        a = set(self.output["admissible_features"])
+        return [c for f, c in zip(self.output["all_predictor_names"],
+                                  self.output["cmi"]) if f in a]
+
+    def infogram_data(self):
+        """Rows of (column, admissible, relevance, cmi, cmi_raw) — the plot
+        data behind h2o-py's ``model.plot()`` infogram."""
+        o = self.output
+        adm = set(o["admissible_features"])
+        return [dict(column=f, admissible=f in adm,
+                     relevance=float(r), cmi=float(c), cmi_raw=float(cr))
+                for f, r, c, cr in zip(o["all_predictor_names"], o["relevance"],
+                                       o["cmi"], o["cmi_raw"])]
+
+
+class Infogram(ModelBuilder):
+    algo = "infogram"
+    supports_regression = False   # CMI needs class probabilities (reference ditto)
+
+    @classmethod
+    def defaults(cls) -> dict:
+        return dict(
+            ModelBuilder.defaults(),
+            protected_columns=None,
+            net_information_threshold=0.1,     # cmi threshold (core)
+            total_information_threshold=0.1,   # relevance threshold (core)
+            safety_index_threshold=0.1,        # cmi threshold (fair)
+            relevance_index_threshold=0.1,     # relevance threshold (fair)
+            top_n_features=50,
+            algorithm="gbm",
+            algorithm_params=None,
+        )
+
+    def _surrogate(self, x, y, frame, weights):
+        from h2o3_tpu.models.gbm import GBM
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.deeplearning import DeepLearning
+        from h2o3_tpu.models.gbm import DRF
+        algos = {"gbm": GBM, "glm": GLM, "drf": DRF, "deeplearning": DeepLearning}
+        cls = algos.get(str(self.params.get("algorithm", "gbm")).lower())
+        if cls is None:
+            raise ValueError(f"unsupported infogram algorithm "
+                             f"{self.params['algorithm']!r}; one of {sorted(algos)}")
+        extra = dict(self.params.get("algorithm_params") or {})
+        if cls in (GBM, DRF):
+            extra.setdefault("ntrees", 20)
+            extra.setdefault("max_depth", 5)
+        seed = int(self.params.get("seed") or -1)
+        if seed >= 0:
+            extra.setdefault("seed", seed)
+        builder = cls(**extra)
+        return builder._fit(Job(f"infogram surrogate on {len(x)} cols"),
+                            frame, list(x), y, weights)
+
+    def _fit(self, job: Job, frame: Frame, x, y, weights) -> InfogramModel:
+        p = self.params
+        protected = list(p.get("protected_columns") or [])
+        build_core = not protected
+        preds = [c for c in x if c not in protected]
+        if not preds:
+            raise ValueError("no predictors left after removing protected columns")
+        top_n = int(p.get("top_n_features") or 50)
+
+        # relevance model: full predictors (core) / all minus protected (fair)
+        rel_model = self._surrogate(preds, y, frame, weights)
+        vi = {name: rel for name, rel, _, _ in rel_model.varimp()}
+        vmax = max(vi.values()) if vi and max(vi.values()) > 0 else 1.0
+        relevance = {c: vi.get(c, 0.0) / vmax for c in preds}
+
+        # keep top-K by relevance (reference: extractTopKPredictors)
+        preds = sorted(preds, key=lambda c: -relevance[c])[:top_n]
+
+        cmi_raw = {}
+        if build_core:
+            full_cmi = _mean_cmi(rel_model, frame, y)
+            for i, c in enumerate(preds):
+                rest = [q for q in preds if q != c]
+                if not rest:
+                    cmi_raw[c] = max(0.0, full_cmi)
+                    continue
+                m = self._surrogate(rest, y, frame, weights)
+                cmi_raw[c] = max(0.0, full_cmi - _mean_cmi(m, frame, y))
+                job.update((i + 1) / (len(preds) + 1), f"CMI {c}")
+        else:
+            base_model = self._surrogate(protected, y, frame, weights)
+            base_cmi = _mean_cmi(base_model, frame, y)
+            for i, c in enumerate(preds):
+                m = self._surrogate(protected + [c], y, frame, weights)
+                cmi_raw[c] = max(0.0, _mean_cmi(m, frame, y) - base_cmi)
+                job.update((i + 1) / (len(preds) + 1), f"CMI {c}")
+
+        cmax = max(cmi_raw.values()) if cmi_raw and max(cmi_raw.values()) > 0 else 1.0
+        cmi = {c: v / cmax for c, v in cmi_raw.items()}
+
+        cmi_thr = float(p["net_information_threshold"] if build_core
+                        else p["safety_index_threshold"])
+        rel_thr = float(p["total_information_threshold"] if build_core
+                        else p["relevance_index_threshold"])
+        admissible = [c for c in preds
+                      if cmi[c] >= cmi_thr and relevance[c] >= rel_thr]
+
+        yvec = frame.vec(y)
+        return InfogramModel(
+            make_model_key(self.algo, self.model_id), self.params,
+            rel_model.data_info, y, yvec.domain,
+            output=dict(
+                all_predictor_names=preds,
+                relevance=[relevance[c] for c in preds],
+                cmi=[cmi[c] for c in preds],
+                cmi_raw=[cmi_raw[c] for c in preds],
+                admissible_features=admissible,
+                protected_columns=protected,
+                build_core=build_core,
+                relevance_model=rel_model,
+            ))

@@ -1,0 +1,56 @@
+"""Infogram tests (reference: h2o-admissibleml hex/Infogram)."""
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.infogram import Infogram
+
+
+def _frame(rng, n=500):
+    x0 = rng.normal(size=n).astype(np.float32)          # strong signal
+    x1 = (x0 + rng.normal(scale=0.05, size=n)).astype(np.float32)  # redundant copy
+    x2 = rng.normal(size=n).astype(np.float32)          # pure noise
+    x3 = rng.normal(size=n).astype(np.float32)          # independent signal
+    logit = 2.0 * x0 + 1.5 * x3
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "yes", "no")
+    return Frame.from_arrays({"x0": x0, "x1": x1, "x2": x2, "x3": x3, "y": y})
+
+
+def test_core_infogram(rng):
+    fr = _frame(rng)
+    m = Infogram(seed=7).train(y="y", training_frame=fr)
+    data = {d["column"]: d for d in m.infogram_data()}
+    assert set(data) == {"x0", "x1", "x2", "x3"}
+    # independent signal x3 must be admissible: relevant AND irreplaceable
+    assert "x3" in m.get_admissible_features()
+    # pure noise must not be admissible
+    assert "x2" not in m.get_admissible_features()
+    # redundant copy: x1's CMI must be far below the max (its info is in x0)
+    assert data["x1"]["cmi"] < 0.6
+    assert data["x3"]["cmi"] > 0.5
+    # normalizations land in [0, 1]
+    for d in data.values():
+        assert -1e-9 <= d["cmi"] <= 1 + 1e-9
+        assert -1e-9 <= d["relevance"] <= 1 + 1e-9
+
+
+def test_fair_infogram(rng):
+    n = 500
+    prot = rng.choice(["g1", "g2"], size=n)
+    leak = (prot == "g1").astype(np.float32) + rng.normal(scale=0.05, size=n).astype(np.float32)
+    safe = rng.normal(size=n).astype(np.float32)
+    logit = 2.0 * (prot == "g1") + 1.5 * safe
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "yes", "no")
+    fr = Frame.from_arrays({"prot": prot, "leak": leak, "safe": safe, "y": y})
+    m = Infogram(protected_columns=["prot"], seed=7).train(y="y", training_frame=fr)
+    data = {d["column"]: d for d in m.infogram_data()}
+    assert set(data) == {"leak", "safe"}
+    # 'safe' carries info beyond the protected attribute; 'leak' mostly doesn't
+    assert data["safe"]["cmi"] > data["leak"]["cmi"]
+    assert "safe" in m.get_admissible_features()
+
+
+def test_infogram_glm_surrogate(rng):
+    fr = _frame(rng, n=300)
+    m = Infogram(algorithm="glm", seed=3).train(y="y", training_frame=fr)
+    assert len(m.infogram_data()) == 4
