@@ -1,0 +1,223 @@
+"""Frame utilities: CreateFrame, interactions, TF-IDF, rebalance.
+
+Reference:
+
+- ``hex/createframe/`` + ``water/api/schemas3/CreateFrameV3.java`` — random
+  frame generator (column-type fractions, factor cardinality, missing
+  fraction, optional response).
+- ``water/fvec/CreateInteractions.java`` / h2o-py ``h2o.interaction`` —
+  categorical interaction columns: combined levels of factor tuples,
+  truncated to the ``max_factors`` most frequent (rest → ``"other"``),
+  ``min_occurrence`` filter.
+- ``hex/tfidf/`` (TermFrequencyTask, InverseDocumentFrequencyTask:
+  ``idf = log((N+1)/(df+1))``) / h2o-py ``tf_idf`` — output rows
+  (document id, word, tf, idf, tf-idf).
+- ``water/fvec/RebalanceDataSet.java`` — re-chunk for parallelism. Here
+  sharding is always even over the device mesh, so rebalance re-materializes
+  the frame (fresh upload → fresh padding/sharding); its main use is
+  compacting a frame whose logical ``nrows`` shrank (filters).
+
+Generation and text processing are host-side (like the reference's
+in-memory chunk builders); the results upload to device-sharded Vecs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+
+def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
+                 value: float = 0.0, real_range: float = 100.0,
+                 categorical_fraction: float = 0.2, factors: int = 100,
+                 integer_fraction: float = 0.2, integer_range: int = 100,
+                 binary_fraction: float = 0.1, binary_ones_fraction: float = 0.02,
+                 time_fraction: float = 0.0, string_fraction: float = 0.0,
+                 missing_fraction: float = 0.01, has_response: bool = False,
+                 response_factors: int = 2, positive_response: bool = False,
+                 seed: int | None = None, key: str | None = None) -> Frame:
+    """h2o-py ``h2o.create_frame`` (reference: CreateFrameV3 fields)."""
+    fracs = (categorical_fraction + integer_fraction + binary_fraction
+             + time_fraction + string_fraction)
+    if fracs > 1.0 + 1e-9:
+        raise ValueError("column type fractions sum to > 1")
+    rng = np.random.default_rng(seed)
+    counts = {
+        "cat": int(round(cols * categorical_fraction)),
+        "int": int(round(cols * integer_fraction)),
+        "bin": int(round(cols * binary_fraction)),
+        "time": int(round(cols * time_fraction)),
+        "str": int(round(cols * string_fraction)),
+    }
+    counts["real"] = max(0, cols - sum(counts.values()))
+
+    names, vecs = [], []
+    if has_response:
+        names.append("response")
+        if response_factors == 1:
+            r = rng.uniform(0, real_range, rows) if positive_response \
+                else rng.uniform(-real_range, real_range, rows)
+            vecs.append(Vec.from_numpy(r.astype(np.float32)))
+        else:
+            dom = tuple(f"resp_{i}" for i in range(response_factors))
+            codes = rng.integers(0, response_factors, rows)
+            vecs.append(Vec.from_numpy(codes.astype(np.int32), VecType.CAT, domain=dom))
+
+    def miss(arr):
+        if missing_fraction > 0 and randomize:
+            m = rng.random(rows) < missing_fraction
+            arr = arr.astype(np.float64)
+            arr[m] = np.nan
+        return arr
+
+    idx = 0
+    for kind, n in counts.items():
+        for _ in range(n):
+            name = f"C{idx + 1}"
+            idx += 1
+            names.append(name)
+            if not randomize:
+                vecs.append(Vec.from_numpy(np.full(rows, value, np.float32)))
+                continue
+            if kind == "real":
+                vecs.append(Vec.from_numpy(
+                    miss(rng.uniform(-real_range, real_range, rows)).astype(np.float32)))
+            elif kind == "int":
+                vecs.append(Vec.from_numpy(
+                    miss(rng.integers(-integer_range, integer_range + 1, rows)
+                         .astype(np.float64)).astype(np.float32)))
+            elif kind == "bin":
+                vecs.append(Vec.from_numpy(
+                    miss((rng.random(rows) < binary_ones_fraction)
+                         .astype(np.float64)).astype(np.float32)))
+            elif kind == "cat":
+                dom = tuple(f"c{idx}.l{i}" for i in range(factors))
+                codes = rng.integers(0, factors, rows).astype(np.int32)
+                if missing_fraction > 0:
+                    codes[rng.random(rows) < missing_fraction] = -1
+                vecs.append(Vec.from_numpy(codes, VecType.CAT, domain=dom))
+            elif kind == "time":
+                t = rng.integers(0, 2_000_000_000_000, rows).astype(np.float64)
+                vecs.append(Vec.from_numpy(miss(t), VecType.TIME))
+            else:  # str
+                strs = np.array([f"s{v:06d}" for v in rng.integers(0, 10**6, rows)],
+                                dtype=object)
+                vecs.append(Vec.from_numpy(strs, VecType.STR))
+    return Frame(names, vecs, key=key)
+
+
+def interaction(frame: Frame, factors: list, pairwise: bool = False,
+                max_factors: int = 100, min_occurrence: int = 1,
+                destination_frame: str | None = None) -> Frame:
+    """h2o-py ``h2o.interaction`` (reference: CreateInteractions.java).
+
+    ``factors``: column names (or a list of lists for several interactions).
+    ``pairwise``: all 2-way combos instead of one N-way interaction.
+    """
+    if factors and isinstance(factors[0], (list, tuple)):
+        groups = [list(g) for g in factors]
+    elif pairwise:
+        groups = [[a, b] for i, a in enumerate(factors)
+                  for b in factors[i + 1:]]
+    else:
+        groups = [list(factors)]
+
+    names, vecs = [], []
+    for group in groups:
+        if len(group) < 2:
+            raise ValueError(f"interaction needs >= 2 columns, got {group}")
+        labels = None
+        na = None
+        for c in group:
+            v = frame.vec(c)
+            if not v.is_categorical:
+                raise ValueError(f"interaction column {c!r} must be categorical")
+            part = v.labels().astype(object)
+            pna = np.array([l is None for l in part])
+            part = np.where(pna, "", part).astype(object)
+            labels = part if labels is None else labels + "_" + part
+            na = pna if na is None else (na | pna)
+        labels[na] = None
+        # frequency-ranked domain, truncated to max_factors (rest → "other")
+        vals, cnts = np.unique(labels[labels != None], return_counts=True)  # noqa: E711
+        keep = vals[cnts >= min_occurrence]
+        kc = cnts[cnts >= min_occurrence]
+        order = np.argsort(-kc, kind="stable")
+        kept = list(keep[order][:max_factors])
+        overflow = (len(keep) > max_factors) or (len(vals) > len(keep))
+        dom = tuple(kept + (["other"] if overflow else []))
+        lut = {lvl: i for i, lvl in enumerate(dom)}
+        other = lut.get("other", -1)
+        codes = np.array([lut.get(l, other) if l is not None else -1
+                          for l in labels], np.int32)
+        names.append("_".join(group))
+        vecs.append(Vec.from_numpy(codes, VecType.CAT, domain=dom))
+    return Frame(names, vecs, key=destination_frame)
+
+
+def tf_idf(frame: Frame, document_id_col: str, text_col: str,
+           preprocess: bool = True, case_sensitive: bool = True) -> Frame:
+    """h2o-py ``tf_idf`` (reference: hex/tfidf). Returns a frame with rows
+    (document id, word, tf, idf, tf-idf); ``idf = log((N+1)/(df+1))``."""
+    doc_v = frame.vec(document_id_col)
+    txt_v = frame.vec(text_col)
+    docs = doc_v.labels() if doc_v.domain is not None else doc_v.to_numpy()
+    texts = txt_v.labels() if txt_v.domain is not None else txt_v.to_numpy()
+
+    pairs: dict[tuple, int] = {}
+    doc_words: dict[str, set] = {}
+    for d, t in zip(docs, texts):
+        if t is None or (isinstance(t, float) and np.isnan(t)):
+            continue
+        words = str(t).split() if preprocess else [str(t)]
+        for w in words:
+            if not case_sensitive:
+                w = w.lower()
+            pairs[(d, w)] = pairs.get((d, w), 0) + 1
+            doc_words.setdefault(w, set()).add(d)
+    n_docs = len(set(np.asarray(docs, dtype=object)[~_isnan_obj(docs)]))
+    rows = sorted(pairs.items(), key=lambda kv: (str(kv[0][0]), kv[0][1]))
+    doc_numeric = doc_v.is_numeric
+    if doc_numeric:
+        out_doc_arr = np.array([float(d) for (d, _), _ in rows], np.float32)
+    else:
+        out_doc_arr = np.array([str(d) for (d, _), _ in rows], dtype=object)
+    out_word = np.array([w for (_, w), _ in rows], dtype=object)
+    tf = np.array([c for _, c in rows], np.float32)
+    idf = np.array([np.log((n_docs + 1) / (len(doc_words[w]) + 1))
+                    for (_, w), _ in rows], np.float32)
+    doc_vec = (Vec.from_numpy(out_doc_arr) if doc_numeric
+               else Vec.from_numpy(out_doc_arr, VecType.STR))
+    return Frame(
+        [document_id_col, text_col, "TF", "IDF", "TF_IDF"],
+        [doc_vec,
+         Vec.from_numpy(out_word, VecType.STR),
+         Vec.from_numpy(tf),
+         Vec.from_numpy(idf),
+         Vec.from_numpy(tf * idf)])
+
+
+def _isnan_obj(a):
+    return np.array([isinstance(v, (float, np.floating)) and np.isnan(v)
+                     for v in a])
+
+
+def rebalance(frame: Frame, key: str | None = None) -> Frame:
+    """Re-materialize a frame with fresh even sharding/padding (reference:
+    RebalanceDataSet re-chunks; here shards are always even over the mesh, so
+    this compacts logical rows and re-uploads)."""
+    names, vecs = [], []
+    for name in frame.names:
+        v = frame.vec(name)
+        host = v.to_numpy()
+        if v.is_categorical:
+            codes = np.asarray(v.to_numpy())
+            vecs.append(Vec.from_numpy(codes.astype(np.int32), VecType.CAT,
+                                       domain=v.domain))
+        else:
+            vecs.append(Vec.from_numpy(host, v.type))
+        names.append(name)
+    return Frame(names, vecs, key=key)

@@ -343,7 +343,18 @@ class DeepLearning(ModelBuilder):
         seed = int(p.get("seed") or -1)
         key = jax.random.PRNGKey(seed if seed >= 0 else 5318008)
         key, init_key = jax.random.split(key)
-        params = self._init_params(init_key, sizes, act)
+        cp = self._resolve_checkpoint()
+        if cp is not None:
+            # resume from the prior model's weights (reference:
+            # DeepLearning.java:348 checkpoint path: continue training the
+            # same topology on more epochs)
+            if cp.output["sizes"] != sizes or cp.output["act"] != act:
+                raise ValueError("checkpoint topology/activation differs; "
+                                 "hidden/activation are immutable across resume")
+            params = cp.output["params"]
+            key = jax.random.fold_in(key, 1 + int(cp.output["samples_trained"]))
+        else:
+            params = self._init_params(init_key, sizes, act)
 
         zeros = jax.tree.map(jnp.zeros_like, params)
         opt = {"Eg": zeros, "Edx": jax.tree.map(jnp.zeros_like, params),

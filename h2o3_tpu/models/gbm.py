@@ -194,6 +194,31 @@ class SharedTreeBuilder(ModelBuilder):
         # guarantee at least one feature
         return m.at[jax.random.randint(kf, (), 0, F)].set(True)
 
+    def _check_checkpoint(self, cp, x, dist: str | None):
+        """Validate checkpoint compatibility (reference: SharedTree.java:241
+        checks immutable params against the prior model)."""
+        if cp is None:
+            return
+        if list(cp.output["x_cols"]) != list(x):
+            raise ValueError("checkpoint feature columns differ from this train")
+        if dist is not None and cp.output["distribution"] != dist:
+            raise ValueError(f"checkpoint distribution {cp.output['distribution']!r}"
+                             f" != {dist!r}")
+        for immut in ("max_depth", "nbins"):
+            if int(cp.params.get(immut, self.params[immut])) != int(self.params[immut]):
+                raise ValueError(f"checkpoint {immut} differs; tree structure "
+                                 "params are immutable across resume")
+        # learn_rate scales EVERY tree at scoring time — changing it across a
+        # resume would silently rescale the checkpoint's trees too
+        if "learn_rate" in self.params and "learn_rate" in cp.params:
+            if float(cp.params["learn_rate"]) != float(self.params["learn_rate"]):
+                raise ValueError("checkpoint learn_rate differs; it is immutable "
+                                 "across resume (it rescales prior trees)")
+        prior = int(cp.output["ntrees"])
+        if int(self.params["ntrees"]) <= prior:
+            raise ValueError(f"ntrees must exceed the checkpoint's {prior} "
+                             "to continue training")
+
     def _row_weights(self, key, w, rate: float, bootstrap: bool):
         if bootstrap:
             # Poisson(rate) ≈ bootstrap of a `rate` fraction (sample_rate honored)
@@ -221,6 +246,16 @@ class GBM(SharedTreeBuilder):
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GBMModel:
         p = self.params
         X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        cp = self._resolve_checkpoint()
+        if cp is not None:
+            # validate BEFORE re-binning: a feature-list mismatch must raise
+            # the intended error, not a shape error from bin_features
+            self._check_checkpoint(cp, x, None)
+            # binning must match the prior model's edges exactly, else tree
+            # thresholds silently shift (reference keeps the checkpoint's
+            # DHistogram bins)
+            edges = cp.output["edges"]
+            binned = bin_features(X, edges)
         dist = str(p["distribution"])
         if yvec.is_categorical:
             if dist not in ("AUTO", "bernoulli", "multinomial"):
@@ -242,16 +277,20 @@ class GBM(SharedTreeBuilder):
 
         if dist == "multinomial":
             return self._fit_multinomial(job, frame, x, y, w, yc, yvec,
-                                         X, edges, binned, domains)
+                                         X, edges, binned, domains, cp)
+        self._check_checkpoint(cp, x, dist)
 
-        ybar = float(jax.device_get((w * yc).sum() / jnp.maximum(w.sum(), 1e-30)))
-        if dist == "bernoulli":
-            ybar = min(max(ybar, 1e-6), 1 - 1e-6)
-            f0 = float(np.log(ybar / (1 - ybar)))
-        elif dist == "poisson":
-            f0 = float(np.log(max(ybar, 1e-10)))
+        if cp is not None:
+            f0 = float(cp.output["f0"])
         else:
-            f0 = ybar
+            ybar = float(jax.device_get((w * yc).sum() / jnp.maximum(w.sum(), 1e-30)))
+            if dist == "bernoulli":
+                ybar = min(max(ybar, 1e-6), 1 - 1e-6)
+                f0 = float(np.log(ybar / (1 - ybar)))
+            elif dist == "poisson":
+                f0 = float(np.log(max(ybar, 1e-10)))
+            else:
+                f0 = ybar
 
         tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
                         min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
@@ -263,8 +302,12 @@ class GBM(SharedTreeBuilder):
         key = jax.random.PRNGKey(seed)
         Fcur = jnp.full(X.shape[0], f0, jnp.float32)
         trees: list[Tree] = []
+        if cp is not None:
+            trees = list(cp.output["trees"])
+            Fcur = Fcur + lr * predict_binned(binned, trees, int(p["nbins"]))
+            key = jax.random.fold_in(key, len(trees))
         ntrees = int(p["ntrees"])
-        for m in range(ntrees):
+        for m in range(len(trees), ntrees):
             key, k1, k2 = jax.random.split(key, 3)
             wt = self._row_weights(k1, w, float(p["sample_rate"]), False)
             g, h = _grad_hess(dist, Fcur, yc, wt)
@@ -288,15 +331,19 @@ class GBM(SharedTreeBuilder):
         )
 
     def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,
-                         X, edges, binned, domains) -> GBMModel:
+                         X, edges, binned, domains, cp=None) -> GBMModel:
         """K one-vs-rest trees per round on softmax gradients (reference:
         GBM.java multinomial — one DTree per class per iteration)."""
         p = self.params
+        self._check_checkpoint(cp, x, "multinomial")
         K = yvec.cardinality()
-        yoh = jax.nn.one_hot(yc.astype(jnp.int32), K) * w[:, None]
-        prior = np.asarray(jax.device_get(yoh.sum(axis=0)), np.float64)
-        prior = np.maximum(prior / max(prior.sum(), 1e-30), 1e-10)
-        f0 = np.log(prior).astype(np.float32)
+        if cp is not None:
+            f0 = np.asarray(cp.output["f0_multi"], np.float32)
+        else:
+            yoh = jax.nn.one_hot(yc.astype(jnp.int32), K) * w[:, None]
+            prior = np.asarray(jax.device_get(yoh.sum(axis=0)), np.float64)
+            prior = np.maximum(prior / max(prior.sum(), 1e-30), 1e-10)
+            f0 = np.log(prior).astype(np.float32)
 
         tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
                         min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
@@ -308,8 +355,16 @@ class GBM(SharedTreeBuilder):
         key = jax.random.PRNGKey(seed)
         Fcur = jnp.broadcast_to(jnp.asarray(f0)[None, :], (X.shape[0], K)).astype(jnp.float32)
         trees_multi: list[list[Tree]] = [[] for _ in range(K)]
+        done = 0
+        if cp is not None:
+            trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
+            done = len(trees_multi[0])
+            Fcur = Fcur + lr * jnp.stack(
+                [predict_binned(binned, ts, int(p["nbins"]))
+                 for ts in trees_multi], axis=1)
+            key = jax.random.fold_in(key, done)
         ntrees = int(p["ntrees"])
-        for m in range(ntrees):
+        for m in range(done, ntrees):
             key, k1, k2, k3 = jax.random.split(key, 4)
             wt = self._row_weights(k1, w, float(p["sample_rate"]), False)
             G, H = _grad_hess_multinomial(Fcur, yc, wt)
@@ -373,6 +428,11 @@ class DRF(SharedTreeBuilder):
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DRFModel:
         p = self.params
         X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        cp = self._resolve_checkpoint()
+        if cp is not None:
+            self._check_checkpoint(cp, x, None)   # before the edges swap
+            edges = cp.output["edges"]
+            binned = bin_features(X, edges)
         classifier = yvec.is_categorical
         nclass = yvec.cardinality() if classifier else 0
         w = weights * valid
@@ -395,7 +455,12 @@ class DRF(SharedTreeBuilder):
             # class fraction (reference: DRF.java multinomial ktrees)
             yoh = jax.nn.one_hot(yc.astype(jnp.int32), nclass)
             trees_multi: list[list[Tree]] = [[] for _ in range(nclass)]
-            for m in range(ntrees):
+            done = 0
+            if cp is not None:
+                trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
+                done = len(trees_multi[0])
+                key = jax.random.fold_in(key, done)
+            for m in range(done, ntrees):
                 key, k1, k3 = jax.random.split(key, 3)
                 wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
                 wt_b = jnp.broadcast_to(wt[None, :], (nclass, wt.shape[0]))
@@ -415,7 +480,10 @@ class DRF(SharedTreeBuilder):
             )
 
         trees: list[Tree] = []
-        for m in range(ntrees):
+        if cp is not None and cp.output.get("trees") is not None:
+            trees = list(cp.output["trees"])
+            key = jax.random.fold_in(key, len(trees))
+        for m in range(len(trees), ntrees):
             key, k1, k2 = jax.random.split(key, 3)
             wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
             g, h = -yc * wt, wt  # leaf = weighted in-node mean of y

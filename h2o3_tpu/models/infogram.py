@@ -96,11 +96,11 @@ class Infogram(ModelBuilder):
         )
 
     def _surrogate(self, x, y, frame, weights):
-        from h2o3_tpu.models.gbm import GBM
+        from h2o3_tpu.models.gbm import DRF, GBM
         from h2o3_tpu.models.glm import GLM
-        from h2o3_tpu.models.deeplearning import DeepLearning
-        from h2o3_tpu.models.gbm import DRF
-        algos = {"gbm": GBM, "glm": GLM, "drf": DRF, "deeplearning": DeepLearning}
+        # surrogates must expose varimp() for the relevance axis — restrict
+        # to tree models + GLM (reference defaults to GBM too)
+        algos = {"gbm": GBM, "glm": GLM, "drf": DRF}
         cls = algos.get(str(self.params.get("algorithm", "gbm")).lower())
         if cls is None:
             raise ValueError(f"unsupported infogram algorithm "

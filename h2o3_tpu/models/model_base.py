@@ -190,7 +190,26 @@ class ModelBuilder:
             ignored_columns=None,
             max_runtime_secs=0.0,
             keep_cross_validation_predictions=False,
+            checkpoint=None,     # prior model (key or Model) to resume from
         )
+
+    def _resolve_checkpoint(self) -> "Model | None":
+        """Resolve the ``checkpoint`` param to a prior Model (reference:
+        ``Model.Parameters._checkpoint``, trees ``SharedTree.java:144,241``,
+        DL ``DeepLearning.java:348``)."""
+        cp = self.params.get("checkpoint")
+        if cp is None:
+            return None
+        if isinstance(cp, Model):
+            self.params["checkpoint"] = cp.key   # don't drag the model object
+            return cp                            # into param snapshots/pickles
+        model = DKV.get(cp)
+        if model is None:
+            raise ValueError(f"checkpoint model {cp!r} not found in DKV")
+        if model.algo != self.algo:
+            raise ValueError(f"checkpoint is a {model.algo!r} model; "
+                             f"this builder is {self.algo!r}")
+        return model
 
     def _fit(self, job: Job, frame: Frame, x: list[str], y: str | None,
              weights: jax.Array) -> Model:
@@ -243,6 +262,15 @@ class ModelBuilder:
         if self.job.status == Job.FAILED:
             raise self.job.exception
         return self.job.result
+
+    def train_segments(self, segments: list[str], y: str,
+                       training_frame: Frame, x: list[str] | None = None,
+                       segment_models_id: str | None = None):
+        """Train one model per unique segment combo (h2o-py
+        ``estimator.train_segments``; reference hex/segments)."""
+        from h2o3_tpu.orchestration.segments import train_segments
+        return train_segments(self, segments, training_frame, y, x=x,
+                              segment_models_id=segment_models_id)
 
     # -- helpers -------------------------------------------------------------
 

@@ -8,8 +8,10 @@ from h2o3_tpu.orchestration.automl import AutoML, EventLog
 from h2o3_tpu.orchestration.grid import Grid, GridSearch
 from h2o3_tpu.orchestration.leaderboard import Leaderboard
 from h2o3_tpu.orchestration.stacked_ensemble import StackedEnsemble, StackedEnsembleModel
+from h2o3_tpu.orchestration.segments import SegmentModels, train_segments
 
 __all__ = [
     "AutoML", "EventLog", "Grid", "GridSearch", "Leaderboard",
     "StackedEnsemble", "StackedEnsembleModel",
+    "SegmentModels", "train_segments",
 ]

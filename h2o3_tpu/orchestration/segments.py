@@ -1,0 +1,114 @@
+"""Segment models — train one model per partition of a frame.
+
+Reference: ``hex/segments/SegmentModelsBuilder.java`` (+ ``SegmentModels.java``
+results container; h2o-py ``estimator.train_segments``): enumerate the unique
+combinations of the segment columns, train the same algorithm/params on each
+segment's rows, collect per-segment model keys + status + errors.
+
+TPU-native: segments are trained by weight-masking the SHARED device-resident
+frame (zero weight = excluded row) — every segment's program has identical
+static shapes, so XLA compiles the algorithm once and segments differ only in
+an input array. The reference instead carves physical sub-frames per segment
+(``SegmentModelsBuilder.makeSegmentFrame``).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.utils.registry import DKV
+
+
+class SegmentModels:
+    """Per-segment training results (reference: hex/segments/SegmentModels.java)."""
+
+    def __init__(self, key: str, segment_cols: list[str], rows: list[dict]):
+        self.key = key
+        self.segment_cols = segment_cols
+        self.rows = rows          # dicts: segment values + model/status/errors
+        DKV.put(key, self)
+
+    def as_frame(self) -> Frame:
+        """Columns: segment cols…, model_id, status, errors (h2o-py
+        ``H2OSegmentModels.as_frame``)."""
+        names, vecs = [], []
+        for c in self.segment_cols:
+            vals = np.array([str(r["segment"][c]) for r in self.rows], dtype=object)
+            names.append(c)
+            vecs.append(Vec.from_numpy(vals, VecType.STR))
+        for field in ("model_id", "status", "errors"):
+            vals = np.array([r.get(field) or "" for r in self.rows], dtype=object)
+            names.append(field)
+            vecs.append(Vec.from_numpy(vals, VecType.STR))
+        return Frame(names, vecs)
+
+    def get_model(self, **segment_values):
+        for r in self.rows:
+            if all(str(r["segment"].get(k)) == str(v)
+                   for k, v in segment_values.items()):
+                if r["model_id"]:
+                    return DKV.get(r["model_id"])
+                return None
+        raise KeyError(f"no segment {segment_values}")
+
+    def __len__(self):
+        return len(self.rows)
+
+
+def train_segments(builder, segments: list[str], frame: Frame, y: str,
+                   x: list[str] | None = None,
+                   segment_models_id: str | None = None) -> SegmentModels:
+    """Train ``builder``'s algorithm once per unique segment combo.
+
+    ``builder``: a configured ModelBuilder instance (its params are reused for
+    every segment; a fresh builder is constructed per segment)."""
+    seg_cols = list(segments)
+    if not seg_cols:
+        raise ValueError("segments must name at least one column")
+    xs = [c for c in (x if x is not None else frame.names)
+          if c != y and c not in seg_cols]
+
+    # enumerate observed combos (host side — segment counts are small)
+    seg_vals = []
+    for c in seg_cols:
+        v = frame.vec(c)
+        seg_vals.append(v.labels() if v.is_categorical else
+                        np.asarray(v.to_numpy(), dtype=object))
+    def _is_na(e):
+        return e is None or (isinstance(e, (float, np.floating)) and np.isnan(e))
+
+    # NA segment values are excluded, as the reference does
+    combos = sorted({tuple(t) for t in zip(*seg_vals)
+                     if not any(_is_na(e) for e in t)}, key=str)
+
+    rows = []
+    for combo in combos:
+        mask_host = np.ones(frame.nrows, bool)
+        for vals, want in zip(seg_vals, combo):
+            mask_host &= np.array([v == want for v in vals])
+        plen = frame.plen
+        padded = np.zeros(plen, np.float32)
+        padded[: frame.nrows] = mask_host.astype(np.float32)
+        wseg = jnp.asarray(padded)
+        seg_desc = dict(zip(seg_cols, combo))
+        entry = dict(segment=seg_desc, model_id=None, status="PENDING", errors=None)
+        try:
+            b = type(builder)(**builder.params)
+            model = b.train(x=xs, y=y, training_frame=frame, weights=wseg)
+            entry["model_id"] = model.key
+            entry["status"] = "SUCCEEDED"
+        except Exception as e:                        # noqa: BLE001
+            entry["status"] = "FAILED"
+            entry["errors"] = f"{type(e).__name__}: {e}"
+            entry["traceback"] = traceback.format_exc()
+        rows.append(entry)
+
+    import uuid
+    key = segment_models_id or f"segment_models_{uuid.uuid4().hex[:8]}"
+    return SegmentModels(key, seg_cols, rows)

@@ -1,0 +1,92 @@
+"""CreateFrame / interaction / tf_idf / rebalance tests
+(reference: hex/createframe, fvec/CreateInteractions, hex/tfidf,
+fvec/RebalanceDataSet)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.utils import create_frame, interaction, rebalance, tf_idf
+
+
+def test_create_frame_types_and_counts():
+    fr = create_frame(rows=500, cols=10, categorical_fraction=0.3,
+                      integer_fraction=0.2, binary_fraction=0.1,
+                      factors=5, missing_fraction=0.05, has_response=True,
+                      response_factors=3, seed=11)
+    assert fr.nrows == 500
+    assert fr.ncols == 11                      # response + 10
+    t = fr.types
+    assert t["response"] == "enum"
+    assert sum(1 for v in t.values() if v == "enum") == 4   # 3 cats + response
+    # missing values present at roughly the requested rate
+    na = sum(fr.vec(c).na_cnt() for c in fr.names if c != "response")
+    assert na > 0
+
+
+def test_create_frame_constant():
+    fr = create_frame(rows=100, cols=3, randomize=False, value=7.0,
+                      categorical_fraction=0, integer_fraction=0,
+                      binary_fraction=0, missing_fraction=0, seed=1)
+    assert np.allclose(fr.vec("C1").to_numpy(), 7.0)
+
+
+def test_interaction_pairwise():
+    fr = Frame.from_arrays({
+        "a": np.array(["x", "x", "y", "y", "x"]),
+        "b": np.array(["1", "2", "1", "2", "1"]),
+        "c": np.array(["p", "p", "q", "p", "p"]),
+    })
+    out = interaction(fr, ["a", "b", "c"], pairwise=True)
+    assert out.names == ["a_b", "a_c", "b_c"]
+    lab = out.vec("a_b").labels()
+    assert list(lab) == ["x_1", "x_2", "y_1", "y_2", "x_1"]
+
+
+def test_interaction_max_factors_and_na():
+    fr = Frame.from_arrays({
+        "a": np.array(["x", "x", "x", "y", "z", None], dtype=object),
+        "b": np.array(["1", "1", "2", "1", "2", "1"], dtype=object),
+    })
+    out = interaction(fr, ["a", "b"], max_factors=2)
+    v = out.vec("a_b")
+    assert "other" in v.domain
+    assert len(v.domain) == 3                  # 2 kept + other
+    assert v.labels()[5] is None               # NA component → NA interaction
+
+
+def test_tf_idf():
+    fr = Frame.from_arrays({
+        "doc": np.array([0, 0, 1, 1, 1], np.float32),
+        "word": np.array(["cat", "cat", "cat", "dog", "dog"], dtype=object),
+    })
+    out = tf_idf(fr, "doc", "word", preprocess=False)
+    rows = {(float(d), w): (tf, idf) for d, w, tf, idf in zip(
+        out.vec("doc").to_numpy(), out.vec("word").to_numpy(),
+        out.vec("TF").to_numpy(), out.vec("IDF").to_numpy())}
+    assert rows[(0.0, "cat")][0] == 2.0
+    assert rows[(1.0, "dog")][0] == 2.0
+    # idf = log((N+1)/(df+1)); cat appears in both docs → log(3/3)=0
+    assert rows[(0.0, "cat")][1] == pytest.approx(0.0)
+    assert rows[(1.0, "dog")][1] == pytest.approx(np.log(3 / 2), rel=1e-5)
+
+
+def test_tf_idf_preprocess_splits_text():
+    fr = Frame.from_arrays({
+        "doc": np.array([0, 1], np.float32),
+        "text": np.array(["the cat sat", "the dog"], dtype=object),
+    })
+    out = tf_idf(fr, "doc", "text", preprocess=True, case_sensitive=False)
+    words = set(out.vec("text").to_numpy())
+    assert words == {"the", "cat", "sat", "dog"}
+
+
+def test_rebalance_preserves_data(rng):
+    fr = Frame.from_arrays({
+        "x": rng.normal(size=37).astype(np.float32),
+        "c": rng.choice(["a", "b"], size=37),
+    })
+    rb = rebalance(fr)
+    assert rb.nrows == 37
+    np.testing.assert_allclose(rb.vec("x").to_numpy(), fr.vec("x").to_numpy())
+    assert list(rb.vec("c").labels()) == list(fr.vec("c").labels())
