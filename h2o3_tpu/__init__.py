@@ -25,6 +25,7 @@ from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
 from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
                               save_model)
 from h2o3_tpu.genmodel import import_mojo
+from h2o3_tpu.explanation import explain, ice, partial_dependence, shap_summary
 from h2o3_tpu.utils.registry import DKV
 
 __version__ = "0.1.0"
@@ -46,6 +47,10 @@ __all__ = [
     "save_model",
     "load_model",
     "import_mojo",
+    "explain",
+    "partial_dependence",
+    "ice",
+    "shap_summary",
     "get_mesh",
     "set_mesh",
     "mesh_context",

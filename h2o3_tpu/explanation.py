@@ -1,0 +1,212 @@
+"""Model explainability — partial dependence, ICE, SHAP summaries, varimp maps.
+
+Reference: h2o-py ``h2o/explanation/_explain.py`` (varimp heatmap, model
+correlation, SHAP summary, PD plots, ICE) and the server-side partial
+dependence task ``h2o-core/.../water/api/ModelMetricsHandler`` +
+``hex/PartialDependence.java`` (grid of column values → mean prediction with
+the column overridden, std over rows).
+
+All functions return DATA (Frames / dicts) rather than figures — the client
+side of the reference renders matplotlib from the same tables.
+
+TPU-native: a PD grid point overrides one column of the device-resident
+design and re-scores — each grid value is one jitted batch score; ICE keeps
+the per-row predictions instead of the mean. SHAP summaries ride the exact
+TreeSHAP contributions (``h2o3_tpu/genmodel/treeshap.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+
+def _response_col(model, raw: np.ndarray) -> np.ndarray:
+    """Collapse a prediction matrix to the 'response' curve: p(class 1) for
+    binomial (reference PD plots track the positive class), else the raw
+    regression prediction."""
+    if raw.ndim == 2 and raw.shape[1] == 2:
+        return raw[:, 1]
+    if raw.ndim == 2:
+        return raw.max(axis=1)
+    return raw
+
+
+def _grid_for(frame: Frame, col: str, nbins: int):
+    v = frame.vec(col)
+    if v.is_categorical:
+        return list(range(len(v.domain))), list(v.domain)
+    x = np.asarray(v.to_numpy(), np.float64)
+    x = x[np.isfinite(x)]
+    if x.size == 0:
+        raise ValueError(f"column {col!r} has no finite values")
+    grid = np.linspace(x.min(), x.max(), nbins)
+    return list(grid), [float(g) for g in grid]
+
+
+def partial_dependence(model, frame: Frame, cols: list[str] | str,
+                       nbins: int = 20, weight_column: str | None = None
+                       ) -> list[Frame]:
+    """Per-column PD tables (h2o-py ``model.partial_plot(..., plot=False)``):
+    rows = (value, mean_response, stddev_response, std_error_mean_response)."""
+    import jax
+    if isinstance(cols, str):
+        cols = [cols]
+    w = None
+    if weight_column is not None:
+        w = np.asarray(frame.vec(weight_column).to_numpy(), np.float64)
+    out = []
+    for col in cols:
+        grid, labels = _grid_for(frame, col, nbins)
+        means, sds, ses = [], [], []
+        for gv in grid:
+            fr2 = _override(frame, col, gv)
+            raw = np.asarray(jax.device_get(model._score_raw(fr2)))[: frame.nrows]
+            resp = _response_col(model, raw)
+            if w is not None:
+                m = float(np.average(resp, weights=w))
+                sd = float(np.sqrt(np.average((resp - m) ** 2, weights=w)))
+            else:
+                m, sd = float(resp.mean()), float(resp.std())
+            means.append(m)
+            sds.append(sd)
+            ses.append(sd / np.sqrt(max(len(resp), 1)))
+        value_vec = (Vec.from_numpy(np.array(labels, dtype=object), VecType.STR)
+                     if frame.vec(col).is_categorical
+                     else Vec.from_numpy(np.array(labels, np.float32)))
+        out.append(Frame(
+            [col, "mean_response", "stddev_response", "std_error_mean_response"],
+            [value_vec,
+             Vec.from_numpy(np.array(means, np.float32)),
+             Vec.from_numpy(np.array(sds, np.float32)),
+             Vec.from_numpy(np.array(ses, np.float32))]))
+    return out
+
+
+def _override(frame: Frame, col: str, value) -> Frame:
+    """Frame view with one column replaced by a constant (device-side fill)."""
+    import jax.numpy as jnp
+    v = frame.vec(col)
+    names, vecs = [], []
+    for name in frame.names:
+        if name != col:
+            names.append(name)
+            vecs.append(frame.vec(name))
+            continue
+        if v.is_categorical:
+            data = jnp.full_like(v.data, int(value))
+            nv = Vec.from_device(data, v.nrows, VecType.CAT, domain=v.domain)
+        else:
+            data = jnp.full_like(v.data, float(value))
+            nv = Vec.from_device(data, v.nrows, v.type)
+        names.append(name)
+        vecs.append(nv)
+    return Frame(names, vecs)
+
+
+def ice(model, frame: Frame, col: str, nbins: int = 20,
+        max_rows: int = 100, seed: int = 42) -> Frame:
+    """Individual Conditional Expectation curves (h2o-py ``ice_plot`` data):
+    one row per (sampled original row, grid value)."""
+    import jax
+    rng = np.random.default_rng(seed)
+    n = min(max_rows, frame.nrows)
+    row_ids = np.sort(rng.choice(frame.nrows, size=n, replace=False))
+    grid, labels = _grid_for(frame, col, nbins)
+    rows_id, rows_val, rows_resp = [], [], []
+    for gv, lab in zip(grid, labels):
+        fr2 = _override(frame, col, gv)
+        raw = np.asarray(jax.device_get(model._score_raw(fr2)))[: frame.nrows]
+        resp = _response_col(model, raw)[row_ids]
+        rows_id.extend(row_ids.tolist())
+        rows_val.extend([lab] * n)
+        rows_resp.extend(resp.tolist())
+    value_vec = (Vec.from_numpy(np.array(rows_val, dtype=object), VecType.STR)
+                 if frame.vec(col).is_categorical
+                 else Vec.from_numpy(np.array(rows_val, np.float32)))
+    return Frame(["row", col, "response"],
+                 [Vec.from_numpy(np.array(rows_id, np.float32)),
+                  value_vec,
+                  Vec.from_numpy(np.array(rows_resp, np.float32))])
+
+
+def shap_summary(model, frame: Frame, top_n: int = 20):
+    """Mean |SHAP| per feature (the bar data of h2o-py's shap_summary_plot).
+
+    Requires a model with ``predict_contributions`` (tree models)."""
+    if not hasattr(model, "predict_contributions"):
+        raise ValueError(f"{model.algo} does not support SHAP contributions")
+    contrib = model.predict_contributions(frame)
+    rows = []
+    for name in contrib.names:
+        if name == "BiasTerm":
+            continue
+        phi = np.asarray(contrib.vec(name).to_numpy())
+        rows.append((name, float(np.abs(phi).mean()), float(phi.mean())))
+    rows.sort(key=lambda r: -r[1])
+    return rows[:top_n]
+
+
+def varimp_heatmap(models) -> dict:
+    """Scaled variable importances per model (h2o-py ``varimp_heatmap`` data):
+    {'columns': [...], 'models': [...], 'matrix': [[...]]}."""
+    all_cols: list[str] = []
+    per_model = []
+    names = []
+    for m in models:
+        vi = {r[0]: r[2] for r in m.varimp()}     # scaled importance
+        per_model.append(vi)
+        names.append(m.key)
+        for c in vi:
+            if c not in all_cols:
+                all_cols.append(c)
+    matrix = [[vi.get(c, 0.0) for c in all_cols] for vi in per_model]
+    return {"columns": all_cols, "models": names, "matrix": matrix}
+
+
+def model_correlation(models, frame: Frame) -> dict:
+    """Pairwise correlation of model predictions on a frame (h2o-py
+    ``model_correlation_heatmap`` data)."""
+    import jax
+    preds = []
+    names = []
+    for m in models:
+        raw = np.asarray(jax.device_get(m._score_raw(frame)))[: frame.nrows]
+        preds.append(_response_col(m, raw))
+        names.append(m.key)
+    P = np.stack(preds)
+    C = np.corrcoef(P)
+    return {"models": names, "matrix": C.tolist()}
+
+
+def explain(models, frame: Frame, top_n_features: int = 5) -> dict:
+    """One-call explanation bundle (h2o-py ``h2o.explain``): leaderboard-ish
+    summary, varimp heatmap (multi-model), model correlation, per-model PD
+    for the top features, SHAP summary where supported."""
+    if not isinstance(models, (list, tuple)):
+        models = [models]
+    result: dict = {}
+    with_vi = [m for m in models if hasattr(m, "varimp")]
+    if len(models) > 1:
+        if with_vi:
+            result["varimp_heatmap"] = varimp_heatmap(with_vi)
+        result["model_correlation"] = model_correlation(models, frame)
+    per_model = {}
+    for m in models:
+        entry: dict = {}
+        if hasattr(m, "varimp"):
+            vi = m.varimp()
+            entry["varimp"] = vi
+            top = [r[0] for r in vi[:top_n_features]]
+            entry["partial_dependence"] = {
+                c: pd for c, pd in zip(top, partial_dependence(m, frame, top))}
+        try:
+            entry["shap_summary"] = shap_summary(m, frame)
+        except (ValueError, KeyError):
+            pass
+        per_model[m.key] = entry
+    result["models"] = per_model
+    return result

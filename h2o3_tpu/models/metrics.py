@@ -56,7 +56,75 @@ class ModelMetricsBinomial(MetricsBase):
     mean_per_class_error: float
     max_f1_threshold: float
     confusion_matrix: np.ndarray  # 2x2 at max-F1 threshold, rows=actual
+    ks: float = 0.0               # Kolmogorov-Smirnov (max TPR-FPR)
     gini: float = dataclasses.field(init=False)
+    # score histograms retained for gains/lift (not shown in repr)
+    _tp_h: np.ndarray | None = dataclasses.field(default=None, repr=False)
+    _fp_h: np.ndarray | None = dataclasses.field(default=None, repr=False)
+    _s_h: np.ndarray | None = dataclasses.field(default=None, repr=False)
+
+    def gains_lift(self, groups: int = 16):
+        """Gains/Lift table rows (reference: ``hex/GainsLift.java`` — the
+        TwoDimTable columns ``GainsLift.java:150``). Groups are quantile bins
+        of the predicted score, resolved on the 400-bin AUC histogram (the
+        reference runs a separate Quantile model; same table up to bin
+        granularity)."""
+        if self._tp_h is None:
+            return []
+        tp_h = np.asarray(self._tp_h, np.float64)[::-1]   # descending score
+        fp_h = np.asarray(self._fp_h, np.float64)[::-1]
+        s_h = np.asarray(self._s_h, np.float64)[::-1]
+        n_h = tp_h + fp_h
+        N = n_h.sum()
+        E = tp_h.sum()
+        if N <= 0:
+            return []
+        P = E / N
+        cum_n = np.cumsum(n_h)
+        cum_e = np.cumsum(tp_h)
+        cum_s = np.cumsum(s_h)
+        nb = len(n_h)
+        rows = []
+        prev_idx = -1
+        prev = np.zeros(3)
+        for g in range(groups):
+            target = N * (g + 1) / groups
+            idx = int(np.searchsorted(cum_n, target - 1e-9))
+            idx = min(idx, nb - 1)
+            if idx <= prev_idx and g < groups - 1:
+                continue                      # empty group (coarse histogram)
+            idx = nb - 1 if g == groups - 1 else idx
+            e_i = cum_e[idx] - prev[0]
+            n_i = cum_n[idx] - prev[1]
+            s_i = cum_s[idx] - prev[2]
+            if n_i <= 0:
+                continue
+            p_i = e_i / n_i
+            lift = p_i / P if P > 0 else np.nan
+            cum_lift = cum_e[idx] / cum_n[idx] / P if P > 0 else np.nan
+            cum_event = cum_e[idx] / max(E, 1e-30)
+            tot_ne = N - E
+            cum_non_event = 0.0 if tot_ne == 0 else \
+                (cum_n[idx] - cum_e[idx]) / tot_ne
+            rows.append(dict(
+                group=len(rows) + 1,
+                cumulative_data_fraction=cum_n[idx] / N,
+                lower_threshold=(nb - 1 - idx) / nb,
+                lift=lift,
+                cumulative_lift=cum_lift,
+                response_rate=p_i,
+                score=s_i / n_i,
+                cumulative_response_rate=cum_e[idx] / cum_n[idx],
+                cumulative_score=cum_s[idx] / cum_n[idx],
+                capture_rate=e_i / max(E, 1e-30),
+                cumulative_capture_rate=cum_event,
+                gain=100 * (lift - 1) if np.isfinite(lift) else np.nan,
+                cumulative_gain=100 * (cum_lift - 1) if np.isfinite(cum_lift) else np.nan,
+                kolmogorov_smirnov=cum_event - cum_non_event,
+            ))
+            prev_idx = idx
+            prev = np.array([cum_e[idx], cum_n[idx], cum_s[idx]])
+        return rows
 
     def __post_init__(self):
         self.gini = 2.0 * self.auc - 1.0
@@ -132,7 +200,8 @@ def _binomial_pass(p, y, mask, nbins=NBINS):
     bins = jnp.where(mask, bins, 0)
     tp_h = jax.ops.segment_sum(w * y, bins, num_segments=nbins)
     fp_h = jax.ops.segment_sum(w * (1.0 - y), bins, num_segments=nbins)
-    return dict(n=n, logloss=logloss, mse=mse, tp_h=tp_h, fp_h=fp_h)
+    s_h = jax.ops.segment_sum(w * p, bins, num_segments=nbins)
+    return dict(n=n, logloss=logloss, mse=mse, tp_h=tp_h, fp_h=fp_h, s_h=s_h)
 
 
 def binomial_metrics(p: jax.Array, y: jax.Array, mask: jax.Array) -> ModelMetricsBinomial:
@@ -160,10 +229,12 @@ def binomial_metrics(p: jax.Array, y: jax.Array, mask: jax.Array) -> ModelMetric
     fn, tn = P - tp, N - fp
     cm = np.array([[tn, fp], [fn, tp]])
     mpce = 0.5 * (fp / max(N, 1e-30) + fn / max(P, 1e-30))
+    ks = float(np.max(tps / max(P, 1e-30) - fps / max(N, 1e-30)))
     return ModelMetricsBinomial(
         nobs=int(r["n"]), mse=float(r["mse"]), auc=auc, pr_auc=pr_auc,
         logloss=float(r["logloss"]), mean_per_class_error=float(mpce),
-        max_f1_threshold=float(thr), confusion_matrix=cm)
+        max_f1_threshold=float(thr), confusion_matrix=cm, ks=ks,
+        _tp_h=tp_h, _fp_h=fp_h, _s_h=np.asarray(r["s_h"], np.float64))
 
 
 # -- multinomial --------------------------------------------------------------

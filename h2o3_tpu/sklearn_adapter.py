@@ -1,0 +1,141 @@
+"""scikit-learn adapters — fit/predict wrappers around the estimators.
+
+Reference: ``h2o-py/h2o/sklearn/`` (generated ``H2O*Classifier`` /
+``H2O*Regressor`` wrappers implementing the sklearn estimator protocol:
+``fit(X, y) → self``, ``predict``, ``predict_proba``, ``get_params`` /
+``set_params``, ``score``). No hard sklearn dependency — the protocol is
+duck-typed, so these work standalone and also pass sklearn's
+``check_estimator``-style usage inside pipelines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+
+def _to_frame(X, y=None, classification=False) -> tuple[Frame, list[str], str | None]:
+    X = np.asarray(X)
+    if X.ndim != 2:
+        raise ValueError("X must be 2-D")
+    cols = {f"x{i}": X[:, i].astype(np.float32) for i in range(X.shape[1])}
+    names = list(cols)
+    ycol = None
+    if y is not None:
+        y = np.asarray(y)
+        ycol = "target"
+        if classification:
+            cols[ycol] = np.array([str(v) for v in y], dtype=object)
+        else:
+            cols[ycol] = y.astype(np.float32)
+    fr = Frame.from_arrays(cols)
+    return fr, names, ycol
+
+
+class _H2OSklearnBase:
+    """Mixin implementing the sklearn estimator protocol over a ModelBuilder."""
+
+    _builder_cls = None
+    _classification = False
+
+    def __init__(self, **params):
+        self._params = dict(params)
+        self.model_ = None
+
+    # sklearn protocol ------------------------------------------------------
+    def get_params(self, deep=True):
+        return dict(self._params)
+
+    def set_params(self, **params):
+        self._params.update(params)
+        return self
+
+    def fit(self, X, y=None):
+        fr, names, ycol = _to_frame(X, y, self._classification)
+        builder = self._builder_cls(**self._params)
+        if getattr(builder, "unsupervised", False) or ycol is None:
+            self.model_ = builder.train(x=names, training_frame=fr)
+        else:
+            self.model_ = builder.train(x=names, y=ycol, training_frame=fr)
+        if self._classification and self.model_.response_domain:
+            self.classes_ = np.array(list(self.model_.response_domain))
+        return self
+
+    def _check_fitted(self):
+        if self.model_ is None:
+            raise RuntimeError("call fit() first")
+
+    def predict(self, X):
+        self._check_fitted()
+        fr, _, _ = _to_frame(X)
+        pred = self.model_.predict(fr)
+        v = pred.vec("predict")
+        if v.is_categorical:
+            return np.asarray(v.labels())
+        return np.asarray(v.to_numpy())
+
+    def score(self, X, y):
+        if self._classification:
+            return float((self.predict(X) == np.array([str(v) for v in y])).mean())
+        pred = self.predict(X).astype(np.float64)
+        y = np.asarray(y, np.float64)
+        ss_res = np.sum((y - pred) ** 2)
+        ss_tot = np.sum((y - y.mean()) ** 2)
+        return float(1.0 - ss_res / max(ss_tot, 1e-30))
+
+
+class _H2OSklearnClassifier(_H2OSklearnBase):
+    _classification = True
+
+    def predict_proba(self, X):
+        self._check_fitted()
+        fr, _, _ = _to_frame(X)
+        pred = self.model_.predict(fr)
+        probs = [np.asarray(pred.vec(f"p{d}").to_numpy())
+                 for d in self.model_.response_domain]
+        return np.stack(probs, axis=1)
+
+
+def _make(name: str, builder_path: str, classifier: bool):
+    """Build a named wrapper class; the builder import is resolved lazily at
+    first fit() to avoid import cycles."""
+    import importlib
+    mod_name, cls_name = builder_path.rsplit(".", 1)
+    base = _H2OSklearnClassifier if classifier else _H2OSklearnBase
+    orig_fit = base.fit
+
+    def fit(self, X, y=None):
+        if type(self)._builder_cls is None:
+            type(self)._builder_cls = getattr(
+                importlib.import_module(mod_name), cls_name)
+        return orig_fit(self, X, y)
+
+    return type(name, (base,), {"fit": fit, "_builder_cls": None,
+                                "__qualname__": name})
+
+
+H2OGradientBoostingClassifier = _make(
+    "H2OGradientBoostingClassifier", "h2o3_tpu.models.gbm.GBM", True)
+H2OGradientBoostingRegressor = _make(
+    "H2OGradientBoostingRegressor", "h2o3_tpu.models.gbm.GBM", False)
+H2ORandomForestClassifier = _make(
+    "H2ORandomForestClassifier", "h2o3_tpu.models.gbm.DRF", True)
+H2ORandomForestRegressor = _make(
+    "H2ORandomForestRegressor", "h2o3_tpu.models.gbm.DRF", False)
+H2OGeneralizedLinearClassifier = _make(
+    "H2OGeneralizedLinearClassifier", "h2o3_tpu.models.glm.GLM", True)
+H2OGeneralizedLinearRegressor = _make(
+    "H2OGeneralizedLinearRegressor", "h2o3_tpu.models.glm.GLM", False)
+H2ODeepLearningClassifier = _make(
+    "H2ODeepLearningClassifier", "h2o3_tpu.models.deeplearning.DeepLearning", True)
+H2ODeepLearningRegressor = _make(
+    "H2ODeepLearningRegressor", "h2o3_tpu.models.deeplearning.DeepLearning", False)
+H2OXGBoostClassifier = _make(
+    "H2OXGBoostClassifier", "h2o3_tpu.models.xgboost.XGBoost", True)
+H2OXGBoostRegressor = _make(
+    "H2OXGBoostRegressor", "h2o3_tpu.models.xgboost.XGBoost", False)
+H2OKMeansEstimator = _make(
+    "H2OKMeansEstimator", "h2o3_tpu.models.kmeans.KMeans", False)

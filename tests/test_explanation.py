@@ -1,0 +1,89 @@
+"""Explanation module + sklearn adapter tests
+(reference: h2o-py explanation/_explain.py, h2o-py/h2o/sklearn)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import GBM, GLM
+
+
+@pytest.fixture
+def binfr(rng):
+    n = 400
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    cat = rng.choice(["u", "v"], size=n)
+    logit = 2.0 * X[:, 0] - X[:, 1] + (cat == "u")
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "yes", "no")
+    return Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+                              "cat": cat, "y": y})
+
+
+def test_partial_dependence(binfr):
+    from h2o3_tpu.explanation import partial_dependence
+    m = GBM(ntrees=10, max_depth=3, seed=1).train(y="y", training_frame=binfr)
+    tables = partial_dependence(m, binfr, ["x0", "cat"], nbins=8)
+    t0 = tables[0]
+    assert t0.names == ["x0", "mean_response", "stddev_response",
+                        "std_error_mean_response"]
+    assert t0.nrows == 8
+    resp = t0.vec("mean_response").to_numpy()
+    # x0 drives the logit up → PD curve increases end-to-end
+    assert resp[-1] > resp[0] + 0.1
+    tcat = tables[1]
+    assert tcat.nrows == 2     # two category levels
+
+
+def test_ice(binfr):
+    from h2o3_tpu.explanation import ice
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=binfr)
+    curves = ice(m, binfr, "x0", nbins=5, max_rows=10)
+    assert curves.nrows == 50
+    assert set(curves.names) == {"row", "x0", "response"}
+
+
+def test_shap_summary_and_heatmaps(binfr):
+    from h2o3_tpu.explanation import (explain, model_correlation, shap_summary,
+                                      varimp_heatmap)
+    m1 = GBM(ntrees=10, max_depth=3, seed=1).train(y="y", training_frame=binfr)
+    m2 = GLM(family="binomial", lambda_=0.0).train(y="y", training_frame=binfr)
+    rows = shap_summary(m1, binfr)
+    assert rows[0][0] in ("x0", "x1", "cat")   # signal features dominate
+    hm = varimp_heatmap([m1, m2])
+    assert set(hm["columns"]) == {"x0", "x1", "x2", "cat"}
+    assert len(hm["matrix"]) == 2
+    mc = model_correlation([m1, m2], binfr)
+    C = np.array(mc["matrix"])
+    assert C.shape == (2, 2)
+    assert C[0, 1] > 0.7       # both models learned the same signal
+    bundle = explain([m1, m2], binfr)
+    assert "model_correlation" in bundle
+    assert m1.key in bundle["models"]
+    assert "shap_summary" in bundle["models"][m1.key]
+
+
+def test_sklearn_classifier(rng):
+    from h2o3_tpu.sklearn_adapter import H2OGradientBoostingClassifier
+    n = 300
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    clf = H2OGradientBoostingClassifier(ntrees=10, max_depth=3, seed=1)
+    assert clf.get_params()["ntrees"] == 10
+    clf.fit(X, y)
+    acc = clf.score(X, y)
+    assert acc > 0.85
+    proba = clf.predict_proba(X)
+    assert proba.shape == (n, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert set(clf.predict(X)) <= {"0", "1"}
+
+
+def test_sklearn_regressor_and_setparams(rng):
+    from h2o3_tpu.sklearn_adapter import H2OGeneralizedLinearRegressor
+    n = 200
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] - X[:, 2] + rng.normal(scale=0.1, size=n)
+    reg = H2OGeneralizedLinearRegressor(lambda_=0.0)
+    reg.set_params(max_iterations=20)
+    reg.fit(X, y)
+    assert reg.score(X, y) > 0.95

@@ -1,6 +1,7 @@
 """Metrics parity tests (reference: hex/AUC2, ModelMetrics* semantics)."""
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from h2o3_tpu.frame.vec import Vec
@@ -57,3 +58,26 @@ def test_multinomial_metrics(rng):
     np.testing.assert_allclose(m.logloss, log_loss(y, probs, labels=list(range(k))), rtol=1e-4)
     np.testing.assert_array_equal(m.confusion_matrix, confusion_matrix(y, probs.argmax(1)))
     assert m.accuracy > 0.7
+
+
+def test_gains_lift_table(rng):
+    """Reference: hex/GainsLift.java — table invariants at the last row:
+    cumulative data fraction 1.0, cumulative capture rate 1.0, cum lift 1.0."""
+    import jax.numpy as jnp
+    from h2o3_tpu.models.metrics import binomial_metrics
+
+    n = 4000
+    p = rng.random(n).astype(np.float32)
+    y = (rng.random(n) < p).astype(np.float32)   # well-calibrated scores
+    m = binomial_metrics(jnp.asarray(p), jnp.asarray(y), jnp.ones(n, bool))
+    gl = m.gains_lift(groups=16)
+    assert 10 <= len(gl) <= 16
+    last = gl[-1]
+    assert last["cumulative_data_fraction"] == pytest.approx(1.0, abs=1e-9)
+    assert last["cumulative_capture_rate"] == pytest.approx(1.0, abs=1e-9)
+    assert last["cumulative_lift"] == pytest.approx(1.0, abs=1e-6)
+    # calibrated scores → top group lift well above 1, monotone-ish capture
+    assert gl[0]["lift"] > 1.5
+    assert m.ks > 0.3
+    # KS column max matches the scalar KS metric up to binning
+    assert max(r["kolmogorov_smirnov"] for r in gl) == pytest.approx(m.ks, abs=0.05)
