@@ -135,6 +135,12 @@ class Model:
         from h2o3_tpu.genmodel.mojo import write_mojo
         return write_mojo(self, path)
 
+    def download_pojo(self, path: str) -> str:
+        """Export standalone scoring source (h2o-py: ``download_pojo``; here
+        a numpy-only Python module instead of a Java class)."""
+        from h2o3_tpu.genmodel.codegen import download_pojo
+        return download_pojo(self, path)
+
     def save(self, path: str) -> str:
         """Binary model save (h2o-py: ``h2o.save_model``)."""
         from h2o3_tpu.persist.model_io import save_model
