@@ -321,6 +321,55 @@ class _Handler(BaseHTTPRequestHandler):
         gc.collect()
         self._reply({"__meta": {"schema_type": "GarbageCollectV3"}})
 
+    # -- observability (reference: TimelineHandler, JStackHandler,
+    #    ProfilerHandler, WaterMeter* behind /3/Timeline,/3/JStack,
+    #    /3/Profiler,/3/WaterMeterCpuTicks,/3/WaterMeterIo) -----------------
+
+    def r_timeline(self):
+        from h2o3_tpu.utils.timeline import TIMELINE
+        self._reply({"__meta": {"schema_type": "TimelineV3"},
+                     "events": TIMELINE.snapshot()})
+
+    def r_jstack(self):
+        from h2o3_tpu.utils.timeline import jstack
+        self._reply({"__meta": {"schema_type": "JStackV3"},
+                     "traces": jstack()})
+
+    def r_profiler(self):
+        # reference: ProfileCollectorTask samples stacks `depth` times
+        import time as _t
+        from h2o3_tpu.utils.timeline import jstack
+        p = self._params()
+        samples = max(1, min(int(p.get("depth", 5)), 50))
+        counts: dict[str, int] = {}
+        for _ in range(samples):
+            for tr in jstack():
+                counts[tr["stack"]] = counts.get(tr["stack"], 0) + 1
+            _t.sleep(0.01)
+        entries = sorted(counts.items(), key=lambda kv: -kv[1])
+        self._reply({"__meta": {"schema_type": "ProfilerV3"},
+                     "stacktraces": [s for s, _ in entries],
+                     "counts": [c for _, c in entries]})
+
+    def r_cpu_ticks(self):
+        from h2o3_tpu.utils.timeline import cpu_ticks
+        self._reply({"__meta": {"schema_type": "WaterMeterCpuTicksV3"},
+                     "cpu_ticks": cpu_ticks()})
+
+    def r_io_meter(self):
+        from h2o3_tpu.utils.timeline import io_stats
+        self._reply({"__meta": {"schema_type": "WaterMeterIoV3"},
+                     "persist_stats": io_stats()})
+
+    def r_logs(self):
+        # reference: LogsHandler /3/Logs/nodes/{n}/files/{name}
+        import logging
+        self._reply({"__meta": {"schema_type": "LogsV3"},
+                     "log": "\n".join(
+                         h.format(r) if hasattr(h, "format") else str(r)
+                         for h in logging.getLogger("h2o3_tpu").handlers
+                         for r in getattr(h, "buffer", []))})
+
 
 _ROUTES = [
     (r"/3/Cloud", "GET", _Handler.r_cloud),
@@ -344,6 +393,12 @@ _ROUTES = [
     (r"/99/AutoMLBuilder", "POST", _Handler.r_automl),
     (r"/3/Shutdown", "POST", _Handler.r_shutdown),
     (r"/3/GarbageCollect", "POST", _Handler.r_gc),
+    (r"/3/Timeline", "GET", _Handler.r_timeline),
+    (r"/3/JStack", "GET", _Handler.r_jstack),
+    (r"/3/Profiler", "GET", _Handler.r_profiler),
+    (r"/3/WaterMeterCpuTicks/\d+", "GET", _Handler.r_cpu_ticks),
+    (r"/3/WaterMeterIo", "GET", _Handler.r_io_meter),
+    (r"/3/Logs", "GET", _Handler.r_logs),
 ]
 
 

@@ -26,6 +26,7 @@ Two styles are supported, and most algorithm code uses the second:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Callable
 
@@ -93,7 +94,14 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
         fn = jax.jit(_shard_map(shard_body, mesh=mesh, in_specs=in_specs, out_specs=P()),
                      donate_argnums=tuple(range(len(cols))) if donate else ())
         _cache_put(key, fn)
-    return fn(*cols)
+    from h2o3_tpu.utils import timeline as _tl
+    if _tl.FAULTS is not None:
+        _tl.FAULTS.maybe_fault("map_reduce")
+    t0 = time.time_ns()
+    out = fn(*cols)
+    _tl.TIMELINE.record("collective", getattr(map_fn, "__name__", "map_reduce"),
+                        time.time_ns() - t0)
+    return out
 
 
 def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:

@@ -1,0 +1,184 @@
+"""Timeline + profiling — observability for the TPU runtime.
+
+Reference: ``water/TimeLine.java:12-42`` — per-node lock-free ring buffer of
+the last 2048 network events (every UDP/TCP send/recv, nanotime, drop bits),
+snapshotted cluster-wide via ``water/api/TimelineHandler``; sampling profiler
+``water/util/ProfileCollectorTask`` + ``JStackCollectorTask`` behind
+``/3/Profiler`` and ``/3/JStack``; per-process CPU/IO meters
+(``WaterMeterCpuTicks``, ``WaterMeterIo``).
+
+TPU-native mapping: the "network events" of this runtime are **device
+dispatches and collectives** (jit calls, host↔device transfers) — recorded
+into the same fixed-size ring buffer; thread stacks come from
+``sys._current_frames`` (the JStack analog); deep kernel-level profiles
+delegate to ``jax.profiler`` traces (the XLA-native tool); CPU/IO meters read
+``/proc``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+RING_SIZE = 2048   # reference: TimeLine.MAX_EVENTS=2048
+
+
+class TimeLine:
+    """Fixed-size event ring (reference: water/TimeLine ring buffer)."""
+
+    def __init__(self, size: int = RING_SIZE):
+        self._size = size
+        self._events: list[tuple] = [None] * size   # (ns, kind, what, dur_ns)
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, what: str, dur_ns: int = 0) -> None:
+        with self._lock:
+            self._events[self._idx % self._size] = (
+                time.time_ns(), kind, what, dur_ns)
+            self._idx += 1
+
+    def snapshot(self) -> list[dict]:
+        """Events oldest→newest (reference: TimelineHandler snapshot)."""
+        with self._lock:
+            n = min(self._idx, self._size)
+            start = self._idx - n
+            evs = [self._events[(start + i) % self._size] for i in range(n)]
+        return [dict(ns=e[0], kind=e[1], what=e[2], dur_ns=e[3])
+                for e in evs if e is not None]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = [None] * self._size
+            self._idx = 0
+
+
+TIMELINE = TimeLine()
+
+
+class timed_event:
+    """Context manager recording a timed event into the global timeline."""
+
+    def __init__(self, kind: str, what: str):
+        self.kind, self.what = kind, what
+
+    def __enter__(self):
+        self._t0 = time.time_ns()
+        return self
+
+    def __exit__(self, *exc):
+        TIMELINE.record(self.kind, self.what, time.time_ns() - self._t0)
+        return False
+
+
+def jstack() -> list[dict]:
+    """All Python thread stacks (reference: JStackCollectorTask → /3/JStack)."""
+    frames = sys._current_frames()
+    out = []
+    for th in threading.enumerate():
+        fr = frames.get(th.ident)
+        stack = traceback.format_stack(fr) if fr is not None else []
+        out.append(dict(name=th.name, daemon=th.daemon, alive=th.is_alive(),
+                        stack="".join(stack)))
+    return out
+
+
+def cpu_ticks() -> dict:
+    """Per-CPU tick counters (reference: WaterMeterCpuTicks reads /proc/stat)."""
+    out = {}
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu"):
+                    parts = line.split()
+                    out[parts[0]] = [int(v) for v in parts[1:8]]
+    except OSError:
+        pass
+    return out
+
+
+def io_stats() -> dict:
+    """Process IO counters (reference: WaterMeterIo reads /proc/self/io)."""
+    out = {}
+    try:
+        with open("/proc/self/io") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                out[k.strip()] = int(v)
+    except OSError:
+        pass
+    return out
+
+
+class FaultInjector:
+    """Random fault injection for the communication substrate (reference:
+    the ``-random_udp_drop`` flag ``water/H2O.java:446`` drops UDP packets to
+    exercise the RPC retry path; here faults hit ``map_reduce`` dispatches —
+    a random delay models a straggler shard, a raised ``FaultInjected``
+    models a lost reduction — exercising Job failure carrying and
+    grid/AutoML recovery)."""
+
+    def __init__(self, drop_rate: float = 0.0, delay_ms: float = 0.0,
+                 delay_rate: float = 0.0, seed: int = 17):
+        import random
+        self.drop_rate = drop_rate
+        self.delay_ms = delay_ms
+        self.delay_rate = delay_rate
+        self._rng = random.Random(seed)
+        self.dropped = 0
+        self.delayed = 0
+
+    def maybe_fault(self, what: str) -> None:
+        r = self._rng.random()
+        if self.drop_rate > 0 and r < self.drop_rate:
+            self.dropped += 1
+            TIMELINE.record("fault", f"drop:{what}")
+            raise FaultInjected(what)
+        if self.delay_rate > 0 and self._rng.random() < self.delay_rate:
+            self.delayed += 1
+            TIMELINE.record("fault", f"delay:{what}")
+            time.sleep(self.delay_ms / 1000.0)
+
+
+class FaultInjected(RuntimeError):
+    pass
+
+
+FAULTS: FaultInjector | None = None
+
+
+class inject_faults:
+    """Context manager enabling fault injection (tests only)."""
+
+    def __init__(self, **kw):
+        self.injector = FaultInjector(**kw)
+
+    def __enter__(self):
+        global FAULTS
+        FAULTS = self.injector
+        return self.injector
+
+    def __exit__(self, *exc):
+        global FAULTS
+        FAULTS = None
+        return False
+
+
+def start_profiler(log_dir: str) -> None:
+    """Start an XLA-level trace (reference analog: /3/Profiler; here the
+    profile is a TensorBoard-compatible jax.profiler trace, the native tool
+    for TPU kernels)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+def device_memory_profile() -> bytes:
+    import jax
+    return jax.profiler.device_memory_profile()
