@@ -77,6 +77,27 @@ def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int
     return hists
 
 
+def _histograms(binned, binned_T, node_local, g, h, w, n_nodes: int,
+                n_bins_tot: int):
+    """Dispatch: Pallas MXU kernel on TPU (≈4× the XLA scatter path inside the
+    fused tree program), segment_sum elsewhere / beyond the kernel's VMEM
+    envelope."""
+    from h2o3_tpu.ops.pallas_hist import hist_pallas, pallas_available
+    if pallas_available(n_nodes, binned.shape[1], n_bins_tot):
+        return hist_pallas(binned_T, node_local, g, h, w, n_nodes, n_bins_tot)
+    return _level_histograms(binned, node_local, g, h, w, n_nodes, n_bins_tot)
+
+
+def _node_totals(node_local, g, h, w, n_nodes: int):
+    """Per-node (G, H, W) sums — the feature-independent stats the final
+    level needs (cheaper than a full histogram build)."""
+    active = node_local >= 0
+    ghw = jnp.stack([g, h, w], axis=1)
+    vals = jnp.where(active[:, None], ghw, 0.0)
+    ids = jnp.where(active, node_local, 0)
+    return jax.ops.segment_sum(vals, ids, num_segments=n_nodes)
+
+
 def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, feat_mask):
     """Vectorized split search (reference: DTree.findBestSplitPoint).
 
@@ -140,7 +161,7 @@ def _leaf_value(G, H, W, reg_lambda, reg_alpha):
     return jnp.where(W > 0, -Gt / jnp.maximum(H + reg_lambda, 1e-30), 0.0)
 
 
-def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
+def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
                       depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                       gamma, min_split_improvement, col_rate: float):
     """Grow one whole tree on device; the level loop unrolls at trace time.
@@ -166,7 +187,7 @@ def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
             lmask = feat_mask & sub
             # the forced index may miss feat_mask; never let the level go empty
             lmask = jnp.where(lmask.any(), lmask, feat_mask)
-        hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
+        hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt)
         gain, feat, t, na_left, G, H, W = _find_splits(
             hists, B, min_rows, reg_lambda, reg_alpha, gamma, lmask)
         do = (gain > min_split_improvement) & jnp.isfinite(gain) & (W > 0)
@@ -186,10 +207,10 @@ def _grow_tree_device(binned, edges, g, h, w, feat_mask, key,
         node_local = _route_rows(binned, node_local, lv_feat[-1], lv_t[-1],
                                  na_left, do, B)
 
-    # final level: all surviving nodes become leaves
+    # final level: all surviving nodes become leaves; only per-node totals
+    # are needed (no split search), so skip the full histogram build
     N = 2 ** depth
-    hists = _level_histograms(binned, node_local, g, h, w, N, Bt)
-    tot = hists[0].reshape(N, Bt, 3).sum(axis=1)   # stats are feature-independent
+    tot = _node_totals(node_local, g, h, w, N)
     leaf = _leaf_value(tot[:, 0], tot[:, 1], tot[:, 2], reg_lambda, reg_alpha)
     lv_feat.append(jnp.full(N, -1, jnp.int32))
     lv_t.append(jnp.zeros(N, jnp.int32))
@@ -215,8 +236,9 @@ def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
                   gamma, min_split_improvement, col_rate: float):
     """K trees in ONE dispatch: vmap over the stats axis (class trees of a
     multinomial round, or K=1). binned/edges are shared (in_axes=None)."""
+    binned_T = binned.T   # once per round; the Pallas kernel wants [F, rows]
     fn = lambda gk, hk, wk, mk, kk: _grow_tree_device(
-        binned, edges, gk, hk, wk, mk, kk, depth, n_bins, min_rows,
+        binned, binned_T, edges, gk, hk, wk, mk, kk, depth, n_bins, min_rows,
         reg_lambda, reg_alpha, gamma, min_split_improvement, col_rate)
     return jax.vmap(fn)(g, h, w, feat_mask, keys)
 

@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for level-synchronous histogram building.
+
+The hot op of tree growth (reference: ``hex/tree/ScoreBuildHistogram2.java``
+— per-bin (w, wY, wYY) accumulation, SURVEY.md §2.9's "Pallas histogram-build
+kernel"). For every feature f, tree node n, bin b:
+
+    hist[f, n, b, :] = Σ_rows [node==n]·[bin_f==b]·(g, h, w)
+
+XLA's ``segment_sum`` lowering of this inside the fused tree program runs at
+~110 ms/level on 500k×28 (scatter-add serialization); this kernel instead
+rides the MXU: per (row-tile, feature) grid step it builds the transposed
+bin one-hot [S, T] on the VPU and contracts it against a per-tile
+node×stat spread matrix ns[T, N*3] (computed once per tile into VMEM
+scratch), accumulating all features' histograms in one resident VMEM output
+block. ~30 ms/level → ~4× end-to-end tree-growth speedup, measured on
+TPU v5e.
+
+Layout notes (Mosaic constraints): the bin one-hot is built TRANSPOSED
+([S, T], bins on sublanes) because dynamic lane indexing is unsupported;
+binned is passed pre-transposed [F, 1, R] so each grid step DMAs a
+contiguous [1, 1, T] row block; the per-feature output offset uses an
+8-aligned padded bin stride S.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# VMEM budget: out block F*S*(N*3)*4 + ns scratch T*(N*3)*4 + narrow input
+# blocks padded to 128 lanes. T=1024 fits comfortably for N ≤ 64, F ≤ ~100.
+_TILE = 1024
+_MAX_NODES = 64      # beyond this the resident out block would blow VMEM
+
+
+def pallas_available(n_nodes: int, n_feat: int, n_bins_tot: int) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    if n_nodes > _MAX_NODES:
+        return False
+    S = ((n_bins_tot + 7) // 8) * 8
+    vmem = n_feat * S * n_nodes * 3 * 4 + _TILE * n_nodes * 3 * 4
+    return vmem < 6 * 1024 * 1024
+
+
+def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, N, S, T):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    f = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(i == 0, f == 0))
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # ns[t, k] = (node[t] == k//3) * ghw[t, k%3]; built once per row tile
+    @pl.when(f == 0)
+    def _():
+        nd = n_ref[:, 0]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, N * 3), 1)
+        ghw_rep = jnp.concatenate([s_ref[:]] * N, axis=1)
+        ns_ref[:] = jnp.where(nd[:, None] == iota_k // 3, ghw_rep, 0.0)
+
+    binf = b_ref[0, 0, :]                                          # [T] lanes
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+    bin_oh_T = (iota_r == binf[None, :]).astype(jnp.float32)       # [S, T]
+    # HIGHEST: the MXU's default bf16 operand rounding loses ~0.4% on
+    # gradient sums — enough to flip near-tie split decisions
+    acc = jax.lax.dot_general(bin_oh_T, ns_ref[:], (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)  # [S, N*3]
+    out_ref[pl.ds(f * S, S), :] += acc
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins_tot"))
+def hist_pallas(binned_T, node, g, h, w, n_nodes: int, n_bins_tot: int):
+    """[F, n_nodes*n_bins_tot, 3] histograms (same layout as the XLA path)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, Bt, T = n_nodes, n_bins_tot, _TILE
+    F, R = binned_T.shape
+    S = ((Bt + 7) // 8) * 8
+    pad = (-R) % T
+    if pad:
+        # padded bin value Bt+1 never matches a one-hot row; padded node -1
+        binned_T = jnp.pad(binned_T, ((0, 0), (0, pad)), constant_values=Bt + 1)
+        node = jnp.pad(node, (0, pad), constant_values=-1)
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        w = jnp.pad(w, (0, pad))
+    Rp = binned_T.shape[1]
+    act = node >= 0
+    ghw = jnp.stack([g, h, w], 1) * act[:, None].astype(jnp.float32)
+    nodec = jnp.where(act, node, 0)[:, None]
+    out = pl.pallas_call(
+        partial(_hist_kernel, N=N, S=S, T=T),
+        out_shape=jax.ShapeDtypeStruct((F * S, N * 3), jnp.float32),
+        grid=(Rp // T, F),
+        in_specs=[
+            pl.BlockSpec((1, 1, T), lambda i, f: (f, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, 1), lambda i, f: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, 3), lambda i, f: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F * S, N * 3), lambda i, f: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((T, N * 3), jnp.float32)],
+    )(binned_T[:, None, :], nodec, ghw)
+    # [F, S, N, 3] → clip bin padding → [F, N, Bt, 3] → [F, N*Bt, 3]
+    out = out.reshape(F, S, N, 3)[:, :Bt].transpose(0, 2, 1, 3)
+    return out.reshape(F, N * Bt, 3)

@@ -189,3 +189,28 @@ def test_drf_multinomial(rng):
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
     acc = (pred.vec("predict").to_numpy() == yi).mean()
     assert acc > 0.95
+
+
+def test_pallas_hist_parity_with_segsum(rng):
+    """The Pallas MXU histogram kernel must match the XLA segment_sum path
+    (skipped off-TPU; the kernel only engages there)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from functools import partial
+    if jax.default_backend() != "tpu":
+        _pytest.skip("pallas kernel is TPU-only")
+    from h2o3_tpu.models.tree import _level_histograms
+    from h2o3_tpu.ops.pallas_hist import hist_pallas
+    R, F, B, N = 10000, 5, 16, 8
+    Bt = B + 1
+    binned = jnp.asarray(rng.integers(0, Bt, size=(R, F)).astype(np.int32))
+    node = jnp.asarray(rng.integers(-1, N, size=R).astype(np.int32))
+    g = jnp.asarray(rng.normal(size=R).astype(np.float32))
+    h = jnp.abs(jnp.asarray(rng.normal(size=R).astype(np.float32)))
+    w = jnp.ones(R, jnp.float32)
+    ref = jax.jit(partial(_level_histograms, n_nodes=N, n_bins_tot=Bt))(
+        binned, node, g, h, w)
+    got = hist_pallas(binned.T, node, g, h, w, N, Bt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-3)
