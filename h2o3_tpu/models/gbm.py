@@ -26,8 +26,10 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
-from h2o3_tpu.models.tree import (Tree, TreeParams, grow_tree,
-                                  grow_trees_batched, predict_binned, predict_raw)
+from jax import lax
+
+from h2o3_tpu.models.tree import (Tree, _grow_tree_device, predict_binned,
+                                  predict_raw)
 from h2o3_tpu.ops.quantile import bin_features, compute_bin_edges, sample_rows_host
 
 
@@ -62,6 +64,96 @@ def _grad_hess_multinomial(F, y, w):
     p = jax.nn.softmax(F, axis=1)
     yoh = jax.nn.one_hot(y.astype(jnp.int32), F.shape[1], dtype=F.dtype)
     return w[:, None] * (p - yoh), w[:, None] * jnp.maximum(p * (1 - p), 1e-10)
+
+
+@partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "col_rate",
+                                   "sample_rate", "col_tree_rate", "min_rows",
+                                   "reg_lambda", "reg_alpha", "gamma",
+                                   "min_split_improvement", "lr", "bootstrap",
+                                   "drf", "nclass"))
+def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
+                dist: str, depth: int, n_bins: int, col_rate: float,
+                sample_rate: float, col_tree_rate: float, min_rows: float,
+                reg_lambda: float, reg_alpha: float, gamma: float,
+                min_split_improvement: float, lr: float,
+                bootstrap: bool, drf: bool, nclass: int):
+    """The WHOLE boosting/bagging run in one compiled program.
+
+    Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
+    node, publishing to DKV per iteration. Here the loop is a ``lax.scan``
+    whose body is gradient refresh + row/feature sampling + one fused tree
+    growth, so the ensemble trains in ONE device dispatch — on a tunneled
+    TPU every host-visible op between trees costs a ~30-40ms round-trip,
+    which at 20 trees would double the total train time.
+
+    ``keys``: [M, 3, 2] per-remaining-tree PRNG keys (precomputed from the
+    base seed so checkpoint resume replays the same per-tree randomness).
+    ``nclass`` > 1 grows one tree per class per round (multinomial), vmapped.
+    Returns stacked heap arrays [M(, K), heap] + final margins Fcur.
+    """
+    F = binned.shape[1]
+    binned_T = binned.T   # hoisted once by XLA; the Pallas kernel wants [F, R]
+
+    def sample_w(k1):
+        if bootstrap:
+            return w * jax.random.poisson(k1, sample_rate, w.shape).astype(jnp.float32)
+        if sample_rate < 1.0:
+            return w * (jax.random.uniform(k1, w.shape) < sample_rate)
+        return w
+
+    def sample_fmask(k2):
+        if col_tree_rate >= 1.0:
+            return fmask_base
+        ku, kf = jax.random.split(k2)
+        # force a guaranteed feature BEFORE intersecting with the base mask
+        # so the sample can never re-enable a feature the base mask bans
+        sub = jax.random.uniform(ku, (F,)) < col_tree_rate
+        sub = sub.at[jax.random.randint(kf, (), 0, F)].set(True)
+        m = fmask_base & sub
+        return jnp.where(m.any(), m, fmask_base)
+
+    def grow(g, h, wt, fmask, k3):
+        return _grow_tree_device(
+            binned, binned_T, edges, g, h, wt, fmask, k3, depth, n_bins,
+            min_rows, reg_lambda, reg_alpha, gamma, min_split_improvement,
+            col_rate)
+
+    if nclass <= 1:
+        def body(Fcur, ks):
+            wt = sample_w(ks[0])
+            if drf:
+                g, h = -yc * wt, wt      # leaf = weighted in-node mean
+            else:
+                g, h = _grad_hess(dist, Fcur, yc, wt)
+            out = grow(g, h, wt, sample_fmask(ks[1]), ks[2])
+            heap, row_leaf = out[:-1], out[-1]
+            return (Fcur if drf else Fcur + lr * row_leaf), heap
+    else:
+        yoh = jax.nn.one_hot(yc.astype(jnp.int32), nclass)
+
+        def body(Fcur, ks):
+            wt = sample_w(ks[0])
+            if drf:
+                G = -(yoh * wt[:, None])
+                H = jnp.broadcast_to(wt[:, None], G.shape)
+            else:
+                G, H = _grad_hess_multinomial(Fcur, yc, wt)
+            fmask = sample_fmask(ks[1])
+            kk = jax.random.split(ks[2], nclass)
+            outs = jax.vmap(lambda gk, hk, k: grow(gk, hk, wt, fmask, k))(
+                G.T, H.T, kk)
+            heap, row_leaf = outs[:-1], outs[-1]       # row_leaf: [K, R]
+            return (Fcur if drf else Fcur + lr * row_leaf.T), heap
+
+    return lax.scan(body, Fcur0, keys)
+
+
+def _trees_from_stacked(heap, m: int, k: int | None = None) -> Tree:
+    """Tree m (class k) from _boost_scan's stacked heap arrays."""
+    pick = (lambda a: a[m] if k is None else a[m][k])
+    hf, ht, htv, hna, hsp, hlf, hg, hc = [pick(a) for a in heap]
+    return Tree(feat=hf, thresh_bin=ht, thresh_val=htv, na_left=hna,
+                is_split=hsp, leaf=hlf, gain=hg, cover=hc)
 
 
 class SharedTreeModel(Model):
@@ -292,11 +384,6 @@ class GBM(SharedTreeBuilder):
             else:
                 f0 = ybar
 
-        tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
-                        min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
-                        reg_alpha=float(p.get("reg_alpha", 0.0)),
-                        gamma=float(p.get("gamma", 0.0)),
-                        min_split_improvement=float(p["min_split_improvement"]))
         lr = float(p["learn_rate"])
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
@@ -305,21 +392,24 @@ class GBM(SharedTreeBuilder):
         if cp is not None:
             trees = list(cp.output["trees"])
             Fcur = Fcur + lr * predict_binned(binned, trees, int(p["nbins"]))
-            key = jax.random.fold_in(key, len(trees))
         ntrees = int(p["ntrees"])
-        for m in range(len(trees), ntrees):
-            key, k1, k2 = jax.random.split(key, 3)
-            wt = self._row_weights(k1, w, float(p["sample_rate"]), False)
-            g, h = _grad_hess(dist, Fcur, yc, wt)
-            key, k3 = jax.random.split(key)
-            fmask = self._feat_mask(k2, X.shape[1], float(p["col_sample_rate_per_tree"]))
-            new, preds = grow_trees_batched(binned, edges, g[None], h[None],
-                                            wt[None], tp, fmask,
-                                            col_rate=float(p["col_sample_rate"]),
-                                            key=k3)
-            trees.append(new[0])
-            Fcur = Fcur + lr * preds[0]
-            job.update((m + 1) / ntrees, f"tree {m + 1}/{ntrees}")
+        done = len(trees)
+        keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+        job.update(0.1, f"growing {ntrees - done} trees (one fused program)")
+        _, heap = _boost_scan(
+            binned, edges, yc, w, jnp.ones(X.shape[1], bool), Fcur,
+            keys, dist=dist, depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
+            col_rate=float(p["col_sample_rate"]),
+            sample_rate=float(p["sample_rate"]),
+            col_tree_rate=float(p["col_sample_rate_per_tree"]),
+            min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
+            reg_alpha=float(p.get("reg_alpha", 0.0)),
+            gamma=float(p.get("gamma", 0.0)),
+            min_split_improvement=float(p["min_split_improvement"]), lr=lr,
+            bootstrap=False, drf=False, nclass=0)
+        jax.block_until_ready(heap)
+        trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
+        job.update(0.9, f"{ntrees} trees grown")
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
@@ -345,11 +435,6 @@ class GBM(SharedTreeBuilder):
             prior = np.maximum(prior / max(prior.sum(), 1e-30), 1e-10)
             f0 = np.log(prior).astype(np.float32)
 
-        tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
-                        min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
-                        reg_alpha=float(p.get("reg_alpha", 0.0)),
-                        gamma=float(p.get("gamma", 0.0)),
-                        min_split_improvement=float(p["min_split_improvement"]))
         lr = float(p["learn_rate"])
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
@@ -362,23 +447,25 @@ class GBM(SharedTreeBuilder):
             Fcur = Fcur + lr * jnp.stack(
                 [predict_binned(binned, ts, int(p["nbins"]))
                  for ts in trees_multi], axis=1)
-            key = jax.random.fold_in(key, done)
         ntrees = int(p["ntrees"])
-        for m in range(done, ntrees):
-            key, k1, k2, k3 = jax.random.split(key, 4)
-            wt = self._row_weights(k1, w, float(p["sample_rate"]), False)
-            G, H = _grad_hess_multinomial(Fcur, yc, wt)
-            fmask = self._feat_mask(k2, X.shape[1], float(p["col_sample_rate_per_tree"]))
-            wt_b = jnp.broadcast_to(wt[None, :], (K, wt.shape[0]))
-            # all K class trees of the round grow in ONE device dispatch
-            new, preds = grow_trees_batched(binned, edges, G.T, H.T, wt_b, tp,
-                                            fmask,
-                                            col_rate=float(p["col_sample_rate"]),
-                                            key=k3)
+        keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+        job.update(0.1, f"growing {(ntrees - done) * K} trees (one fused program)")
+        _, heap = _boost_scan(
+            binned, edges, yc, w, jnp.ones(X.shape[1], bool), Fcur,
+            keys, dist="multinomial", depth=int(p["max_depth"]),
+            n_bins=int(p["nbins"]), col_rate=float(p["col_sample_rate"]),
+            sample_rate=float(p["sample_rate"]),
+            col_tree_rate=float(p["col_sample_rate_per_tree"]),
+            min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
+            reg_alpha=float(p.get("reg_alpha", 0.0)),
+            gamma=float(p.get("gamma", 0.0)),
+            min_split_improvement=float(p["min_split_improvement"]), lr=lr,
+            bootstrap=False, drf=False, nclass=K)
+        jax.block_until_ready(heap)
+        for m in range(ntrees - done):
             for k in range(K):
-                trees_multi[k].append(new[k])
-            Fcur = Fcur + lr * preds.T
-            job.update((m + 1) / ntrees, f"round {m + 1}/{ntrees} ({K} trees)")
+                trees_multi[k].append(_trees_from_stacked(heap, m, k))
+        job.update(0.9, f"{ntrees * K} trees grown")
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
@@ -442,9 +529,6 @@ class DRF(SharedTreeBuilder):
         mtries = int(p["mtries"])
         if mtries <= 0:
             mtries = max(1, int(np.sqrt(F)) if classifier else max(F // 3, 1))
-        tp = TreeParams(max_depth=int(p["max_depth"]), nbins=int(p["nbins"]),
-                        min_rows=float(p["min_rows"]), reg_lambda=0.0,
-                        min_split_improvement=float(p["min_split_improvement"]))
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
         ntrees = int(p["ntrees"])
@@ -453,23 +537,25 @@ class DRF(SharedTreeBuilder):
         if nclass > 2:
             # one class-indicator tree per class per round; leaf = in-node
             # class fraction (reference: DRF.java multinomial ktrees)
-            yoh = jax.nn.one_hot(yc.astype(jnp.int32), nclass)
             trees_multi: list[list[Tree]] = [[] for _ in range(nclass)]
             done = 0
             if cp is not None:
                 trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
                 done = len(trees_multi[0])
-                key = jax.random.fold_in(key, done)
-            for m in range(done, ntrees):
-                key, k1, k3 = jax.random.split(key, 3)
-                wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
-                wt_b = jnp.broadcast_to(wt[None, :], (nclass, wt.shape[0]))
-                new, _ = grow_trees_batched(binned, edges, -(yoh * wt[:, None]).T,
-                                            wt_b, wt_b, tp, fmask,
-                                            col_rate=mtries / F, key=k3)
+            keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+            _, heap = _boost_scan(
+                binned, edges, yc, w, fmask,
+                jnp.zeros((X.shape[0], nclass), jnp.float32), keys,
+                dist="multinomial", depth=int(p["max_depth"]),
+                n_bins=int(p["nbins"]), col_rate=mtries / F,
+                sample_rate=float(p["sample_rate"]), col_tree_rate=1.0,
+                min_rows=float(p["min_rows"]), reg_lambda=0.0, reg_alpha=0.0,
+                gamma=0.0,
+                min_split_improvement=float(p["min_split_improvement"]),
+                lr=1.0, bootstrap=True, drf=True, nclass=nclass)
+            for m in range(ntrees - done):
                 for k in range(nclass):
-                    trees_multi[k].append(new[k])
-                job.update((m + 1) / ntrees, f"round {m + 1}/{ntrees}")
+                    trees_multi[k].append(_trees_from_stacked(heap, m, k))
             return DRFModel(
                 key=make_model_key(self.algo, self.model_id),
                 params=self.params, data_info=None, response_column=y,
@@ -482,14 +568,18 @@ class DRF(SharedTreeBuilder):
         trees: list[Tree] = []
         if cp is not None and cp.output.get("trees") is not None:
             trees = list(cp.output["trees"])
-            key = jax.random.fold_in(key, len(trees))
-        for m in range(len(trees), ntrees):
-            key, k1, k2 = jax.random.split(key, 3)
-            wt = self._row_weights(k1, w, float(p["sample_rate"]), bootstrap=True)
-            g, h = -yc * wt, wt  # leaf = weighted in-node mean of y
-            trees.append(grow_tree(binned, edges, g, h, wt, tp, fmask,
-                                   col_rate=mtries / F, key=k2))
-            job.update((m + 1) / ntrees, f"tree {m + 1}/{ntrees}")
+        done = len(trees)
+        keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+        _, heap = _boost_scan(
+            binned, edges, yc, w, fmask,
+            jnp.zeros(X.shape[0], jnp.float32), keys,
+            dist="gaussian", depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
+            col_rate=mtries / F, sample_rate=float(p["sample_rate"]),
+            col_tree_rate=1.0, min_rows=float(p["min_rows"]), reg_lambda=0.0,
+            reg_alpha=0.0, gamma=0.0,
+            min_split_improvement=float(p["min_split_improvement"]),
+            lr=1.0, bootstrap=True, drf=True, nclass=0)
+        trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
 
         return DRFModel(
             key=make_model_key(self.algo, self.model_id),

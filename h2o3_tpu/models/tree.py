@@ -230,7 +230,9 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
             jnp.concatenate(lv_gain), jnp.concatenate(lv_cover), row_leaf)
 
 
-@partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate"))
+@partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate", "min_rows",
+                                   "reg_lambda", "reg_alpha", "gamma",
+                                   "min_split_improvement"))
 def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
                   depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                   gamma, min_split_improvement, col_rate: float):
@@ -261,11 +263,14 @@ def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
     keys = jax.random.split(key, K)
     if feat_mask.ndim == 1:
         feat_mask = jnp.broadcast_to(feat_mask[None, :], (K, feat_mask.shape[0]))
+    # hyperparams are STATIC (compiled constants): a traced jnp scalar would
+    # cost a host→device upload per call — ~43ms each over a tunneled TPU,
+    # dwarfing the 200ms tree-growth compute itself
     hf, ht, htv, hna, hsp, hlf, hg, hc, preds = _grow_batched(
         binned, edges, g, h, w, feat_mask, keys,
-        params.max_depth, params.nbins, jnp.float32(params.min_rows),
-        jnp.float32(params.reg_lambda), jnp.float32(params.reg_alpha),
-        jnp.float32(params.gamma), jnp.float32(params.min_split_improvement),
+        params.max_depth, params.nbins, float(params.min_rows),
+        float(params.reg_lambda), float(params.reg_alpha),
+        float(params.gamma), float(params.min_split_improvement),
         float(col_rate))
     trees = [Tree(feat=hf[k], thresh_bin=ht[k], thresh_val=htv[k],
                   na_left=hna[k], is_split=hsp[k], leaf=hlf[k],
