@@ -46,14 +46,55 @@ def tree_matrix(frame: Frame, cols: list[str], domains: dict[str, tuple]) -> jax
     return jnp.stack(arrs, axis=1)
 
 
-@partial(jax.jit, static_argnames=("dist",))
-def _grad_hess(dist: str, F, y, w):
+def _weighted_quantile_host(y, w, prob: float) -> float:
+    """Weighted quantile of y over rows with w>0 (host-side, init only)."""
+    yh = np.asarray(jax.device_get(y), np.float64)
+    wh = np.asarray(jax.device_get(w), np.float64)
+    ok = wh > 0
+    if not ok.any():
+        return 0.0
+    order = np.argsort(yh[ok])
+    ys, ws = yh[ok][order], wh[ok][order]
+    cw = np.cumsum(ws)
+    idx = int(np.searchsorted(cw, prob * cw[-1]))
+    return float(ys[min(idx, len(ys) - 1)])
+
+
+@partial(jax.jit, static_argnames=("dist", "quantile_alpha", "huber_alpha",
+                                   "tweedie_power"))
+def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
+               huber_alpha: float = 0.9, tweedie_power: float = 1.5):
+    """Per-distribution (g, h) pairs (reference: hex/Distribution.java loss
+    families; non-smooth losses use the standard GBM pseudo-residual with
+    unit hessian, leaf value = weighted mean pseudo-residual)."""
     if dist == "bernoulli":
         p = jax.nn.sigmoid(F)
         return w * (p - y), w * jnp.maximum(p * (1 - p), 1e-10)
     if dist == "poisson":
         mu = jnp.exp(jnp.clip(F, -30, 30))
         return w * (mu - y), w * mu
+    if dist == "gamma":
+        # log link; deviance grad: 1 - y*exp(-F)
+        ey = y * jnp.exp(jnp.clip(-F, -30, 30))
+        return w * (1.0 - ey), w * ey
+    if dist == "tweedie":
+        p_ = tweedie_power
+        e1 = jnp.exp(jnp.clip((1.0 - p_) * F, -30, 30))
+        e2 = jnp.exp(jnp.clip((2.0 - p_) * F, -30, 30))
+        g = w * (-y * e1 + e2)
+        h = w * (-(1.0 - p_) * y * e1 + (2.0 - p_) * e2)
+        return g, jnp.maximum(h, 1e-10)
+    if dist == "laplace":
+        return w * jnp.sign(F - y), w
+    if dist == "quantile":
+        a = quantile_alpha
+        return w * jnp.where(y > F, -a, 1.0 - a), w
+    if dist == "huber":
+        # reference: delta = huber_alpha quantile of |residual|, refreshed
+        # every iteration (DistributionFactory huber)
+        r = F - y
+        delta = jnp.quantile(jnp.abs(jnp.where(w > 0, r, 0.0)), huber_alpha)
+        return w * jnp.clip(r, -delta, delta), w
     return w * (F - y), w  # gaussian
 
 
@@ -70,13 +111,16 @@ def _grad_hess_multinomial(F, y, w):
                                    "sample_rate", "col_tree_rate", "min_rows",
                                    "reg_lambda", "reg_alpha", "gamma",
                                    "min_split_improvement", "lr", "bootstrap",
-                                   "drf", "nclass"))
+                                   "drf", "nclass", "quantile_alpha",
+                                   "huber_alpha", "tweedie_power"))
 def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 dist: str, depth: int, n_bins: int, col_rate: float,
                 sample_rate: float, col_tree_rate: float, min_rows: float,
                 reg_lambda: float, reg_alpha: float, gamma: float,
                 min_split_improvement: float, lr: float,
-                bootstrap: bool, drf: bool, nclass: int):
+                bootstrap: bool, drf: bool, nclass: int,
+                quantile_alpha: float = 0.5, huber_alpha: float = 0.9,
+                tweedie_power: float = 1.5):
     """The WHOLE boosting/bagging run in one compiled program.
 
     Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
@@ -124,7 +168,8 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
             if drf:
                 g, h = -yc * wt, wt      # leaf = weighted in-node mean
             else:
-                g, h = _grad_hess(dist, Fcur, yc, wt)
+                g, h = _grad_hess(dist, Fcur, yc, wt, quantile_alpha,
+                                  huber_alpha, tweedie_power)
             out = grow(g, h, wt, sample_fmask(ks[1]), ks[2])
             heap, row_leaf = out[:-1], out[-1]
             return (Fcur if drf else Fcur + lr * row_leaf), heap
@@ -237,8 +282,8 @@ class GBMModel(SharedTreeModel):
         if self.output["distribution"] == "bernoulli":
             p = jax.nn.sigmoid(f)
             return jnp.stack([1 - p, p], axis=1)
-        if self.output["distribution"] == "poisson":
-            return jnp.exp(jnp.clip(f, -30, 30))
+        if self.output["distribution"] in ("poisson", "gamma", "tweedie"):
+            return jnp.exp(jnp.clip(f, -30, 30))   # log link
         return f
 
 
@@ -333,6 +378,9 @@ class GBM(SharedTreeBuilder):
             distribution="AUTO",
             reg_lambda=0.0,
             col_sample_rate=1.0,   # per-level feature sampling inside grow_tree
+            quantile_alpha=0.5,    # quantile distribution target
+            huber_alpha=0.9,       # huber delta = this quantile of |residual|
+            tweedie_power=1.5,
         )
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GBMModel:
@@ -361,9 +409,11 @@ class GBM(SharedTreeBuilder):
                 dist = "gaussian"
             if dist == "bernoulli":
                 raise ValueError("bernoulli distribution requires a categorical (2-level) response")
-            if dist not in ("gaussian", "poisson"):
+            if dist not in ("gaussian", "poisson", "gamma", "tweedie",
+                            "laplace", "quantile", "huber"):
                 raise ValueError(f"unsupported distribution {dist!r}; "
-                                 "have gaussian, bernoulli, poisson, AUTO")
+                                 "have gaussian, bernoulli, poisson, gamma, "
+                                 "tweedie, laplace, quantile, huber, AUTO")
         w = weights * valid
         yc = jnp.where(w > 0, yy, 0.0)
 
@@ -379,8 +429,12 @@ class GBM(SharedTreeBuilder):
             if dist == "bernoulli":
                 ybar = min(max(ybar, 1e-6), 1 - 1e-6)
                 f0 = float(np.log(ybar / (1 - ybar)))
-            elif dist == "poisson":
-                f0 = float(np.log(max(ybar, 1e-10)))
+            elif dist in ("poisson", "gamma", "tweedie"):
+                f0 = float(np.log(max(ybar, 1e-10)))   # log link
+            elif dist in ("laplace", "huber"):
+                f0 = _weighted_quantile_host(yy, w, 0.5)
+            elif dist == "quantile":
+                f0 = _weighted_quantile_host(yy, w, float(p["quantile_alpha"]))
             else:
                 f0 = ybar
 
@@ -406,7 +460,10 @@ class GBM(SharedTreeBuilder):
             reg_alpha=float(p.get("reg_alpha", 0.0)),
             gamma=float(p.get("gamma", 0.0)),
             min_split_improvement=float(p["min_split_improvement"]), lr=lr,
-            bootstrap=False, drf=False, nclass=0)
+            bootstrap=False, drf=False, nclass=0,
+            quantile_alpha=float(p["quantile_alpha"]),
+            huber_alpha=float(p["huber_alpha"]),
+            tweedie_power=float(p["tweedie_power"]))
         jax.block_until_ready(heap)
         trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
         job.update(0.9, f"{ntrees} trees grown")

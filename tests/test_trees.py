@@ -214,3 +214,46 @@ def test_pallas_hist_parity_with_segsum(rng):
     got = hist_pallas(binned.T, node, g, h, w, N, Bt)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-3)
+
+
+def test_gbm_distribution_families(rng):
+    """Reference: hex/Distribution.java families — gamma/tweedie (log link),
+    laplace/quantile/huber (robust)."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.frame.frame import Frame as _F
+    n = 600
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    mu = np.exp(0.8 * X[:, 0] - 0.4 * X[:, 1] + 0.5)
+    y_gamma = rng.gamma(shape=2.0, scale=mu / 2.0).astype(np.float32)
+    fr = _F.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+                         "y": y_gamma})
+    for dist in ("gamma", "tweedie"):
+        m = GBM(ntrees=15, max_depth=3, distribution=dist, seed=1).train(
+            y="y", training_frame=fr)
+        pred = np.asarray(m.predict(fr).vec("predict").to_numpy())
+        assert (pred > 0).all(), dist        # log link ⇒ positive predictions
+        assert np.corrcoef(pred, mu)[0, 1] > 0.7, dist
+
+    # robust losses on contaminated data: laplace/huber track the median
+    y_out = (2 * X[:, 0] + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    y_out[:20] += 60.0                        # gross outliers
+    fr2 = _F.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+                          "y": y_out})
+    preds = {}
+    for dist in ("gaussian", "laplace", "huber"):
+        m = GBM(ntrees=25, max_depth=3, distribution=dist, seed=1).train(
+            y="y", training_frame=fr2)
+        preds[dist] = np.asarray(m.predict(fr2).vec("predict").to_numpy())
+    clean = slice(20, None)
+    err = {d: np.abs(preds[d][clean] - y_out[clean]).mean() for d in preds}
+    assert err["laplace"] < err["gaussian"]
+    assert err["huber"] < err["gaussian"]
+
+    # quantile regression: alpha=0.9 predictions sit above alpha=0.1
+    m_lo = GBM(ntrees=20, max_depth=3, distribution="quantile",
+               quantile_alpha=0.1, seed=1).train(y="y", training_frame=fr2)
+    m_hi = GBM(ntrees=20, max_depth=3, distribution="quantile",
+               quantile_alpha=0.9, seed=1).train(y="y", training_frame=fr2)
+    lo = np.asarray(m_lo.predict(fr2).vec("predict").to_numpy())
+    hi = np.asarray(m_hi.predict(fr2).vec("predict").to_numpy())
+    assert (hi >= lo - 1e-4).mean() > 0.95
