@@ -141,7 +141,7 @@ def test_xgboost_regularization_params(rng):
 def test_gbm_bad_distribution(rng):
     f, _, _ = _friedman(rng, n=200)
     with pytest.raises(ValueError, match="unsupported distribution"):
-        GBM(distribution="gamma").train(y="y", training_frame=f)
+        GBM(distribution="ordinal").train(y="y", training_frame=f)
     with pytest.raises(ValueError, match="categorical"):
         GBM(distribution="bernoulli").train(y="y", training_frame=f)
 
