@@ -26,7 +26,8 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.models.distributions import get_family
 from h2o3_tpu.models.job import Job
-from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
+                                        make_model_key)
 
 
 def _fam(family: str, tweedie_p: float):
@@ -119,6 +120,36 @@ def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2):
     return lam1 * nobs / jnp.maximum(gram_diag, 1e-12)
 
 
+def _wald_inference(family: str, tw: float, X, yy, w, beta, dev: float):
+    """Wald standard errors / z / p per coefficient (reference: GLM.java
+    ``computePValues`` — inverse information matrix at the MLE; dispersion
+    estimated for gaussian/gamma/tweedie, fixed 1 for binomial/poisson)."""
+    fam = _fam(family, tw)
+    eta = X @ beta[:-1] + beta[-1]
+    d = fam.dmu_deta(eta)
+    var = fam.variance(fam.linkinv(eta))
+    W = w * d * d / jnp.maximum(var, 1e-12)
+    nobs = jnp.maximum(w.sum(), 1.0)
+    gram, _ = _weighted_gram(X, W, jnp.zeros_like(yy), 0.0, nobs, 1e-8)
+    inv = jnp.linalg.inv(gram)
+    n_eff = float(jax.device_get((w > 0).sum()))
+    pdim = X.shape[1] + 1
+    phi = (dev / max(n_eff - pdim, 1.0)
+           if family in ("gaussian", "gamma", "tweedie") else 1.0)
+    se = jnp.sqrt(jnp.clip(jnp.diag(inv) * phi, 0.0, None))
+    z = beta / jnp.maximum(se, 1e-30)
+    p = jax.scipy.special.erfc(jnp.abs(z) / np.sqrt(2.0))
+    return (np.asarray(jax.device_get(se)), np.asarray(jax.device_get(z)),
+            np.asarray(jax.device_get(p)))
+
+
+@partial(jax.jit, static_argnames=("family", "tweedie_p"))
+def _deviance_at(family: str, tweedie_p: float, X, y, w, beta):
+    fam = _fam(family, tweedie_p)
+    mu = fam.linkinv(X @ beta[:-1] + beta[-1])
+    return (w * fam.deviance(y, mu)).sum()
+
+
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
 def _null_deviance(family: str, tweedie_p: float, y, w):
     fam = _fam(family, tweedie_p)
@@ -195,6 +226,28 @@ class GLMModel(Model):
         return {f"coefs_class_{k}": dict(zip(names, mat[:, k]))
             for k in range(mat.shape[1])}
 
+    def coef_table(self):
+        """Rows (name, coefficient, std_error, z_value, p_value) — the
+        reference's coefficients table with Wald inference (needs
+        ``compute_p_values=True``)."""
+        if "p_values" not in self.output:
+            raise ValueError("train with compute_p_values=True")
+        names = self.output["coef_names"] + ["Intercept"]
+        return [dict(name=n, coefficient=float(c), std_error=float(s),
+                     z_value=float(z), p_value=float(p))
+                for n, c, s, z, p in zip(
+                    names, np.asarray(self.output["coef"]),
+                    self.output["std_errs"], self.output["z_values"],
+                    self.output["p_values"])]
+
+    def get_regularization_path(self):
+        """Lambda-search path (h2o-py ``getGLMRegularizationPath``): dicts of
+        (lambda_, deviance, dev_explained, nonzero, beta)."""
+        path = self.output.get("regularization_path")
+        if path is None:
+            raise ValueError("train with lambda_search=True")
+        return path
+
     def varimp(self, use_pandas: bool = False):
         """Standardized-coefficient magnitudes per SOURCE column (reference:
         GLM variable importances = abs standardized coefs; one-hot levels of a
@@ -241,7 +294,78 @@ class GLM(ModelBuilder):
             beta_epsilon=1e-4,
             objective_epsilon=1e-6,
             compute_p_values=False,
+            lambda_search=False,
+            nlambdas=30,
+            lambda_min_ratio=1e-4,
         )
+
+    def _irls_fit(self, job: Job, family, tw, X, yy, w, beta, lambda_: float,
+                  params) -> tuple[jax.Array, float, int]:
+        """IRLS to convergence at ONE lambda (reference: GLM.java IRLSM
+        iteration loop); elastic-net L1 handled by the ADMM pass."""
+        lam = lambda_ * (1.0 - float(params["alpha"]))
+        dev_prev, dev, it = np.inf, np.inf, 0
+        nn = bool(params.get("non_negative"))
+        for it in range(int(params["max_iterations"])):
+            beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
+                                       non_negative=nn)
+            dev = float(jax.device_get(dev))
+            delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
+            beta = beta_new
+            job.update((it + 1) / int(params["max_iterations"]),
+                       f"iter {it} deviance {dev:.4f}")
+            if family == "gaussian" and not nn and it >= 1:
+                break
+            if delta < float(params["beta_epsilon"]):
+                break
+            if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
+                    float(params["objective_epsilon"]) * max(abs(dev_prev), 1.0):
+                break
+            dev_prev = dev
+        if float(params["alpha"]) > 0 and lambda_ > 0:
+            local = ModelParameters(params)
+            local["lambda_"] = lambda_
+            beta = self._admm_l1(family, tw, X, yy, w, beta, local)
+            dev = float(jax.device_get(_deviance_at(family, tw, X, yy, w, beta)))
+        return beta, dev, it
+
+    def _lambda_search(self, job: Job, family, tw, X, yy, w, beta, params):
+        """Regularization path with warm starts (reference: GLM.java lambda
+        search / glmnet: geometric grid from lambda_max down; stop when the
+        deviance-explained gain plateaus; ``getGLMRegularizationPath``)."""
+        alpha = max(float(params["alpha"]), 1e-3)   # glmnet λmax convention
+        mu_bar = (w * yy).sum() / jnp.maximum(w.sum(), 1e-30)
+        lam_max = float(jax.device_get(
+            jnp.max(jnp.abs(X.T @ (w * (yy - mu_bar))))
+            / jnp.maximum(w.sum(), 1e-30))) / alpha
+        lam_max = max(lam_max, 1e-6)
+        nlam = int(params["nlambdas"])
+        ratio = float(params["lambda_min_ratio"])
+        lambdas = lam_max * np.power(ratio, np.linspace(0, 1, nlam))
+        null_dev = float(jax.device_get(_null_deviance(family, tw, yy, w)))
+        path = []
+        dev_prev, flat_steps = null_dev, 0
+        for i, lam in enumerate(lambdas):
+            beta, dev, it = self._irls_fit(job, family, tw, X, yy, w, beta,
+                                           float(lam), params)
+            nz = int(jax.device_get((jnp.abs(beta[:-1]) > 1e-8).sum()))
+            path.append(dict(lambda_=float(lam), deviance=dev,
+                             dev_explained=1.0 - dev / max(null_dev, 1e-30),
+                             nonzero=nz,
+                             beta=np.asarray(jax.device_get(beta))))
+            # stop once extra shrinkage relief stops paying — but only after
+            # SUSTAINED flatness: near lambda_max every step is flat because
+            # beta is still ~0 (reference stops on devExplained plateau)
+            if (dev_prev - dev) < 1e-4 * max(null_dev, 1e-30):
+                flat_steps += 1
+                if flat_steps >= 3 and path[i]["dev_explained"] > 0:
+                    break
+            else:
+                flat_steps = 0
+            dev_prev = dev
+        best = min(path, key=lambda e: e["deviance"])
+        beta = jnp.asarray(best["beta"])
+        return beta, best["deviance"], 0, best["lambda_"], path
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
@@ -282,27 +406,13 @@ class GLM(ModelBuilder):
         beta = beta.at[-1].set(float(jax.device_get(
             fam.link((w * mu0).sum() / jnp.maximum(w.sum(), 1e-30)))))
 
-        lam = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
-        dev_prev = np.inf
-        nn = bool(params.get("non_negative"))
-        for it in range(int(params["max_iterations"])):
-            beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
-                                       non_negative=nn)
-            dev = float(jax.device_get(dev))
-            delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
-            beta = beta_new
-            job.update((it + 1) / int(params["max_iterations"]), f"iter {it} deviance {dev:.4f}")
-            if family == "gaussian" and not params.get("non_negative") and it >= 1:
-                break
-            if delta < float(params["beta_epsilon"]):
-                break
-            if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
-                    float(params["objective_epsilon"]) * max(abs(dev_prev), 1.0):
-                break
-            dev_prev = dev
-
-        if float(params["alpha"]) > 0 and float(params["lambda_"]) > 0:
-            beta = self._admm_l1(family, tw, X, yy, w, beta, params)
+        if bool(params.get("lambda_search")):
+            beta, dev, it, lambda_best, reg_path = self._lambda_search(
+                job, family, tw, X, yy, w, beta, params)
+        else:
+            beta, dev, it = self._irls_fit(job, family, tw, X, yy, w, beta,
+                                           float(params["lambda_"]), params)
+            lambda_best, reg_path = float(params["lambda_"]), None
 
         # destandardize for reporting: X_std = (x - sub) * mul
         b = np.asarray(jax.device_get(beta), np.float64)
@@ -317,15 +427,23 @@ class GLM(ModelBuilder):
         from h2o3_tpu.models.model_base import ModelParameters
         mparams = ModelParameters(self.params)   # snapshot: builder stays reusable
         mparams["family"] = family
+        output = dict(beta=beta, coef=coef, coef_names=di.coef_names,
+                      residual_deviance=dev, null_deviance=null_dev,
+                      iterations=it + 1, family=family,
+                      lambda_best=lambda_best, regularization_path=reg_path)
+        if bool(params.get("compute_p_values")):
+            if float(params["lambda_"]) > 0 or bool(params.get("lambda_search")):
+                raise ValueError("compute_p_values requires no regularization "
+                                 "(reference: GLM.java p-values need lambda=0)")
+            se, zv, pv = _wald_inference(family, tw, X, yy, w, beta, dev)
+            output.update(std_errs=se, z_values=zv, p_values=pv)
         model = GLMModel(
             key=make_model_key(self.algo, self.model_id),
             params=mparams,
             data_info=di,
             response_column=y,
             response_domain=yvec.domain if yvec.is_categorical else None,
-            output=dict(beta=beta, coef=coef, coef_names=di.coef_names,
-                        residual_deviance=dev, null_deviance=null_dev,
-                        iterations=it + 1, family=family),
+            output=output,
         )
         return model
 

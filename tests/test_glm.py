@@ -205,3 +205,42 @@ def test_glm_lasso_sparsifies(rng):
     bn = m.coef_norm()
     assert abs(bn["x3"]) < 1e-6, bn          # pure-noise coef zeroed
     assert abs(bn["x0"]) > 0.5 and abs(bn["x1"]) > 0.5
+
+
+def test_glm_p_values(rng):
+    """Wald inference (reference: GLM.java computePValues): strong predictor
+    gets p ~ 0, pure-noise predictor p > 0.05."""
+    n = 500
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (2.0 * X[:, 0] + rng.normal(scale=1.0, size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x0": X[:, 0], "noise": X[:, 1], "y": y})
+    m = GLM(family="gaussian", lambda_=0.0, compute_p_values=True).train(
+        y="y", training_frame=fr)
+    tbl = {r["name"]: r for r in m.coef_table()}
+    assert tbl["x0"]["p_value"] < 1e-6
+    assert tbl["noise"]["p_value"] > 0.01
+    # SE sanity: sigma/sqrt(n) scale for a standardized design
+    assert 0.0 < tbl["x0"]["std_error"] < 1.0
+    with pytest.raises(ValueError, match="regularization"):
+        GLM(family="gaussian", lambda_=0.5, compute_p_values=True).train(
+            y="y", training_frame=fr)
+
+
+def test_glm_lambda_search(rng):
+    n = 400
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (1.5 * X[:, 0] - X[:, 1] + rng.normal(scale=0.5, size=n)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = y
+    fr = Frame.from_arrays(cols)
+    m = GLM(family="gaussian", alpha=1.0, lambda_search=True, nlambdas=20).train(
+        y="y", training_frame=fr)
+    path = m.get_regularization_path()
+    assert len(path) >= 2
+    lams = [p["lambda_"] for p in path]
+    assert all(a > b for a, b in zip(lams, lams[1:]))   # decreasing
+    devs = [p["deviance"] for p in path]
+    assert devs[-1] <= devs[0] + 1e-6                    # deviance improves
+    assert m.output["lambda_best"] in lams
+    # the selected fit actually learned the signal
+    assert m.training_metrics.r2 > 0.8
