@@ -1,0 +1,69 @@
+"""Flow — the built-in web console served from the node.
+
+Reference: ``h2o-web/`` packages the Flow notebook (CoffeeScript app served
+by the node at ``/``; ``h2o-web/README.md:1-8``). The TPU build ships a
+dependency-free single-page console over the same V3 REST surface: cluster
+status, frames, models, jobs, and a Rapids prompt — the day-to-day Flow
+operations — rendered client-side from ``/3/*`` JSON.
+"""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>h2o3-tpu Flow</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1c2733}
+ header{background:#1c2733;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:baseline}
+ header h1{font-size:16px;margin:0}
+ header span{color:#9db2c4;font-size:12px}
+ main{padding:16px 20px;display:grid;grid-template-columns:1fr 1fr;gap:16px}
+ section{background:#fff;border:1px solid #dde4ea;border-radius:6px;padding:12px}
+ h2{font-size:13px;text-transform:uppercase;letter-spacing:.06em;color:#5a6b7b;margin:0 0 8px}
+ table{width:100%;border-collapse:collapse;font-size:13px}
+ td,th{text-align:left;padding:4px 6px;border-bottom:1px solid #eef2f5}
+ th{color:#5a6b7b;font-weight:600}
+ #rapids{grid-column:1/3}
+ input[type=text]{width:80%;padding:6px;border:1px solid #cfd8e0;border-radius:4px}
+ button{padding:6px 12px;border:0;border-radius:4px;background:#2f6fed;color:#fff;cursor:pointer}
+ pre{background:#f4f6f8;padding:8px;border-radius:4px;overflow:auto;max-height:200px}
+ .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px;background:#e7f0e7;color:#2b6a2b}
+</style></head><body>
+<header><h1>h2o3-tpu Flow</h1><span id="cloud">connecting…</span></header>
+<main>
+ <section><h2>Frames</h2><table id="frames"><tr><th>key</th><th>rows</th><th>cols</th></tr></table></section>
+ <section><h2>Models</h2><table id="models"><tr><th>key</th><th>algo</th></tr></table></section>
+ <section><h2>Jobs</h2><table id="jobs"><tr><th>key</th><th>status</th><th>progress</th></tr></table></section>
+ <section><h2>Timeline (last events)</h2><table id="timeline"><tr><th>kind</th><th>what</th><th>ms</th></tr></table></section>
+ <section id="rapids"><h2>Rapids</h2>
+  <input type="text" id="expr" placeholder="(+ 1 2)"> <button onclick="runRapids()">Run</button>
+  <pre id="result"></pre></section>
+</main>
+<script>
+async function j(p, opt){const r = await fetch(p, opt); return r.json();}
+function row(t, cells){const tr = document.createElement('tr');
+ for(const c of cells){const td = document.createElement('td'); td.textContent = c; tr.appendChild(td);}
+ t.appendChild(tr);}
+function reset(t){while(t.rows.length > 1) t.deleteRow(1);}
+async function refresh(){
+ try{
+  const c = await j('/3/Cloud');
+  document.getElementById('cloud').textContent =
+    `cloud ${c.cloud_name ?? ''} · ${c.cloud_size} node(s) · ` +
+    (c.cloud_healthy ? 'healthy' : 'unhealthy') + ` · v${c.version ?? ''}`;
+  const fr = await j('/3/Frames'); const ft = document.getElementById('frames'); reset(ft);
+  for(const f of (fr.frames ?? [])) row(ft, [f.frame_id?.name ?? f.key, f.rows, f.column_count]);
+  const mo = await j('/3/Models'); const mt = document.getElementById('models'); reset(mt);
+  for(const m of (mo.models ?? [])) row(mt, [m.model_id?.name ?? m.key, m.algo]);
+  const tl = await j('/3/Timeline'); const tt = document.getElementById('timeline'); reset(tt);
+  for(const e of (tl.events ?? []).slice(-12).reverse())
+    row(tt, [e.kind, e.what, (e.dur_ns/1e6).toFixed(2)]);
+ }catch(e){document.getElementById('cloud').textContent = 'disconnected: '+e;}
+}
+async function runRapids(){
+ const ast = document.getElementById('expr').value;
+ const out = await j('/99/Rapids', {method:'POST',
+   headers:{'Content-Type':'application/json'}, body: JSON.stringify({ast})});
+ document.getElementById('result').textContent = JSON.stringify(out, null, 2);
+ refresh();
+}
+refresh(); setInterval(refresh, 4000);
+</script></body></html>
+"""

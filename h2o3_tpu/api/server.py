@@ -361,6 +361,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "WaterMeterIoV3"},
                      "persist_stats": io_stats()})
 
+    def r_flow(self):
+        # reference: h2o-web Flow notebook served from the node at /
+        from h2o3_tpu.api.flow import FLOW_HTML
+        body = FLOW_HTML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def r_logs(self):
         # reference: LogsHandler /3/Logs/nodes/{n}/files/{name}
         import logging
@@ -399,6 +409,8 @@ _ROUTES = [
     (r"/3/WaterMeterCpuTicks/\d+", "GET", _Handler.r_cpu_ticks),
     (r"/3/WaterMeterIo", "GET", _Handler.r_io_meter),
     (r"/3/Logs", "GET", _Handler.r_logs),
+    (r"/", "GET", _Handler.r_flow),
+    (r"/flow/index\.html", "GET", _Handler.r_flow),
 ]
 
 

@@ -102,3 +102,14 @@ def test_error_does_not_kill_server(client, bin_frame):
         client.train("glm", "train_frame", y="nope")
     # server still alive
     assert client.cloud_status()["cloud_healthy"]
+
+
+def test_flow_ui_served(server):
+    import urllib.request
+    with urllib.request.urlopen(server.url + "/") as r:
+        body = r.read().decode()
+        assert r.headers["Content-Type"].startswith("text/html")
+    assert "h2o3-tpu Flow" in body
+    assert "/3/Cloud" in body
+    with urllib.request.urlopen(server.url + "/flow/index.html") as r:
+        assert r.status == 200
