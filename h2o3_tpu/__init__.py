@@ -21,6 +21,7 @@ Public surface mirrors the h2o-py client (``h2o-py/h2o/h2o.py``): ``import_file`
 from h2o3_tpu.frame import Frame, Vec, VecType
 from h2o3_tpu.frame.parse import import_file, parse_raw, upload_file
 from h2o3_tpu.frame.utils import create_frame, interaction, rebalance, tf_idf
+from h2o3_tpu.frame.sql import import_sql_select, import_sql_table
 from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
 from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
                               save_model)
@@ -41,6 +42,8 @@ __all__ = [
     "interaction",
     "tf_idf",
     "rebalance",
+    "import_sql_table",
+    "import_sql_select",
     "export_file",
     "save_frame",
     "load_frame",

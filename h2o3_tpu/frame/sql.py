@@ -1,0 +1,102 @@
+"""SQL ingest — the JDBC import equivalent.
+
+Reference: ``water/jdbc/SQLManager.java`` (h2o-py ``import_sql_table`` /
+``import_sql_select``): connect via JDBC, partition the table into SELECT
+ranges fetched in parallel by the cluster, build a frame.
+
+TPU-native: ingestion is a host-side concern (SURVEY.md §7 stage 2 — parse
+on host, upload device-sharded). Python DB-API replaces JDBC: ``sqlite3``
+ships in-tree; any other installed DB-API driver works through
+``connection_factory``. Range-partitioned fetches mirror the reference's
+SELECT splitting (over ``rowid`` for sqlite).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+
+
+def _connect(connection_url: str, connection_factory=None):
+    if connection_factory is not None:
+        return connection_factory(connection_url)
+    if connection_url.startswith(("sqlite:", "sqlite3:")):
+        import sqlite3
+        path = connection_url.split(":", 1)[1].lstrip("/")
+        # keep absolute paths absolute (sqlite:///tmp/x.db)
+        if connection_url.count("/") >= 3 or connection_url.startswith("sqlite:/"):
+            path = "/" + path if not path.startswith("/") else path
+        return sqlite3.connect(path)
+    raise ValueError(
+        f"unsupported connection url {connection_url!r}: built-in support is "
+        "sqlite:<path>; pass connection_factory=<callable> for other DB-API "
+        "drivers (the reference's JDBC drivers are likewise user-supplied)")
+
+
+def _rows_to_frame(cols, rows, key=None) -> Frame:
+    n = len(rows)
+    arrays = {}
+    for i, name in enumerate(cols):
+        vals = [r[i] for r in rows]
+        numeric = all(v is None or isinstance(v, (int, float)) for v in vals)
+        if numeric:
+            arr = np.array([np.nan if v is None else float(v) for v in vals],
+                           np.float32)
+        else:
+            arr = np.array(["" if v is None else str(v) for v in vals],
+                           dtype=object)
+        arrays[name] = arr
+    if n == 0:
+        raise ValueError("query returned no rows")
+    return Frame.from_arrays(arrays, key=key)
+
+
+def import_sql_select(connection_url: str, select_query: str,
+                      username: str | None = None, password: str | None = None,
+                      connection_factory=None, key: str | None = None) -> Frame:
+    """h2o-py ``import_sql_select``: run a SELECT, build a frame."""
+    conn = _connect(connection_url, connection_factory)
+    try:
+        cur = conn.cursor()
+        cur.execute(select_query)
+        cols = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    return _rows_to_frame(cols, rows, key=key)
+
+
+def import_sql_table(connection_url: str, table: str,
+                     columns: list[str] | None = None,
+                     username: str | None = None, password: str | None = None,
+                     fetch_mode: str = "SINGLE", num_chunks: int = 4,
+                     connection_factory=None, key: str | None = None) -> Frame:
+    """h2o-py ``import_sql_table``: fetch a whole table.
+
+    ``fetch_mode="DISTRIBUTED"`` splits the scan into ``num_chunks`` rowid
+    ranges (the reference's parallel SELECT ranges, SQLManager.java)."""
+    if not table.replace("_", "").replace(".", "").isalnum():
+        raise ValueError(f"suspicious table name {table!r}")
+    collist = ", ".join(columns) if columns else "*"
+    conn = _connect(connection_url, connection_factory)
+    try:
+        cur = conn.cursor()
+        if fetch_mode.upper() == "DISTRIBUTED":
+            cur.execute(f"SELECT COUNT(*) FROM {table}")   # noqa: S608
+            total = cur.fetchone()[0]
+            per = max(1, (total + num_chunks - 1) // num_chunks)
+            rows, cols = [], None
+            for c in range(num_chunks):
+                cur.execute(f"SELECT {collist} FROM {table} "   # noqa: S608
+                            f"LIMIT {per} OFFSET {c * per}")
+                if cols is None:
+                    cols = [d[0] for d in cur.description]
+                rows.extend(cur.fetchall())
+        else:
+            cur.execute(f"SELECT {collist} FROM {table}")   # noqa: S608
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+    finally:
+        conn.close()
+    return _rows_to_frame(cols, rows, key=key)

@@ -90,3 +90,30 @@ def test_rebalance_preserves_data(rng):
     assert rb.nrows == 37
     np.testing.assert_allclose(rb.vec("x").to_numpy(), fr.vec("x").to_numpy())
     assert list(rb.vec("c").labels()) == list(fr.vec("c").labels())
+
+
+def test_import_sql_table(tmp_path, rng):
+    """SQL ingest (reference: water/jdbc SQLManager; h2o-py import_sql_table)."""
+    import sqlite3
+    db = tmp_path / "t.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (x REAL, label TEXT)")
+    rows = [(float(i) / 10, "a" if i % 2 else "b") for i in range(50)]
+    conn.executemany("INSERT INTO pts VALUES (?, ?)", rows)
+    conn.commit(); conn.close()
+
+    from h2o3_tpu.frame.sql import import_sql_select, import_sql_table
+    fr = import_sql_table(f"sqlite:{db}", "pts")
+    assert fr.nrows == 50 and set(fr.names) == {"x", "label"}
+    assert fr.vec("x").mean() == pytest.approx(2.45, abs=1e-5)
+    assert fr.vec("label").type.name in ("CAT", "STR")
+
+    fr2 = import_sql_table(f"sqlite:{db}", "pts", fetch_mode="DISTRIBUTED",
+                           num_chunks=3)
+    assert fr2.nrows == 50
+
+    fr3 = import_sql_select(f"sqlite:{db}", "SELECT x FROM pts WHERE x > 2.0")
+    assert fr3.nrows == 29     # x in {2.1 … 4.9}
+
+    with pytest.raises(ValueError, match="unsupported connection url"):
+        import_sql_table("postgres://h", "pts")
