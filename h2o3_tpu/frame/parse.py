@@ -38,6 +38,8 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
         df = orc.ORCFile(path).read().to_pandas()
     elif ext == "svmlight" or ext == "svm":
         return _parse_svmlight(path, key)
+    elif ext == "arff":
+        return _parse_arff(path, key)
     else:
         if ext in ("csv", "txt", "data") and na_strings is None and header == 0 \
                 and (sep is None or len(sep) == 1):
@@ -92,6 +94,63 @@ def parse_raw(text: str, key: str | None = None, **kw) -> Frame:
     frame = Frame.from_pandas(df, key=key)
     if key:
         DKV.put(key, frame)
+    return frame
+
+
+def _parse_arff(path: str, key: str | None) -> Frame:
+    """ARFF (reference: ``water/parser/ARFFParser.java``): @attribute header
+    declares name + type (numeric / {nominal,...} / string / date), @data is
+    CSV. Declared nominals become categorical domains even when unobserved."""
+    import io
+
+    import pandas as pd
+
+    names: list[str] = []
+    kinds: list[tuple[str, tuple[str, ...] | None]] = []
+    data_lines: list[str] = []
+    in_data = False
+    with open(path) as f:
+        for line in f:
+            s = line.strip()
+            if not s or s.startswith("%"):
+                continue
+            low = s.lower()
+            if in_data:
+                data_lines.append(s)
+            elif low.startswith("@attribute"):
+                rest = s.split(None, 2)[1:]
+                name = rest[0].strip("'\"")
+                typ = rest[1] if len(rest) > 1 else "numeric"
+                if typ.startswith("{"):
+                    dom = tuple(v.strip().strip("'\"")
+                                for v in typ.strip("{}").split(","))
+                    kinds.append(("nominal", dom))
+                elif typ.lower() in ("numeric", "real", "integer"):
+                    kinds.append(("numeric", None))
+                else:
+                    kinds.append(("string", None))
+                names.append(name)
+            elif low.startswith("@data"):
+                in_data = True
+    df = pd.read_csv(io.StringIO("\n".join(data_lines)), header=None,
+                     names=names, na_values=["?"], skipinitialspace=True)
+    from h2o3_tpu.frame.types import VecType
+    from h2o3_tpu.frame.vec import Vec
+    vecs = []
+    for name, (kind, dom) in zip(names, kinds):
+        col = df[name]
+        if kind == "nominal":
+            vals = col.astype("object")
+            lut = {lvl: i for i, lvl in enumerate(dom)}
+            codes = np.array([lut.get(str(v).strip("'\""), -1)
+                              if not pd.isna(v) else -1 for v in vals], np.int32)
+            vecs.append(Vec.from_numpy(codes, VecType.CAT, domain=dom))
+        elif kind == "numeric":
+            vecs.append(Vec.from_numpy(col.to_numpy(np.float32)))
+        else:
+            vecs.append(Vec.from_numpy(col.astype(str).to_numpy(), VecType.STR))
+    frame = Frame(names, vecs, key=key or _key_from_path(path))
+    DKV.put(frame.key, frame)
     return frame
 
 

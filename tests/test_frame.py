@@ -136,3 +136,27 @@ def test_frame_add_duplicate_rejected(rng):
     f = Frame.from_arrays({"a": np.arange(5)})
     with pytest.raises(ValueError, match="duplicate"):
         f.add("a", Vec.from_numpy(np.arange(5)))
+
+
+def test_arff_parse(tmp_path):
+    """Reference: water/parser/ARFFParser — typed header + CSV data."""
+    p = tmp_path / "weather.arff"
+    p.write_text("""% comment
+@relation weather
+@attribute outlook {sunny, overcast, rainy}
+@attribute temperature numeric
+@attribute windy {TRUE, FALSE}
+@attribute play {yes, no}
+@data
+sunny,85,FALSE,no
+overcast,83,FALSE,yes
+rainy,70,TRUE,?
+""")
+    from h2o3_tpu.frame.parse import import_file
+    fr = import_file(str(p))
+    assert fr.names == ["outlook", "temperature", "windy", "play"]
+    assert fr.vec("outlook").domain == ("sunny", "overcast", "rainy")
+    assert fr.vec("temperature").is_numeric
+    assert float(fr.vec("temperature").mean()) == pytest.approx((85+83+70)/3)
+    lab = fr.vec("play").labels()
+    assert list(lab) == ["no", "yes", None]
