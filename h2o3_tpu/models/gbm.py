@@ -98,6 +98,24 @@ def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
     return w * (F - y), w  # gaussian
 
 
+@partial(jax.jit, static_argnames=("dist",))
+def _train_deviance(dist: str, F, y, w):
+    """Mean training deviance at margins F (the reference's AUTO stopping
+    metric: logloss for classification, deviance/MSE for regression)."""
+    n = jnp.maximum(w.sum(), 1e-30)
+    if dist == "bernoulli":
+        p = jnp.clip(jax.nn.sigmoid(F), 1e-15, 1 - 1e-15)
+        return -(w * (y * jnp.log(p) + (1 - y) * jnp.log1p(-p))).sum() / n
+    if dist == "multinomial":
+        logp = jax.nn.log_softmax(F, axis=1)
+        picked = jnp.take_along_axis(logp, y.astype(jnp.int32)[:, None], 1)[:, 0]
+        return -(w * picked).sum() / n
+    if dist in ("poisson", "gamma", "tweedie"):
+        mu = jnp.exp(jnp.clip(F, -30, 30))
+        return (w * (mu - y * jnp.clip(F, -30, 30))).sum() / n
+    return (w * (F - y) ** 2).sum() / n    # gaussian & robust families
+
+
 @jax.jit
 def _grad_hess_multinomial(F, y, w):
     """Softmax gradients for all K classes at once (reference: GBM.java
@@ -302,6 +320,8 @@ class SharedTreeBuilder(ModelBuilder):
             col_sample_rate_per_tree=1.0,
             min_split_improvement=1e-5,
             stopping_rounds=0,
+            stopping_metric="AUTO",      # deviance (logloss/MSE) like reference
+            stopping_tolerance=1e-3,
         )
 
     # Dense-heap trees cap depth at 16 (2^17 nodes); the reference's default 20
@@ -450,9 +470,8 @@ class GBM(SharedTreeBuilder):
         done = len(trees)
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
         job.update(0.1, f"growing {ntrees - done} trees (one fused program)")
-        _, heap = _boost_scan(
-            binned, edges, yc, w, jnp.ones(X.shape[1], bool), Fcur,
-            keys, dist=dist, depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
+        kwargs = dict(
+            dist=dist, depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
             col_rate=float(p["col_sample_rate"]),
             sample_rate=float(p["sample_rate"]),
             col_tree_rate=float(p["col_sample_rate_per_tree"]),
@@ -464,9 +483,10 @@ class GBM(SharedTreeBuilder):
             quantile_alpha=float(p["quantile_alpha"]),
             huber_alpha=float(p["huber_alpha"]),
             tweedie_power=float(p["tweedie_power"]))
-        jax.block_until_ready(heap)
-        trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
-        job.update(0.9, f"{ntrees} trees grown")
+        fmask_base = jnp.ones(X.shape[1], bool)
+        trees += self._grow_with_stopping(job, binned, edges, yc, w, fmask_base,
+                                          Fcur, keys, dist, 0, kwargs, p)
+        job.update(0.9, f"{len(trees)} trees grown")
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
@@ -476,6 +496,47 @@ class GBM(SharedTreeBuilder):
                         distribution=dist, x_cols=list(x), feat_domains=domains,
                         ntrees=len(trees)),
         )
+
+    def _grow_with_stopping(self, job, binned, edges, yc, w, fmask_base,
+                            Fcur, keys, dist: str, nclass: int, kwargs: dict,
+                            p) -> list:
+        """Run the fused scan; with ``stopping_rounds`` > 0, grow per-tree
+        chunks scoring training deviance between them (reference:
+        ScoreKeeper.stopEarly — stop after k scoring events without a
+        relative ``stopping_tolerance`` improvement). The per-tree dispatch
+        round-trips only occur when early stopping is requested."""
+        M = keys.shape[0]
+        sr = int(p.get("stopping_rounds") or 0)
+        out_trees: list = []
+
+        def collect(heap, count):
+            if nclass > 1:
+                return [[_trees_from_stacked(heap, m, k) for k in range(nclass)]
+                        for m in range(count)]
+            return [_trees_from_stacked(heap, m) for m in range(count)]
+
+        if sr <= 0:
+            _, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
+                                  keys, **kwargs)
+            jax.block_until_ready(heap)
+            return collect(heap, M)
+
+        tol = float(p.get("stopping_tolerance") or 1e-3)
+        sdist = "multinomial" if nclass > 1 else dist
+        best, since = np.inf, 0
+        for i in range(M):
+            Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
+                                     keys[i:i + 1], **kwargs)
+            out_trees.extend(collect(heap, 1))
+            dev = float(jax.device_get(_train_deviance(sdist, Fcur, yc, w)))
+            job.update(0.1 + 0.8 * (i + 1) / M, f"tree {i + 1}: dev {dev:.5f}")
+            if dev < best * (1.0 - tol) or not np.isfinite(best):
+                best, since = dev, 0
+            else:
+                since += 1
+                if since >= sr:
+                    break
+        return out_trees
 
     def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,
                          X, edges, binned, domains, cp=None) -> GBMModel:
@@ -507,9 +568,8 @@ class GBM(SharedTreeBuilder):
         ntrees = int(p["ntrees"])
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
         job.update(0.1, f"growing {(ntrees - done) * K} trees (one fused program)")
-        _, heap = _boost_scan(
-            binned, edges, yc, w, jnp.ones(X.shape[1], bool), Fcur,
-            keys, dist="multinomial", depth=int(p["max_depth"]),
+        kwargs = dict(
+            dist="multinomial", depth=int(p["max_depth"]),
             n_bins=int(p["nbins"]), col_rate=float(p["col_sample_rate"]),
             sample_rate=float(p["sample_rate"]),
             col_tree_rate=float(p["col_sample_rate_per_tree"]),
@@ -518,11 +578,13 @@ class GBM(SharedTreeBuilder):
             gamma=float(p.get("gamma", 0.0)),
             min_split_improvement=float(p["min_split_improvement"]), lr=lr,
             bootstrap=False, drf=False, nclass=K)
-        jax.block_until_ready(heap)
-        for m in range(ntrees - done):
+        rounds = self._grow_with_stopping(job, binned, edges, yc, w,
+                                          jnp.ones(X.shape[1], bool), Fcur,
+                                          keys, "multinomial", K, kwargs, p)
+        for per_class in rounds:
             for k in range(K):
-                trees_multi[k].append(_trees_from_stacked(heap, m, k))
-        job.update(0.9, f"{ntrees * K} trees grown")
+                trees_multi[k].append(per_class[k])
+        job.update(0.9, f"{len(rounds) * K} trees grown")
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),

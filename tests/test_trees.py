@@ -257,3 +257,24 @@ def test_gbm_distribution_families(rng):
     lo = np.asarray(m_lo.predict(fr2).vec("predict").to_numpy())
     hi = np.asarray(m_hi.predict(fr2).vec("predict").to_numpy())
     assert (hi >= lo - 1e-4).mean() > 0.95
+
+
+def test_gbm_early_stopping(rng):
+    """stopping_rounds (reference: ScoreKeeper.stopEarly): on an easy problem
+    training halts well before ntrees once deviance plateaus."""
+    from h2o3_tpu.models import GBM
+    from h2o3_tpu.frame.frame import Frame as _F
+    n = 400
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    # noisy signal: late trees improve the training deviance only marginally,
+    # so the relative-tolerance plateau rule fires
+    logit = 2.0 * X[:, 0]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "a", "b")
+    fr = _F.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2], "y": y})
+    m = GBM(ntrees=100, max_depth=3, stopping_rounds=3,
+            stopping_tolerance=0.02, seed=1).train(y="y", training_frame=fr)
+    assert m.output["ntrees"] < 100
+    assert m.training_metrics.auc > 0.85
+    # without stopping all trees grow
+    m2 = GBM(ntrees=12, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    assert m2.output["ntrees"] == 12
