@@ -108,6 +108,42 @@ def _tweedie(p: float = 1.5):
     )
 
 
+def _negativebinomial(theta: float = 1.0):
+    """Log link; Var = mu + theta*mu^2 (reference: GLM negativebinomial
+    family, ``hex/glm`` NB deviance with dispersion theta)."""
+    def deviance(y, mu):
+        mu = jnp.maximum(mu, _EPS)
+        y1 = jnp.maximum(y, _EPS)
+        t1 = jnp.where(y > 0, y * jnp.log(y1 / mu), 0.0)
+        t2 = (y + 1.0 / theta) * jnp.log((1.0 + theta * y) / (1.0 + theta * mu))
+        return 2.0 * (t1 - t2)
+
+    return Family(
+        "negativebinomial",
+        link=lambda mu: jnp.log(jnp.maximum(mu, _EPS)),
+        linkinv=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        dmu_deta=lambda eta: jnp.exp(jnp.clip(eta, -30, 30)),
+        variance=lambda mu: jnp.maximum(mu, _EPS) * (1.0 + theta * jnp.maximum(mu, _EPS)),
+        deviance=deviance,
+    )
+
+
+def _quasibinomial():
+    """Binomial machinery on a CONTINUOUS y in [0,1] (reference:
+    quasibinomial / fractionalbinomial families — same link/variance, y not
+    required to be 0/1)."""
+    b = _binomial()
+    return Family(
+        "quasibinomial",
+        link=b.link, linkinv=b.linkinv, dmu_deta=b.dmu_deta,
+        variance=b.variance,
+        deviance=lambda y, mu: -2.0 * (
+            jnp.where(y > 0, y * jnp.log(_clip01(mu) / jnp.maximum(y, _EPS)), 0.0)
+            + jnp.where(y < 1, (1 - y) * jnp.log((1 - _clip01(mu))
+                                                 / jnp.maximum(1 - y, _EPS)), 0.0)),
+    )
+
+
 _FAMILIES: dict[str, Callable[[], Family]] = {
     "gaussian": _gaussian,
     "binomial": _binomial,
@@ -115,6 +151,9 @@ _FAMILIES: dict[str, Callable[[], Family]] = {
     "poisson": _poisson,
     "gamma": _gamma,
     "tweedie": _tweedie,
+    "negativebinomial": _negativebinomial,
+    "quasibinomial": _quasibinomial,
+    "fractionalbinomial": _quasibinomial,
 }
 
 

@@ -31,7 +31,14 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
 
 
 def _fam(family: str, tweedie_p: float):
-    return get_family(family, p=tweedie_p) if family == "tweedie" else get_family(family)
+    """``tweedie_p`` doubles as the family's auxiliary parameter: variance
+    power for tweedie, dispersion theta for negativebinomial (one static
+    slot through every jitted solver)."""
+    if family == "tweedie":
+        return get_family(family, p=tweedie_p)
+    if family == "negativebinomial":
+        return get_family(family, theta=tweedie_p)
+    return get_family(family)
 
 
 def _weighted_gram(X, W, z, l2, nobs, jitter):
@@ -207,7 +214,10 @@ class GLMModel(Model):
     def _score_raw(self, frame: Frame) -> jax.Array:
         X = self.data_info.expand(frame)
         return _glm_score(self.params["family"], self.nclasses or 0,
-                          float(self.params["tweedie_variance_power"]), X, self.output["beta"])
+                          float(self.params.get("theta", 1.0))
+                          if self.params["family"] == "negativebinomial"
+                          else float(self.params["tweedie_variance_power"]),
+                          X, self.output["beta"])
 
     def coef(self):
         """Coefficients on the original scale (reference: GLMModel.coefficients()).
@@ -286,6 +296,7 @@ class GLM(ModelBuilder):
             alpha=0.0,                # elastic-net mix (L1 fraction)
             lambda_=0.0,              # regularization strength
             tweedie_variance_power=1.5,
+            theta=1.0,                # negativebinomial dispersion
             standardize=True,
             use_all_factor_levels=False,
             intercept=True,
@@ -389,7 +400,8 @@ class GLM(ModelBuilder):
                 raise ValueError("binomial family requires a categorical (2-level) response")
             if family == "multinomial":
                 raise ValueError("multinomial family requires a categorical response")
-        tw = float(params["tweedie_variance_power"])
+        tw = (float(params.get("theta", 1.0)) if family == "negativebinomial"
+              else float(params["tweedie_variance_power"]))
 
         di = DataInfo.make(frame, x, standardize=params["standardize"],
                            use_all_factor_levels=params["use_all_factor_levels"])

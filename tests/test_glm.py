@@ -244,3 +244,27 @@ def test_glm_lambda_search(rng):
     assert m.output["lambda_best"] in lams
     # the selected fit actually learned the signal
     assert m.training_metrics.r2 > 0.8
+
+
+def test_glm_negativebinomial_and_quasibinomial(rng):
+    """New families (reference: GLM negativebinomial w/ theta,
+    quasibinomial/fractionalbinomial on continuous [0,1] response)."""
+    n = 600
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    mu = np.exp(0.7 * X[:, 0] + 1.0)
+    y_nb = rng.negative_binomial(n=2, p=2 / (2 + mu)).astype(np.float32)
+    fr = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "y": y_nb})
+    m = GLM(family="negativebinomial", theta=0.5, lambda_=0.0).train(
+        y="y", training_frame=fr)
+    c = m.coef()
+    assert abs(c["x0"] - 0.7) < 0.15          # recovers the log-link slope
+    pred = np.asarray(m.predict(fr).vec("predict").to_numpy())
+    assert (pred > 0).all()
+
+    p_frac = 1 / (1 + np.exp(-(1.5 * X[:, 0])))
+    y_frac = np.clip(p_frac + rng.normal(scale=0.05, size=n), 0, 1).astype(np.float32)
+    fr2 = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "y": y_frac})
+    m2 = GLM(family="fractionalbinomial", lambda_=0.0).train(y="y", training_frame=fr2)
+    pred2 = np.asarray(m2.predict(fr2).vec("predict").to_numpy())
+    assert (pred2 >= 0).all() and (pred2 <= 1).all()
+    assert np.corrcoef(pred2, p_frac)[0, 1] > 0.95
