@@ -38,30 +38,77 @@ class Frame:
     @staticmethod
     def from_arrays(cols: Mapping[str, np.ndarray], types: Mapping[str, VecType] | None = None,
                     key: str | None = None) -> "Frame":
+        """Build a frame with BATCHED device upload: all float columns go up
+        as one transfer and all categorical code columns as another (a
+        per-column ``device_put`` costs a tunnel round-trip each)."""
+        from h2o3_tpu.frame.vec import CAT_NA, _factorize, _guess_type, upload_columns
         types = types or {}
-        vecs = [Vec.from_numpy(np.asarray(v), type=types.get(k)) for k, v in cols.items()]
-        return Frame(list(cols.keys()), vecs, key=key)
+        names = list(cols.keys())
+        plans: dict[str, tuple] = {}
+        float_cols: list[tuple[str, np.ndarray]] = []
+        cat_cols: list[tuple[str, np.ndarray, tuple]] = []
+        for k in names:
+            v = np.asarray(cols[k])
+            t = types.get(k) or _guess_type(v)
+            if t is VecType.CAT and v.dtype.kind not in "iu":
+                codes, dom = _factorize(v)
+                cat_cols.append((k, codes.astype(np.int32), tuple(dom)))
+            elif t is VecType.CAT:
+                # caller passed codes + (domain unknown) — per-column path
+                plans[k] = ("direct", Vec.from_numpy(v, type=t))
+            elif t in (VecType.NUM, VecType.INT) and v.dtype.kind in "fiub":
+                float_cols.append((k, np.asarray(v, np.float32), t))
+            else:
+                plans[k] = ("direct", Vec.from_numpy(v, type=t))
+        nrows = len(next(iter(cols.values()))) if cols else 0
+        fdev = upload_columns([h for _, h, _ in float_cols], nrows, np.nan, np.float32)
+        cdev = upload_columns([c for _, c, _ in cat_cols], nrows, CAT_NA, np.int32)
+        for (k, _, t), d in zip(float_cols, fdev):
+            plans[k] = ("dev", Vec.from_device(d, nrows, t))
+        for (k, _, dom), d in zip(cat_cols, cdev):
+            plans[k] = ("dev", Vec.from_device(d, nrows, VecType.CAT, domain=dom))
+        vecs = [plans[k][1] for k in names]
+        return Frame(names, vecs, key=key)
 
     @staticmethod
     def from_pandas(df, key: str | None = None) -> "Frame":
-        """Convert a pandas DataFrame (type guessing per parser semantics)."""
-        names, vecs = [], []
+        """Convert a pandas DataFrame (type guessing per parser semantics);
+        numeric/categorical columns ride the batched upload of
+        :meth:`from_arrays`."""
+        cols: dict[str, np.ndarray] = {}
+        types: dict[str, VecType] = {}
+        time_cols: dict[str, np.ndarray] = {}
         for col in df.columns:
             s = df[col]
-            names.append(str(col))
+            name = str(col)
             if s.dtype.kind in "OUS" or str(s.dtype) in ("category", "str"):
                 if str(s.dtype) == "category":
                     # re-factorize so the domain is sorted (parser contract)
-                    vecs.append(Vec.from_numpy(s.astype(object).to_numpy()))
+                    cols[name] = s.astype(object).to_numpy()
                 else:
-                    vecs.append(Vec.from_numpy(s.to_numpy(dtype=object)))
+                    cols[name] = s.to_numpy(dtype=object)
             elif s.dtype.kind == "M":
                 # pandas >=3.0 defaults to datetime64[us]; Vec normalizes to ns
-                vecs.append(Vec.from_numpy(s.to_numpy(), type=VecType.TIME))
+                time_cols[name] = s.to_numpy()
+                cols[name] = s.to_numpy()   # placeholder, replaced below
+                types[name] = VecType.TIME
             elif s.dtype.kind == "b":
-                vecs.append(Vec.from_numpy(s.to_numpy().astype(np.float32), type=VecType.INT))
+                cols[name] = s.to_numpy().astype(np.float32)
+                types[name] = VecType.INT
             else:
-                vecs.append(Vec.from_numpy(s.to_numpy(dtype=np.float32, na_value=np.nan)))
+                cols[name] = s.to_numpy(dtype=np.float32, na_value=np.nan)
+        fr = Frame.from_arrays(
+            {k: v for k, v in cols.items() if k not in time_cols},
+            types={k: t for k, t in types.items() if k not in time_cols})
+        names, vecs = [], []
+        for col in df.columns:
+            name = str(col)
+            if name in time_cols:
+                names.append(name)
+                vecs.append(Vec.from_numpy(time_cols[name], type=VecType.TIME))
+            else:
+                names.append(name)
+                vecs.append(fr.vec(name))
         return Frame(names, vecs, key=key)
 
     # -- shape --------------------------------------------------------------

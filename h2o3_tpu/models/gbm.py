@@ -212,11 +212,21 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
 
 
 def _trees_from_stacked(heap, m: int, k: int | None = None) -> Tree:
-    """Tree m (class k) from _boost_scan's stacked heap arrays."""
+    """Tree m (class k) from _boost_scan's stacked heap arrays.
+
+    ``heap`` should be host-side (see ``_heap_to_host``): slicing device
+    arrays per tree would cost a dispatch each — hundreds of tunnel
+    round-trips per model."""
     pick = (lambda a: a[m] if k is None else a[m][k])
     hf, ht, htv, hna, hsp, hlf, hg, hc = [pick(a) for a in heap]
     return Tree(feat=hf, thresh_bin=ht, thresh_val=htv, na_left=hna,
                 is_split=hsp, leaf=hlf, gain=hg, cover=hc)
+
+
+def _heap_to_host(heap):
+    """One transfer for the whole stacked ensemble (the heap arrays are tiny:
+    ntrees x 2^(depth+1) nodes)."""
+    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), heap)
 
 
 class SharedTreeModel(Model):
@@ -484,9 +494,19 @@ class GBM(SharedTreeBuilder):
             huber_alpha=float(p["huber_alpha"]),
             tweedie_power=float(p["tweedie_power"]))
         fmask_base = jnp.ones(X.shape[1], bool)
-        trees += self._grow_with_stopping(job, binned, edges, yc, w, fmask_base,
-                                          Fcur, keys, dist, 0, kwargs, p)
+        grown, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
+                                               fmask_base, Fcur, keys, dist,
+                                               0, kwargs, p)
+        trees += grown
         job.update(0.9, f"{len(trees)} trees grown")
+        # final margins double as training predictions (skips the re-score)
+        if dist == "bernoulli":
+            pe = jax.nn.sigmoid(Fend)
+            train_raw = jnp.stack([1 - pe, pe], axis=1)
+        elif dist in ("poisson", "gamma", "tweedie"):
+            train_raw = jnp.exp(jnp.clip(Fend, -30, 30))
+        else:
+            train_raw = Fend
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
@@ -494,7 +514,7 @@ class GBM(SharedTreeBuilder):
             response_domain=yvec.domain if yvec.is_categorical else None,
             output=dict(trees=trees, edges=edges, f0=f0, learn_rate=lr,
                         distribution=dist, x_cols=list(x), feat_domains=domains,
-                        ntrees=len(trees)),
+                        ntrees=len(trees), _train_raw=train_raw),
         )
 
     def _grow_with_stopping(self, job, binned, edges, yc, w, fmask_base,
@@ -510,16 +530,17 @@ class GBM(SharedTreeBuilder):
         out_trees: list = []
 
         def collect(heap, count):
+            heap = _heap_to_host(heap)
             if nclass > 1:
                 return [[_trees_from_stacked(heap, m, k) for k in range(nclass)]
                         for m in range(count)]
             return [_trees_from_stacked(heap, m) for m in range(count)]
 
         if sr <= 0:
-            _, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
-                                  keys, **kwargs)
+            Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
+                                     keys, **kwargs)
             jax.block_until_ready(heap)
-            return collect(heap, M)
+            return collect(heap, M), Fcur
 
         tol = float(p.get("stopping_tolerance") or 1e-3)
         sdist = "multinomial" if nclass > 1 else dist
@@ -536,7 +557,7 @@ class GBM(SharedTreeBuilder):
                 since += 1
                 if since >= sr:
                     break
-        return out_trees
+        return out_trees, Fcur
 
     def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,
                          X, edges, binned, domains, cp=None) -> GBMModel:
@@ -578,9 +599,10 @@ class GBM(SharedTreeBuilder):
             gamma=float(p.get("gamma", 0.0)),
             min_split_improvement=float(p["min_split_improvement"]), lr=lr,
             bootstrap=False, drf=False, nclass=K)
-        rounds = self._grow_with_stopping(job, binned, edges, yc, w,
-                                          jnp.ones(X.shape[1], bool), Fcur,
-                                          keys, "multinomial", K, kwargs, p)
+        rounds, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
+                                                jnp.ones(X.shape[1], bool),
+                                                Fcur, keys, "multinomial", K,
+                                                kwargs, p)
         for per_class in rounds:
             for k in range(K):
                 trees_multi[k].append(per_class[k])
@@ -591,6 +613,7 @@ class GBM(SharedTreeBuilder):
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain,
             output=dict(trees_multi=trees_multi, edges=edges, f0_multi=f0,
+                        _train_raw=jax.nn.softmax(Fend, axis=1),
                         learn_rate=lr, distribution="multinomial",
                         x_cols=list(x), feat_domains=domains, ntrees=ntrees),
         )
