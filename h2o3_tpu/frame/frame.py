@@ -44,8 +44,8 @@ class Frame:
         from h2o3_tpu.frame.vec import CAT_NA, _factorize, _guess_type, upload_columns
         types = types or {}
         names = list(cols.keys())
-        plans: dict[str, tuple] = {}
-        float_cols: list[tuple[str, np.ndarray]] = []
+        plans: dict[str, Vec] = {}
+        float_cols: list[tuple[str, np.ndarray, VecType]] = []
         cat_cols: list[tuple[str, np.ndarray, tuple]] = []
         for k in names:
             v = np.asarray(cols[k])
@@ -55,20 +55,19 @@ class Frame:
                 cat_cols.append((k, codes.astype(np.int32), tuple(dom)))
             elif t is VecType.CAT:
                 # caller passed codes + (domain unknown) — per-column path
-                plans[k] = ("direct", Vec.from_numpy(v, type=t))
+                plans[k] = Vec.from_numpy(v, type=t)
             elif t in (VecType.NUM, VecType.INT) and v.dtype.kind in "fiub":
                 float_cols.append((k, np.asarray(v, np.float32), t))
             else:
-                plans[k] = ("direct", Vec.from_numpy(v, type=t))
+                plans[k] = Vec.from_numpy(v, type=t)
         nrows = len(next(iter(cols.values()))) if cols else 0
         fdev = upload_columns([h for _, h, _ in float_cols], nrows, np.nan, np.float32)
         cdev = upload_columns([c for _, c, _ in cat_cols], nrows, CAT_NA, np.int32)
         for (k, _, t), d in zip(float_cols, fdev):
-            plans[k] = ("dev", Vec.from_device(d, nrows, t))
+            plans[k] = Vec.from_device(d, nrows, t)
         for (k, _, dom), d in zip(cat_cols, cdev):
-            plans[k] = ("dev", Vec.from_device(d, nrows, VecType.CAT, domain=dom))
-        vecs = [plans[k][1] for k in names]
-        return Frame(names, vecs, key=key)
+            plans[k] = Vec.from_device(d, nrows, VecType.CAT, domain=dom)
+        return Frame(names, [plans[k] for k in names], key=key)
 
     @staticmethod
     def from_pandas(df, key: str | None = None) -> "Frame":
@@ -90,16 +89,12 @@ class Frame:
             elif s.dtype.kind == "M":
                 # pandas >=3.0 defaults to datetime64[us]; Vec normalizes to ns
                 time_cols[name] = s.to_numpy()
-                cols[name] = s.to_numpy()   # placeholder, replaced below
-                types[name] = VecType.TIME
             elif s.dtype.kind == "b":
                 cols[name] = s.to_numpy().astype(np.float32)
                 types[name] = VecType.INT
             else:
                 cols[name] = s.to_numpy(dtype=np.float32, na_value=np.nan)
-        fr = Frame.from_arrays(
-            {k: v for k, v in cols.items() if k not in time_cols},
-            types={k: t for k, t in types.items() if k not in time_cols})
+        fr = Frame.from_arrays(cols, types=types)
         names, vecs = [], []
         for col in df.columns:
             name = str(col)

@@ -499,14 +499,15 @@ class GBM(SharedTreeBuilder):
                                                0, kwargs, p)
         trees += grown
         job.update(0.9, f"{len(trees)} trees grown")
-        # final margins double as training predictions (skips the re-score)
+        # final margins double as training predictions (skips the re-score);
+        # cached on the transient builder so models never pickle them
         if dist == "bernoulli":
             pe = jax.nn.sigmoid(Fend)
-            train_raw = jnp.stack([1 - pe, pe], axis=1)
+            self._last_train_raw = jnp.stack([1 - pe, pe], axis=1)
         elif dist in ("poisson", "gamma", "tweedie"):
-            train_raw = jnp.exp(jnp.clip(Fend, -30, 30))
+            self._last_train_raw = jnp.exp(jnp.clip(Fend, -30, 30))
         else:
-            train_raw = Fend
+            self._last_train_raw = Fend
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
@@ -514,7 +515,7 @@ class GBM(SharedTreeBuilder):
             response_domain=yvec.domain if yvec.is_categorical else None,
             output=dict(trees=trees, edges=edges, f0=f0, learn_rate=lr,
                         distribution=dist, x_cols=list(x), feat_domains=domains,
-                        ntrees=len(trees), _train_raw=train_raw),
+                        ntrees=len(trees)),
         )
 
     def _grow_with_stopping(self, job, binned, edges, yc, w, fmask_base,
@@ -607,13 +608,13 @@ class GBM(SharedTreeBuilder):
             for k in range(K):
                 trees_multi[k].append(per_class[k])
         job.update(0.9, f"{len(rounds) * K} trees grown")
+        self._last_train_raw = jax.nn.softmax(Fend, axis=1)
 
         return GBMModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain,
             output=dict(trees_multi=trees_multi, edges=edges, f0_multi=f0,
-                        _train_raw=jax.nn.softmax(Fend, axis=1),
                         learn_rate=lr, distribution="multinomial",
                         x_cols=list(x), feat_domains=domains, ntrees=ntrees),
         )
@@ -695,6 +696,7 @@ class DRF(SharedTreeBuilder):
                 gamma=0.0,
                 min_split_improvement=float(p["min_split_improvement"]),
                 lr=1.0, bootstrap=True, drf=True, nclass=nclass)
+            heap = _heap_to_host(heap)
             for m in range(ntrees - done):
                 for k in range(nclass):
                     trees_multi[k].append(_trees_from_stacked(heap, m, k))
@@ -721,6 +723,7 @@ class DRF(SharedTreeBuilder):
             reg_alpha=0.0, gamma=0.0,
             min_split_improvement=float(p["min_split_improvement"]),
             lr=1.0, bootstrap=True, drf=True, nclass=0)
+        heap = _heap_to_host(heap)
         trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
 
         return DRFModel(

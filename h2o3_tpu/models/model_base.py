@@ -290,9 +290,11 @@ class ModelBuilder:
 
     def _holdout_metrics(self, model: Model, frame: Frame, y: str, w: jax.Array):
         from h2o3_tpu.models.data_info import response_as_float
-        # a fit that already holds training-row predictions (e.g. the boosting
-        # scan's final margins) skips the full re-score of the training frame
-        raw = model.output.pop("_train_raw", None) if model.output else None
+        # a fit that already produced training-row predictions (e.g. the
+        # boosting scan's final margins) caches them on the transient builder
+        # — skip the full re-score of the training frame
+        raw = getattr(self, "_last_train_raw", None)
+        self._last_train_raw = None
         if raw is None:
             raw = model._score_raw(frame)
         yy, valid = response_as_float(frame.vec(y))
