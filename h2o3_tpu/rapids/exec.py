@@ -217,4 +217,113 @@ def _eval(node, s: Session):
         rng = np.random.default_rng(int(seed) if seed >= 0 else None)
         return Frame(["rnd"], [Vec.from_numpy(
             rng.random(fr.nrows).astype(np.float32))])
+
+    # -- string prims (reference: ast/prims/string/) ------------------------
+    if op == "strsplit":
+        from h2o3_tpu.rapids import strings as st
+        parts = st.strsplit(_as_vec(args[0]), str(args[1]))
+        return Frame([f"C{i + 1}" for i in range(len(parts))], parts)
+    if op in _STRING_OPS:
+        from h2o3_tpu.rapids import strings as st
+        fn = getattr(st, _STRING_OPS[op])
+        extra = [int(a) if isinstance(a, float) and float(a).is_integer() else a
+                 for a in args[1:]]
+        return _colwise(args[0], lambda v: fn(v, *extra))
+    # -- time prims (reference: ast/prims/time/) ----------------------------
+    if op in _TIME_OPS:
+        from h2o3_tpu.rapids import timeops as tt
+        fn = getattr(tt, _TIME_OPS[op])
+        return _colwise(args[0], fn)
+    # -- advmath / munger prims (reference: ast/prims/advmath, mungers) -----
+    if op == "quantile":
+        probs = np.atleast_1d(args[1]).astype(np.float64) if len(args) > 1 \
+            else np.array([0.25, 0.5, 0.75])
+        return args[0].quantile(list(probs))
+    if op in ("cumsum", "cumprod", "cummin", "cummax"):
+        return _colwise(args[0], lambda v: getattr(ops, op)(v))
+    if op == "cut":
+        fr = args[0]
+        breaks = np.atleast_1d(args[1]).astype(np.float64)
+        return _colwise(fr, lambda v: ops.cut(v, breaks))
+    if op == "hist":
+        nbins = int(args[1]) if len(args) > 1 else 20
+        return ops.hist(_as_vec(args[0]), nbins)
+    if op in ("h2o.impute", "impute"):
+        col = args[1] if len(args) > 1 else None
+        method = args[2] if len(args) > 2 else "mean"
+        return args[0].impute(col, method=method)
+    if op == "scale":
+        center = bool(args[1]) if len(args) > 1 else True
+        sc = bool(args[2]) if len(args) > 2 else True
+        return args[0].scale(center=center, scale=sc)
+    if op == "round":
+        digits = int(args[1]) if len(args) > 1 else 0
+        return _colwise(args[0], lambda v: ops.round_(v, digits))
+    if op == "signif":
+        digits = int(args[1]) if len(args) > 1 else 6
+        return _colwise(args[0], lambda v: ops.signif(v, digits))
+    if op == "table":
+        return munge.table(args[0])
+    if op == "GB" or op == "groupby":
+        fr, by, agg_col, how = args[0], args[1], args[2], args[3]
+        by = [by] if isinstance(by, str) else \
+            [fr.names[int(i)] for i in np.atleast_1d(by)]
+        return munge.group_by(fr, by, {str(agg_col): str(how)})
+    if op == "pivot":
+        return munge.pivot(args[0], str(args[1]), str(args[2]), str(args[3]))
+    if op == "melt":
+        ids = [str(v) for v in (args[1] if isinstance(args[1], list)
+                                else [args[1]])]
+        return munge.melt(args[0], ids)
+    # -- type coercions (reference: ast/prims/operators As*) ----------------
+    if op in ("as.factor", "as.character", "as.numeric", "is.na",
+              "is.factor", "is.numeric"):
+        fr = args[0]
+        from h2o3_tpu.frame.types import VecType
+        import jax.numpy as jnp
+
+        def coerce(v: Vec) -> Vec:
+            if op == "as.factor":
+                if v.is_categorical:
+                    return v
+                vals = np.asarray(v.to_numpy())
+                return Vec.from_numpy(np.array(
+                    ["" if (isinstance(x, float) and np.isnan(x)) else str(x)
+                     for x in vals], dtype=object))
+            if op == "as.character":
+                lab = v.labels() if v.is_categorical else \
+                    np.array([str(x) for x in v.to_numpy()], dtype=object)
+                return Vec.from_numpy(np.asarray(lab, dtype=object),
+                                      type=VecType.STR)
+            if op == "as.numeric":
+                return Vec.from_device(v.as_float(), v.nrows, VecType.NUM)
+            if op == "is.na":
+                isna = (jnp.isnan(v.as_float())
+                        if v.data is not None else
+                        jnp.zeros(v.plen, bool))
+                return Vec.from_device(isna.astype(jnp.float32), v.nrows,
+                                       VecType.INT)
+            flag = v.is_categorical if op == "is.factor" else v.is_numeric
+            return Vec.from_numpy(np.full(v.nrows, float(flag), np.float32))
+        return _colwise(fr, coerce)
+    if op == "colnames":
+        return [str(n) for n in args[0].names]
+    if op == "levels":
+        v = _as_vec(args[0])
+        return list(v.domain or [])
     raise ValueError(f"unknown rapids op {op!r}")
+
+
+_STRING_OPS = {
+    "toupper": "toupper", "tolower": "tolower", "trim": "trim",
+    "lstrip": "lstrip", "rstrip": "rstrip", "nchar": "nchar",
+    "substring": "substring", "sub": "sub", "gsub": "gsub",
+    "grep": "grep", "entropy": "entropy",
+    "startsWith": "startswith", "endsWith": "endswith",
+}
+
+_TIME_OPS = {
+    "year": "year", "month": "month", "day": "day", "hour": "hour",
+    "minute": "minute", "second": "second", "millis": "millis",
+    "dayOfWeek": "day_of_week", "week": "week",
+}

@@ -331,3 +331,51 @@ def test_rapids_exec(rng):
     assert sub.nrows == int((x > 0).sum())
     tmp = rapids("(tmp= t1 (* (cols fr1 'b') 2))")
     np.testing.assert_allclose(tmp.vecs[0].to_numpy(), x * 4, rtol=1e-6)
+
+
+def test_rapids_extended_prims():
+    """Wider AST coverage (reference: ast/prims/{string,time,advmath,mungers})."""
+    from h2o3_tpu.rapids.exec import Session, rapids
+    from h2o3_tpu.utils.registry import DKV
+    import pandas as pd
+
+    fr = Frame.from_arrays({
+        "txt": np.array(["Apple pie", "banana Split", "Cherry"], dtype=object),
+        "x": np.array([1.0, 2.0, 16.0], np.float32),
+        "t": np.array(["2024-03-05 10:30:00", "2023-12-31 23:59:59",
+                       "2020-01-01 00:00:00"], dtype="datetime64[ns]"),
+    }, key="rfr")
+    DKV.put("rfr", fr)
+    s = Session()
+
+    up = rapids('(toupper (cols rfr "txt"))', s)
+    assert list(up.vecs[0].labels()) == ["APPLE PIE", "BANANA SPLIT", "CHERRY"]
+
+    n = rapids('(nchar (cols rfr "txt"))', s)
+    assert list(n.vecs[0].to_numpy()) == [9.0, 12.0, 6.0]
+
+    g = rapids('(gsub (cols rfr "txt") "a" "_")', s)
+    assert g.vecs[0].labels()[1] == "b_n_n_ Split"
+
+    sp = rapids('(strsplit (cols rfr "txt") " ")', s)
+    assert sp.ncols == 2
+
+    yr = rapids('(year (cols rfr "t"))', s)
+    assert list(yr.vecs[0].to_numpy()) == [2024.0, 2023.0, 2020.0]
+    mo = rapids('(month (cols rfr "t"))', s)
+    assert list(mo.vecs[0].to_numpy()) == [3.0, 12.0, 1.0]
+
+    cs = rapids('(cumsum (cols rfr "x"))', s)
+    assert list(cs.vecs[0].to_numpy()) == [1.0, 3.0, 19.0]
+
+    cf = rapids('(as.character (cols rfr "x"))', s)
+    assert cf.vecs[0].type.name == "STR"
+
+    isna = rapids('(is.na (cols rfr "x"))', s)
+    assert list(isna.vecs[0].to_numpy()) == [0.0, 0.0, 0.0]
+
+    cn = rapids('(colnames rfr)', s)
+    assert cn == ["txt", "x", "t"]
+
+    q = rapids('(quantile rfr [0.5])', s)
+    assert q is not None
