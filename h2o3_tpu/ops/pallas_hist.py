@@ -41,9 +41,15 @@ def pallas_available(n_nodes: int, n_feat: int, n_bins_tot: int) -> bool:
         return False
     if n_nodes > _MAX_NODES:
         return False
+    # resident out block + ns scratch + bin one-hot + double-buffered narrow
+    # inputs (padded to 128 lanes); 11MB leaves headroom in 16MB VMEM —
+    # validated up to 257 bins × 64 nodes × 28 features
     S = ((n_bins_tot + 7) // 8) * 8
-    vmem = n_feat * S * n_nodes * 3 * 4 + _TILE * n_nodes * 3 * 4
-    return vmem < 6 * 1024 * 1024
+    vmem = (n_feat * S * n_nodes * 3 * 4        # out block
+            + _TILE * n_nodes * 3 * 4           # ns scratch
+            + S * _TILE * 4                     # bin one-hot
+            + 3 * _TILE * 128 * 4 * 2)          # padded input double-buffers
+    return vmem < 11 * 1024 * 1024
 
 
 def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, N, S, T):
