@@ -28,6 +28,7 @@ from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
 from h2o3_tpu.genmodel import import_mojo
 from h2o3_tpu.explanation import explain, ice, partial_dependence, shap_summary
 from h2o3_tpu.utils.registry import DKV
+from h2o3_tpu.session import cluster, connect, connection, init, shutdown
 
 __version__ = "0.1.0"
 
@@ -59,5 +60,10 @@ __all__ = [
     "mesh_context",
     "num_devices",
     "DKV",
+    "init",
+    "connect",
+    "connection",
+    "cluster",
+    "shutdown",
     "__version__",
 ]

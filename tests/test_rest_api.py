@@ -113,3 +113,19 @@ def test_flow_ui_served(server):
     assert "/3/Cloud" in body
     with urllib.request.urlopen(server.url + "/flow/index.html") as r:
         assert r.status == 200
+
+
+def test_init_connect_cluster_shutdown():
+    """h2o-py session surface: init() boots a node, cluster() reports,
+    connect() attaches, shutdown() tears down."""
+    import h2o3_tpu.session as hc
+    hc.shutdown()                      # clean slate
+    client = hc.init(port=0)
+    st = hc.cluster()
+    assert st["cloud_size"] >= 1
+    c2 = hc.connect(client.url if hasattr(client, "url") else hc._server.url)
+    assert c2.cloud_status()["cloud_healthy"]
+    hc.shutdown()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        hc.cluster()
