@@ -35,6 +35,8 @@ def _connect(connection_url: str, connection_factory=None):
 
 
 def _rows_to_frame(cols, rows, key=None) -> Frame:
+    """Build + DKV-register (imports are addressable by key, like the
+    reference's destination_frame)."""
     n = len(rows)
     arrays = {}
     for i, name in enumerate(cols):
@@ -49,7 +51,11 @@ def _rows_to_frame(cols, rows, key=None) -> Frame:
         arrays[name] = arr
     if n == 0:
         raise ValueError("query returned no rows")
-    return Frame.from_arrays(arrays, key=key)
+    frame = Frame.from_arrays(arrays, key=key)
+    if frame.key:
+        from h2o3_tpu.utils.registry import DKV
+        DKV.put(frame.key, frame)
+    return frame
 
 
 def import_sql_select(connection_url: str, select_query: str,
@@ -78,6 +84,7 @@ def import_sql_table(connection_url: str, table: str,
     ranges (the reference's parallel SELECT ranges, SQLManager.java)."""
     if not table.replace("_", "").replace(".", "").isalnum():
         raise ValueError(f"suspicious table name {table!r}")
+    key = key or table          # default destination key = table name
     collist = ", ".join(columns) if columns else "*"
     conn = _connect(connection_url, connection_factory)
     try:
