@@ -78,6 +78,30 @@ class CoxPHModel(Model):
     def hazard_ratios(self) -> dict[str, float]:
         return {k: float(np.exp(v)) for k, v in self.coefficients().items()}
 
+    def baseline_hazard(self) -> Frame:
+        """Breslow cumulative baseline hazard H0(t) at the covariate mean
+        (reference: CoxPHModel baseline hazard table / R ``survfit``)."""
+        t = self.output["baseline_times"]
+        h = self.output["baseline_cumhaz"]
+        return Frame(["t", "cumhaz"],
+                     [Vec.from_numpy(np.asarray(t, np.float32)),
+                      Vec.from_numpy(np.asarray(h, np.float32))])
+
+    def predict_survival(self, frame: Frame, times) -> Frame:
+        """S(t | x) = exp(-H0(t) · exp(lp)) per row for each requested time
+        (the survfit curve evaluated on new data)."""
+        lp = np.asarray(jax.device_get(self._score_raw(frame)))[: frame.nrows]
+        bt = np.asarray(self.output["baseline_times"])
+        bh = np.asarray(self.output["baseline_cumhaz"])
+        names, vecs = [], []
+        for t in np.atleast_1d(times):
+            idx = np.searchsorted(bt, float(t), side="right") - 1
+            h0 = bh[idx] if idx >= 0 else 0.0
+            s = np.exp(-h0 * np.exp(lp))
+            names.append(f"S_{t:g}")
+            vecs.append(Vec.from_numpy(s.astype(np.float32)))
+        return Frame(names, vecs)
+
 
 class CoxPH(ModelBuilder):
     """h2o-py surface: ``H2OCoxProportionalHazardsEstimator``."""
@@ -183,11 +207,39 @@ class CoxPH(ModelBuilder):
         x_mean = np.asarray(jax.device_get(
             (ws[:, None] * Xs).sum(axis=0) / jnp.maximum(ws.sum(), 1e-30)))
 
+        # Breslow cumulative baseline hazard at the (centered) covariate mean
+        # (reference: CoxPH.java baseline hazard output / R survfit):
+        # dH0(t) = sum(w_i : event at t) / sum(w_j exp((x_j - xbar)β) : t_j >= t)
+        rs = np.asarray(jax.device_get(
+            jnp.exp((Xs - jnp.asarray(x_mean)[None, :]) @ beta))) * np.asarray(
+            jax.device_get(ws))
+        wh_events = np.asarray(jax.device_get(es * ws))
+        # ts is DESCENDING → risk set at time t is the prefix through t's group
+        risk_prefix = np.cumsum(rs)
+        uniq_desc, last_idx = np.unique(-ts, return_index=True)
+        # np.unique on -ts ascending == ts descending; index of FIRST occurrence
+        order_groups = np.argsort(last_idx)
+        times_desc = -uniq_desc[order_groups]
+        bh_t, bh_h = [], []
+        h_acc = 0.0
+        _, group_ids = np.unique(-ts, return_inverse=True)
+        for g in range(group_ids.max() + 1)[::-1]:   # ascending time order
+            sel = group_ids == g
+            d = float(wh_events[sel].sum())
+            t_here = float(ts[sel][0])
+            denom = float(risk_prefix[np.nonzero(sel)[0].max()])
+            if d > 0 and denom > 0:
+                h_acc += d / denom
+            bh_t.append(t_here)
+            bh_h.append(h_acc)
+
         return CoxPHModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=di, response_column=y,
             response_domain=None,
             output=dict(coef=beta, se_coef=se, loglik=ll_prev, iterations=iters,
                         coef_names=di.coef_names, x_mean=x_mean,
+                        baseline_times=np.asarray(bh_t, np.float64),
+                        baseline_cumhaz=np.asarray(bh_h, np.float64),
                         n=int(keep.size), n_events=int(eh.sum())),
         )

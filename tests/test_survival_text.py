@@ -163,3 +163,28 @@ def test_coxph_builder_reusable(rng):
     b.train(x=["x0", "x1"], y="event", training_frame=f)
     b.train(x=["x0", "x1"], y="event", training_frame=f)
     assert b.params["ignored_columns"] is None
+
+
+def test_coxph_baseline_hazard_and_survival(rng):
+    """Breslow baseline hazard + survfit curves (reference: CoxPH baseline
+    hazard output; S(t|x)=exp(-H0(t)e^lp))."""
+    from h2o3_tpu.models import CoxPH
+    n = 400
+    x = rng.normal(size=n).astype(np.float32)
+    # exponential hazards: rate = exp(0.8 x)
+    t = rng.exponential(scale=1.0 / np.exp(0.8 * x)).astype(np.float32)
+    event = (rng.random(n) < 0.8).astype(np.float32)
+    fr = Frame.from_arrays({"x": x, "time": t,
+                            "event": event.astype(np.float32)})
+    m = CoxPH(stop_column="time").train(y="event", training_frame=fr)
+    bh = m.baseline_hazard()
+    tt = bh.vec("t").to_numpy()
+    hh = bh.vec("cumhaz").to_numpy()
+    assert (np.diff(tt) > 0).all()            # ascending times
+    assert (np.diff(hh) >= -1e-9).all()       # cumhaz non-decreasing
+    assert hh[-1] > hh[0] >= 0.0
+    surv = m.predict_survival(fr, times=[np.median(t)])
+    s = surv.vecs[0].to_numpy()
+    assert ((s >= 0) & (s <= 1)).all()
+    # higher-risk rows (larger x) must have LOWER survival
+    assert s[x > 1.0].mean() < s[x < -1.0].mean()
