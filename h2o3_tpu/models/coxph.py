@@ -216,22 +216,17 @@ class CoxPH(ModelBuilder):
         wh_events = np.asarray(jax.device_get(es * ws))
         # ts is DESCENDING → risk set at time t is the prefix through t's group
         risk_prefix = np.cumsum(rs)
-        uniq_desc, last_idx = np.unique(-ts, return_index=True)
-        # np.unique on -ts ascending == ts descending; index of FIRST occurrence
-        order_groups = np.argsort(last_idx)
-        times_desc = -uniq_desc[order_groups]
-        bh_t, bh_h = [], []
-        h_acc = 0.0
-        _, group_ids = np.unique(-ts, return_inverse=True)
-        for g in range(group_ids.max() + 1)[::-1]:   # ascending time order
-            sel = group_ids == g
-            d = float(wh_events[sel].sum())
-            t_here = float(ts[sel][0])
-            denom = float(risk_prefix[np.nonzero(sel)[0].max()])
-            if d > 0 and denom > 0:
-                h_acc += d / denom
-            bh_t.append(t_here)
-            bh_h.append(h_acc)
+        _, group_ids = np.unique(-ts, return_inverse=True)   # 0 = largest time
+        ng = int(group_ids.max()) + 1
+        d = np.bincount(group_ids, weights=wh_events, minlength=ng)
+        last = np.zeros(ng, np.int64)
+        last[group_ids] = np.arange(len(group_ids))    # last write = max index
+        first = np.full(ng, len(group_ids), np.int64)
+        np.minimum.at(first, group_ids, np.arange(len(group_ids)))
+        denom = risk_prefix[last]
+        inc = np.where((d > 0) & (denom > 0), d / np.maximum(denom, 1e-30), 0.0)
+        bh_t = ts[first][::-1]                         # ascending time
+        bh_h = np.cumsum(inc[::-1])
 
         return CoxPHModel(
             key=make_model_key(self.algo, self.model_id),
