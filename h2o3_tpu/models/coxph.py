@@ -216,13 +216,11 @@ class CoxPH(ModelBuilder):
         wh_events = np.asarray(jax.device_get(es * ws))
         # ts is DESCENDING → risk set at time t is the prefix through t's group
         risk_prefix = np.cumsum(rs)
-        _, group_ids = np.unique(-ts, return_inverse=True)   # 0 = largest time
-        ng = int(group_ids.max()) + 1
-        d = np.bincount(group_ids, weights=wh_events, minlength=ng)
-        last = np.zeros(ng, np.int64)
-        last[group_ids] = np.arange(len(group_ids))    # last write = max index
-        first = np.full(ng, len(group_ids), np.int64)
-        np.minimum.at(first, group_ids, np.arange(len(group_ids)))
+        # `group` (tie groups, 0 = largest time) is non-decreasing because ts
+        # is sorted descending, so group boundaries come straight from unique
+        _, first = np.unique(-ts, return_index=True)
+        last = np.append(first[1:] - 1, len(ts) - 1)
+        d = np.bincount(group, weights=wh_events, minlength=n_groups)
         denom = risk_prefix[last]
         inc = np.where((d > 0) & (denom > 0), d / np.maximum(denom, 1e-30), 0.0)
         bh_t = ts[first][::-1]                         # ascending time
