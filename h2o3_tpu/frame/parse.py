@@ -30,6 +30,20 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
     """Parse a file into a Frame (reference: ``h2o.import_file`` → ``POST /3/Parse``)."""
     import pandas as pd
 
+    # URI routing (reference: water/persist/PersistManager scheme dispatch)
+    if "://" in path:
+        scheme = path.split("://", 1)[0].lower()
+        if scheme in ("s3", "s3a", "s3n", "gs", "gcs", "hdfs", "drive"):
+            raise ValueError(
+                f"{scheme}:// persist backend is not enabled in this build "
+                "(reference ships h2o-persist-s3/gcs/hdfs as optional "
+                "modules); download the object locally or serve it over "
+                "http(s) and re-import")
+        if scheme not in ("http", "https", "file"):
+            raise ValueError(f"unknown URI scheme {scheme!r}")
+        if scheme == "file":
+            path = path.split("://", 1)[1]
+
     ext = os.path.splitext(path)[1].lower().lstrip(".")
     if ext in ("parquet", "pq"):
         df = pd.read_parquet(path)

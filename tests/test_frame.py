@@ -160,3 +160,17 @@ rainy,70,TRUE,?
     assert float(fr.vec("temperature").mean()) == pytest.approx((85+83+70)/3)
     lab = fr.vec("play").labels()
     assert list(lab) == ["no", "yes", None]
+
+
+def test_import_file_uri_routing(tmp_path):
+    """PersistManager-style scheme dispatch: gated cloud backends raise
+    informative errors; file:// works."""
+    from h2o3_tpu.frame.parse import import_file
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\n1,2\n3,4\n")
+    fr = import_file(f"file://{p}")
+    assert fr.nrows == 2
+    with pytest.raises(ValueError, match="persist backend"):
+        import_file("s3://bucket/x.csv")
+    with pytest.raises(ValueError, match="unknown URI scheme"):
+        import_file("ftp://host/x.csv")
