@@ -1,0 +1,87 @@
+"""1-device vs N-device numerical equivalence.
+
+Reference contract: an H2O model trained on a 1-node cloud and on a 4-JVM
+localhost cloud (``multiNodeUtils.sh:21-26``) produces the same model given
+the same seed — the MRTask reduces are commutative-associative and the row
+partitioning does not change the math. The TPU equivalent: the same frame
+sharded over a 1-device mesh and an 8-device mesh must yield the same trees /
+coefficients / metrics (within float tolerance — reduction order differs).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.parallel.mesh import ROWS, mesh_context
+
+
+def _make_data(rng, n=512):
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32) for i in range(5)}
+    cols["cat"] = rng.choice(["a", "b", "c"], size=n)
+    cols["y"] = rng.choice(["no", "yes"], size=n)
+    return cols
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), axis_names=(ROWS,))
+
+
+def _train_on_mesh(n_dev, cols, builder_fn):
+    with mesh_context(_mesh(n_dev)):
+        fr = Frame.from_arrays(cols)
+        return builder_fn(fr)
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_gbm_device_count_parity(rng, n_dev):
+    from h2o3_tpu.models.gbm import GBM
+
+    cols = _make_data(rng)
+
+    def build(fr):
+        m = GBM(ntrees=5, max_depth=4, nbins=32, learn_rate=0.2, seed=7).train(
+            y="y", training_frame=fr)
+        preds = m.predict(fr)
+        return (np.asarray(preds.vec("pyes").to_numpy()),
+                m.training_metrics.logloss, m.training_metrics.auc)
+
+    p1, ll1, auc1 = _train_on_mesh(1, cols, build)
+    pn, lln, aucn = _train_on_mesh(n_dev, cols, build)
+
+    np.testing.assert_allclose(p1, pn, rtol=1e-4, atol=1e-5)
+    assert abs(ll1 - lln) < 1e-5
+    assert abs(auc1 - aucn) < 1e-6
+
+
+def test_glm_device_count_parity(rng):
+    from h2o3_tpu.models.glm import GLM
+
+    cols = _make_data(rng)
+
+    def build(fr):
+        m = GLM(family="binomial", lambda_=1e-3, seed=5).train(
+            y="y", training_frame=fr)
+        return np.asarray(m.output["coef"]), m.training_metrics.logloss
+
+    c1, ll1 = _train_on_mesh(1, cols, build)
+    c8, ll8 = _train_on_mesh(8, cols, build)
+
+    np.testing.assert_allclose(c1, c8, rtol=1e-3, atol=1e-4)
+    assert abs(ll1 - ll8) < 1e-5
+
+
+def test_kmeans_device_count_parity(rng):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    cols = {f"x{i}": rng.normal(size=256).astype(np.float32) for i in range(4)}
+
+    def build(fr):
+        m = KMeans(k=3, seed=11, max_iterations=10).train(training_frame=fr)
+        return np.sort(np.asarray(m.output["centers"]), axis=0)
+
+    c1 = _train_on_mesh(1, cols, build)
+    c8 = _train_on_mesh(8, cols, build)
+    np.testing.assert_allclose(c1, c8, rtol=1e-4, atol=1e-4)
