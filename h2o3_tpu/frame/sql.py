@@ -93,9 +93,25 @@ def import_sql_table(connection_url: str, table: str,
             cur.execute(f"SELECT COUNT(*) FROM {table}")   # noqa: S608
             total = cur.fetchone()[0]
             per = max(1, (total + num_chunks - 1) // num_chunks)
+            # SQL result order is unspecified; chunked LIMIT/OFFSET without a
+            # total order can overlap/skip rows on real DBs. The reference
+            # partitions by keyed ranges (SQLManager.java); we impose a
+            # deterministic ORDER BY on every chunk query. sqlite exposes
+            # `rowid`; other DB-API drivers order by ALL fetched columns
+            # (identical rows are interchangeable, so that is a total order
+            # up to permutations that cannot change the assembled frame).
+            if connection_factory is None:
+                order = "rowid"
+            else:
+                cur.execute(f"SELECT {collist} FROM {table} "   # noqa: S608
+                            "LIMIT 1")
+                cur.fetchall()
+                ncols = len(cur.description)
+                order = ", ".join(str(i + 1) for i in range(ncols))
             rows, cols = [], None
             for c in range(num_chunks):
                 cur.execute(f"SELECT {collist} FROM {table} "   # noqa: S608
+                            f"ORDER BY {order} "
                             f"LIMIT {per} OFFSET {c * per}")
                 if cols is None:
                     cols = [d[0] for d in cur.description]

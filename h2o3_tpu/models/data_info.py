@@ -114,6 +114,17 @@ def response_as_float(vec) -> tuple[jax.Array, jax.Array]:
     return yy, valid
 
 
+def response_adapted(vec, train_domain) -> tuple[jax.Array, jax.Array]:
+    """Response as f32 + validity, remapped to the TRAIN domain when the
+    frame's categorical levels differ (``Model.adaptTestForTrain`` semantics;
+    unseen levels → invalid). The single home for held-out response adaptation
+    — model_performance and mid-train validation scoring both route here."""
+    if train_domain and vec.is_categorical and vec.domain != train_domain:
+        codes = _remap_codes(vec.data, vec.domain or (), train_domain)
+        return codes.astype(jnp.float32), codes >= 0
+    return response_as_float(vec)
+
+
 def _remap_codes(codes: jax.Array, src_dom: tuple[str, ...], dst_dom: tuple[str, ...]) -> jax.Array:
     """Align test categorical codes to the train domain (unseen → NA).
 

@@ -91,9 +91,15 @@ def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
         return w * jnp.where(y > F, -a, 1.0 - a), w
     if dist == "huber":
         # reference: delta = huber_alpha quantile of |residual|, refreshed
-        # every iteration (DistributionFactory huber)
+        # every iteration (DistributionFactory huber). Weighted quantile so
+        # zero-weight rows (shard padding, excluded rows) cannot bias delta.
         r = F - y
-        delta = jnp.quantile(jnp.abs(jnp.where(w > 0, r, 0.0)), huber_alpha)
+        ar = jnp.abs(r)
+        order = jnp.argsort(ar)
+        cw = jnp.cumsum(w[order])
+        tgt = huber_alpha * jnp.maximum(cw[-1], 1e-30)
+        idx = jnp.clip(jnp.searchsorted(cw, tgt), 0, ar.shape[0] - 1)
+        delta = ar[order][idx]
         return w * jnp.clip(r, -delta, delta), w
     return w * (F - y), w  # gaussian
 
@@ -494,9 +500,15 @@ class GBM(SharedTreeBuilder):
             huber_alpha=float(p["huber_alpha"]),
             tweedie_power=float(p["tweedie_power"]))
         fmask_base = jnp.ones(X.shape[1], bool)
+        valid = None
+        if int(p.get("stopping_rounds") or 0) > 0:
+            valid = self._valid_stop_data(
+                edges, 0, f0, lr, domains,
+                yvec.domain if yvec.is_categorical else None,
+                prior_trees=trees or None)
         grown, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
                                                fmask_base, Fcur, keys, dist,
-                                               0, kwargs, p)
+                                               0, kwargs, p, valid=valid)
         trees += grown
         job.update(0.9, f"{len(trees)} trees grown")
         # final margins double as training predictions (skips the re-score);
@@ -518,16 +530,112 @@ class GBM(SharedTreeBuilder):
                         ntrees=len(trees)),
         )
 
+    #: early-stopping metrics honored (reference: ScoreKeeper.StoppingMetric)
+    STOPPING_METRICS = ("AUTO", "deviance", "logloss", "MSE", "RMSE", "AUC",
+                        "misclassification")
+
+    def _stop_score(self, metric: str, dist: str, F, y, w, nclass: int) -> float:
+        """Less-is-better score for ``stopping_metric`` (reference:
+        ``ScoreKeeper.stopEarly`` — more-is-better metrics are negated)."""
+        sdist = "multinomial" if nclass > 1 else dist
+        if metric in ("logloss", "misclassification", "AUC") and sdist not in (
+                "bernoulli", "multinomial"):
+            raise ValueError(f"stopping_metric={metric!r} requires a "
+                             "classification distribution")
+        if metric in ("AUTO", "deviance", "logloss"):
+            return float(jax.device_get(_train_deviance(sdist, F, y, w)))
+        if sdist == "bernoulli":
+            prob = jax.nn.sigmoid(F)
+        elif sdist == "multinomial":
+            prob = jax.nn.softmax(F, axis=1)
+        else:
+            prob = None
+        if metric in ("MSE", "RMSE"):
+            if sdist == "bernoulli":
+                err = (prob - y) ** 2
+            elif sdist == "multinomial":
+                ptrue = jnp.take_along_axis(
+                    prob, y.astype(jnp.int32)[:, None], 1)[:, 0]
+                err = (1.0 - ptrue) ** 2
+            else:
+                mu = (jnp.exp(jnp.clip(F, -30, 30))
+                      if sdist in ("poisson", "gamma", "tweedie") else F)
+                err = (mu - y) ** 2
+            mse = float(jax.device_get(
+                (w * err).sum() / jnp.maximum(w.sum(), 1e-30)))
+            return float(np.sqrt(mse)) if metric == "RMSE" else mse
+        if metric == "misclassification":
+            if sdist == "bernoulli":
+                pred = (prob > 0.5).astype(jnp.float32)
+            else:
+                pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
+            return float(jax.device_get(
+                (w * (pred != y)).sum() / jnp.maximum(w.sum(), 1e-30)))
+        if metric == "AUC":
+            if sdist != "bernoulli":
+                raise ValueError("stopping_metric='AUC' requires a binomial "
+                                 "response")
+            # weighted Mann-Whitney AUC (ties across rows ignored — the
+            # stopping test only needs a consistent monotone score)
+            order = jnp.argsort(prob)
+            ys, ws = y[order], w[order]
+            negw = ws * (1.0 - ys)
+            cumneg = jnp.cumsum(negw)
+            posw = ws * ys
+            tot = jnp.maximum(posw.sum() * negw.sum(), 1e-30)
+            auc = float(jax.device_get((posw * cumneg).sum() / tot))
+            return -auc
+        raise ValueError(f"unsupported stopping_metric {metric!r}; have "
+                         f"{self.STOPPING_METRICS}")
+
+    def _valid_stop_data(self, edges, nclass: int, f0, lr: float,
+                         domains, y_domain, prior_trees=None):
+        """Bin the validation frame with the training edges and seed its
+        margins — early stopping then scores the held-out frame per tree
+        chunk (reference: ScoreKeeper scores the validation frame when one
+        is given). Categorical features and response are remapped to the
+        train domains (``Model.adaptTestForTrain`` semantics)."""
+        vf = getattr(self, "_validation_frame", None)
+        if vf is None:
+            return None
+        x = self._x_cols
+        Xv = tree_matrix(vf, x, domains)
+        binned_v = bin_features(Xv, edges)
+        from h2o3_tpu.models.data_info import response_adapted
+        yvec = vf.vec(self._y_col)
+        yv, validv = response_adapted(yvec, y_domain)
+        wv = vf.row_mask().astype(jnp.float32) * validv
+        yv = jnp.where(wv > 0, yv, 0.0)
+        nbins = int(self.params["nbins"])
+        if nclass > 1:
+            Fval = jnp.broadcast_to(
+                jnp.asarray(f0, jnp.float32)[None, :],
+                (Xv.shape[0], nclass)).astype(jnp.float32)
+            if prior_trees:  # checkpoint: [K][ntrees] lists
+                Fval = Fval + lr * jnp.stack(
+                    [predict_binned(binned_v, ts, nbins) for ts in prior_trees],
+                    axis=1)
+        else:
+            Fval = jnp.full(Xv.shape[0], float(f0), jnp.float32)
+            if prior_trees:
+                Fval = Fval + lr * predict_binned(binned_v, prior_trees, nbins)
+        return binned_v, yv, wv, Fval
+
     def _grow_with_stopping(self, job, binned, edges, yc, w, fmask_base,
                             Fcur, keys, dist: str, nclass: int, kwargs: dict,
-                            p) -> list:
+                            p, valid=None) -> list:
         """Run the fused scan; with ``stopping_rounds`` > 0, grow per-tree
-        chunks scoring training deviance between them (reference:
+        chunks scoring ``stopping_metric`` between them — on the validation
+        frame when one was given, else on train (reference:
         ScoreKeeper.stopEarly — stop after k scoring events without a
         relative ``stopping_tolerance`` improvement). The per-tree dispatch
         round-trips only occur when early stopping is requested."""
         M = keys.shape[0]
         sr = int(p.get("stopping_rounds") or 0)
+        metric = str(p.get("stopping_metric") or "AUTO")
+        if metric not in self.STOPPING_METRICS:
+            raise ValueError(f"unsupported stopping_metric {metric!r}; have "
+                             f"{self.STOPPING_METRICS}")
         out_trees: list = []
 
         def collect(heap, count):
@@ -544,15 +652,31 @@ class GBM(SharedTreeBuilder):
             return collect(heap, M), Fcur
 
         tol = float(p.get("stopping_tolerance") or 1e-3)
-        sdist = "multinomial" if nclass > 1 else dist
+        lr = float(kwargs["lr"])
+        nbins = int(kwargs["n_bins"])
         best, since = np.inf, 0
         for i in range(M):
             Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
                                      keys[i:i + 1], **kwargs)
-            out_trees.extend(collect(heap, 1))
-            dev = float(jax.device_get(_train_deviance(sdist, Fcur, yc, w)))
-            job.update(0.1 + 0.8 * (i + 1) / M, f"tree {i + 1}: dev {dev:.5f}")
-            if dev < best * (1.0 - tol) or not np.isfinite(best):
+            new = collect(heap, 1)
+            out_trees.extend(new)
+            if valid is not None:
+                binned_v, yv, wv, Fval = valid
+                if nclass > 1:
+                    Fval = Fval + lr * jnp.stack(
+                        [predict_binned(binned_v, [new[0][k]], nbins)
+                         for k in range(nclass)], axis=1)
+                else:
+                    Fval = Fval + lr * predict_binned(binned_v, new, nbins)
+                valid = (binned_v, yv, wv, Fval)
+                dev = self._stop_score(metric, dist, Fval, yv, wv, nclass)
+            else:
+                dev = self._stop_score(metric, dist, Fcur, yc, w, nclass)
+            shown = -dev if metric == "AUC" else dev   # AUC is negated for
+            job.update(0.1 + 0.8 * (i + 1) / M,        # less-is-better compare
+                       f"tree {i + 1}: {metric} {shown:.5f}")
+            # sign-safe relative improvement: partial deviances can be < 0
+            if dev < best - tol * abs(best) or not np.isfinite(best):
                 best, since = dev, 0
             else:
                 since += 1
@@ -600,10 +724,15 @@ class GBM(SharedTreeBuilder):
             gamma=float(p.get("gamma", 0.0)),
             min_split_improvement=float(p["min_split_improvement"]), lr=lr,
             bootstrap=False, drf=False, nclass=K)
+        valid = None
+        if int(p.get("stopping_rounds") or 0) > 0:
+            valid = self._valid_stop_data(
+                edges, K, f0, lr, domains, yvec.domain,
+                prior_trees=trees_multi if done else None)
         rounds, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
                                                 jnp.ones(X.shape[1], bool),
                                                 Fcur, keys, "multinomial", K,
-                                                kwargs, p)
+                                                kwargs, p, valid=valid)
         for per_class in rounds:
             for k in range(K):
                 trees_multi[k].append(per_class[k])

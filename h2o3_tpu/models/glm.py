@@ -21,6 +21,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from scipy.special import erfc
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import DataInfo
@@ -143,11 +144,11 @@ def _wald_inference(family: str, tw: float, X, yy, w, beta, dev: float):
     pdim = X.shape[1] + 1
     phi = (dev / max(n_eff - pdim, 1.0)
            if family in ("gaussian", "gamma", "tweedie") else 1.0)
-    se = jnp.sqrt(jnp.clip(jnp.diag(inv) * phi, 0.0, None))
-    z = beta / jnp.maximum(se, 1e-30)
-    p = jax.scipy.special.erfc(jnp.abs(z) / np.sqrt(2.0))
-    return (np.asarray(jax.device_get(se)), np.asarray(jax.device_get(z)),
-            np.asarray(jax.device_get(p)))
+    cov = np.asarray(jax.device_get(inv), np.float64) * phi
+    se = np.sqrt(np.clip(np.diag(cov), 0.0, None))
+    z = np.asarray(jax.device_get(beta), np.float64) / np.maximum(se, 1e-30)
+    p = erfc(np.abs(z) / np.sqrt(2.0))
+    return se, z, p, cov
 
 
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
@@ -447,7 +448,20 @@ class GLM(ModelBuilder):
             if float(params["lambda_"]) > 0 or bool(params.get("lambda_search")):
                 raise ValueError("compute_p_values requires no regularization "
                                  "(reference: GLM.java p-values need lambda=0)")
-            se, zv, pv = _wald_inference(family, tw, X, yy, w, beta, dev)
+            se, zv, pv, cov = _wald_inference(family, tw, X, yy, w, beta, dev)
+            if params["standardize"] and di.num_cols:
+                # SEs must be on the same (de-standardized) scale as `coef`:
+                # se_orig[num] = se_std[num] * mul; intercept via the delta
+                # method on b_int - sum_j b_j*mul_j*sub_j using the full cov.
+                s0, nnum = di.ncats_expanded, len(di.num_cols)
+                se = se.copy()
+                se[s0:s0 + nnum] *= mul
+                a = np.zeros(len(b))
+                a[-1] = 1.0
+                a[s0:s0 + nnum] = -(mul * sub)
+                se[-1] = float(np.sqrt(max(a @ cov @ a, 0.0)))
+                zv = coef / np.maximum(se, 1e-30)
+                pv = erfc(np.abs(zv) / np.sqrt(2.0))
             output.update(std_errs=se, z_values=zv, p_values=pv)
         model = GLMModel(
             key=make_model_key(self.algo, self.model_id),

@@ -119,13 +119,9 @@ class Model:
         raw = self._score_raw(frame)
         yvec = frame.vec(self.response_column)
         mask = frame.row_mask()
-        if self.is_classifier and yvec.domain != self.response_domain:
-            from h2o3_tpu.models.data_info import _remap_codes
-            codes = _remap_codes(yvec.data, yvec.domain or (), self.response_domain)
-            y, valid = codes.astype(jnp.float32), codes >= 0
-        else:
-            from h2o3_tpu.models.data_info import response_as_float
-            y, valid = response_as_float(yvec)
+        from h2o3_tpu.models.data_info import response_adapted
+        y, valid = response_adapted(
+            yvec, self.response_domain if self.is_classifier else None)
         return compute_metrics(raw, y, mask & valid, self.nclasses)
 
     # -- persistence hooks ---------------------------------------------------
@@ -246,6 +242,12 @@ class ModelBuilder:
             base_w = base_w * frame.vec(self.params["weights_column"]).data
         if weights is not None:
             base_w = base_w * weights
+
+        # stashed for trainers that score held-out data mid-train (GBM/DRF
+        # early stopping on the validation frame, ScoreKeeper semantics)
+        self._validation_frame = validation_frame
+        self._x_cols = x
+        self._y_col = y
 
         self.job = Job(f"{self.algo} on {frame.key or 'frame'}")
         t0 = time.time()
