@@ -89,30 +89,31 @@ def import_sql_table(connection_url: str, table: str,
     conn = _connect(connection_url, connection_factory)
     try:
         cur = conn.cursor()
-        if fetch_mode.upper() == "DISTRIBUTED":
-            cur.execute(f"SELECT COUNT(*) FROM {table}")   # noqa: S608
-            total = cur.fetchone()[0]
-            per = max(1, (total + num_chunks - 1) // num_chunks)
-            # SQL result order is unspecified; chunked LIMIT/OFFSET without a
-            # total order can overlap/skip rows on real DBs. The reference
-            # partitions by keyed ranges (SQLManager.java); we impose a
-            # deterministic ORDER BY on every chunk query. sqlite exposes
-            # `rowid`; other DB-API drivers order by ALL fetched columns
-            # (identical rows are interchangeable, so that is a total order
-            # up to permutations that cannot change the assembled frame).
-            if connection_factory is None:
-                order = "rowid"
-            else:
-                cur.execute(f"SELECT {collist} FROM {table} "   # noqa: S608
-                            "LIMIT 1")
-                cur.fetchall()
-                ncols = len(cur.description)
-                order = ", ".join(str(i + 1) for i in range(ncols))
+        # The reference's DISTRIBUTED mode partitions by KEYED ranges
+        # (SQLManager.java: WHERE id > a AND id <= b per node) — never
+        # LIMIT/OFFSET, whose unspecified order can overlap/skip rows.
+        # Keyed ranges need a key: sqlite exposes `rowid`, so we range over
+        # it there; for other DB-API drivers (and sqlite views/WITHOUT-ROWID
+        # tables, which have no rowid) a single-controller ingest gains
+        # nothing from chunked scans, so they take the one-SELECT path.
+        ranges = None
+        if fetch_mode.upper() == "DISTRIBUTED" and connection_factory is None:
+            try:
+                cur.execute(f"SELECT MIN(rowid), MAX(rowid) FROM {table}")  # noqa: S608
+                lo, hi = cur.fetchone()
+            except Exception:
+                lo = hi = None      # view / WITHOUT ROWID: fall through
+            if lo is not None:
+                per = max(1, (hi - lo + 1 + num_chunks - 1) // num_chunks)
+                ranges = [(lo - 1 + c * per, min(lo - 1 + (c + 1) * per, hi))
+                          for c in range(num_chunks)
+                          if lo - 1 + c * per < hi]
+        if ranges is not None:
             rows, cols = [], None
-            for c in range(num_chunks):
+            for a, b in ranges:
                 cur.execute(f"SELECT {collist} FROM {table} "   # noqa: S608
-                            f"ORDER BY {order} "
-                            f"LIMIT {per} OFFSET {c * per}")
+                            f"WHERE rowid > {a} AND rowid <= {b} "
+                            "ORDER BY rowid")
                 if cols is None:
                     cols = [d[0] for d in cur.description]
                 rows.extend(cur.fetchall())

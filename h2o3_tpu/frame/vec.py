@@ -37,11 +37,22 @@ def padded_len(nrows: int, ndev: int | None = None) -> int:
     return max(unit, ((nrows + unit - 1) // unit) * unit)
 
 
+def _put(host: np.ndarray, sharding) -> jax.Array:
+    """Host→device under the given sharding. Multi-process: the sharding may
+    span devices this process cannot address — materialize only the local
+    shards from the (replicated) host array (every process holds the full
+    ingest, the cross-host Frame layout comes from the mesh)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+    return jax.device_put(host, sharding)
+
+
 def _upload(host: np.ndarray, nrows: int, fill) -> jax.Array:
     plen = padded_len(nrows)
     padded = np.full(plen, fill, dtype=host.dtype)
     padded[:nrows] = host
-    return jax.device_put(padded, row_sharding(1))
+    return _put(padded, row_sharding(1))
 
 
 def upload_columns(hosts: list[np.ndarray], nrows: int, fill, dtype) -> list[jax.Array]:
@@ -59,7 +70,7 @@ def upload_columns(hosts: list[np.ndarray], nrows: int, fill, dtype) -> list[jax
     mat = np.full((len(hosts), plen), fill, dtype=dtype)
     for i, h in enumerate(hosts):
         mat[i, :nrows] = h
-    dev = jax.device_put(mat, NamedSharding(get_mesh(), P(None, ROWS)))
+    dev = _put(mat, NamedSharding(get_mesh(), P(None, ROWS)))
     return [dev[i] for i in range(len(hosts))]
 
 
@@ -172,7 +183,8 @@ class Vec:
             return self.host_values
         if self.type is VecType.TIME and self.host_values is not None:
             return self.host_values[: self.nrows]
-        return np.asarray(jax.device_get(self.data))[: self.nrows]
+        from h2o3_tpu.parallel.distributed import fetch
+        return fetch(self.data)[: self.nrows]
 
     def labels(self) -> np.ndarray:
         """Categorical column as its level strings (NA → None); the view the

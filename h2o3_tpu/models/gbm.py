@@ -605,6 +605,9 @@ class GBM(SharedTreeBuilder):
         yvec = vf.vec(self._y_col)
         yv, validv = response_adapted(yvec, y_domain)
         wv = vf.row_mask().astype(jnp.float32) * validv
+        wcol = self.params.get("weights_column")
+        if wcol and wcol in vf:
+            wv = wv * vf.vec(wcol).data
         yv = jnp.where(wv > 0, yv, 0.0)
         nbins = int(self.params["nbins"])
         if nclass > 1:

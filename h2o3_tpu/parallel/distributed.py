@@ -1,0 +1,87 @@
+"""Multi-process (multi-host) runtime — the TPU-native "cloud".
+
+Reference: a multi-node H2O cloud forms by heartbeat gossip until every JVM
+agrees on the member list (``water/H2O.java:1890`` ``startLocalNode``,
+``:2099`` ``waitForCloudSize``; ``water/Paxos.java``). The TPU equivalent is
+JAX's multi-controller runtime: every process runs the same program, calls
+:func:`jax.distributed.initialize` against a coordinator address, and the
+global device mesh — spanning every process's chips — IS the locked cloud.
+XLA collectives over ICI/DCN replace the reference's UDP+TCP RPC.
+
+Single-controller semantics are preserved: after :func:`init_distributed`
+the process-global mesh (``parallel/mesh.py``) covers ALL processes' devices,
+frames upload row-sharded across hosts (each process materializes its own
+row range — ``jax.make_array_from_callback``), and every jitted step is the
+same SPMD program on every process.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None,
+                     local_device_ids=None) -> None:
+    """Join (or form) a multi-process cloud and install the spanning mesh.
+
+    Mirrors ``h2o.init(...)`` on a multi-node cluster: blocks until all
+    ``num_processes`` processes have connected to the coordinator (the
+    reference's ``waitForCloudSize``), then installs a global 1-D ``"rows"``
+    mesh over every device in the cloud.
+
+    On a single process (all args None) this is a no-op beyond mesh setup.
+    """
+    global _initialized
+    if coordinator_address is not None and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+        _initialized = True
+    # (re)install the default mesh over the now-global device set
+    from h2o3_tpu.parallel.mesh import set_mesh
+    set_mesh(None)
+
+
+def shutdown_distributed() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def fetch(arr: jax.Array) -> np.ndarray:
+    """Gather a (possibly cross-process row-sharded) array to every host.
+
+    Single-process: plain ``device_get``. Multi-process: non-addressable
+    shards are exchanged via an all-gather collective (the reference's
+    equivalent is a ``TaskGetKey`` fetch of remote chunks to the caller)."""
+    if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def barrier(name: str = "sync") -> None:
+    """Cross-process sync point (reference: ``MRTask`` blocking ``doAll``)."""
+    if is_multiprocess():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

@@ -21,15 +21,32 @@ _server = None
 _client = None
 
 
-def init(port: int = 54321, strict_port: bool = False) -> "H2OClient":
+def init(port: int = 54321, strict_port: bool = False,
+         coordinator_address: str | None = None,
+         num_processes: int | None = None,
+         process_id: int | None = None) -> "H2OClient":
     """Start (once) an in-process server and bind the module client
     (h2o-py: ``h2o.init``). Falls back to an ephemeral port unless
-    ``strict_port``."""
+    ``strict_port``.
+
+    Multi-host: pass ``coordinator_address`` (+ ``num_processes`` /
+    ``process_id``) to join a process-spanning cloud first — every process
+    calls ``init`` with the same coordinator, blocks until the cloud forms
+    (reference ``waitForCloudSize``, ``water/H2O.java:2099``), and installs
+    a mesh over all hosts' devices. See also ``python -m h2o3_tpu.launch``.
+    Only process 0 serves REST (the reference: any node serves, one answers).
+    """
     from h2o3_tpu.api.client import H2OClient
     from h2o3_tpu.api.server import H2OServer
     global _server, _client
     if _client is not None:
         return _client
+    if coordinator_address is not None:
+        from h2o3_tpu.parallel.distributed import init_distributed
+        init_distributed(coordinator_address, num_processes, process_id)
+        import jax
+        if jax.process_index() != 0:
+            return None
     try:
         _server = H2OServer(port=port).start()
     except OSError:

@@ -1,0 +1,59 @@
+"""Worker script for the 2-process cloud integration test.
+
+Run via ``python -m h2o3_tpu.launch --fork 2 ...`` — each process joins the
+cloud, verifies the spanning mesh, trains GBM + GLM on a frame row-sharded
+ACROSS the processes, and writes its metrics to ``<outdir>/proc<i>.json``.
+The parent test asserts both processes agree and match the single-process
+result (the reference contract: the 4-JVM localhost cloud of
+``multiNodeUtils.sh`` trains the same model as one JVM).
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+outdir = sys.argv[1]
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+assert len(jax.local_devices()) == 4
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.parallel.distributed import barrier, fetch
+from h2o3_tpu.models.gbm import GBM
+from h2o3_tpu.models.glm import GLM
+
+rng = np.random.default_rng(9)
+n = 400
+cols = {f"x{i}": rng.normal(size=n).astype(np.float32) for i in range(4)}
+cols["y"] = np.array(["no", "yes"], dtype=object)[
+    (rng.random(n) < 1 / (1 + np.exp(-2 * cols["x0"]))).astype(int)]
+fr = Frame.from_arrays(cols)
+
+# the frame must really span both processes' devices
+devs = {s.device for s in fr.vec("x0").data.addressable_shards}
+assert len(devs) == 4, devs
+assert not fr.vec("x0").data.is_fully_addressable
+
+gbm = GBM(ntrees=3, max_depth=3, nbins=16, seed=2).train(y="y", training_frame=fr)
+glm = GLM(family="binomial", lambda_=1e-3, seed=2).train(y="y", training_frame=fr)
+
+pred = fetch(gbm.predict(fr).vec("pyes").data)[:n]
+
+out = dict(
+    process=jax.process_index(),
+    gbm_logloss=float(gbm.training_metrics.logloss),
+    gbm_auc=float(gbm.training_metrics.auc),
+    glm_logloss=float(glm.training_metrics.logloss),
+    glm_coef=[float(c) for c in np.asarray(glm.output["coef"])],
+    pred_head=[float(p) for p in pred[:16]],
+)
+os.makedirs(outdir, exist_ok=True)
+with open(os.path.join(outdir, f"proc{jax.process_index()}.json"), "w") as f:
+    json.dump(out, f)
+
+barrier("done")
+print(f"proc {jax.process_index()} OK")

@@ -1,0 +1,60 @@
+"""Multi-process cloud: 2 processes × 4 CPU devices = one 8-device mesh.
+
+Reference: ``multiNodeUtils.sh:21-26`` boots a 4-JVM localhost cloud for the
+Java test suite; training there must equal single-JVM training. Here the
+launcher forks 2 processes that join via ``jax.distributed`` and train over
+a frame sharded across BOTH processes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_two_process_cloud(tmp_path):
+    script = os.path.join(REPO, "tests", "scripts", "multiproc_train.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.launch", "--fork", "2",
+         "--devices-per-process", "4", "--port", "7455",
+         script, str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+
+    with open(tmp_path / "proc0.json") as f:
+        r0 = json.load(f)
+    with open(tmp_path / "proc1.json") as f:
+        r1 = json.load(f)
+
+    # both controllers computed the SAME model (SPMD: identical programs,
+    # identical reductions)
+    assert r0["gbm_logloss"] == pytest.approx(r1["gbm_logloss"], abs=1e-7)
+    assert r0["gbm_auc"] == pytest.approx(r1["gbm_auc"], abs=1e-7)
+    assert r0["glm_logloss"] == pytest.approx(r1["glm_logloss"], abs=1e-7)
+    np.testing.assert_allclose(r0["glm_coef"], r1["glm_coef"], rtol=1e-6)
+    np.testing.assert_allclose(r0["pred_head"], r1["pred_head"], rtol=1e-6)
+
+    # and it matches the single-process 8-device model on the same data/seed
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import GBM
+
+    rng = np.random.default_rng(9)
+    n = 400
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32) for i in range(4)}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[
+        (rng.random(n) < 1 / (1 + np.exp(-2 * cols["x0"]))).astype(int)]
+    fr = Frame.from_arrays(cols)
+    gbm = GBM(ntrees=3, max_depth=3, nbins=16, seed=2).train(
+        y="y", training_frame=fr)
+    assert r0["gbm_logloss"] == pytest.approx(
+        float(gbm.training_metrics.logloss), abs=1e-5)
