@@ -114,5 +114,64 @@ class H2OClient:
         job = self._poll(out["job"]["key"]["name"])
         return self.request("GET", f"/99/Grids/{job['dest']['name']}")
 
+    # -- round-2 parity surface ----------------------------------------------
+
+    def parse_setup(self, source_frames: list[str]) -> dict:
+        return self.request("POST", "/3/ParseSetup",
+                            {"source_frames": source_frames})
+
+    def split_frame(self, frame_key: str, ratios: list[float],
+                    destination_frames: list[str] | None = None) -> list[str]:
+        d = {"dataset": frame_key, "ratios": ratios}
+        if destination_frames:
+            d["destination_frames"] = destination_frames
+        out = self.request("POST", "/3/SplitFrame", d)
+        self._poll(out["key"]["name"])
+        return [f["name"] for f in out["destination_frames"]]
+
+    def model_metrics(self, model_key: str, frame_key: str) -> dict:
+        out = self.request(
+            "POST", f"/3/ModelMetrics/models/{model_key}/frames/{frame_key}")
+        return out["model_metrics"][0]
+
+    def partial_dependence(self, model_key: str, frame_key: str,
+                           cols: list[str], nbins: int = 20) -> list[dict]:
+        out = self.request("POST", "/3/PartialDependence/",
+                           {"model_id": model_key, "frame_id": frame_key,
+                            "cols": cols, "nbins": nbins})
+        self._poll(out["key"]["name"])
+        got = self.request("GET",
+                           f"/3/PartialDependence/{out['destination_key']}")
+        return got["partial_dependence_data"]
+
+    def quantiles(self, frame_key: str, column: str,
+                  probs: list[float] = (0.25, 0.5, 0.75)) -> list[float]:
+        res = self.rapids(
+            f"(quantile (cols {frame_key} \"{column}\") [{' '.join(map(str, probs))}])")
+        fr = self.frame(res["key"]["name"])
+        qcol = [c for c in fr["columns"] if c["label"] == column][0]
+        return qcol["data"]
+
+    def typeahead(self, src: str, limit: int = 100) -> list[str]:
+        q = urllib.parse.urlencode({"src": src, "limit": limit})
+        return self.request("GET", f"/3/Typeahead/files?{q}")["matches"]
+
+    def save_model(self, model_key: str, directory: str) -> str:
+        q = urllib.parse.urlencode({"dir": directory})
+        return self.request("GET", f"/99/Models.bin/{model_key}?{q}")["dir"]
+
+    def load_model(self, path: str) -> str:
+        out = self.request("POST", "/99/Models.bin/", {"dir": path})
+        return out["models"][0]["model_id"]["name"]
+
+    def remove_all(self) -> None:
+        self.request("DELETE", "/3/DKV")
+
+    def jobs(self) -> list[dict]:
+        return self.request("GET", "/3/Jobs")["jobs"]
+
+    def ping(self) -> bool:
+        return bool(self.request("GET", "/3/Ping").get("healthy"))
+
     def shutdown(self) -> None:
         self.request("POST", "/3/Shutdown")

@@ -40,29 +40,60 @@ def _meta(schema_type: str) -> dict:
 def cloud_v3(version: str) -> dict:
     import jax
     devs = jax.devices()
+    # field set mirrors water/api/schemas3/CloudV3.java — the real h2o-py
+    # client's H2OCluster reads these at connect time
     return {**_meta("CloudV3"), "version": version, "cloud_name": "h2o3_tpu",
-            "cloud_size": len(devs), "cloud_healthy": True,
-            "nodes": [{"h2o": str(d), "healthy": True, "num_cpus": 1}
+            "cloud_size": len(devs), "cloud_healthy": True, "bad_nodes": 0,
+            "consensus": True, "locked": True, "is_client": False,
+            "cloud_uptime_millis": 0, "internal_security_enabled": False,
+            "branch_name": "tpu", "build_number": "0", "build_age": "",
+            "build_too_old": False, "node_idx": 0,
+            "cloud_internal_timezone": "UTC",
+            "datafile_parser_timezone": "UTC",
+            "nodes": [{"h2o": str(d), "healthy": True, "num_cpus": 1,
+                       "cpus_allowed": 1, "free_mem": 0, "max_mem": 0,
+                       "mem_value_size": 0, "pojo_mem": 0, "swap_mem": 0,
+                       "free_disk": 0, "max_disk": 0, "num_keys": 0,
+                       "tcps_active": 0, "open_fds": 0, "rpcs_active": 0,
+                       "last_ping": 0, "sys_load": 0.0,
+                       "my_cpu_pct": 0, "sys_cpu_pct": 0, "pid": 0}
                       for d in devs]}
 
 
 def frame_v3(key: str, frame, rows: int = 10) -> dict:
+    """FrameV3 with the exact per-column fields h2o-py's expr cache pops
+    (``h2o-py/h2o/expr.py:_fill_data``): __meta, domain_cardinality,
+    string_data, data; enum data = integer codes + domain (reference
+    water/api/schemas3/FrameV3.java ColV3)."""
     cols = []
-    head = frame.to_pandas().head(rows)
     for name, vec in zip(frame.names, frame.vecs):
-        r = vec.rollups()
-        col = {"label": name, "type": str(vec.type).lower(),
+        r = vec.rollups()     # handles host-resident (string/uuid) vecs too
+        if rows <= 0:
+            data, sdata = [], None
+        elif vec.type.value == "string" or not vec.type.on_device:
+            data, sdata = None, [None if v is None else str(v)
+                                 for v in vec.to_numpy()[:rows]]
+        else:
+            data, sdata = _clean(vec.to_numpy()[:rows]), None
+        col = {"__meta": {"schema_name": "ColV3", "schema_type": "ColV3"},
+               "label": name, "type": vec.type.value,
                "missing_count": int(r.na_cnt),
                "domain": list(vec.domain) if vec.domain else None,
                "domain_cardinality": vec.cardinality(),
-               "data": _clean(head[name].to_numpy() if name in head else [])}
+               "data": data, "string_data": sdata,
+               "precision": 0, "zero_count": 0,
+               "positive_infinity_count": 0, "negative_infinity_count": 0}
         if vec.is_numeric:
             col.update(mins=[_clean(r.min)], maxs=[_clean(r.max)],
                        mean=_clean(r.mean), sigma=_clean(r.sigma))
+        else:
+            col.update(mins=[], maxs=[], mean=None, sigma=None)
         cols.append(col)
     return {**_meta("FrameV3"), "frame_id": {"name": key},
             "rows": frame.nrows, "row_count": frame.nrows,
-            "column_count": frame.ncols, "columns": cols}
+            "row_offset": 0, "column_offset": 0,
+            "column_count": frame.ncols, "total_column_count": frame.ncols,
+            "columns": cols}
 
 
 def frames_list_v3(store) -> dict:
@@ -84,7 +115,24 @@ def metrics_v3(mm) -> dict | None:
         v = getattr(mm, f, None)
         if v is not None and not callable(v):
             out[f] = _clean(v)
-    return {**_meta("ModelMetricsV3"), **out}
+    # h2o-py's metrics mixins read the reference's exact (capitalized) keys
+    # and pick their class from __meta.schema_name (h2o/model/metrics/)
+    schema = {"ModelMetricsBinomial": "ModelMetricsBinomialV3",
+              "ModelMetricsMultinomial": "ModelMetricsMultinomialV3",
+              "ModelMetricsRegression": "ModelMetricsRegressionV3",
+              "ModelMetricsClustering": "ModelMetricsClusteringV3",
+              }.get(type(mm).__name__, "ModelMetricsV3")
+    for lower, upper in (("mse", "MSE"), ("rmse", "RMSE"), ("auc", "AUC"),
+                         ("gini", "Gini"), ("r2", "r2")):
+        v = getattr(mm, lower, None)
+        if v is not None and not callable(v):
+            out[upper] = _clean(v)
+    out.setdefault("nobs", _clean(getattr(mm, "nobs", 0)))
+    out["description"] = None
+    out["custom_metric_name"] = None
+    out["custom_metric_value"] = 0.0
+    out["scoring_time"] = 0
+    return {**_meta(schema), **out}
 
 
 def model_v3(model) -> dict:
@@ -119,9 +167,13 @@ def job_v3(job_id: str, job) -> dict:
               "CANCELLED": "CANCELLED"}.get(job.status, job.status)
     d = {**_meta("JobV3"), "key": {"name": job_id}, "status": status,
          "progress": _clean(job.progress), "progress_msg": job.progress_msg,
-         "msec": int(job.run_time * 1000)}
+         "msec": int(job.run_time * 1000),
+         "description": getattr(job, "description", ""),
+         "auto_recoverable": False,  # these three are read unconditionally
+         "exception": None,          # by h2o-py's H2OJob init/poll loop
+         "warnings": None,
+         "dest": {"name": getattr(job, "dest_key", None) or job_id}}
     if job.status == "FAILED" and job.exception is not None:
         d["exception"] = str(job.exception)
-    if getattr(job, "dest_key", None):
-        d["dest"] = {"name": job.dest_key}
+        d["stacktrace"] = ""
     return d

@@ -54,6 +54,21 @@ def _algo_registry():
     return _ALGOS
 
 
+def _name(x):
+    """Unwrap h2o-py's KeyV3 payloads: {"name": k} → k."""
+    return x.get("name") if isinstance(x, dict) else x
+
+
+def _done_job(description: str, dest_key: str | None = None) -> dict:
+    """A completed, DKV-registered job serialized as JobV3 — synchronous
+    routes still hand h2o-py's H2OJob wrapper a pollable job payload."""
+    job = Job(description, key=f"job_{uuid.uuid4().hex[:12]}")
+    if dest_key:
+        job.dest_key = dest_key
+    job.run(lambda j: dest_key, background=False)
+    return schemas.job_v3(job.key, job)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = f"h2o3_tpu/{__version__}"
 
@@ -63,6 +78,12 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, obj, code: int = 200):
+        meta = obj.get("__meta") if isinstance(obj, dict) else None
+        if isinstance(meta, dict) and "schema_name" not in meta:
+            # h2o-py's response hook requires __meta.schema_name on every
+            # payload (h2o-py/h2o/backend/connection.py H2OResponse)
+            meta.setdefault("schema_name", meta.get("schema_type", "IcedV3"))
+            meta.setdefault("schema_version", 3)
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -71,8 +92,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _error(self, code: int, msg: str):
+        import time as _t
         self._reply({"__meta": {"schema_type": "H2OErrorV3"},
-                     "http_status": code, "msg": msg, "exception_msg": msg}, code)
+                     "http_status": code, "msg": msg, "exception_msg": msg,
+                     "timestamp": int(_t.time() * 1000),
+                     "error_url": self.path, "dev_msg": msg,
+                     "exception_type": "java.lang.RuntimeException",
+                     "values": {}, "stacktrace": []}, code)
 
     def _params(self) -> dict:
         q = urllib.parse.urlparse(self.path).query
@@ -97,6 +123,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self._route("DELETE")
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.end_headers()
 
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
@@ -128,20 +158,43 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "ImportFilesV3"},
                      "destination_frames": [fr.key], "fails": []})
 
+    def r_import_multi(self):
+        """Reference ImportFilesMulti: h2o-py sends paths as "[p1,p2]"."""
+        p = self._params()
+        paths = p.get("paths", "")
+        if isinstance(paths, str):
+            paths = [s.strip() for s in paths.strip("[]").split(",") if s.strip()]
+        from h2o3_tpu.frame.parse import import_file
+        keys, fails = [], []
+        for path in paths:
+            try:
+                keys.append(import_file(path).key)
+            except Exception as e:     # noqa: BLE001 — report per-file fails
+                fails.append(f"{path}: {e}")
+        self._reply({"__meta": {"schema_type": "ImportFilesV3"},
+                     "destination_frames": keys, "fails": fails})
+
     def r_parse(self):
         # the reference splits guess (ParseSetup) and parse; import_file did
-        # both, so Parse is an alias that can re-key the frame
+        # both, so Parse re-keys the already-parsed frame and hands back an
+        # immediately-DONE job for the client's poll loop
         p = self._params()
         src = json.loads(p["source_frames"]) if isinstance(
             p.get("source_frames"), str) else p.get("source_frames", [])
         src_key = (src[0] if src else p.get("source_key", ""))
-        src_key = src_key.get("name") if isinstance(src_key, dict) else src_key
+        src_key = _name(src_key)
         fr = DKV[src_key]
-        dest = p.get("destination_frame") or src_key
+        dest = _name(p.get("destination_frame")) or src_key
+        if dest != src_key:
+            DKV.remove(src_key)
         fr.key = dest
         DKV.put(dest, fr)
+        job = Job("Parse", key=f"job_{uuid.uuid4().hex[:12]}")
+        job.run(lambda j: setattr(j, "dest_key", dest) or dest,
+                background=False)
         self._reply({"__meta": {"schema_type": "ParseV3"},
                      "destination_frame": {"name": dest},
+                     "job": schemas.job_v3(job.key, job),
                      "rows": fr.nrows})
 
     def r_frames(self):
@@ -201,8 +254,13 @@ class _Handler(BaseHTTPRequestHandler):
                     v = json.loads(v)
             kwargs[k] = v
         builder = cls(**kwargs)
+        # pre-assign the model key: h2o-py's H2OJob reads dest.name from the
+        # INITIAL builder response, before the background train finishes
+        builder.model_id = (p.get("model_id")
+                            or f"{algo.lower()}_{uuid.uuid4().hex[:10]}")
 
         job = Job(f"{algo} via REST", key=f"job_{uuid.uuid4().hex[:12]}")
+        job.dest_key = builder.model_id
 
         def driver(j: Job):
             m = builder.train(x=x, y=y, training_frame=frame,
@@ -212,7 +270,9 @@ class _Handler(BaseHTTPRequestHandler):
 
         job.run(driver, background=True)
         self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
-                     "job": schemas.job_v3(job.key, job)})
+                     "job": schemas.job_v3(job.key, job),
+                     "messages": [], "error_count": 0,
+                     "parameters": [], "algo": algo.lower()})
 
     def r_job(self, key):
         job = DKV[key]
@@ -233,16 +293,39 @@ class _Handler(BaseHTTPRequestHandler):
                      "predictions_frame": {"name": dest},
                      "model_metrics": []})
 
+    def r_predict_v4(self, model_key, frame_key):
+        """V4 surface: h2o-py model.predict POSTs here and polls the job."""
+        m, fr = DKV[model_key], DKV[frame_key]
+        dest = f"prediction_{uuid.uuid4().hex[:8]}"
+        job = Job("Predict", key=f"job_{uuid.uuid4().hex[:12]}")
+        job.dest_key = dest
+
+        def driver(j: Job):
+            pred = m.predict(fr)
+            pred.key = dest
+            DKV.put(dest, pred)
+            return pred
+
+        job.run(driver, background=False)
+        self._reply({"__meta": {"schema_type": "JobV4"},
+                     "job": schemas.job_v3(job.key, job)})
+
     def r_rapids(self):
         p = self._params()
         from h2o3_tpu.rapids import rapids
-        res = rapids(p["ast"])
+        from h2o3_tpu.rapids.exec import Session
+        # temp-frame scope persists across calls within one client session
+        # (reference: water/rapids/Session.java keyed by session_id)
+        sid = p.get("session_id") or self.server._session_id
+        sess = self.server._rapids_sessions.setdefault(sid, Session())
+        res = rapids(p["ast"], session=sess)
         if isinstance(res, Frame):
-            key = p.get("id") or f"rapids_{uuid.uuid4().hex[:8]}"
+            key = p.get("id") or res.key or f"rapids_{uuid.uuid4().hex[:8]}"
             res.key = key
             DKV.put(key, res)
             self._reply({"__meta": {"schema_type": "RapidsFrameV3"},
-                         "key": {"name": key}})
+                         "key": {"name": key},
+                         "num_rows": res.nrows, "num_cols": res.ncols})
         elif isinstance(res, (int, float)):
             self._reply({"__meta": {"schema_type": "RapidsNumberV3"},
                          "scalar": schemas._clean(res)})
@@ -380,12 +463,561 @@ class _Handler(BaseHTTPRequestHandler):
                          for h in logging.getLogger("h2o3_tpu").handlers
                          for r in getattr(h, "buffer", []))})
 
+    # -- round-2 parity sweep: the routes the real h2o-py client traffics
+    #    (reference registrations: water/api/RegisterV3Api.java) -------------
+
+    def r_ping(self):
+        self._reply({"__meta": {"schema_type": "PingV3"}, "healthy": True})
+
+    def r_jobs(self):
+        jobs = [schemas.job_v3(k, DKV[k]) for k in DKV.keys()
+                if isinstance(DKV.get(k), Job)]
+        self._reply({"__meta": {"schema_type": "JobsV3"}, "jobs": jobs})
+
+    def r_parse_setup(self):
+        """Reference ParseSetupHandler: guess header/types from the source.
+        Sources that are already parsed frames report their schema; raw
+        paths get imported (our import does guess+parse in one pass)."""
+        p = self._params()
+        src = p.get("source_frames", [])
+        if isinstance(src, str):
+            src = json.loads(src)
+        keys = [s.get("name") if isinstance(s, dict) else s for s in src]
+        if not keys:
+            raise KeyError("source_frames is required")
+        frames = []
+        for k in keys:
+            if k in DKV and isinstance(DKV[k], Frame):
+                frames.append(DKV[k])
+            else:
+                from h2o3_tpu.frame.parse import import_file
+                frames.append(import_file(k))
+        fr = frames[0]
+        type_names = {"real": "Numeric", "int": "Numeric", "enum": "Enum",
+                      "string": "String", "time": "Time", "uuid": "UUID"}
+        self._reply({"__meta": {"schema_type": "ParseSetupV3"},
+                     "source_frames": [{"name": k} for k in keys],
+                     "destination_frame": (keys[0].rsplit("/", 1)[-1]
+                                           .replace(".", "_") + ".hex"),
+                     "number_columns": fr.ncols,
+                     "column_names": list(fr.names),
+                     "column_types": [type_names.get(v.type.value, "Numeric")
+                                      for v in fr.vecs],
+                     "separator": 44, "check_header": 1,
+                     "parse_type": "CSV", "chunk_size": 4194304,
+                     "na_strings": None, "single_quotes": False,
+                     "escapechar": None, "skipped_columns": None,
+                     "custom_non_data_line_markers": None,
+                     "partition_by": None})
+
+    def r_split_frame(self):
+        """Reference SplitFrameHandler (hex/splitframe/SplitFrame.java):
+        EXACT contiguous row split by ratios (unlike the client-side
+        probabilistic H2OFrame.split_frame)."""
+        p = self._params()
+        fr = DKV[_name(p["dataset"])]
+        ratios = p["ratios"]
+        if isinstance(ratios, str):
+            ratios = json.loads(ratios)
+        dests = p.get("destination_frames")
+        if isinstance(dests, str):
+            dests = json.loads(dests)
+        dests = [_name(d) for d in dests] if dests else [
+            f"split_{uuid.uuid4().hex[:6]}_{i}" for i in range(len(ratios) + 1)]
+        import numpy as np
+        from h2o3_tpu.rapids.munge import gather_rows
+        n = fr.nrows
+        counts = [int(round(r * n)) for r in ratios]
+        counts.append(n - sum(counts))
+        job = Job("SplitFrame", key=f"job_{uuid.uuid4().hex[:12]}")
+
+        def driver(j: Job):
+            start = 0
+            for dest, c in zip(dests, counts):
+                part = gather_rows(fr, np.arange(start, start + c))
+                part.key = dest
+                DKV.put(dest, part)
+                start += c
+            j.dest_key = dests[0]
+            return dests
+
+        job.run(driver, background=True)
+        self._reply({"__meta": {"schema_type": "SplitFrameV3"},
+                     "key": {"name": job.key},
+                     "destination_frames": [{"name": d} for d in dests]})
+
+    def r_create_frame(self):
+        p = self._params()
+        from h2o3_tpu.frame.utils import create_frame
+        kw = {k: (json.loads(v) if isinstance(v, str) and v[:1] in "[{tf"
+                  else v) for k, v in p.items()}
+        key = kw.pop("dest", None) or kw.pop("destination_frame",
+                                             f"frame_{uuid.uuid4().hex[:8]}")
+        numkw = {}
+        import inspect
+        sig = inspect.signature(create_frame)
+        for k, v in kw.items():
+            if k in sig.parameters:
+                d = sig.parameters[k].default
+                if isinstance(v, str) and isinstance(d, bool):
+                    v = v.lower() in ("1", "true", "yes")
+                elif isinstance(v, str):
+                    try:             # None-defaulted params still need typing
+                        v = int(v) if isinstance(d, int) or d is None else float(v)
+                    except ValueError:
+                        try:
+                            v = float(v)
+                        except ValueError:
+                            pass
+                numkw[k] = v
+        fr = create_frame(**numkw)
+        fr.key = key
+        DKV.put(key, fr)
+        self._reply({**_done_job("CreateFrame", key),
+                     "key": {"name": key}, "rows": fr.nrows})
+
+    def r_interaction(self):
+        p = self._params()
+        from h2o3_tpu.frame.utils import interaction
+        factors = p.get("factor_columns") or p.get("factors") or []
+        if isinstance(factors, str):
+            factors = json.loads(factors)
+        fr = interaction(DKV[_name(p["source_frame"])], factors,
+                         pairwise=str(p.get("pairwise", "")).lower() == "true",
+                         max_factors=int(p.get("max_factors", 100)),
+                         min_occurrence=int(p.get("min_occurrence", 1)))
+        key = _name(p.get("dest")) or f"interaction_{uuid.uuid4().hex[:6]}"
+        fr.key = key
+        DKV.put(key, fr)
+        self._reply({**_done_job("Interaction", key), "key": {"name": key}})
+
+    def r_missing_inserter(self):
+        """Reference MissingInserterHandler: corrupt a fraction of cells to
+        NA (pyunit fixture machinery)."""
+        p = self._params()
+        import numpy as np
+        fr = DKV[_name(p["dataset"])]
+        frac = float(p.get("fraction", 0.1))
+        seed = int(p.get("seed", -1) or -1)
+        rng = np.random.default_rng(None if seed < 0 else seed)
+        from h2o3_tpu.frame.frame import Frame as _F
+        from h2o3_tpu.frame.vec import Vec
+        from h2o3_tpu.frame.types import VecType
+        out = []
+        for v in fr.vecs:
+            if not v.type.on_device:
+                out.append(v)
+                continue
+            vals = v.to_numpy().copy()
+            hit = rng.random(len(vals)) < frac
+            if v.is_categorical:
+                vals = np.where(hit, -1, vals).astype(np.int32)
+                out.append(Vec.from_numpy(vals, type=VecType.CAT,
+                                          domain=v.domain))
+            else:
+                vals = vals.astype(np.float64)
+                vals[hit] = np.nan
+                out.append(Vec.from_numpy(vals.astype(np.float32),
+                                          type=v.type))
+        fr2 = _F(fr.names, out, key=fr.key)
+        DKV.put(fr.key, fr2)
+        self._reply({**_done_job("MissingInserter", fr.key),
+                     "key": {"name": fr.key}})
+
+    def r_typeahead(self):
+        import glob
+        import os
+        p = self._params()
+        src = p.get("src", "")
+        limit = int(p.get("limit", 100))
+        matches = sorted(glob.glob(src + "*"))[:limit] if src else []
+        matches = [m + "/" if os.path.isdir(m) else m for m in matches]
+        self._reply({"__meta": {"schema_type": "TypeaheadV3"},
+                     "matches": matches})
+
+    def r_find(self):
+        """Reference FindHandler: first row index >= `row` whose `column`
+        equals `match`."""
+        p = self._params()
+        import numpy as np
+        fr = DKV[_name(p["key"])]
+        col = p["column"]
+        start = int(p.get("row", 0))
+        target = p.get("match")
+        v = fr.vec(col)
+        vals = v.labels() if v.is_categorical else v.to_numpy()
+        idx = -1
+        for i in range(start, len(vals)):
+            val = vals[i]
+            if val is None or (isinstance(val, float) and np.isnan(val)):
+                hit = target in (None, "", "NA")
+            elif v.is_categorical:
+                hit = str(val) == str(target)
+            else:
+                try:
+                    hit = float(val) == float(target)
+                except (TypeError, ValueError):
+                    hit = False
+            if hit:
+                idx = i
+                break
+        self._reply({"__meta": {"schema_type": "FindV3"}, "prev": -1,
+                     "next": idx})
+
+    def r_frame_summary(self, key):
+        # serves both /summary and /light: full column metadata, no data
+        # page (h2o-py's H2OFrame._frame(light=True) builds its cache here)
+        fr = DKV[key]
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "frames": [schemas.frame_v3(key, fr, rows=0)]})
+
+    def r_frame_columns(self, key):
+        fr = DKV[key]
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "columns": [{"label": n, "type": str(v.type).lower()}
+                                 for n, v in zip(fr.names, fr.vecs)]})
+
+    def r_frame_column(self, key, col):
+        fr = DKV[key]
+        sub = fr[[col]]
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "frames": [schemas.frame_v3(key, sub)]})
+
+    def r_frame_col_summary(self, key, col):
+        fr = DKV[key]
+        v = fr.vec(col)
+        r = v.rollups()
+        out = {"label": col, "missing_count": int(r.na_cnt)}
+        if v.is_numeric:
+            out.update(mins=[schemas._clean(r.min)],
+                       maxs=[schemas._clean(r.max)],
+                       mean=schemas._clean(r.mean),
+                       sigma=schemas._clean(r.sigma),
+                       histogram_bins=schemas._clean(
+                           getattr(r, "histogram", None)),
+                       percentiles=schemas._clean(
+                           fr[[col]].quantile().vec(col).to_numpy()))
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "frames": [{"frame_id": {"name": key},
+                                 "columns": [out]}]})
+
+    def r_frame_col_domain(self, key, col):
+        v = DKV[key].vec(col)
+        self._reply({"__meta": {"schema_type": "FrameV3"},
+                     "domain": [list(v.domain) if v.domain else None]})
+
+    def r_frame_export(self, key):
+        p = self._params()
+        from h2o3_tpu.persist.frame_io import export_file
+        path = export_file(DKV[key], p["path"])
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "job": _done_job("Export File", key), "path": path})
+
+    def r_frame_save(self, key):
+        import os
+        p = self._params()
+        from h2o3_tpu.persist.frame_io import save_frame
+        dest = p["dir"]
+        if os.path.isdir(dest):
+            dest = os.path.join(dest, key)
+        path = save_frame(DKV[key], dest)
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "job": _done_job("Save Frame", key), "path": path})
+
+    def r_frame_load(self):
+        p = self._params()
+        from h2o3_tpu.persist.frame_io import load_frame
+        fr = load_frame(p["dir"], key=p.get("frame_id"))
+        DKV.put(fr.key, fr)
+        self._reply({"__meta": {"schema_type": "FramesV3"},
+                     "job": _done_job("Load Frame", fr.key),
+                     "frame_id": {"name": fr.key}})
+
+    def r_frames_delete_all(self):
+        for k in list(DKV.keys()):
+            if isinstance(DKV.get(k), Frame):
+                DKV.remove(k)
+        self._reply({"__meta": {"schema_type": "FramesV3"}})
+
+    def r_dkv_delete(self, key):
+        DKV.remove(key)
+        self._reply({"__meta": {"schema_type": "RemoveV3"}})
+
+    def r_dkv_delete_all(self):
+        DKV.clear()
+        self._reply({"__meta": {"schema_type": "RemoveAllV3"}})
+
+    def r_download_dataset(self):
+        p = self._params()
+        fr = DKV[_name(p["frame_id"])]
+        csv = fr.to_pandas().to_csv(index=False)
+        body = csv.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/csv")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{fr.key or "frame"}.csv"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_import_sql(self):
+        p = self._params()
+        from h2o3_tpu.frame.sql import import_sql_table
+        fr = import_sql_table(p["connection_url"], p["table"],
+                              fetch_mode=p.get("fetch_mode", "SINGLE"))
+        self._reply(_done_job("ImportSQLTable", fr.key))
+
+    def r_model_builders(self):
+        self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
+                     "model_builders": {
+                         a: {"algo": a, "visibility": "Stable"}
+                         for a in sorted(_algo_registry())}})
+
+    def r_model_builder(self, algo):
+        cls = _algo_registry().get(algo.lower())
+        if cls is None:
+            raise KeyError(f"unknown algorithm {algo!r}")
+        params = [{"name": k,
+                   "default_value": schemas._clean(v),
+                   "type": type(v).__name__}
+                  for k, v in cls.defaults().items()]
+        self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
+                     "model_builders": {algo.lower(): {
+                         "algo": algo.lower(), "parameters": params}}})
+
+    def r_model_metrics_compute(self, model_key, frame_key):
+        m, fr = DKV[model_key], DKV[frame_key]
+        mm = m.model_performance(fr)
+        item = schemas.metrics_v3(mm)
+        item["frame"] = {"name": frame_key}     # h2o-py filters on these
+        item["model"] = {"name": model_key}
+        self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+                     "model_metrics": [item]})
+
+    def r_model_metrics_get(self, model_key):
+        m = DKV[model_key]
+        mms = [schemas.metrics_v3(mm) for mm in
+               (m.training_metrics, m.validation_metrics,
+                m.cross_validation_metrics) if mm is not None]
+        self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+                     "model_metrics": mms})
+
+    def r_make_metrics(self, pred_key, actual_key):
+        """Reference: h2o.make_metrics — metrics from a predictions frame
+        vs an actuals column (no model needed)."""
+        p = self._params()
+        pred, act = DKV[pred_key], DKV[actual_key]
+        from h2o3_tpu.models.data_info import response_as_float
+        from h2o3_tpu.models.model_base import compute_metrics
+        yvec = act.vec(p.get("response_column") or act.names[-1])
+        y, valid = response_as_float(yvec)
+        mask = act.row_mask() & valid
+        prob_cols = [n for n in pred.names if n != "predict"]
+        if yvec.is_categorical and prob_cols:
+            raw = pred.matrix(prob_cols)
+            ncl = len(prob_cols)
+        else:
+            raw = pred.vec("predict").data
+            ncl = 0
+        mm = compute_metrics(raw, y, mask, ncl)
+        self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+                     "model_metrics": [schemas.metrics_v3(mm)]})
+
+    def r_partial_dependence(self):
+        p = self._params()
+        from h2o3_tpu.explanation import partial_dependence
+        m = DKV[_name(p["model_id"])]
+        fr = DKV[_name(p["frame_id"])]
+        cols = p.get("cols") or p.get("col_pairs_2dpdp") or []
+        if isinstance(cols, str):
+            cols = json.loads(cols)
+        nbins = int(p.get("nbins", 20))
+        name = p.get("destination_key") or f"pdp_{uuid.uuid4().hex[:8]}"
+        job = Job("PartialDependence", key=f"job_{uuid.uuid4().hex[:12]}")
+
+        def driver(j: Job):
+            tables = partial_dependence(m, fr, cols, nbins=nbins)
+            DKV.put(name, tables)
+            j.dest_key = name
+            return tables
+
+        job.run(driver, background=True)
+        job.dest_key = name
+        self._reply({**schemas.job_v3(job.key, job),
+                     "destination_key": name})
+
+    def r_partial_dependence_get(self, name):
+        tables = DKV[name]
+        data = [{"columns": list(t.names),
+                 "data": {n: schemas._clean(t.vec(n).to_numpy())
+                          for n in t.names}} for t in tables]
+        self._reply({"__meta": {"schema_type": "PartialDependenceV3"},
+                     "partial_dependence_data": data})
+
+    def r_pojo(self, model_key):
+        import os
+        import tempfile
+        m = DKV[model_key.removesuffix(".java")]
+        with tempfile.TemporaryDirectory() as d:
+            path = m.download_pojo(os.path.join(d, f"{m.key}_pojo.py"))
+            with open(path, "rb") as f:
+                body = f.read()
+            fname = os.path.basename(path)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{fname}"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_mojo(self, model_key):
+        import os
+        import tempfile
+        m = DKV[model_key]
+        with tempfile.TemporaryDirectory() as d:
+            path = m.download_mojo(os.path.join(d, f"{m.key}.zip"))
+            with open(path, "rb") as f:
+                body = f.read()
+            fname = os.path.basename(path)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/zip")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{fname}"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_model_save(self, model_key):
+        import os
+        p = self._params()
+        from h2o3_tpu.persist.model_io import save_model
+        dest = p["dir"]
+        if os.path.isdir(dest):      # h2o-py passes a directory
+            dest = os.path.join(dest, model_key)
+        path = save_model(DKV[model_key], dest)
+        self._reply({"__meta": {"schema_type": "ModelsV3"},
+                     "dir": path, "models": [{"model_id": {"name": model_key}}]})
+
+    def r_model_load(self, model_key):
+        p = self._params()
+        from h2o3_tpu.persist.model_io import load_model
+        m = load_model(p["dir"])
+        DKV.put(m.key, m)
+        self._reply({"__meta": {"schema_type": "ModelsV3"},
+                     "models": [{"model_id": {"name": m.key}}]})
+
+    def r_model_json(self, model_key):
+        self._reply({"__meta": {"schema_type": "ModelsV3"},
+                     "models": [schemas.model_v3(DKV[model_key])]})
+
+    def r_grids(self):
+        from h2o3_tpu.orchestration.grid import Grid
+        grids = [{"grid_id": {"name": k}} for k in DKV.keys()
+                 if isinstance(DKV.get(k), Grid)]
+        self._reply({"__meta": {"schema_type": "GridsV99"}, "grids": grids})
+
+    def r_capabilities(self):
+        self._reply({"__meta": {"schema_type": "CapabilitiesV3"},
+                     "capabilities": [
+                         {"name": a, "module": "core"}
+                         for a in sorted(_algo_registry())]})
+
+    def r_init_id(self):
+        self._reply({"__meta": {"schema_type": "InitIDV3"},
+                     "session_key": self.server._session_id})
+
+    def r_sessions_v4(self):
+        # h2o-py >=3.22 opens its Rapids session via the V4 endpoint; each
+        # client gets a FRESH id so concurrent clients cannot collide on
+        # temp-frame names (py_N_<sid>)
+        from h2o3_tpu.rapids.exec import Session
+        sid = f"_sid_{uuid.uuid4().hex[:10]}"
+        self.server._rapids_sessions[sid] = Session()
+        self._reply({"__meta": {"schema_type": "SessionIdV4"},
+                     "session_key": sid})
+
+    def r_init_id_delete(self, sid=None):
+        # end the client's Rapids session: drop its temp frames (reference:
+        # Session.end + temp-key cleanup)
+        sess = self.server._rapids_sessions.pop(
+            sid or self.server._session_id, None)
+        if sess is not None:
+            for name in list(sess._tmp):
+                sess.remove(name)
+            sess.end()
+        self._reply({"__meta": {"schema_type": "InitIDV3"}})
+
+    def r_session_properties(self):
+        props = self.server._session_props
+        p = self._params()
+        if self.command == "POST":
+            props[p["key"]] = p.get("value")
+        self._reply({"__meta": {"schema_type": "SessionPropertyV3"},
+                     "key": p.get("key"), "value": props.get(p.get("key"))})
+
+    def r_log_and_echo(self):
+        p = self._params()
+        import logging
+        logging.getLogger("h2o3_tpu").info(p.get("message", ""))
+        self._reply({"__meta": {"schema_type": "LogAndEchoV3"},
+                     "message": p.get("message", "")})
+
+    def r_rapids_help(self):
+        from h2o3_tpu.rapids.exec import known_prims
+        self._reply({"__meta": {"schema_type": "RapidsHelpV3"},
+                     "syntax": sorted(known_prims())})
+
+    def r_metadata_endpoints(self):
+        self._reply({"__meta": {"schema_type": "MetadataV3"},
+                     "routes": [{"http_method": m, "url_pattern": pat}
+                                for pat, m, _ in _ROUTES]})
+
+    # field inventories h2o-py's schema bootstrap fetches at connect time
+    # (reference: water/api/schemas3/H2OErrorV3.java et al.)
+    _SCHEMA_FIELDS = {
+        "H2OErrorV3": ["timestamp", "error_url", "msg", "dev_msg",
+                       "http_status", "values", "exception_type",
+                       "exception_msg", "stacktrace"],
+        "H2OModelBuilderErrorV3": [
+            "timestamp", "error_url", "msg", "dev_msg", "http_status",
+            "values", "exception_type", "exception_msg", "stacktrace",
+            "parameters", "messages", "error_count"],
+        "CloudV3": ["version", "cloud_name", "cloud_size", "cloud_healthy",
+                    "nodes", "bad_nodes", "consensus", "locked", "is_client",
+                    "cloud_uptime_millis", "internal_security_enabled",
+                    "branch_name", "build_number", "build_age",
+                    "build_too_old", "node_idx", "cloud_internal_timezone",
+                    "datafile_parser_timezone"],
+    }
+
+    def r_metadata_schema(self, name):
+        fields = self._SCHEMA_FIELDS.get(name, [])
+        self._reply({"__meta": {"schema_type": "MetadataV3"},
+                     "schemas": [{"name": name,
+                                  "fields": [{"name": f, "is_schema": False,
+                                              "help": f} for f in fields]}]})
+
+    def r_network_test(self):
+        """Reference NetworkTestHandler: measure collective latency. Here:
+        time one all-reduce over the mesh (the only 'network')."""
+        import time as _t
+        import jax
+        import jax.numpy as jnp
+        t0 = _t.time()
+        jax.block_until_ready(jnp.sum(jnp.ones(1024)))
+        dt = (_t.time() - t0) * 1e3
+        self._reply({"__meta": {"schema_type": "NetworkTestV3"},
+                     "microseconds_collective": dt * 1000,
+                     "table": [{"collective_ms": dt}]})
+
 
 _ROUTES = [
     (r"/3/Cloud", "GET", _Handler.r_cloud),
     (r"/3/About", "GET", _Handler.r_about),
     (r"/3/ImportFiles", "GET", _Handler.r_import),
     (r"/3/ImportFiles", "POST", _Handler.r_import),
+    (r"/3/ImportFilesMulti", "POST", _Handler.r_import_multi),
     (r"/3/Parse", "POST", _Handler.r_parse),
     (r"/3/Frames", "GET", _Handler.r_frames),
     (r"/3/Frames/([^/]+)", "GET", _Handler.r_frame),
@@ -397,6 +1029,7 @@ _ROUTES = [
     (r"/3/Jobs/([^/]+)", "GET", _Handler.r_job),
     (r"/3/Jobs/([^/]+)/cancel", "POST", _Handler.r_job_cancel),
     (r"/3/Predictions/models/([^/]+)/frames/([^/]+)", "POST", _Handler.r_predict),
+    (r"/4/Predictions/models/([^/]+)/frames/([^/]+)", "POST", _Handler.r_predict_v4),
     (r"/99/Rapids", "POST", _Handler.r_rapids),
     (r"/99/Grid/([^/]+)", "POST", _Handler.r_grid),
     (r"/99/Grids/([^/]+)", "GET", _Handler.r_grid_get),
@@ -411,6 +1044,66 @@ _ROUTES = [
     (r"/3/Logs", "GET", _Handler.r_logs),
     (r"/", "GET", _Handler.r_flow),
     (r"/flow/index\.html", "GET", _Handler.r_flow),
+    # round-2 parity sweep (reference: RegisterV3Api.java)
+    (r"/3/Ping", "GET", _Handler.r_ping),
+    (r"/3/Jobs", "GET", _Handler.r_jobs),
+    (r"/3/ParseSetup", "POST", _Handler.r_parse_setup),
+    (r"/3/SplitFrame", "POST", _Handler.r_split_frame),
+    (r"/3/CreateFrame", "POST", _Handler.r_create_frame),
+    (r"/3/Interaction", "POST", _Handler.r_interaction),
+    (r"/3/MissingInserter", "POST", _Handler.r_missing_inserter),
+    (r"/3/Typeahead/files", "GET", _Handler.r_typeahead),
+    (r"/3/Find", "GET", _Handler.r_find),
+    (r"/3/Frames/([^/]+)/summary", "GET", _Handler.r_frame_summary),
+    (r"/3/Frames/([^/]+)/light", "GET", _Handler.r_frame_summary),
+    (r"/3/Frames/([^/]+)/columns", "GET", _Handler.r_frame_columns),
+    (r"/3/Frames/([^/]+)/columns/([^/]+)", "GET", _Handler.r_frame_column),
+    (r"/3/Frames/([^/]+)/columns/([^/]+)/summary", "GET",
+     _Handler.r_frame_col_summary),
+    (r"/3/Frames/([^/]+)/columns/([^/]+)/domain", "GET",
+     _Handler.r_frame_col_domain),
+    (r"/3/Frames/([^/]+)/export", "POST", _Handler.r_frame_export),
+    (r"/3/Frames/([^/]+)/save", "POST", _Handler.r_frame_save),
+    (r"/3/Frames/load", "POST", _Handler.r_frame_load),
+    (r"/3/Frames", "DELETE", _Handler.r_frames_delete_all),
+    (r"/3/DKV/([^/]+)", "DELETE", _Handler.r_dkv_delete),
+    (r"/3/DKV", "DELETE", _Handler.r_dkv_delete_all),
+    (r"/3/DownloadDataset", "GET", _Handler.r_download_dataset),
+    (r"/3/DownloadDataset\.bin", "GET", _Handler.r_download_dataset),
+    (r"/99/ImportSQLTable", "POST", _Handler.r_import_sql),
+    (r"/3/ModelBuilders", "GET", _Handler.r_model_builders),
+    (r"/3/ModelBuilders/([^/]+)", "GET", _Handler.r_model_builder),
+    (r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", "POST",
+     _Handler.r_model_metrics_compute),
+    (r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)", "GET",
+     _Handler.r_model_metrics_compute),
+    (r"/3/ModelMetrics/models/([^/]+)", "GET", _Handler.r_model_metrics_get),
+    (r"/3/ModelMetrics/predictions_frame/([^/]+)/actuals_frame/([^/]+)",
+     "POST", _Handler.r_make_metrics),
+    (r"/3/PartialDependence/", "POST", _Handler.r_partial_dependence),
+    (r"/3/PartialDependence/([^/]+)", "GET",
+     _Handler.r_partial_dependence_get),
+    (r"/3/Models\.java/([^/]+)", "GET", _Handler.r_pojo),
+    (r"/3/Models/([^/]+)/mojo", "GET", _Handler.r_mojo),
+    (r"/99/Models\.mojo/([^/]+)", "GET", _Handler.r_mojo),
+    (r"/99/Models\.bin/([^/]*)", "GET", _Handler.r_model_save),
+    (r"/99/Models\.bin/([^/]*)", "POST", _Handler.r_model_load),
+    (r"/99/Models/([^/]+)/json", "GET", _Handler.r_model_json),
+    (r"/99/Grids", "GET", _Handler.r_grids),
+    (r"/3/Capabilities", "GET", _Handler.r_capabilities),
+    (r"/3/Capabilities/Core", "GET", _Handler.r_capabilities),
+    (r"/3/Capabilities/API", "GET", _Handler.r_capabilities),
+    (r"/3/InitID", "GET", _Handler.r_init_id),
+    (r"/3/InitID", "DELETE", _Handler.r_init_id_delete),
+    (r"/4/sessions", "POST", _Handler.r_sessions_v4),
+    (r"/4/sessions/([^/]+)", "DELETE", _Handler.r_init_id_delete),
+    (r"/3/SessionProperties", "GET", _Handler.r_session_properties),
+    (r"/3/SessionProperties", "POST", _Handler.r_session_properties),
+    (r"/3/LogAndEcho", "POST", _Handler.r_log_and_echo),
+    (r"/99/Rapids/help", "GET", _Handler.r_rapids_help),
+    (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
+    (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
+    (r"/3/NetworkTest", "GET", _Handler.r_network_test),
 ]
 
 
@@ -419,6 +1112,9 @@ class H2OServer:
 
     def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd._session_id = f"_sid_{uuid.uuid4().hex[:10]}"
+        self.httpd._session_props = {}
+        self.httpd._rapids_sessions = {}
         self.host, self.port = host, self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -248,6 +248,11 @@ class Frame:
         from h2o3_tpu.rapids import munge
         return munge.cbind(self, *others)
 
+    def split_frame(self, ratios=(0.75,), destination_frames=None,
+                    seed: int = -1) -> list["Frame"]:
+        from h2o3_tpu.frame.utils import split_frame
+        return split_frame(self, ratios, destination_frames, seed)
+
     def unique(self, cols=None) -> "Frame":
         from h2o3_tpu.rapids import munge
         return munge.unique(self, cols)

@@ -30,6 +30,38 @@ from h2o3_tpu.frame.types import VecType
 from h2o3_tpu.frame.vec import Vec
 
 
+def split_frame(frame: Frame, ratios=(0.75,), destination_frames=None,
+                seed: int = -1) -> list[Frame]:
+    """Probabilistic row split (h2o-py ``frame.split_frame``; reference
+    ``h2o-py/h2o/frame.py:2543`` — per-row uniform draw against cumulative
+    ratio boundaries, so splits have the ratios in expectation, exact-ish at
+    scale). Returns ``len(ratios)+1`` frames; registers them in DKV when
+    ``destination_frames`` names are given."""
+    ratios = list(ratios)
+    if not ratios:
+        raise ValueError("ratios may not be empty")
+    if any(r <= 0 for r in ratios):
+        raise ValueError("ratios must be > 0")
+    if sum(ratios) >= 1.0:
+        raise ValueError("ratios must add up to less than 1.0")
+    if destination_frames is not None and len(destination_frames) != len(ratios) + 1:
+        raise ValueError("need len(ratios)+1 destination_frames")
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    u = rng.random(frame.nrows)
+    bounds = np.cumsum([0.0] + ratios + [1.0])
+    out = []
+    for i in range(len(ratios) + 1):
+        mask = np.zeros(frame.plen, np.float32)
+        mask[:frame.nrows] = ((u > bounds[i]) if i else (u >= 0)) & (u <= bounds[i + 1])
+        part = frame.filter(mask)
+        if destination_frames is not None:
+            from h2o3_tpu.utils.registry import DKV
+            part.key = destination_frames[i]
+            DKV.put(part.key, part)
+        out.append(part)
+    return out
+
+
 def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
                  value: float = 0.0, real_range: float = 100.0,
                  categorical_fraction: float = 0.2, factors: int = 100,

@@ -433,6 +433,8 @@ class GBM(SharedTreeBuilder):
             edges = cp.output["edges"]
             binned = bin_features(X, edges)
         dist = str(p["distribution"])
+        if dist.lower() == "auto":   # h2o-py sends lowercase enum names
+            dist = "AUTO"
         if yvec.is_categorical:
             if dist not in ("AUTO", "bernoulli", "multinomial"):
                 raise ValueError(f"distribution {dist!r} requires a numeric response")
@@ -636,6 +638,9 @@ class GBM(SharedTreeBuilder):
         M = keys.shape[0]
         sr = int(p.get("stopping_rounds") or 0)
         metric = str(p.get("stopping_metric") or "AUTO")
+        # h2o-py sends enum values lowercase
+        metric = {m.lower(): m for m in self.STOPPING_METRICS}.get(
+            metric.lower(), metric)
         if metric not in self.STOPPING_METRICS:
             raise ValueError(f"unsupported stopping_metric {metric!r}; have "
                              f"{self.STOPPING_METRICS}")

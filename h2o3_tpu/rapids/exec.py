@@ -122,7 +122,19 @@ class Session:
 
     def assign(self, name: str, value: Frame):
         self._tmp[name] = value
+        # session temps are DKV-resident until rm'd (reference Session
+        # semantics) so clients can fetch them via /3/Frames/{name}
+        if isinstance(value, Frame):
+            value.key = name
+            DKV.put(name, value)
         return value
+
+    def remove(self, name: str):
+        """Drop a temp or DKV key (reference: ``AstRm``)."""
+        if name in self._tmp:
+            del self._tmp[name]
+        elif name in DKV:
+            DKV.remove(name)
 
     def end(self):
         self._tmp.clear()
@@ -151,6 +163,10 @@ def _eval(node, s: Session):
     if op in ("tmp=", "assign"):
         name = node[1][1] if isinstance(node[1], tuple) else str(node[1])
         return s.assign(name, _eval(node[2], s))
+    if op in ("rm", "h2o.rm"):
+        name = node[1][1] if isinstance(node[1], tuple) else str(node[1])
+        s.remove(name)
+        return 0.0
 
     args = [_eval(a, s) for a in node[1:]]
 
@@ -312,6 +328,26 @@ def _eval(node, s: Session):
         v = _as_vec(args[0])
         return list(v.domain or [])
     raise ValueError(f"unknown rapids op {op!r}")
+
+
+#: ops handled by the dispatch if-chain above (kept in sync by
+#: tests/test_rapids.py::test_prims_inventory exercising /99/Rapids/help)
+_CHAIN_OPS = (
+    "tmp=", "assign", "rm", "h2o.rm", "ifelse", "cols", "rows", "nrow",
+    "ncol", "rbind",
+    "cbind", "unique", "sort", "merge", "h2o.runif", "strsplit", "quantile",
+    "cumsum", "cumprod", "cummin", "cummax", "cut", "hist", "h2o.impute",
+    "impute", "scale", "round", "signif", "table", "GB", "groupby", "pivot",
+    "melt", "as.factor", "as.character", "as.numeric", "is.na", "is.factor",
+    "is.numeric", "colnames", "levels",
+)
+
+
+def known_prims() -> set[str]:
+    """Every rapids primitive this engine evaluates (the `/99/Rapids/help`
+    surface; reference: ast/prims/* file inventory)."""
+    return (set(_BINOPS) | set(ops._UNARY) | set(_REDUCERS)
+            | set(_STRING_OPS) | set(_TIME_OPS) | set(_CHAIN_OPS))
 
 
 _STRING_OPS = {

@@ -26,6 +26,7 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.types import CAT_NA, VecType
 from h2o3_tpu.frame.vec import Vec, padded_len
+from h2o3_tpu.parallel.distributed import fetch
 from h2o3_tpu.parallel.mesh import row_sharding
 
 # ---------------------------------------------------------------------------
@@ -33,7 +34,16 @@ from h2o3_tpu.parallel.mesh import row_sharding
 
 
 def _put(arr: np.ndarray | jax.Array) -> jax.Array:
-    return jax.device_put(jnp.asarray(arr), row_sharding(1))
+    """Row-shard onto the global mesh; multi-process safe. Device inputs
+    that span processes are gathered host-side first (the join planners are
+    host algorithms anyway), then re-uploaded via the process-local-shard
+    path shared with Frame ingest."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        arr = fetch(arr)
+    if isinstance(arr, jax.Array):
+        return jax.device_put(arr, row_sharding(1))
+    from h2o3_tpu.frame.vec import _put as _vec_put
+    return _vec_put(np.asarray(arr), row_sharding(1))
 
 
 def _pad_to(arr: jax.Array, plen: int, fill) -> jax.Array:
@@ -103,7 +113,7 @@ def sort_perm(frame: Frame, by: Sequence[str], ascending) -> np.ndarray:
     is_pad = (jnp.arange(frame.plen) >= frame.nrows).astype(jnp.int32)
     # lexsort: LAST key is primary — padding first, then by[0], by[1], ...
     perm = jnp.lexsort(tuple(reversed(keys)) + (is_pad,))
-    return np.asarray(jax.device_get(perm))[: frame.nrows]
+    return fetch(perm)[: frame.nrows]
 
 
 def sort(frame: Frame, by: str | Sequence[str], ascending=True) -> Frame:
@@ -134,16 +144,16 @@ def _group_ids(key_cols: list[jax.Array], valid: jax.Array):
                      [jnp.concatenate([jnp.zeros(1, bool), k[1:] != k[:-1]])
                       for k in skeys])
     gid_sorted = jnp.cumsum(differs.astype(jnp.int32))
-    nvalid = int(jax.device_get(valid.sum()))
+    nvalid = int(fetch(valid.sum()))
     if nvalid == 0:
         return jnp.zeros(plen, jnp.int32), 0, np.empty(0, np.int32)
-    ngroups = int(jax.device_get(gid_sorted[nvalid - 1])) + 1
+    ngroups = int(fetch(gid_sorted[nvalid - 1])) + 1
     gid = jnp.zeros(plen, jnp.int32).at[perm].set(gid_sorted)
     gid = jnp.where(valid, gid, ngroups).astype(jnp.int32)
     # representative source row per group = min original index
     rep = jax.ops.segment_min(jnp.arange(plen, dtype=jnp.int32), gid,
                               num_segments=ngroups + 1)[:ngroups]
-    return gid, ngroups, np.asarray(jax.device_get(rep))
+    return gid, ngroups, fetch(rep)
 
 
 def frame_group_ids(frame: Frame, by: Sequence[str]):
@@ -219,7 +229,7 @@ def group_by(frame: Frame, by: str | Sequence[str],
             agg = _group_median(frame, col, gid, nseg)
         agg = jnp.where(cnt > 0, agg, jnp.nan) if op not in ("count", "nrow") else agg
         out_names.append(f"{op}_{col}" if op != "nrow" else "nrow")
-        out_vals.append(np.asarray(jax.device_get(agg))[:ng])
+        out_vals.append(fetch(agg)[:ng])
 
     # key columns: representative source row per group
     out = gather_rows(frame[by], rep)
@@ -274,7 +284,7 @@ def merge(left: Frame, right: Frame, by: Sequence[str] | None = None,
     keys = [jnp.concatenate([a, b]) for a, b in zip(kl, kr)]
     valid = jnp.concatenate([left.row_mask(), right.row_mask()])
     gid, ng, _ = _group_ids(keys, valid)
-    g = np.asarray(jax.device_get(gid))
+    g = fetch(gid)
     gl, gr = g[: left.plen][: left.nrows], g[left.plen:][: right.nrows]
 
     order_r = np.argsort(gr, kind="stable")
@@ -374,12 +384,12 @@ def rbind(*frames: Frame) -> Frame:
             parts = []
             for v in vs:
                 m = np.array([lut[s] for s in v.domain] + [CAT_NA], np.int32)
-                codes = np.asarray(jax.device_get(v.data))[: v.nrows]
+                codes = fetch(v.data)[: v.nrows]
                 parts.append(m[np.where(codes >= 0, codes, len(m) - 1)])
             out_vecs.append(Vec.from_numpy(np.concatenate(parts), type=t,
                                            domain=dom))
         elif t.on_device and t is not VecType.TIME:
-            parts = [np.asarray(jax.device_get(v.data))[: v.nrows] for v in vs]
+            parts = [fetch(v.data)[: v.nrows] for v in vs]
             host = np.concatenate(parts)
             out_vecs.append(Vec.from_numpy(host, type=t))
         elif t is VecType.TIME:
@@ -458,7 +468,7 @@ def pivot(frame: Frame, index: str, column: str, value: str,
     else:
         raise ValueError(f"unknown pivot agg {agg!r}")
     cells = jnp.where(cnt > 0, cells, jnp.nan) if agg != "count" else cells
-    host = np.asarray(jax.device_get(cells))[: ng * K].reshape(ng, K)
+    host = fetch(cells)[: ng * K].reshape(ng, K)
     out = gather_rows(frame[[index]], rep)
     for k, lev in enumerate(cv.domain):
         out.add(str(lev), Vec.from_numpy(host[:, k].astype(np.float64)))
@@ -492,5 +502,5 @@ def filter_rows(frame: Frame, mask: Vec | jax.Array) -> Frame:
     if m.dtype == bool:
         m = m.astype(jnp.float32)
     keep = (m > 0) & ~jnp.isnan(m) & frame.row_mask()
-    idx = np.nonzero(np.asarray(jax.device_get(keep)))[0]
+    idx = np.nonzero(fetch(keep))[0]
     return gather_rows(frame, idx)

@@ -1,0 +1,61 @@
+"""Reference-client compatibility flow: the REAL unmodified h2o-py package
+(from /root/reference/h2o-py) speaks to our server.
+
+Covers the connect → import_file (ImportFilesMulti/ParseSetup/Parse/job
+poll) → split_frame (Rapids session temps) → estimator.train (ModelBuilders
++ job poll + Models fetch) → predict (V4 Predictions) → model_performance
+(ModelMetrics compute) → remove_all (DKV delete) call chain.
+"""
+
+import os
+import sys
+import warnings
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, "/root/reference/h2o-py")
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from h2o3_tpu.api import H2OServer
+
+server = H2OServer(port=0).start()
+
+import h2o
+from h2o.estimators import H2OGradientBoostingEstimator
+
+h2o.connect(url=server.url, strict_version_check=False)
+
+csv = sys.argv[1]
+rng = np.random.default_rng(3)
+with open(csv, "w") as f:
+    f.write("x1,x2,y\n" + "\n".join(
+        f"{a:.3f},{b:.3f},{'yes' if a - b > 0 else 'no'}"
+        for a, b in rng.normal(size=(300, 2))))
+
+fr = h2o.import_file(csv)
+assert fr.nrow == 300 and fr.ncol == 3, (fr.nrow, fr.ncol)
+assert fr.types == {"x1": "real", "x2": "real", "y": "enum"}, fr.types
+
+tr, te = fr.split_frame(ratios=[0.8], seed=1)
+assert tr.nrow + te.nrow == 300
+
+gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3)
+gbm.train(x=["x1", "x2"], y="y", training_frame=tr, validation_frame=te)
+
+pred = gbm.predict(te)
+assert pred.col_names == ["predict", "pno", "pyes"], pred.col_names
+assert pred.nrow == te.nrow
+
+perf = gbm.model_performance(te)
+assert 0.7 < perf.auc() <= 1.0, perf.auc()
+
+h2o.remove_all()
+print("H2O_PY_COMPAT_OK")
+# skip h2o-py's atexit session teardown (its ExprNode.__del__ chain assumes
+# a live reference cluster shutdown endpoint)
+import os
+os._exit(0)

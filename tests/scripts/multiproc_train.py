@@ -38,6 +38,13 @@ devs = {s.device for s in fr.vec("x0").data.addressable_shards}
 assert len(devs) == 4, devs
 assert not fr.vec("x0").data.is_fully_addressable
 
+# munge paths must survive cross-process shards (filter/gather/sort)
+tr, te = fr.split_frame(ratios=[0.75], seed=4)
+assert tr.nrows + te.nrows == n
+srt = fr.sort("x0")
+x0s = fetch(srt.vec("x0").data)[:n]
+assert (np.diff(x0s) >= 0).all()
+
 gbm = GBM(ntrees=3, max_depth=3, nbins=16, seed=2).train(y="y", training_frame=fr)
 glm = GLM(family="binomial", lambda_=1e-3, seed=2).train(y="y", training_frame=fr)
 
