@@ -85,10 +85,12 @@ class _Path:
         return total
 
 
-def tree_shap(tree, X: np.ndarray) -> np.ndarray:
+def tree_shap(tree, X: np.ndarray, cat_card=None, n_bins: int = 0) -> np.ndarray:
     """[rows, F+1] contributions (last column = bias) of one dense-heap tree.
 
     X uses the model's raw feature layout (cat codes as floats, NaN = NA).
+    Group-split trees (``tree.left_mask`` set) route categorical features by
+    bin membership; ``cat_card``/``n_bins`` supply the code→bin mapping.
     """
     feat = np.asarray(jax.device_get(tree.feat))
     tv = np.asarray(jax.device_get(tree.thresh_val))
@@ -100,13 +102,24 @@ def tree_shap(tree, X: np.ndarray) -> np.ndarray:
     if cover is None:
         raise ValueError("tree has no cover stats (grown before gain/cover "
                          "channels); retrain to use predict_contributions")
+    mask = (np.asarray(jax.device_get(tree.left_mask))
+            if getattr(tree, "left_mask", None) is not None else None)
+    cc = (np.asarray(jax.device_get(cat_card))
+          if cat_card is not None else None)
     rows, F = X.shape
     phi = np.zeros((rows, F + 1))
     if cover[0] <= 0:
         return phi
 
     def go_left(node: int) -> np.ndarray:
-        x = X[:, feat[node]]
+        f = feat[node]
+        x = X[:, f]
+        if mask is not None and cc is not None and cc[f] > 0:
+            code = np.nan_to_num(x, nan=0.0).astype(np.int64)
+            b = (code * n_bins) // max(int(cc[f]), 1) \
+                if cc[f] > n_bins else code
+            b = np.clip(b, 0, mask.shape[1] - 1)
+            return np.where(np.isnan(x), nal[node], mask[node, b]).astype(bool)
         return np.where(np.isnan(x), nal[node], x < tv[node]).astype(bool)
 
     def recurse(node: int, path: _Path):
@@ -149,11 +162,12 @@ def _expected_value(leaf, cover, isp) -> float:
     return float((leaf[leaves] * cover[leaves]).sum() / tot)
 
 
-def ensemble_contributions(trees, X: np.ndarray) -> np.ndarray:
+def ensemble_contributions(trees, X: np.ndarray, cat_card=None,
+                           n_bins: int = 0) -> np.ndarray:
     """Σ per-tree SHAP values (reference: ``PredictTreeSHAPTask``); the bias
     column sums each tree's expected value so row-sums equal the raw margin."""
     out = None
     for t in trees:
-        c = tree_shap(t, X)
+        c = tree_shap(t, X, cat_card=cat_card, n_bins=n_bins)
         out = c if out is None else out + c
     return out

@@ -144,7 +144,8 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 min_split_improvement: float, lr: float,
                 bootstrap: bool, drf: bool, nclass: int,
                 quantile_alpha: float = 0.5, huber_alpha: float = 0.9,
-                tweedie_power: float = 1.5):
+                tweedie_power: float = 1.5, mono=None, reach=None,
+                cat_feats=None):
     """The WHOLE boosting/bagging run in one compiled program.
 
     Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
@@ -184,7 +185,7 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
         return _grow_tree_device(
             binned, binned_T, edges, g, h, wt, fmask, k3, depth, n_bins,
             min_rows, reg_lambda, reg_alpha, gamma, min_split_improvement,
-            col_rate)
+            col_rate, mono=mono, reach=reach, cat_feats=cat_feats)
 
     if nclass <= 1:
         def body(Fcur, ks):
@@ -224,9 +225,11 @@ def _trees_from_stacked(heap, m: int, k: int | None = None) -> Tree:
     arrays per tree would cost a dispatch each — hundreds of tunnel
     round-trips per model."""
     pick = (lambda a: a[m] if k is None else a[m][k])
-    hf, ht, htv, hna, hsp, hlf, hg, hc = [pick(a) for a in heap]
+    vals = [pick(a) for a in heap]
+    hf, ht, htv, hna, hsp, hlf, hg, hc = vals[:8]
+    hm = vals[8] if len(vals) > 8 else None   # group-split membership masks
     return Tree(feat=hf, thresh_bin=ht, thresh_val=htv, na_left=hna,
-                is_split=hsp, leaf=hlf, gain=hg, cover=hc)
+                is_split=hsp, leaf=hlf, gain=hg, cover=hc, left_mask=hm)
 
 
 def _heap_to_host(heap):
@@ -238,7 +241,29 @@ def _heap_to_host(heap):
 class SharedTreeModel(Model):
     def _tree_raw_sum(self, frame: Frame) -> jax.Array:
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
-        return predict_raw(X, self.output["trees"])
+        return predict_raw(X, self.output["trees"],
+                           cat_card=self.output.get("cat_card"),
+                           n_bins=int(self.output.get("cat_bins") or 0))
+
+    def predict(self, frame: Frame) -> Frame:
+        """Score; a calibrated binomial model appends ``cal_p0``/``cal_p1``
+        (reference: ``CalibrationHelper.postProcessPredictions``)."""
+        out = super().predict(frame)
+        cal = self.output.get("calibration")
+        if cal is not None:
+            p1 = np.clip(out.vec(2).to_numpy(), 1e-15, 1 - 1e-15)
+            if cal["method"] == "PlattScaling":
+                z = cal["a"] * np.log(p1 / (1 - p1)) + cal["b"]
+                cp1 = 1.0 / (1.0 + np.exp(-z))
+            else:                     # IsotonicRegression: PAV step interp
+                cp1 = np.interp(p1, cal["xs"], cal["ys"])
+            from h2o3_tpu.frame.types import VecType
+            from h2o3_tpu.frame.vec import Vec
+            out.add("cal_p0", Vec.from_numpy((1 - cp1).astype(np.float32),
+                                             type=VecType.NUM))
+            out.add("cal_p1", Vec.from_numpy(cp1.astype(np.float32),
+                                             type=VecType.NUM))
+        return out
 
     def varimp(self, use_pandas: bool = False):
         """Per-feature split-gain importance (reference: ``SharedTree``
@@ -285,7 +310,10 @@ class SharedTreeModel(Model):
         X = np.asarray(jax.device_get(
             tree_matrix(frame, self.output["x_cols"],
                         self.output["feat_domains"])))[: frame.nrows]
-        phi = ensemble_contributions(self.output["trees"], X)
+        phi = ensemble_contributions(
+            self.output["trees"], X,
+            cat_card=self.output.get("cat_card"),
+            n_bins=int(self.output.get("cat_bins") or 0))
         scale, bias = self._contrib_scale_bias()
         phi *= scale
         phi[:, -1] += bias
@@ -297,8 +325,10 @@ class SharedTreeModel(Model):
     def _tree_raw_sum_per_class(self, frame: Frame) -> jax.Array:
         """[rows, K] per-class sums for multinomial (trees_multi[k] = class k)."""
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
-        return jnp.stack([predict_raw(X, ts) for ts in self.output["trees_multi"]],
-                         axis=1)
+        cc = self.output.get("cat_card")
+        nb = int(self.output.get("cat_bins") or 0)
+        return jnp.stack([predict_raw(X, ts, cat_card=cc, n_bins=nb)
+                          for ts in self.output["trees_multi"]], axis=1)
 
 
 class GBMModel(SharedTreeModel):
@@ -313,6 +343,11 @@ class GBMModel(SharedTreeModel):
                 + self.output["learn_rate"] * self._tree_raw_sum_per_class(frame)
             return jax.nn.softmax(f, axis=1)
         f = self.output["f0"] + self.output["learn_rate"] * self._tree_raw_sum(frame)
+        oc = self.params.get("offset_column")
+        if oc:
+            if oc not in frame:
+                raise ValueError(f"scoring frame lacks offset column {oc!r}")
+            f = f + jnp.nan_to_num(frame.vec(oc).as_float(), nan=0.0)
         if self.output["distribution"] == "bernoulli":
             p = jax.nn.sigmoid(f)
             return jnp.stack([1 - p, p], axis=1)
@@ -338,6 +373,14 @@ class SharedTreeBuilder(ModelBuilder):
             stopping_rounds=0,
             stopping_metric="AUTO",      # deviance (logloss/MSE) like reference
             stopping_tolerance=1e-3,
+            monotone_constraints=None,       # {col: ±1} (Constraints.java)
+            interaction_constraints=None,    # [[cols...], ...] (BranchInteractionConstraints)
+            calibrate_model=False,           # CalibrationHelper.java:18
+            calibration_frame=None,
+            calibration_method="PlattScaling",   # or IsotonicRegression
+            nbins_cats=1024,                 # DHistogram enum bins (capped at nbins here)
+            categorical_encoding="AUTO",     # AUTO/enum = group splits; ordinal = thresholds
+            offset_column=None,              # per-row margin offset (Model.Parameters._offset)
         )
 
     # Dense-heap trees cap depth at 16 (2^17 nodes); the reference's default 20
@@ -353,11 +396,167 @@ class SharedTreeBuilder(ModelBuilder):
         X = tree_matrix(frame, x, {})
         sample = sample_rows_host(X, frame.nrows)
         edges = jnp.asarray(compute_bin_edges(sample, int(self.params["nbins"])))
-        binned = bin_features(X, edges)
+        self._setup_cat_info(frame, x)
+        binned = self._apply_cat_bins(X, bin_features(X, edges))
         from h2o3_tpu.models.data_info import response_as_float
         yy, valid = response_as_float(yvec)
         domains = {c: frame.vec(c).domain for c in x if frame.vec(c).is_categorical}
         return X, edges, binned, yy, valid, yvec, domains
+
+    def _setup_cat_info(self, frame: Frame, x: list[str]) -> None:
+        """Categorical group-split binning state (reference: DHistogram gives
+        enums one bin per level up to ``nbins_cats``, then range-groups;
+        ``categorical_encoding="ordinal"`` opts back into threshold splits)."""
+        enc = str(self.params.get("categorical_encoding") or "AUTO").lower()
+        cat_card = np.zeros(len(x), np.int32)
+        if enc in ("auto", "enum"):
+            for j, c in enumerate(x):
+                if frame.vec(c).is_categorical:
+                    cat_card[j] = frame.vec(c).cardinality()
+        elif enc not in ("ordinal", "label_encoder", "labelencoder"):
+            raise ValueError(f"unsupported categorical_encoding {enc!r}; "
+                             "have AUTO, enum, ordinal/label_encoder")
+        if cat_card.any():
+            nbins = int(self.params["nbins"])
+            cat_bins = min(nbins, int(self.params.get("nbins_cats") or nbins))
+            self._cat_info = (jnp.asarray(cat_card), cat_bins)
+        else:
+            self._cat_info = None
+
+    def _apply_cat_bins(self, X, binned):
+        """Re-bin categorical columns: bin = (possibly range-grouped) level
+        code, missing stays the overflow bin."""
+        if self._cat_info is None:
+            return binned
+        cc, cat_bins = self._cat_info
+        from h2o3_tpu.models.tree import cat_bins_for_codes
+        nbins = int(self.params["nbins"])
+        cb = cat_bins_for_codes(X, cc, cat_bins)
+        is_cat = cc[None, :] > 0
+        nan = jnp.isnan(X)
+        binned = jnp.where(is_cat & ~nan, cb, binned)
+        return jnp.where(is_cat & nan, nbins, binned)
+
+    @property
+    def _cat_feats(self):
+        return None if self._cat_info is None else self._cat_info[0] > 0
+
+    def _cat_output(self) -> dict:
+        """Extra model-output entries for group-split models."""
+        if self._cat_info is None:
+            return {}
+        cc, cat_bins = self._cat_info
+        return dict(cat_card=cc, cat_bins=cat_bins)
+
+    def _maybe_calibrate(self, model) -> None:
+        """Fit probability calibration on a held-out frame (reference:
+        ``hex/tree/CalibrationHelper.java:18`` — Platt scaling or isotonic
+        regression on the model's predicted p1 vs the actual class)."""
+        if not self.params.get("calibrate_model"):
+            return
+        if model.nclasses != 2:
+            raise ValueError("calibrate_model requires a binomial model "
+                             "(reference: CalibrationHelper)")
+        cf = self.params.get("calibration_frame")
+        if cf is None:
+            raise ValueError("calibrate_model requires calibration_frame")
+        if isinstance(cf, str):
+            from h2o3_tpu.utils.registry import DKV
+            cf = DKV[cf]
+        method = str(self.params.get("calibration_method") or "PlattScaling")
+        if method not in ("PlattScaling", "IsotonicRegression"):
+            raise ValueError(f"unknown calibration_method {method!r}")
+        from h2o3_tpu.models.data_info import response_adapted
+        from h2o3_tpu.parallel.distributed import fetch
+        raw = model._score_raw(cf)
+        yv, valid = response_adapted(cf.vec(model.response_column),
+                                     model.response_domain)
+        mask = fetch(cf.row_mask() & valid)[:cf.nrows]
+        p1 = np.clip(fetch(raw)[:cf.nrows, 1][mask], 1e-15, 1 - 1e-15)
+        y = fetch(yv)[:cf.nrows][mask]
+        if method == "PlattScaling":
+            f = np.log(p1 / (1 - p1))
+            # Platt's target smoothing: t+=(N++1)/(N++2), t-=1/(N-+2)
+            npos, nneg = float(y.sum()), float((1 - y).sum())
+            t = np.where(y > 0, (npos + 1) / (npos + 2), 1 / (nneg + 2))
+            a, b = 1.0, 0.0
+            for _ in range(50):
+                p = 1 / (1 + np.exp(-(a * f + b)))
+                g = np.array([np.sum((p - t) * f), np.sum(p - t)])
+                W = np.maximum(p * (1 - p), 1e-10)
+                Hm = np.array([[np.sum(W * f * f) + 1e-9, np.sum(W * f)],
+                               [np.sum(W * f), np.sum(W) + 1e-9]])
+                step = np.linalg.solve(Hm, g)
+                a, b = a - step[0], b - step[1]
+                if np.abs(step).max() < 1e-10:
+                    break
+            model.output["calibration"] = dict(method=method, a=float(a),
+                                               b=float(b))
+        else:
+            order = np.argsort(p1)
+            xs, ys = p1[order], y[order].astype(np.float64)
+            # pool-adjacent-violators (reference hex/isotonic)
+            vals, wts, cnt = list(ys), [1.0] * len(ys), list(xs)
+            i = 0
+            merged_v, merged_w, merged_x = [], [], []
+            for v, wt, xx in zip(vals, wts, cnt):
+                merged_v.append(v); merged_w.append(wt); merged_x.append(xx)
+                while len(merged_v) > 1 and merged_v[-2] > merged_v[-1]:
+                    v2, w2 = merged_v.pop(), merged_w.pop()
+                    merged_x.pop()
+                    merged_v[-1] = (merged_v[-1] * merged_w[-1] + v2 * w2) / (merged_w[-1] + w2)
+                    merged_w[-1] += w2
+            model.output["calibration"] = dict(
+                method=method,
+                xs=[float(v) for v in merged_x],
+                ys=[float(v) for v in merged_v])
+
+    def _constraint_arrays(self, x: list[str], frame: Frame):
+        """(mono[F], reach[F,F]) device arrays from the constraint params.
+
+        Reference: ``hex/tree/Constraints.java:7`` (monotone directions) and
+        ``BranchInteractionConstraints.java`` (allowed-feature propagation).
+        Unlisted features form singleton interaction sets (XGBoost
+        semantics: they may split anywhere but nothing else may follow)."""
+        mc = self.params.get("monotone_constraints") or {}
+        ic = self.params.get("interaction_constraints")
+        mono = reach = None
+        if mc:
+            bad = set(mc) - set(x)
+            if bad:
+                raise ValueError(f"monotone_constraints name non-feature "
+                                 f"columns: {sorted(bad)}")
+            for c in mc:
+                if frame.vec(c).is_categorical:
+                    raise ValueError(f"monotone constraint on categorical "
+                                     f"column {c!r} (reference: numeric only)")
+                if int(mc[c]) not in (-1, 0, 1):
+                    raise ValueError(f"monotone_constraints[{c!r}] must be "
+                                     "-1, 0 or 1")
+            mono = jnp.asarray([int(mc.get(c, 0)) for c in x], jnp.int32)
+        if ic:
+            F = len(x)
+            reach_np = np.zeros((F, F), bool)
+            listed: set[int] = set()
+            for group in ic:
+                bad = set(group) - set(x)
+                if bad:
+                    raise ValueError(f"interaction_constraints name "
+                                     f"non-feature columns: {sorted(bad)}")
+                idxs = [x.index(c) for c in group]
+                for i in idxs:
+                    reach_np[i, idxs] = True
+                listed.update(idxs)
+            for f in range(F):
+                if f not in listed:
+                    reach_np[f, f] = True
+            reach = jnp.asarray(reach_np)
+        return mono, reach
+
+    def _effective_col_rate(self) -> float:
+        """Per-level feature-sampling rate (XGBoost overrides to fold
+        colsample_bynode in without mutating the stored params)."""
+        return float(self.params["col_sample_rate"])
 
     def _feat_mask(self, key, F: int, rate: float) -> jax.Array:
         if rate >= 1.0:
@@ -381,6 +580,18 @@ class SharedTreeBuilder(ModelBuilder):
             if int(cp.params.get(immut, self.params[immut])) != int(self.params[immut]):
                 raise ValueError(f"checkpoint {immut} differs; tree structure "
                                  "params are immutable across resume")
+        # group-split state must match: mixing masked and threshold trees in
+        # one ensemble would mis-route every categorical (the traversal mode
+        # is chosen per ensemble)
+        cp_grouped = cp.output.get("cat_card") is not None
+        if cp_grouped != (getattr(self, "_cat_info", None) is not None):
+            raise ValueError(
+                "checkpoint categorical encoding differs (group splits vs "
+                "ordinal); set categorical_encoding to match the checkpoint")
+        if cp_grouped and int(cp.output.get("cat_bins") or 0) != \
+                int(self._cat_info[1]):
+            raise ValueError("checkpoint nbins_cats differs; immutable "
+                             "across resume")
         # learn_rate scales EVERY tree at scoring time — changing it across a
         # resume would silently rescale the checkpoint's trees too
         if "learn_rate" in self.params and "learn_rate" in cp.params:
@@ -431,7 +642,7 @@ class GBM(SharedTreeBuilder):
             # thresholds silently shift (reference keeps the checkpoint's
             # DHistogram bins)
             edges = cp.output["edges"]
-            binned = bin_features(X, edges)
+            binned = self._apply_cat_bins(X, bin_features(X, edges))
         dist = str(p["distribution"])
         if dist.lower() == "auto":   # h2o-py sends lowercase enum names
             dist = "AUTO"
@@ -456,6 +667,9 @@ class GBM(SharedTreeBuilder):
         yc = jnp.where(w > 0, yy, 0.0)
 
         if dist == "multinomial":
+            if p.get("offset_column"):
+                raise ValueError("offset_column is not supported for "
+                                 "multinomial distributions")
             return self._fit_multinomial(job, frame, x, y, w, yc, yvec,
                                          X, edges, binned, domains, cp)
         self._check_checkpoint(cp, x, dist)
@@ -480,6 +694,11 @@ class GBM(SharedTreeBuilder):
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
         Fcur = jnp.full(X.shape[0], f0, jnp.float32)
+        oc = p.get("offset_column")
+        if oc:
+            # per-row margin offset (reference: offset_column adds to F on
+            # both train and score; score0 re-reads it from the scored frame)
+            Fcur = Fcur + jnp.nan_to_num(frame.vec(oc).as_float(), nan=0.0)
         trees: list[Tree] = []
         if cp is not None:
             trees = list(cp.output["trees"])
@@ -490,7 +709,7 @@ class GBM(SharedTreeBuilder):
         job.update(0.1, f"growing {ntrees - done} trees (one fused program)")
         kwargs = dict(
             dist=dist, depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
-            col_rate=float(p["col_sample_rate"]),
+            col_rate=self._effective_col_rate(),
             sample_rate=float(p["sample_rate"]),
             col_tree_rate=float(p["col_sample_rate_per_tree"]),
             min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
@@ -501,6 +720,8 @@ class GBM(SharedTreeBuilder):
             quantile_alpha=float(p["quantile_alpha"]),
             huber_alpha=float(p["huber_alpha"]),
             tweedie_power=float(p["tweedie_power"]))
+        mono, reach = self._constraint_arrays(x, frame)
+        kwargs.update(mono=mono, reach=reach, cat_feats=self._cat_feats)
         fmask_base = jnp.ones(X.shape[1], bool)
         valid = None
         if int(p.get("stopping_rounds") or 0) > 0:
@@ -523,14 +744,16 @@ class GBM(SharedTreeBuilder):
         else:
             self._last_train_raw = Fend
 
-        return GBMModel(
+        model = GBMModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain if yvec.is_categorical else None,
             output=dict(trees=trees, edges=edges, f0=f0, learn_rate=lr,
                         distribution=dist, x_cols=list(x), feat_domains=domains,
-                        ntrees=len(trees)),
+                        ntrees=len(trees), **self._cat_output()),
         )
+        self._maybe_calibrate(model)
+        return model
 
     #: early-stopping metrics honored (reference: ScoreKeeper.StoppingMetric)
     STOPPING_METRICS = ("AUTO", "deviance", "logloss", "MSE", "RMSE", "AUC",
@@ -602,7 +825,7 @@ class GBM(SharedTreeBuilder):
             return None
         x = self._x_cols
         Xv = tree_matrix(vf, x, domains)
-        binned_v = bin_features(Xv, edges)
+        binned_v = self._apply_cat_bins(Xv, bin_features(Xv, edges))
         from h2o3_tpu.models.data_info import response_adapted
         yvec = vf.vec(self._y_col)
         yv, validv = response_adapted(yvec, y_domain)
@@ -724,7 +947,7 @@ class GBM(SharedTreeBuilder):
         job.update(0.1, f"growing {(ntrees - done) * K} trees (one fused program)")
         kwargs = dict(
             dist="multinomial", depth=int(p["max_depth"]),
-            n_bins=int(p["nbins"]), col_rate=float(p["col_sample_rate"]),
+            n_bins=int(p["nbins"]), col_rate=self._effective_col_rate(),
             sample_rate=float(p["sample_rate"]),
             col_tree_rate=float(p["col_sample_rate_per_tree"]),
             min_rows=float(p["min_rows"]), reg_lambda=float(p["reg_lambda"]),
@@ -732,6 +955,11 @@ class GBM(SharedTreeBuilder):
             gamma=float(p.get("gamma", 0.0)),
             min_split_improvement=float(p["min_split_improvement"]), lr=lr,
             bootstrap=False, drf=False, nclass=K)
+        if self.params.get("monotone_constraints"):
+            raise ValueError("monotone_constraints are not supported for "
+                             "multinomial distributions (reference: GBM.java)")
+        _, reach = self._constraint_arrays(x, frame)
+        kwargs.update(mono=None, reach=reach, cat_feats=self._cat_feats)
         valid = None
         if int(p.get("stopping_rounds") or 0) > 0:
             valid = self._valid_stop_data(
@@ -753,7 +981,8 @@ class GBM(SharedTreeBuilder):
             response_domain=yvec.domain,
             output=dict(trees_multi=trees_multi, edges=edges, f0_multi=f0,
                         learn_rate=lr, distribution="multinomial",
-                        x_cols=list(x), feat_domains=domains, ntrees=ntrees),
+                        x_cols=list(x), feat_domains=domains, ntrees=ntrees,
+                        **self._cat_output()),
         )
 
 
@@ -799,7 +1028,7 @@ class DRF(SharedTreeBuilder):
         if cp is not None:
             self._check_checkpoint(cp, x, None)   # before the edges swap
             edges = cp.output["edges"]
-            binned = bin_features(X, edges)
+            binned = self._apply_cat_bins(X, bin_features(X, edges))
         classifier = yvec.is_categorical
         nclass = yvec.cardinality() if classifier else 0
         w = weights * valid
@@ -832,7 +1061,8 @@ class DRF(SharedTreeBuilder):
                 min_rows=float(p["min_rows"]), reg_lambda=0.0, reg_alpha=0.0,
                 gamma=0.0,
                 min_split_improvement=float(p["min_split_improvement"]),
-                lr=1.0, bootstrap=True, drf=True, nclass=nclass)
+                lr=1.0, bootstrap=True, drf=True, nclass=nclass,
+                cat_feats=self._cat_feats)
             heap = _heap_to_host(heap)
             for m in range(ntrees - done):
                 for k in range(nclass):
@@ -843,7 +1073,8 @@ class DRF(SharedTreeBuilder):
                 response_domain=yvec.domain,
                 output=dict(trees_multi=trees_multi, edges=edges, ntrees=ntrees,
                             binomial=False, x_cols=list(x), feat_domains=domains,
-                            f0=0.0, learn_rate=1.0, distribution="multinomial"),
+                            f0=0.0, learn_rate=1.0, distribution="multinomial",
+                            **self._cat_output()),
             )
 
         trees: list[Tree] = []
@@ -859,15 +1090,19 @@ class DRF(SharedTreeBuilder):
             col_tree_rate=1.0, min_rows=float(p["min_rows"]), reg_lambda=0.0,
             reg_alpha=0.0, gamma=0.0,
             min_split_improvement=float(p["min_split_improvement"]),
-            lr=1.0, bootstrap=True, drf=True, nclass=0)
+            lr=1.0, bootstrap=True, drf=True, nclass=0,
+            cat_feats=self._cat_feats)
         heap = _heap_to_host(heap)
         trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
 
-        return DRFModel(
+        model = DRFModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain if classifier else None,
             output=dict(trees=trees, edges=edges, ntrees=len(trees),
                         binomial=classifier, x_cols=list(x), feat_domains=domains,
-                        f0=0.0, learn_rate=1.0, distribution="gaussian"),
+                        f0=0.0, learn_rate=1.0, distribution="gaussian",
+                        **self._cat_output()),
         )
+        self._maybe_calibrate(model)
+        return model

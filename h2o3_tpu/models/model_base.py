@@ -231,6 +231,8 @@ class ModelBuilder:
         ignored = set(self.params.get("ignored_columns") or [])
         if self.params.get("weights_column"):
             ignored.add(self.params["weights_column"])
+        if self.params.get("offset_column"):
+            ignored.add(self.params["offset_column"])
         x = [c for c in (x if x is not None else frame.names)
              if c != y and c not in ignored and frame.vec(c).type.on_device]
         if not x:

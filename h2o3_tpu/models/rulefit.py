@@ -109,8 +109,10 @@ class RuleFit(ModelBuilder):
         trees = []
         lo, hi = int(p["min_rule_length"]), int(p["max_rule_length"])
         for d in range(lo, hi + 1):
+            # ordinal cat encoding: rule extraction reads threshold splits
             gbm = GBM(ntrees=int(p["rule_generation_ntrees"]), max_depth=d,
-                      learn_rate=0.1, seed=int(p.get("seed") or 0) + d) \
+                      learn_rate=0.1, seed=int(p.get("seed") or 0) + d,
+                      categorical_encoding="ordinal") \
                 .train(x=x, y=y, training_frame=frame, weights=weights)
             trees.extend(gbm.output["trees"])
             job.update(0.3 * (d - lo + 1) / (hi - lo + 1), f"depth {d} trees")

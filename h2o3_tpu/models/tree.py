@@ -57,6 +57,10 @@ class Tree:
     leaf: jax.Array         # f32 leaf values (valid where !is_split)
     gain: jax.Array | None = None    # f32 split gain (0 at leaves) — varimp
     cover: jax.Array | None = None   # f32 sum of row weights through the node
+    # [heap, B] bool — bins routed LEFT at each node. Present only when the
+    # model has categorical features (group splits, reference DHistogram enum
+    # subsets); numeric-only trees route by thresh_bin/thresh_val alone.
+    left_mask: jax.Array | None = None
 
 
 def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int):
@@ -98,12 +102,26 @@ def _node_totals(node_local, g, h, w, n_nodes: int):
     return jax.ops.segment_sum(vals, ids, num_segments=n_nodes)
 
 
-def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, feat_mask):
+def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma,
+                 feat_mask, mono=None, allowed=None, cat_feats=None):
     """Vectorized split search (reference: DTree.findBestSplitPoint).
 
-    hists: [F, N*(n_bins+1), 3]. Returns per-node best (gain, feat, t, na_left)
-    and node totals (G, H, W). Candidate split t in [1, n_bins-1]: bins < t go
-    left; the missing bin (index n_bins) is assigned to the better direction.
+    hists: [F, N*(n_bins+1), 3]. Returns per-node best (gain, feat, t,
+    na_left, child values) and node totals (G, H, W). Candidate split t in
+    [1, n_bins-1]: bins < t go left; the missing bin (index n_bins) is
+    assigned to the better direction.
+
+    ``mono`` [F] in {-1,0,1} rejects splits whose child leaf values violate
+    the feature's monotone direction (reference ``hex/tree/Constraints.java``;
+    LightGBM "basic" mode — violating candidates get -inf gain; the CALLER
+    propagates [lo,hi] bounds down the heap and clamps leaf values).
+    ``allowed`` [N,F] masks features an interaction-constrained branch may
+    split on (reference ``BranchInteractionConstraints.java``).
+    ``cat_feats`` [F] marks categorical features: their candidate splits are
+    GROUP splits — bins re-ranked per node by gradient ratio G/H and scanned
+    as sorted prefixes (reference ``DHistogram`` enum handling /
+    ``DTree.findBestSplitPoint`` Fisher-optimal subset search) — instead of
+    ordinal thresholds.
     """
     F = hists.shape[0]
     Bt = n_bins + 1
@@ -112,6 +130,17 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, fea
     reg = hist4[:, :, :n_bins, :]                 # [F,N,B,3]
     na = hist4[:, :, n_bins, :]                   # [F,N,3]
     cum = jnp.cumsum(reg, axis=2)                 # [F,N,B,3]
+    rank = None
+    if cat_feats is not None:
+        # rank bins by mean gradient; empty bins sort to the end so prefix
+        # candidates enumerate only occupied categories first
+        ratio = reg[..., 0] / jnp.maximum(reg[..., 1], 1e-12)
+        ratio = jnp.where(reg[..., 2] > 0, ratio, jnp.inf)
+        order = jnp.argsort(ratio, axis=2)                      # [F,N,B]
+        reg_sorted = jnp.take_along_axis(reg, order[..., None], axis=2)
+        cum_sorted = jnp.cumsum(reg_sorted, axis=2)
+        rank = jnp.argsort(order, axis=2)                       # bin → rank
+        cum = jnp.where(cat_feats[:, None, None, None], cum_sorted, cum)
     tot = cum[:, :, -1, :] + na                   # [F,N,3] (same for all f)
     G, H, W = tot[0, :, 0], tot[0, :, 1], tot[0, :, 2]
 
@@ -131,6 +160,14 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, fea
     parent = half(G, H)[None, None, :, None]
     gain = 0.5 * (half(gl, hl) + half(gr, hr) - parent) - gamma
     ok = (wl >= min_rows) & (wr >= min_rows) & feat_mask[None, :, None, None]
+    if allowed is not None:
+        ok = ok & allowed.T[None, :, :, None]
+    vl = _leaf_value(gl, hl, wl, reg_lambda, reg_alpha)
+    vr = _leaf_value(gr, hr, wr, reg_lambda, reg_alpha)
+    if mono is not None:
+        m = mono[None, :, None, None]
+        viol = ((m > 0) & (vl > vr)) | ((m < 0) & (vl < vr))
+        ok = ok & ~viol
     gain = jnp.where(ok, gain, -jnp.inf)
 
     flat = gain.transpose(2, 0, 1, 3).reshape(N, -1)   # [N, 2*F*(B-1)]
@@ -140,18 +177,35 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma, fea
     rem = best % (F * (n_bins - 1))
     best_feat = (rem // (n_bins - 1)).astype(jnp.int32)
     best_t = (rem % (n_bins - 1) + 1).astype(jnp.int32)
-    return best_gain, best_feat, best_t, na_left, G, H, W
+    nn = jnp.arange(N)
+    dirs = jnp.where(na_left, 0, 1)
+    vl_b = vl[dirs, best_feat, nn, best_t - 1]
+    vr_b = vr[dirs, best_feat, nn, best_t - 1]
+    # left-membership mask over bins for the chosen split: numeric = bins
+    # below the threshold; categorical = bins whose per-node rank is in the
+    # sorted prefix (the group going left)
+    member = jnp.arange(n_bins)[None, :] < best_t[:, None]       # [N,B]
+    if cat_feats is not None:
+        rank_best = rank[best_feat, nn, :]                       # [N,B]
+        member = jnp.where(cat_feats[best_feat][:, None],
+                           rank_best < best_t[:, None], member)
+    return best_gain, best_feat, best_t, na_left, G, H, W, vl_b, vr_b, member
 
 
-def _route_rows(binned, node_local, feat, t, na_left, do_split, n_bins: int):
-    """Advance rows to next-level node ids; frozen (leaf) rows get -1."""
+def _route_rows(binned, node_local, feat, member, na_left, do_split,
+                n_bins: int):
+    """Advance rows to next-level node ids; frozen (leaf) rows get -1.
+
+    ``member`` [N, B]: left-membership of each bin at each node (covers both
+    ordinal thresholds and categorical group splits)."""
     active = node_local >= 0
     nl = jnp.where(active, node_local, 0)
     f = feat[nl]
     split = do_split[nl] & active
     b = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
     is_na = b >= n_bins
-    left = jnp.where(is_na, na_left[nl], b < t[nl])
+    left = jnp.where(is_na, na_left[nl],
+                     member[nl, jnp.minimum(b, n_bins - 1)])
     child = nl * 2 + jnp.where(left, 0, 1)
     return jnp.where(split, child, -1)
 
@@ -163,10 +217,17 @@ def _leaf_value(G, H, W, reg_lambda, reg_alpha):
 
 def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
                       depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
-                      gamma, min_split_improvement, col_rate: float):
+                      gamma, min_split_improvement, col_rate: float,
+                      mono=None, reach=None, cat_feats=None):
     """Grow one whole tree on device; the level loop unrolls at trace time.
 
     Returns heap arrays + per-row training predictions (leaf of each row).
+
+    ``mono`` [F]: monotone directions per feature; child leaf bounds
+    propagate down the heap and leaves clamp into them.
+    ``reach`` [F, F]: interaction reachability — ``reach[f]`` is the set of
+    features allowed below a split on ``f`` (union of the constraint sets
+    containing ``f``; unlisted features are singletons, XGBoost semantics).
     """
     B = n_bins
     Bt = B + 1
@@ -174,8 +235,13 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
     node_local = jnp.zeros(binned.shape[0], jnp.int32)
 
     lv_feat, lv_t, lv_tv, lv_na, lv_sp, lv_leaf = [], [], [], [], [], []
-    lv_gain, lv_cover = [], []
+    lv_gain, lv_cover, lv_mask = [], [], []
     row_leaf = jnp.zeros(binned.shape[0], jnp.float32)
+    bounds = jnp.array([[-jnp.inf, jnp.inf]], jnp.float32) if mono is not None else None
+    allowed = jnp.ones((1, F), bool) if reach is not None else None
+
+    def clamp(v, bnd):
+        return jnp.clip(v, bnd[:, 0], bnd[:, 1]) if bnd is not None else v
 
     for d in range(depth):
         N = 2 ** d
@@ -188,10 +254,13 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
             # the forced index may miss feat_mask; never let the level go empty
             lmask = jnp.where(lmask.any(), lmask, feat_mask)
         hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt)
-        gain, feat, t, na_left, G, H, W = _find_splits(
-            hists, B, min_rows, reg_lambda, reg_alpha, gamma, lmask)
+        gain, feat, t, na_left, G, H, W, vl_b, vr_b, member = _find_splits(
+            hists, B, min_rows, reg_lambda, reg_alpha, gamma, lmask,
+            mono=mono, allowed=allowed, cat_feats=cat_feats)
         do = (gain > min_split_improvement) & jnp.isfinite(gain) & (W > 0)
-        leaf = jnp.where(do, 0.0, _leaf_value(G, H, W, reg_lambda, reg_alpha))
+        leaf = jnp.where(do, 0.0,
+                         clamp(_leaf_value(G, H, W, reg_lambda, reg_alpha),
+                               bounds))
         lv_feat.append(jnp.where(do, feat, -1))
         lv_t.append(jnp.where(do, t, 0))
         lv_tv.append(jnp.where(do, edges[feat, jnp.maximum(t - 1, 0)], 0.0))
@@ -200,18 +269,37 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
         lv_leaf.append(leaf)
         lv_gain.append(jnp.where(do, gain, 0.0))
         lv_cover.append(W)
+        if cat_feats is not None:
+            lv_mask.append(member & do[:, None])
         # rows whose node froze at this level take its leaf value
         active = node_local >= 0
         nl = jnp.where(active, node_local, 0)
         row_leaf = jnp.where(active & ~do[nl], leaf[nl], row_leaf)
-        node_local = _route_rows(binned, node_local, lv_feat[-1], lv_t[-1],
+        node_local = _route_rows(binned, node_local, lv_feat[-1], member,
                                  na_left, do, B)
+        if bounds is not None:
+            # monotone bound propagation: split midpoint bounds the children
+            lo, hi = bounds[:, 0], bounds[:, 1]
+            mid = jnp.clip(0.5 * (vl_b + vr_b), lo, hi)
+            c = mono[feat] * do          # 0 where unconstrained or no split
+            l_lo = jnp.where(c < 0, mid, lo)
+            l_hi = jnp.where(c > 0, mid, hi)
+            r_lo = jnp.where(c > 0, mid, lo)
+            r_hi = jnp.where(c < 0, mid, hi)
+            bounds = jnp.stack(
+                [jnp.stack([l_lo, l_hi], 1), jnp.stack([r_lo, r_hi], 1)],
+                axis=1).reshape(2 * N, 2)
+        if allowed is not None:
+            child_allowed = jnp.where(do[:, None],
+                                      allowed & reach[feat], allowed)
+            allowed = jnp.repeat(child_allowed, 2, axis=0)
 
     # final level: all surviving nodes become leaves; only per-node totals
     # are needed (no split search), so skip the full histogram build
     N = 2 ** depth
     tot = _node_totals(node_local, g, h, w, N)
-    leaf = _leaf_value(tot[:, 0], tot[:, 1], tot[:, 2], reg_lambda, reg_alpha)
+    leaf = clamp(_leaf_value(tot[:, 0], tot[:, 1], tot[:, 2], reg_lambda,
+                             reg_alpha), bounds)
     lv_feat.append(jnp.full(N, -1, jnp.int32))
     lv_t.append(jnp.zeros(N, jnp.int32))
     lv_tv.append(jnp.zeros(N, jnp.float32))
@@ -220,14 +308,19 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
     lv_leaf.append(leaf)
     lv_gain.append(jnp.zeros(N, jnp.float32))
     lv_cover.append(tot[:, 2])
+    if cat_feats is not None:
+        lv_mask.append(jnp.zeros((N, B), bool))
     active = node_local >= 0
     nl = jnp.where(active, node_local, 0)
     row_leaf = jnp.where(active, leaf[nl], row_leaf)
 
-    return (jnp.concatenate(lv_feat), jnp.concatenate(lv_t),
-            jnp.concatenate(lv_tv), jnp.concatenate(lv_na),
-            jnp.concatenate(lv_sp), jnp.concatenate(lv_leaf),
-            jnp.concatenate(lv_gain), jnp.concatenate(lv_cover), row_leaf)
+    out = (jnp.concatenate(lv_feat), jnp.concatenate(lv_t),
+           jnp.concatenate(lv_tv), jnp.concatenate(lv_na),
+           jnp.concatenate(lv_sp), jnp.concatenate(lv_leaf),
+           jnp.concatenate(lv_gain), jnp.concatenate(lv_cover))
+    if cat_feats is not None:
+        out = out + (jnp.concatenate(lv_mask, axis=0),)
+    return out + (row_leaf,)
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate", "min_rows",
@@ -235,18 +328,21 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
                                    "min_split_improvement"))
 def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
                   depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
-                  gamma, min_split_improvement, col_rate: float):
+                  gamma, min_split_improvement, col_rate: float,
+                  mono=None, reach=None, cat_feats=None):
     """K trees in ONE dispatch: vmap over the stats axis (class trees of a
     multinomial round, or K=1). binned/edges are shared (in_axes=None)."""
     binned_T = binned.T   # once per round; the Pallas kernel wants [F, rows]
     fn = lambda gk, hk, wk, mk, kk: _grow_tree_device(
         binned, binned_T, edges, gk, hk, wk, mk, kk, depth, n_bins, min_rows,
-        reg_lambda, reg_alpha, gamma, min_split_improvement, col_rate)
+        reg_lambda, reg_alpha, gamma, min_split_improvement, col_rate,
+        mono=mono, reach=reach, cat_feats=cat_feats)
     return jax.vmap(fn)(g, h, w, feat_mask, keys)
 
 
 def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
-                       col_rate: float = 1.0, key: jax.Array | None = None
+                       col_rate: float = 1.0, key: jax.Array | None = None,
+                       mono=None, reach=None, cat_feats=None
                        ) -> tuple[list[Tree], jax.Array]:
     """Grow K trees (leading axis of g/h/w) in one compiled program.
 
@@ -266,15 +362,19 @@ def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
     # hyperparams are STATIC (compiled constants): a traced jnp scalar would
     # cost a host→device upload per call — ~43ms each over a tunneled TPU,
     # dwarfing the 200ms tree-growth compute itself
-    hf, ht, htv, hna, hsp, hlf, hg, hc, preds = _grow_batched(
+    out = _grow_batched(
         binned, edges, g, h, w, feat_mask, keys,
         params.max_depth, params.nbins, float(params.min_rows),
         float(params.reg_lambda), float(params.reg_alpha),
         float(params.gamma), float(params.min_split_improvement),
-        float(col_rate))
+        float(col_rate), mono=mono, reach=reach, cat_feats=cat_feats)
+    hf, ht, htv, hna, hsp, hlf, hg, hc = out[:8]
+    hm = out[8] if cat_feats is not None else None
+    preds = out[-1]
     trees = [Tree(feat=hf[k], thresh_bin=ht[k], thresh_val=htv[k],
                   na_left=hna[k], is_split=hsp[k], leaf=hlf[k],
-                  gain=hg[k], cover=hc[k])
+                  gain=hg[k], cover=hc[k],
+                  left_mask=None if hm is None else hm[k])
              for k in range(K)]
     return trees, preds
 
@@ -291,6 +391,10 @@ def grow_tree(binned: jax.Array, edges: jax.Array, g: jax.Array, h: jax.Array,
 def predict_binned(binned, trees: list[Tree], n_bins: int) -> jax.Array:
     """Sum of leaf values over stacked trees, traversing binned features."""
     stack = lambda attr: jnp.stack([getattr(t, attr) for t in trees])
+    if trees[0].left_mask is not None:
+        return _predict_binned_masked(binned, stack("feat"),
+                                      stack("left_mask"), stack("na_left"),
+                                      stack("is_split"), stack("leaf"), n_bins)
     return _predict_binned_impl(binned, stack("feat"), stack("thresh_bin"),
                                 stack("na_left"), stack("is_split"), stack("leaf"),
                                 n_bins)
@@ -317,6 +421,30 @@ def _predict_binned_impl(binned, feat_s, t_s, na_s, sp_s, leaf_s, n_bins: int):
     return acc
 
 
+@partial(jax.jit, static_argnames=("n_bins",))
+def _predict_binned_masked(binned, feat_s, mask_s, na_s, sp_s, leaf_s,
+                           n_bins: int):
+    """Traversal by left-membership masks (group splits)."""
+    rows = binned.shape[0]
+    depth = int(np.log2(feat_s.shape[1] + 1)) - 1
+
+    def one_tree(acc, tr):
+        feat, mask, na_l, is_sp, leaf = tr
+        idx = jnp.zeros(rows, jnp.int32)
+        for _ in range(depth):
+            f = jnp.maximum(feat[idx], 0)
+            b = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+            left = jnp.where(b >= n_bins, na_l[idx],
+                             mask[idx, jnp.minimum(b, n_bins - 1)])
+            nxt = idx * 2 + jnp.where(left, 1, 2)
+            idx = jnp.where(is_sp[idx], nxt, idx)
+        return acc + leaf[idx], None
+
+    acc, _ = lax.scan(one_tree, jnp.zeros(rows, jnp.float32),
+                      (feat_s, mask_s, na_s, sp_s, leaf_s))
+    return acc
+
+
 @jax.jit
 def _predict_raw_impl(X, feat_s, tv_s, na_s, sp_s, leaf_s):
     """Raw-value traversal for scoring new frames (threshold = edge value)."""
@@ -339,7 +467,54 @@ def _predict_raw_impl(X, feat_s, tv_s, na_s, sp_s, leaf_s):
     return acc
 
 
-def predict_raw(X, trees: list[Tree]) -> jax.Array:
+@partial(jax.jit, static_argnames=("n_bins",))
+def _predict_raw_masked(X, cat_card, feat_s, tv_s, mask_s, na_s, sp_s, leaf_s,
+                        n_bins: int):
+    """Raw traversal with group splits: categorical features map raw codes
+    to their histogram bin (range-grouped when cardinality > bins) and test
+    membership; numeric features compare against the edge threshold."""
+    rows = X.shape[0]
+    depth = int(np.log2(feat_s.shape[1] + 1)) - 1
+    cat_bin = cat_bins_for_codes(X, cat_card, n_bins)   # [rows, F] int32
+
+    def one_tree(acc, tr):
+        feat, tv, mask, na_l, is_sp, leaf = tr
+        idx = jnp.zeros(rows, jnp.int32)
+        for _ in range(depth):
+            f = jnp.maximum(feat[idx], 0)
+            x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            is_cat = cat_card[f] > 0
+            b = jnp.take_along_axis(cat_bin, f[:, None], axis=1)[:, 0]
+            left_cat = mask[idx, jnp.clip(b, 0, n_bins - 1)]
+            left = jnp.where(jnp.isnan(x), na_l[idx],
+                             jnp.where(is_cat, left_cat, x < tv[idx]))
+            nxt = idx * 2 + jnp.where(left, 1, 2)
+            idx = jnp.where(is_sp[idx], nxt, idx)
+        return acc + leaf[idx], None
+
+    acc, _ = lax.scan(one_tree, jnp.zeros(rows, jnp.float32),
+                      (feat_s, tv_s, mask_s, na_s, sp_s, leaf_s))
+    return acc
+
+
+def cat_bins_for_codes(X, cat_card, n_bins: int) -> jax.Array:
+    """Map raw categorical codes to histogram bins: identity when the
+    cardinality fits, contiguous range-grouping otherwise (reference
+    DHistogram nbins_cats grouping)."""
+    code = jnp.nan_to_num(X, nan=0.0).astype(jnp.int32)
+    card = jnp.maximum(cat_card, 1)[None, :]
+    grouped = (code * n_bins) // card
+    return jnp.where(cat_card[None, :] > n_bins,
+                     jnp.clip(grouped, 0, n_bins - 1),
+                     jnp.clip(code, 0, n_bins - 1)).astype(jnp.int32)
+
+
+def predict_raw(X, trees: list[Tree], cat_card=None, n_bins: int = 0) -> jax.Array:
     stack = lambda attr: jnp.stack([getattr(t, attr) for t in trees])
+    if trees[0].left_mask is not None:
+        return _predict_raw_masked(X, cat_card, stack("feat"),
+                                   stack("thresh_val"), stack("left_mask"),
+                                   stack("na_left"), stack("is_split"),
+                                   stack("leaf"), n_bins)
     return _predict_raw_impl(X, stack("feat"), stack("thresh_val"),
                              stack("na_left"), stack("is_split"), stack("leaf"))

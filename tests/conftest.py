@@ -33,6 +33,17 @@ def _clear_dkv():
     DKV.clear()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches():
+    """Free compiled executables between test modules: a long single-process
+    run accumulates hundreds of live XLA CPU executables, which eventually
+    segfaults the LLVM JIT mid-compile (observed deterministically around the
+    ~500th compile). Shapes rarely repeat across modules, so the recompile
+    cost is negligible."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

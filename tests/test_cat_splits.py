@@ -1,0 +1,101 @@
+"""Categorical group splits (reference: DHistogram enum bins +
+DTree.findBestSplitPoint subset search; nbins_cats range grouping).
+
+The canonical case ordinal thresholds CANNOT express: a categorical whose
+predictive levels interleave with non-predictive ones in code order. A
+group split separates them in ONE split; ordinal needs depth ~= levels.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import GBM, DRF
+
+
+def _interleaved(rng, n=2000):
+    # levels a,c,e,g → 'yes'-ish; b,d,f,h → 'no'-ish; alternating in sorted
+    # (code) order so no single threshold separates them
+    levels = list("abcdefgh")
+    codes = rng.integers(0, 8, size=n)
+    p = np.where(codes % 2 == 0, 0.9, 0.1)
+    y = rng.random(n) < p
+    return Frame.from_arrays({
+        "c": np.array(levels, dtype=object)[codes],
+        "noise": rng.normal(size=n).astype(np.float32),
+        "y": np.array(["no", "yes"], dtype=object)[y.astype(int)],
+    })
+
+
+def test_group_split_beats_ordinal_depth1(rng):
+    fr = _interleaved(rng)
+    kw = dict(ntrees=1, max_depth=1, learn_rate=1.0, seed=1, nbins=16)
+    grouped = GBM(**kw).train(y="y", training_frame=fr)
+    ordinal = GBM(**kw, categorical_encoding="ordinal").train(
+        y="y", training_frame=fr)
+    auc_g = grouped.training_metrics.auc
+    auc_o = ordinal.training_metrics.auc
+    # one group split nails the interleaved pattern; one threshold cannot
+    assert auc_g > 0.85, auc_g
+    assert auc_o < 0.75, auc_o
+    assert grouped.output["trees"][0].left_mask is not None
+    assert ordinal.output["trees"][0].left_mask is None
+
+
+def test_group_split_predict_consistency(rng):
+    """Training-time (binned) and scoring-time (raw) traversals agree."""
+    fr = _interleaved(rng, 800)
+    m = GBM(ntrees=5, max_depth=3, seed=2).train(y="y", training_frame=fr)
+    p = m.predict(fr).vec("pyes").to_numpy()
+    mm = m.model_performance(fr)
+    assert mm.auc > 0.85
+    # re-predict on a COPY of the frame (fresh domain-mapping path)
+    fr2 = Frame.from_arrays({
+        "c": fr.vec("c").labels(), "noise": fr.vec("noise").to_numpy(),
+        "y": fr.vec("y").labels()})
+    p2 = m.predict(fr2).vec("pyes").to_numpy()
+    np.testing.assert_allclose(p, p2, rtol=1e-5)
+
+
+def test_nbins_cats_range_grouping(rng):
+    """Cardinality above nbins_cats range-groups levels instead of failing."""
+    n = 1500
+    codes = rng.integers(0, 40, size=n)         # 40 levels, nbins_cats=8
+    y = rng.random(n) < np.where(codes < 20, 0.85, 0.15)
+    fr = Frame.from_arrays({
+        "c": np.array([f"lv{i:02d}" for i in range(40)], dtype=object)[codes],
+        "y": np.array(["no", "yes"], dtype=object)[y.astype(int)],
+    })
+    m = GBM(ntrees=3, max_depth=2, nbins=16, nbins_cats=8, seed=3).train(
+        y="y", training_frame=fr)
+    assert int(m.output["cat_bins"]) == 8
+    assert m.training_metrics.auc > 0.8
+
+
+def test_group_split_pojo_and_shap(rng, tmp_path):
+    fr = _interleaved(rng, 600)
+    m = GBM(ntrees=4, max_depth=3, seed=4).train(y="y", training_frame=fr)
+
+    # POJO module reproduces the grouped-split scoring
+    path = m.download_pojo(str(tmp_path / "pj.py"))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("pj", path)
+    pj = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pj)
+    rows = fr.to_pandas().to_dict("records")[:50]
+    ours = m.predict(fr).vec("pyes").to_numpy()[:50]
+    theirs = np.array([pj.score(r)[1] for r in rows])
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+
+    # TreeSHAP contributions still sum to the raw margin
+    contrib = m.predict_contributions(fr)
+    tot = sum(contrib.vec(nm).to_numpy() for nm in contrib.names)
+    p = np.clip(m.predict(fr).vec("pyes").to_numpy(), 1e-12, 1 - 1e-12)
+    margin = np.log(p / (1 - p))
+    np.testing.assert_allclose(tot, margin, rtol=1e-3, atol=1e-3)
+
+
+def test_drf_group_splits(rng):
+    fr = _interleaved(rng, 1000)
+    m = DRF(ntrees=10, max_depth=4, seed=5).train(y="y", training_frame=fr)
+    assert m.training_metrics.auc > 0.85

@@ -1,0 +1,154 @@
+"""Tree feature parity: monotone constraints, interaction constraints,
+probability calibration.
+
+Reference: ``hex/tree/Constraints.java:7`` (monotone),
+``BranchInteractionConstraints.java`` (interaction),
+``hex/tree/CalibrationHelper.java:18`` (Platt / isotonic calibration).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import GBM
+
+
+def _mono_data(rng, n=800):
+    x0 = rng.uniform(-2, 2, n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    # y increases with x0 on average but with enough noise that an
+    # unconstrained tree produces local decreases
+    y = (x0 + 1.5 * np.sin(3 * x0) + 0.5 * x1
+         + rng.normal(scale=0.5, size=n)).astype(np.float32)
+    return Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+
+
+def _pd_curve(model, lo=-2.0, hi=2.0, k=41):
+    grid = np.linspace(lo, hi, k, dtype=np.float32)
+    fr = Frame.from_arrays({
+        "x0": grid, "x1": np.zeros(k, np.float32)})
+    return model.predict(fr).vec("predict").to_numpy()
+
+
+def test_monotone_increasing_constraint(rng):
+    fr = _mono_data(rng)
+    un = GBM(ntrees=30, max_depth=4, seed=1).train(y="y", training_frame=fr)
+    con = GBM(ntrees=30, max_depth=4, seed=1,
+              monotone_constraints={"x0": 1}).train(y="y", training_frame=fr)
+
+    curve_un = _pd_curve(un)
+    curve_con = _pd_curve(con)
+    # constrained: predictions never decrease along x0
+    assert (np.diff(curve_con) >= -1e-5).all(), np.diff(curve_con).min()
+    # the data's wiggles make the unconstrained model non-monotone
+    assert (np.diff(curve_un) < -1e-4).any()
+    # and the constrained model still learns the overall trend
+    assert curve_con[-1] - curve_con[0] > 1.0
+
+
+def test_monotone_decreasing_constraint(rng):
+    fr = _mono_data(rng)
+    neg = Frame.from_arrays({
+        "x0": fr.vec("x0").to_numpy(),
+        "x1": fr.vec("x1").to_numpy(),
+        "y": -fr.vec("y").to_numpy()})
+    con = GBM(ntrees=30, max_depth=4, seed=1,
+              monotone_constraints={"x0": -1}).train(y="y", training_frame=neg)
+    curve = _pd_curve(con)
+    assert (np.diff(curve) <= 1e-5).all()
+
+
+def test_monotone_validation(rng):
+    fr = Frame.from_arrays({
+        "x": rng.normal(size=50).astype(np.float32),
+        "c": rng.choice(["a", "b"], size=50),
+        "y": rng.normal(size=50).astype(np.float32)})
+    with pytest.raises(ValueError, match="categorical"):
+        GBM(ntrees=2, monotone_constraints={"c": 1}).train(
+            y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="non-feature"):
+        GBM(ntrees=2, monotone_constraints={"zzz": 1}).train(
+            y="y", training_frame=fr)
+
+
+def test_interaction_constraints(rng):
+    n = 600
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    c = rng.normal(size=n).astype(np.float32)
+    y = (a * b + 0.3 * c + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"a": a, "b": b, "c": c, "y": y})
+
+    m = GBM(ntrees=10, max_depth=4, seed=2,
+            interaction_constraints=[["a", "b"]]).train(
+        y="y", training_frame=fr)
+    # walk every tree: under any path that used 'a' or 'b', only {a, b}
+    # may appear; under 'c' (singleton), only 'c'
+    groups = {0: {0, 1}, 1: {0, 1}, 2: {2}}
+    for tree in m.output["trees"]:
+        feat = np.asarray(tree.feat)
+        is_sp = np.asarray(tree.is_split)
+
+        def walk(i, allowed):
+            if i >= len(feat) or not is_sp[i]:
+                return
+            f = int(feat[i])
+            assert allowed is None or f in allowed, (i, f, allowed)
+            nxt = groups[f] if allowed is None else (allowed & groups[f])
+            walk(2 * i + 1, nxt)
+            walk(2 * i + 2, nxt)
+
+        walk(0, None)
+
+
+def test_platt_calibration(rng):
+    n = 1200
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    logit = 1.5 * x[:, 0] - x[:, 1]
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    cols = {f"x{i}": x[:, i] for i in range(3)}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[y.astype(int)]
+    fr = Frame.from_arrays(cols)
+    cal = Frame.from_arrays({k: v[:400] for k, v in cols.items()})
+
+    m = GBM(ntrees=20, max_depth=3, seed=3, calibrate_model=True,
+            calibration_frame=cal).train(y="y", training_frame=fr)
+    assert m.output["calibration"]["method"] == "PlattScaling"
+    pred = m.predict(fr)
+    assert "cal_p0" in pred.names and "cal_p1" in pred.names
+    cp1 = pred.vec("cal_p1").to_numpy()
+    cp0 = pred.vec("cal_p0").to_numpy()
+    np.testing.assert_allclose(cp0 + cp1, 1.0, atol=1e-5)
+    assert ((cp1 >= 0) & (cp1 <= 1)).all()
+    # calibrated probs should correlate with the raw ones
+    p1 = pred.vec("pyes").to_numpy()
+    assert np.corrcoef(p1, cp1)[0, 1] > 0.9
+
+
+def test_isotonic_calibration(rng):
+    n = 800
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    y = rng.random(n) < 1 / (1 + np.exp(-2 * x[:, 0]))
+    cols = {"x0": x[:, 0], "x1": x[:, 1],
+            "y": np.array(["no", "yes"], dtype=object)[y.astype(int)]}
+    fr = Frame.from_arrays(cols)
+
+    m = GBM(ntrees=10, max_depth=3, seed=4, calibrate_model=True,
+            calibration_frame=fr,
+            calibration_method="IsotonicRegression").train(
+        y="y", training_frame=fr)
+    pred = m.predict(fr)
+    cp1 = pred.vec("cal_p1").to_numpy()
+    p1 = pred.vec("pyes").to_numpy()
+    # isotonic map preserves order
+    o = np.argsort(p1)
+    assert (np.diff(cp1[o]) >= -1e-9).all()
+
+
+def test_calibration_validation(rng):
+    fr = Frame.from_arrays({
+        "x": rng.normal(size=50).astype(np.float32),
+        "y": rng.normal(size=50).astype(np.float32)})
+    with pytest.raises(ValueError, match="binomial"):
+        GBM(ntrees=2, calibrate_model=True, calibration_frame=fr).train(
+            y="y", training_frame=fr)
