@@ -1,0 +1,102 @@
+"""XGBoost differentiation: DART, param aliases, by-node sampling, offset.
+
+Reference: ``h2o-extensions/xgboost`` XGBoostParameters surface; DART per
+Rashmi & Gilad-Bachrach (2015) as implemented by libxgboost.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.xgboost import XGBoost
+
+
+def _reg_frame(rng, n=600):
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] * 2 - x[:, 1] + 0.2 * rng.normal(size=n)).astype(np.float32)
+    cols = {f"x{i}": x[:, i] for i in range(4)}
+    cols["y"] = y
+    return Frame.from_arrays(cols)
+
+
+def _bin_frame(rng, n=600):
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    yb = rng.random(n) < 1 / (1 + np.exp(-(1.5 * x[:, 0] - x[:, 1])))
+    cols = {f"x{i}": x[:, i] for i in range(3)}
+    cols["y"] = np.array(["no", "yes"], dtype=object)[yb.astype(int)]
+    return Frame.from_arrays(cols)
+
+
+def test_xgb_param_aliases(rng):
+    fr = _reg_frame(rng)
+    m = XGBoost(ntrees=5, eta=0.2, max_bin=32, subsample=0.9,
+                colsample_bytree=0.9, min_child_weight=2.0,
+                min_split_loss=0.01, seed=1).train(y="y", training_frame=fr)
+    assert m.params["learn_rate"] == 0.2
+    assert m.params["nbins"] == 32
+    assert m.params["sample_rate"] == 0.9
+    assert m.algo == "xgboost"
+    assert m.training_metrics.rmse < 1.0
+
+
+def test_dart_trains_and_scores(rng):
+    fr = _bin_frame(rng)
+    m = XGBoost(ntrees=12, max_depth=3, booster="dart", rate_drop=0.3,
+                one_drop=True, seed=2).train(y="y", training_frame=fr)
+    assert len(m.output["trees"]) == 12
+    assert len(m.output["dart_weights"]) == 12
+    # renormalization really happened: not all weights equal eta
+    assert len({round(w, 6) for w in m.output["dart_weights"]}) > 1
+    assert m.training_metrics.auc > 0.85
+    pred = m.predict(fr)
+    p = pred.vec("pyes").to_numpy()
+    assert ((p >= 0) & (p <= 1)).all()
+    # training-cache metrics equal re-scored metrics (weights baked in)
+    mm = m.model_performance(fr)
+    assert abs(mm.auc - m.training_metrics.auc) < 1e-6
+
+
+def test_dart_regression_and_forest_norm(rng):
+    fr = _reg_frame(rng)
+    m = XGBoost(ntrees=10, max_depth=3, booster="dart", rate_drop=0.2,
+                normalize_type="forest", seed=3).train(
+        y="y", training_frame=fr)
+    assert m.training_metrics.rmse < 1.0
+
+
+def test_colsample_bynode_folds(rng):
+    fr = _reg_frame(rng)
+    b = XGBoost(ntrees=5, colsample_bynode=0.5, colsample_bylevel=0.8, seed=4)
+    assert b._effective_col_rate() == pytest.approx(0.4)
+    m = b.train(y="y", training_frame=fr)
+    # stored params keep the USER's values (no in-place folding)
+    assert m.params["col_sample_rate"] == pytest.approx(0.8)
+    assert m.params["col_sample_by_node"] == pytest.approx(0.5)
+    # repeated training must not compound the rate
+    b.train(y="y", training_frame=fr)
+    assert b._effective_col_rate() == pytest.approx(0.4)
+
+
+def test_offset_column(rng):
+    n = 500
+    x = rng.normal(size=n).astype(np.float32)
+    off = np.where(x > 0, 2.0, -2.0).astype(np.float32)
+    y = (3.0 * x + off + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+
+    m = XGBoost(ntrees=20, max_depth=3, offset_column="off", seed=5).train(
+        y="y", training_frame=fr)
+    # offset column must not be used as a feature
+    assert m.output["x_cols"] == ["x"]
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.6
+    # scoring without the offset column fails loudly
+    fr2 = Frame.from_arrays({"x": x, "y": y})
+    with pytest.raises(ValueError, match="offset"):
+        m.predict(fr2)
+
+
+def test_gblinear_rejected(rng):
+    fr = _reg_frame(rng)
+    with pytest.raises(ValueError, match="gblinear"):
+        XGBoost(ntrees=2, booster="gblinear").train(y="y", training_frame=fr)
