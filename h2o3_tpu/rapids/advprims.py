@@ -1,0 +1,690 @@
+"""Rapids prim closure — the advmath / munger / reducer / search / repeater /
+matrix / timeseries primitives beyond the core engine.
+
+Reference: ``water/rapids/ast/prims/*/`` (207 prim files; each function here
+names its Ast* counterpart). Device math stays on device (correlations,
+distances, ranks ride XLA); plan-shaped ops (dedup, fills, releveling) run
+host-side like the reference's single-node fallbacks, then re-upload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.parallel.distributed import fetch
+from h2o3_tpu.rapids import munge
+
+
+def _valid_np(v: Vec) -> tuple[np.ndarray, np.ndarray]:
+    a = v.to_numpy().astype(np.float64)
+    return a, ~np.isnan(a)
+
+
+# -- advmath ----------------------------------------------------------------
+
+def cor(frame: Frame, frame2: Frame | None = None, use: str = "complete.obs",
+        method: str = "Pearson") -> Frame:
+    """AstCorrelation / AstSpearmanCorrelation: column correlation matrix."""
+    cols = [c for c in frame.names if frame.vec(c).is_numeric]
+    X = np.stack([frame.vec(c).to_numpy().astype(np.float64) for c in cols], 1)
+    if method.lower().startswith("spearman"):
+        from scipy.stats import rankdata
+        ok = ~np.isnan(X).any(axis=1)
+        X = X[ok]
+        X = np.stack([rankdata(X[:, j]) for j in range(X.shape[1])], 1)
+    else:
+        ok = ~np.isnan(X).any(axis=1)
+        X = X[ok]
+    C = np.corrcoef(X, rowvar=False).reshape(len(cols), len(cols))
+    return Frame(cols, [Vec.from_numpy(C[:, j].astype(np.float32))
+                        for j in range(len(cols))])
+
+
+def distance(frame: Frame, other: Frame, measure: str = "l2") -> Frame:
+    """AstDistance: [nx, ny] pairwise distances (device matmul for the
+    inner products — the MXU path)."""
+    X = frame.matrix()[: frame.nrows]
+    Y = other.matrix()[: other.nrows]
+    if measure in ("cosine", "cosine_sq"):
+        xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-30)
+        yn = Y / jnp.maximum(jnp.linalg.norm(Y, axis=1, keepdims=True), 1e-30)
+        sim = xn @ yn.T
+        D = sim * sim if measure == "cosine_sq" else sim
+    elif measure == "l1":
+        D = jnp.abs(X[:, None, :] - Y[None, :, :]).sum(axis=2)
+    else:                                   # l2
+        x2 = (X * X).sum(1)[:, None]
+        y2 = (Y * Y).sum(1)[None, :]
+        D = jnp.sqrt(jnp.maximum(x2 + y2 - 2.0 * (X @ Y.T), 0.0))
+    Dh = np.asarray(jax.device_get(D))
+    return Frame([f"C{j + 1}" for j in range(Dh.shape[1])],
+                 [Vec.from_numpy(Dh[:, j].astype(np.float32))
+                  for j in range(Dh.shape[1])])
+
+
+def kfold_column(frame: Frame, nfolds: int, seed: int = -1) -> Vec:
+    """AstKFold: uniform random fold assignment."""
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    return Vec.from_numpy(rng.integers(0, nfolds, frame.nrows)
+                          .astype(np.float32))
+
+
+def modulo_kfold_column(frame: Frame, nfolds: int) -> Vec:
+    """AstModuloKFold: fold = row % nfolds."""
+    return Vec.from_numpy((np.arange(frame.nrows) % nfolds).astype(np.float32))
+
+
+def stratified_kfold_column(vec: Vec, nfolds: int, seed: int = -1) -> Vec:
+    """AstStratifiedKFold: per-class balanced folds."""
+    y = vec.to_numpy()
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    out = np.zeros(len(y), np.float32)
+    for cls in np.unique(y[~np.isnan(y.astype(np.float64))]
+                         if y.dtype.kind == "f" else np.unique(y)):
+        idx = np.nonzero(y == cls)[0]
+        rng.shuffle(idx)
+        out[idx] = np.arange(len(idx)) % nfolds
+    return Vec.from_numpy(out)
+
+
+def stratified_split(vec: Vec, test_frac: float = 0.2, seed: int = -1) -> Vec:
+    """AstStratifiedSplit: per-class train/test factor column."""
+    y = vec.to_numpy()
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    out = np.zeros(len(y), np.int32)
+    for cls in np.unique(y):
+        idx = np.nonzero(y == cls)[0]
+        rng.shuffle(idx)
+        k = int(round(test_frac * len(idx)))
+        out[idx[:k]] = 1
+    return Vec.from_numpy(out, type=VecType.CAT, domain=("train", "test"))
+
+
+def skewness(vec: Vec, na_rm: bool = True) -> float:
+    """AstSkewness: sample skewness g1 * sqrt(n(n-1))/(n-2) (bias-corrected,
+    matching the reference's MathUtils)."""
+    a, ok = _valid_np(vec)
+    if not na_rm and not ok.all():
+        return float("nan")
+    a = a[ok]
+    n = len(a)
+    m = a.mean()
+    m2 = ((a - m) ** 2).mean()
+    m3 = ((a - m) ** 3).mean()
+    g1 = m3 / max(m2, 1e-300) ** 1.5
+    return float(g1 * np.sqrt(n * (n - 1)) / max(n - 2, 1))
+
+
+def kurtosis(vec: Vec, na_rm: bool = True) -> float:
+    """AstKurtosis: Pearson kurtosis m4/m2² (≈3 for a normal)."""
+    a, ok = _valid_np(vec)
+    if not na_rm and not ok.all():
+        return float("nan")
+    a = a[ok]
+    m = a.mean()
+    m2 = ((a - m) ** 2).mean()
+    m4 = ((a - m) ** 4).mean()
+    return float(m4 / max(m2, 1e-300) ** 2)
+
+
+def mode(vec: Vec) -> float:
+    """AstMode: most frequent categorical level code."""
+    if not vec.is_categorical:
+        raise ValueError("mode requires a categorical column")
+    codes = vec.to_numpy()
+    codes = codes[codes >= 0]
+    if len(codes) == 0:
+        return -1.0
+    vals, cnt = np.unique(codes, return_counts=True)
+    return float(vals[np.argmax(cnt)])
+
+
+# -- filters ----------------------------------------------------------------
+
+def drop_duplicates(frame: Frame, by=None, keep: str = "first") -> Frame:
+    """Astdropduplicates: keep first/last row of each duplicate group."""
+    cols = list(by) if by else list(frame.names)
+    cols = [frame.names[int(c)] if isinstance(c, (int, float)) else c
+            for c in cols]
+    gid, _, _ = munge.frame_group_ids(frame, cols)
+    g = fetch(gid)[: frame.nrows]
+    order = np.arange(len(g))
+    if keep == "last":
+        order = order[::-1]
+    seen, pick = set(), []
+    for i in order:
+        if g[i] not in seen:
+            seen.add(g[i])
+            pick.append(i)
+    pick = np.sort(np.asarray(pick))
+    return munge.gather_rows(frame, pick)
+
+
+# -- matrix -----------------------------------------------------------------
+
+def mmult(a: Frame, b: Frame) -> Frame:
+    """AstMMult: matrix product on the MXU."""
+    X = a.matrix()[: a.nrows]
+    Y = b.matrix()[: b.nrows]
+    Z = np.asarray(jax.device_get(X @ Y))
+    return Frame([f"C{j + 1}" for j in range(Z.shape[1])],
+                 [Vec.from_numpy(Z[:, j].astype(np.float32))
+                  for j in range(Z.shape[1])])
+
+
+def transpose(frame: Frame) -> Frame:
+    """AstTranspose."""
+    X = np.stack([frame.vec(c).to_numpy().astype(np.float32)
+                  for c in frame.names], 0)
+    return Frame([f"C{j + 1}" for j in range(X.shape[1])],
+                 [Vec.from_numpy(X[:, j]) for j in range(X.shape[1])])
+
+
+# -- mungers ----------------------------------------------------------------
+
+def any_factor(frame: Frame) -> bool:
+    """AstAnyFactor."""
+    return any(v.is_categorical for v in frame.vecs)
+
+
+def append_levels(vec: Vec, levels) -> Vec:
+    """AstAppendLevels: extend the domain (codes unchanged)."""
+    if not vec.is_categorical:
+        raise ValueError("appendLevels requires a categorical column")
+    dom = tuple(vec.domain) + tuple(l for l in levels if l not in vec.domain)
+    return Vec(vec.data, VecType.CAT, vec.nrows, domain=dom)
+
+
+def columns_by_type(frame: Frame, coltype: str = "numeric") -> list[float]:
+    """AstColumnsByType: 0-based indices of columns of the given type."""
+    def match(v: Vec) -> bool:
+        t = coltype.lower()
+        if t == "numeric":
+            return v.type in (VecType.NUM, VecType.INT)
+        if t == "categorical":
+            return v.type is VecType.CAT
+        if t == "string":
+            return v.type is VecType.STR
+        if t == "time":
+            return v.type is VecType.TIME
+        if t == "uuid":
+            return v.type is VecType.UUID
+        if t == "bad":
+            return v.type is VecType.BAD
+        raise ValueError(f"unknown column type {coltype!r}")
+    return [float(i) for i, v in enumerate(frame.vecs) if match(v)]
+
+
+def ddply(frame: Frame, by, col, fn: str) -> Frame:
+    """AstDdply: per-group reduction (the lambda subset the engine runs:
+    named reducers over one column; reference ships the same built-ins)."""
+    cols = [frame.names[int(c)] if isinstance(c, (int, float)) else c
+            for c in (by if isinstance(by, (list, tuple)) else [by])]
+    col = frame.names[int(col)] if isinstance(col, (int, float)) else col
+    return munge.group_by(frame, cols, {col: fn})
+
+
+def fillna(frame: Frame, method: str = "forward", axis: int = 0,
+           maxlen: int = 1) -> Frame:
+    """AstFillNA: directional fill with a run-length cap."""
+    fwd = method.lower().startswith("f")
+    out = []
+    for v in frame.vecs:
+        if not v.type.on_device:
+            out.append(v)
+            continue
+        a = v.to_numpy().astype(np.float64)
+        if v.is_categorical:
+            a = np.where(a < 0, np.nan, a)
+        b = a.copy()
+        run = 0
+        rng_iter = range(len(b)) if fwd else range(len(b) - 1, -1, -1)
+        last = np.nan
+        for i in rng_iter:
+            if np.isnan(b[i]):
+                if run < maxlen and not np.isnan(last):
+                    b[i] = last
+                    run += 1
+            else:
+                last = b[i]
+                run = 0
+        if v.is_categorical:
+            out.append(Vec.from_numpy(
+                np.where(np.isnan(b), -1, b).astype(np.int32),
+                type=VecType.CAT, domain=v.domain))
+        else:
+            out.append(Vec.from_numpy(b.astype(np.float32), type=v.type))
+    return Frame(list(frame.names), out)
+
+
+def filter_na_cols(frame: Frame, frac: float = 0.2) -> list[float]:
+    """AstFilterNaCols: indices of columns with NA fraction below frac."""
+    keep = []
+    for i, v in enumerate(frame.vecs):
+        na = int(v.rollups().na_cnt)
+        if na / max(frame.nrows, 1) < frac:
+            keep.append(float(i))
+    return keep
+
+
+def flatten(frame: Frame):
+    """AstFlatten: 1x1 frame → scalar/string."""
+    if frame.nrows != 1 or frame.ncols != 1:
+        raise ValueError("flatten requires a 1x1 frame")
+    v = frame.vecs[0]
+    if v.is_categorical:
+        return v.labels()[0]
+    val = v.to_numpy()[0]
+    return float(val) if v.type.on_device else val
+
+
+def getrow(frame: Frame) -> list:
+    """AstGetrow: single-row frame → list of values."""
+    if frame.nrows != 1:
+        raise ValueError(f"getrow requires a 1-row frame, got {frame.nrows}")
+    out = []
+    for v in frame.vecs:
+        out.append(float(v.to_numpy()[0]) if v.type.on_device else
+                   v.host_values[0])
+    return out
+
+
+def na_omit(frame: Frame) -> Frame:
+    """AstNaOmit: drop rows containing any NA."""
+    ok = np.ones(frame.nrows, bool)
+    for v in frame.vecs:
+        if not v.type.on_device:
+            ok &= np.array([x is not None for x in v.host_values[:frame.nrows]])
+            continue
+        a = v.to_numpy().astype(np.float64)
+        ok &= (a >= 0) if v.is_categorical else ~np.isnan(a)
+    return munge.gather_rows(frame, np.nonzero(ok)[0])
+
+
+def nlevels(vec: Vec) -> float:
+    """AstNLevels."""
+    return float(vec.cardinality())
+
+
+def rank_within_group_by(frame: Frame, group_cols, sort_cols, ascending=None,
+                         new_col: str = "rank", sort_cols_sorted: bool = False
+                         ) -> Frame:
+    """AstRankWithinGroupBy: dense 1-based rank of each row within its
+    group under the sort order (ties broken by row order, reference
+    semantics)."""
+    gcols = [frame.names[int(c)] if isinstance(c, (int, float)) else c
+             for c in group_cols]
+    scols = [frame.names[int(c)] if isinstance(c, (int, float)) else c
+             for c in sort_cols]
+    asc = list(ascending) if ascending is not None else [True] * len(scols)
+    gid, _, _ = munge.frame_group_ids(frame, gcols)
+    g = fetch(gid)[: frame.nrows].astype(np.int64)
+    keys = []
+    for c, a in zip(scols[::-1], asc[::-1]):
+        k = frame.vec(c).to_numpy().astype(np.float64)
+        keys.append(k if a else -k)
+    keys.append(g)
+    order = np.lexsort(keys)
+    rank = np.zeros(frame.nrows, np.float32)
+    prev_g, r = None, 0
+    for i in order:
+        if g[i] != prev_g:
+            prev_g, r = g[i], 0
+        r += 1
+        rank[i] = r
+    out = Frame(list(frame.names), list(frame.vecs))
+    out.add(new_col, Vec.from_numpy(rank))
+    if sort_cols_sorted:
+        out = munge.sort(out, gcols + scols, True)
+    return out
+
+
+def relevel(vec: Vec, level: str) -> Vec:
+    """AstReLevel: make ``level`` the first (baseline) domain entry."""
+    if not vec.is_categorical or level not in (vec.domain or ()):
+        raise ValueError(f"level {level!r} not in domain")
+    dom = [level] + [d for d in vec.domain if d != level]
+    lut = np.array([dom.index(d) for d in vec.domain], np.int32)
+    codes = vec.to_numpy()
+    new = np.where(codes >= 0, lut[np.clip(codes, 0, None)], -1)
+    return Vec.from_numpy(new.astype(np.int32), type=VecType.CAT,
+                          domain=tuple(dom))
+
+
+def relevel_by_freq(vec: Vec, weights: Vec | None = None,
+                    top_n: int = -1) -> Vec:
+    """AstRelevelByFreq: reorder domain by descending frequency."""
+    codes = vec.to_numpy()
+    w = weights.to_numpy() if weights is not None else np.ones(len(codes))
+    cnt = np.zeros(len(vec.domain))
+    for c, wt in zip(codes, w):
+        if c >= 0:
+            cnt[int(c)] += wt
+    order = np.argsort(-cnt, kind="stable")
+    if top_n > 0:   # only promote the top_n most frequent
+        rest = np.sort(order[top_n:])
+        order = np.concatenate([order[:top_n], rest])
+    dom = [vec.domain[i] for i in order]
+    lut = np.array([dom.index(d) for d in vec.domain], np.int32)
+    new = np.where(codes >= 0, lut[np.clip(codes, 0, None)], -1)
+    return Vec.from_numpy(new.astype(np.int32), type=VecType.CAT,
+                          domain=tuple(dom))
+
+
+def rename(frame: Frame, old, new: str) -> Frame:
+    """AstRename (colnames<- single)."""
+    i = frame._index(old if not isinstance(old, float) else int(old))
+    names = list(frame.names)
+    names[i] = new
+    return Frame(names, list(frame.vecs), key=frame.key)
+
+
+def set_domain(vec: Vec, domain) -> Vec:
+    """AstSetDomain: replace the level names (codes unchanged)."""
+    if not vec.is_categorical:
+        raise ValueError("setDomain requires a categorical column")
+    if len(domain) != len(vec.domain or ()):
+        raise ValueError(f"new domain has {len(domain)} levels, column has "
+                         f"{len(vec.domain or ())}")
+    return Vec(vec.data, VecType.CAT, vec.nrows, domain=tuple(domain))
+
+
+def set_level(vec: Vec, level: str) -> Vec:
+    """AstSetLevel: constant column at the given level."""
+    if level not in (vec.domain or ()):
+        raise ValueError(f"level {level!r} not in domain")
+    code = vec.domain.index(level)
+    return Vec.from_numpy(np.full(vec.nrows, code, np.int32),
+                          type=VecType.CAT, domain=vec.domain)
+
+
+def apply_margin(frame: Frame, margin: int, fn: str) -> Frame:
+    """AstApply (named-reducer subset): margin 1 = per row, 2 = per column."""
+    from h2o3_tpu.rapids import ops
+    X = frame.matrix()[: frame.nrows]
+    axis = 1 if int(margin) == 1 else 0
+    fns = {"sum": jnp.nansum, "mean": jnp.nanmean, "min": jnp.nanmin,
+           "max": jnp.nanmax, "median": lambda a, axis: jnp.nanmedian(a, axis),
+           "sd": lambda a, axis: jnp.sqrt(jnp.nanvar(a, axis, ddof=1)),
+           "var": lambda a, axis: jnp.nanvar(a, axis, ddof=1),
+           "abs": None, "sqrt": None}
+    if fn in ("abs", "sqrt"):   # elementwise: margin irrelevant
+        Y = np.asarray(jax.device_get(getattr(jnp, fn)(X)))
+        return Frame(list(frame.names),
+                     [Vec.from_numpy(Y[:, j]) for j in range(Y.shape[1])])
+    if fn not in fns:
+        raise ValueError(f"apply supports {sorted(fns)}, got {fn!r}")
+    r = np.asarray(jax.device_get(fns[fn](X, axis=axis))).ravel()
+    if axis == 1:
+        return Frame([fn], [Vec.from_numpy(r.astype(np.float32))])
+    return Frame(list(frame.names),
+                 [Vec.from_numpy(np.float32([v])) for v in r])
+
+
+# -- reducers ---------------------------------------------------------------
+
+def mad(vec: Vec, constant: float = 1.4826) -> float:
+    """AstMad: median absolute deviation, scaled."""
+    a, ok = _valid_np(vec)
+    a = a[ok]
+    med = np.median(a)
+    return float(constant * np.median(np.abs(a - med)))
+
+
+def _na_poison(vec: Vec, base: float) -> float:
+    return float("nan") if int(vec.rollups().na_cnt) > 0 else base
+
+
+def max_na(vec: Vec) -> float:
+    """AstMaxNa: NA if any NA present (AstNaRollupOp semantics)."""
+    from h2o3_tpu.rapids import ops
+    return _na_poison(vec, ops.vmax(vec))
+
+
+def min_na(vec: Vec) -> float:
+    from h2o3_tpu.rapids import ops
+    return _na_poison(vec, ops.vmin(vec))
+
+
+def sum_na(vec: Vec) -> float:
+    from h2o3_tpu.rapids import ops
+    return _na_poison(vec, ops.vsum(vec))
+
+
+def prod_na(vec: Vec) -> float:
+    from h2o3_tpu.rapids import ops
+    return _na_poison(vec, ops.vprod(vec))
+
+
+def na_cnt(vec: Vec) -> float:
+    """AstNaCnt."""
+    return float(vec.rollups().na_cnt)
+
+
+def any_na(frame: Frame) -> bool:
+    """AstAnyNa."""
+    return any(int(v.rollups().na_cnt) > 0 for v in frame.vecs)
+
+
+def sum_axis(frame: Frame, na_rm: bool = True, axis: int = 0) -> Frame:
+    """AstSumAxis: per-column (axis 0) or per-row (axis 1) sums."""
+    X = frame.matrix()[: frame.nrows]
+    red = jnp.nansum if na_rm else jnp.sum
+    if int(axis) == 1:
+        r = np.asarray(jax.device_get(red(X, axis=1)))
+        return Frame(["sum"], [Vec.from_numpy(r.astype(np.float32))])
+    r = np.asarray(jax.device_get(red(X, axis=0))).ravel()
+    return Frame(list(frame.names),
+                 [Vec.from_numpy(np.float32([v])) for v in r])
+
+
+def topn(frame: Frame, col, n_percent: float, grab: str = "top") -> Frame:
+    """AstTopN: rows (original index, value) of the top/bottom n% values."""
+    col = frame.names[int(col)] if isinstance(col, (int, float)) else col
+    a = frame.vec(col).to_numpy().astype(np.float64)
+    ok = ~np.isnan(a)
+    idx = np.nonzero(ok)[0]
+    k = max(1, int(round(len(idx) * n_percent / 100.0)))
+    order = np.argsort(a[idx])
+    pick = idx[order[-k:][::-1]] if grab == "top" else idx[order[:k]]
+    return Frame(["index", col],
+                 [Vec.from_numpy(pick.astype(np.float32)),
+                  Vec.from_numpy(a[pick].astype(np.float32))])
+
+
+# -- repeaters --------------------------------------------------------------
+
+def seq(frm: float, to: float, by: float = 1.0) -> Vec:
+    """AstSeq."""
+    return Vec.from_numpy(np.arange(frm, to + by * 0.5 * np.sign(by), by)
+                          .astype(np.float32))
+
+
+def seq_len(n: float) -> Vec:
+    """AstSeqLen: 1..n."""
+    return Vec.from_numpy(np.arange(1, int(n) + 1).astype(np.float32))
+
+
+def rep_len(x, length: float) -> Vec:
+    """AstRepLen: recycle x (vec or scalar) to the given length."""
+    n = int(length)
+    if isinstance(x, Vec):
+        a = x.to_numpy()
+        reps = int(np.ceil(n / max(len(a), 1)))
+        out = np.tile(a, reps)[:n]
+        if x.is_categorical:
+            return Vec.from_numpy(out.astype(np.int32), type=VecType.CAT,
+                                  domain=x.domain)
+        return Vec.from_numpy(out.astype(np.float32))
+    return Vec.from_numpy(np.full(n, float(x), np.float32))
+
+
+# -- search -----------------------------------------------------------------
+
+def match(vec: Vec, table, nomatch: float = np.nan, start_index: float = 1
+          ) -> Vec:
+    """AstMatch: position of each value in ``table`` (1-based)."""
+    table = list(table) if isinstance(table, (list, tuple)) else [table]
+    if vec.is_categorical:
+        vals = vec.labels()
+        lut = {str(t): i + start_index for i, t in enumerate(table)}
+        out = np.array([lut.get(v, nomatch) if v is not None else nomatch
+                        for v in vals], np.float64)
+    else:
+        a = vec.to_numpy().astype(np.float64)
+        lut = {float(t): i + start_index for i, t in enumerate(table)}
+        out = np.array([lut.get(float(v), nomatch) if not np.isnan(v)
+                        else nomatch for v in a], np.float64)
+    return Vec.from_numpy(out.astype(np.float32))
+
+
+def which(vec: Vec) -> Vec:
+    """AstWhich: 0-based row numbers where the value is truthy."""
+    a = vec.to_numpy().astype(np.float64)
+    idx = np.nonzero(~np.isnan(a) & (a != 0))[0]
+    return Vec.from_numpy(idx.astype(np.float32))
+
+
+def which_max(frame: Frame, na_rm: bool = True, axis: int = 0) -> Frame:
+    return _which_extreme(frame, np.nanargmax, axis)
+
+
+def which_min(frame: Frame, na_rm: bool = True, axis: int = 0) -> Frame:
+    return _which_extreme(frame, np.nanargmin, axis)
+
+
+def _which_extreme(frame: Frame, red, axis: int) -> Frame:
+    X = np.stack([frame.vec(c).to_numpy().astype(np.float64)
+                  for c in frame.names], 1)
+    if int(axis) == 1:
+        r = red(X, axis=1).astype(np.float32)
+        return Frame(["which"], [Vec.from_numpy(r)])
+    r = red(X, axis=0).astype(np.float32).ravel()
+    return Frame(list(frame.names),
+                 [Vec.from_numpy(np.float32([v])) for v in r])
+
+
+# -- string extras ----------------------------------------------------------
+
+def count_matches(vec: Vec, pattern) -> Vec:
+    """AstCountMatches: occurrences of pattern(s) per string."""
+    pats = list(pattern) if isinstance(pattern, (list, tuple)) else [pattern]
+    vals = vec.labels() if vec.is_categorical else vec.host_values
+    out = np.array([sum(str(v).count(p) for p in pats) if v is not None
+                    else np.nan for v in vals[: vec.nrows]], np.float64)
+    return Vec.from_numpy(out.astype(np.float32))
+
+
+def str_distance(vec: Vec, other: Vec, measure: str = "lv",
+                 compare_empty: bool = True) -> Vec:
+    """AstStrDistance: per-row Levenshtein (lv) / Jaccard (jaccard)."""
+    a = vec.labels() if vec.is_categorical else vec.host_values
+    b = other.labels() if other.is_categorical else other.host_values
+
+    def lev(s, t):
+        if s is None or t is None:
+            return np.nan
+        if not compare_empty and (s == "" or t == ""):
+            return np.nan
+        prev = list(range(len(t) + 1))
+        for i, cs in enumerate(s, 1):
+            cur = [i]
+            for j, ct in enumerate(t, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (cs != ct)))
+            prev = cur
+        return prev[-1]
+
+    def jac(s, t):
+        if s is None or t is None:
+            return np.nan
+        A, B = set(s), set(t)
+        return 1.0 - len(A & B) / max(len(A | B), 1)
+
+    fn = jac if measure == "jaccard" else lev
+    out = np.array([fn(x, y) for x, y in
+                    zip(a[: vec.nrows], b[: other.nrows])], np.float64)
+    return Vec.from_numpy(out.astype(np.float32))
+
+
+def tokenize(frame: Frame, split: str) -> Frame:
+    """AstTokenize: one token per row, NA row between documents (the
+    Word2Vec ingest format)."""
+    import re as _re
+    toks: list = []
+    for v in frame.vecs:
+        vals = v.labels() if v.is_categorical else v.host_values
+        for s in vals[: v.nrows]:
+            if s is None:
+                toks.append(None)
+                continue
+            toks.extend(t for t in _re.split(split, str(s)) if t)
+            toks.append(None)
+    return Frame(["token"], [Vec.from_numpy(np.array(toks, dtype=object),
+                                            type=VecType.STR)])
+
+
+# -- timeseries -------------------------------------------------------------
+
+def difflag1(vec: Vec) -> Vec:
+    """AstDiffLag1: x[i] - x[i-1] (first row NA)."""
+    a = vec.to_numpy().astype(np.float64)
+    out = np.empty_like(a)
+    out[0] = np.nan
+    out[1:] = a[1:] - a[:-1]
+    return Vec.from_numpy(out.astype(np.float32))
+
+
+def isax(frame: Frame, num_words: int, max_cardinality: int,
+         optimize_card: bool = False) -> Frame:
+    """AstIsax: per-row iSAX word — PAA over ``num_words`` segments, each
+    quantized into ``max_cardinality`` gaussian breakpoints."""
+    from scipy.stats import norm
+    X = np.stack([frame.vec(c).to_numpy().astype(np.float64)
+                  for c in frame.names], 1)
+    mu = np.nanmean(X, axis=1, keepdims=True)
+    sd = np.nanstd(X, axis=1, keepdims=True)
+    Z = (X - mu) / np.maximum(sd, 1e-12)
+    segs = np.array_split(np.arange(X.shape[1]), num_words)
+    paa = np.stack([Z[:, s].mean(axis=1) for s in segs], 1)
+    breaks = norm.ppf(np.linspace(0, 1, max_cardinality + 1)[1:-1])
+    codes = np.stack([np.searchsorted(breaks, paa[:, j])
+                      for j in range(num_words)], 1)
+    words = np.array(["^".join(str(c) for c in row) for row in codes],
+                     dtype=object)
+    out = Frame(["iSax_index"], [Vec.from_numpy(words, type=VecType.STR)])
+    for j in range(num_words):
+        out.add(f"c{j}", Vec.from_numpy(codes[:, j].astype(np.float32)))
+    return out
+
+
+# -- models -----------------------------------------------------------------
+
+def perfect_auc(probs: Vec, acts: Vec) -> float:
+    """AstPerfectAUC: exact (not binned) AUC from raw probabilities."""
+    p = probs.to_numpy().astype(np.float64)
+    y = acts.to_numpy().astype(np.float64)
+    ok = ~np.isnan(p) & ~np.isnan(y)
+    p, y = p[ok], y[ok]
+    order = np.argsort(p, kind="mergesort")
+    p, y = p[order], y[order]
+    # average ranks over ties for the Mann-Whitney statistic
+    ranks = np.empty(len(p))
+    i = 0
+    while i < len(p):
+        j = i
+        while j + 1 < len(p) and p[j + 1] == p[i]:
+            j += 1
+        ranks[i:j + 1] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    npos = y.sum()
+    nneg = len(y) - npos
+    if npos == 0 or nneg == 0:
+        return 1.0
+    return float((ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg))

@@ -61,9 +61,21 @@ def _parse(tokens: list[str]) -> Any:
         while tokens[0] != "]":
             lst.append(_parse(tokens))
         tokens.pop(0)
-        return np.array(lst, dtype=np.float64)
+        # numeric literals stay an ndarray (row/col index lists); string
+        # lists (domains, match tables, pattern lists) stay Python lists
+        if all(isinstance(x, float) for x in lst):
+            return np.array(lst, dtype=np.float64)
+        return [x[1] if isinstance(x, tuple) else x for x in lst]
     if tok[0] in "\"'":
         return ("str", tok[1:-1])
+    if tok in ("TRUE", "True", "true"):
+        return 1.0
+    if tok in ("FALSE", "False", "false"):
+        return 0.0
+    if tok in ("NA", "NaN", "nan"):
+        return float("nan")
+    if tok.startswith("#"):      # reference numeric literal syntax
+        tok = tok[1:]
     try:
         return float(tok)
     except ValueError:
@@ -327,7 +339,251 @@ def _eval(node, s: Session):
     if op == "levels":
         v = _as_vec(args[0])
         return list(v.domain or [])
+
+    # -- prim closure (reference: remaining ast/prims families; exact op
+    #    names from each Ast*.str()) — h2o3_tpu/rapids/advprims.py ---------
+    from h2o3_tpu.rapids import advprims as ap
+
+    def _vec1(i=0) -> Vec:
+        return _as_vec(args[i])
+
+    def _wrap(v):
+        if isinstance(v, Vec):
+            return Frame(["C1"], [v])
+        return v
+
+    if op == "cor":
+        fr2 = args[1] if len(args) > 1 and isinstance(args[1], Frame) else None
+        use = args[2] if len(args) > 2 else "complete.obs"
+        method = args[3] if len(args) > 3 else "Pearson"
+        return ap.cor(args[0], fr2, str(use), str(method))
+    if op == "spearman":
+        return ap.cor(args[0], None, "complete.obs", "Spearman")
+    if op == "distance":
+        return ap.distance(args[0], args[1],
+                           str(args[2]) if len(args) > 2 else "l2")
+    if op == "kfold_column":
+        return _wrap(ap.kfold_column(args[0], int(args[1]),
+                                     int(args[2]) if len(args) > 2 else -1))
+    if op == "modulo_kfold_column":
+        return _wrap(ap.modulo_kfold_column(args[0], int(args[1])))
+    if op == "stratified_kfold_column":
+        return _wrap(ap.stratified_kfold_column(
+            _vec1(), int(args[1]), int(args[2]) if len(args) > 2 else -1))
+    if op in ("h2o.random_stratified_split", "stratified_split"):
+        return _wrap(ap.stratified_split(
+            _vec1(), float(args[1]) if len(args) > 1 else 0.2,
+            int(args[2]) if len(args) > 2 else -1))
+    if op == "skewness":
+        return ap.skewness(_vec1(), bool(args[1]) if len(args) > 1 else True)
+    if op == "kurtosis":
+        return ap.kurtosis(_vec1(), bool(args[1]) if len(args) > 1 else True)
+    if op == "mode":
+        return ap.mode(_vec1())
+    if op == "dropdup":
+        keep = str(args[2]) if len(args) > 2 else "first"
+        by = args[1] if len(args) > 1 else None
+        if isinstance(by, (str, float, int)):
+            by = [by]
+        return ap.drop_duplicates(args[0], by, keep)
+    if op == "x":
+        return ap.mmult(args[0], args[1])
+    if op == "t":
+        return ap.transpose(args[0])
+    if op == "ddply":
+        return ap.ddply(args[0], args[1], args[2], str(args[3]))
+    if op == "h2o.fillna":
+        return ap.fillna(args[0], str(args[1]) if len(args) > 1 else "forward",
+                         int(args[2]) if len(args) > 2 else 0,
+                         int(args[3]) if len(args) > 3 else 1)
+    if op == "filterNACols":
+        return ap.filter_na_cols(args[0],
+                                 float(args[1]) if len(args) > 1 else 0.2)
+    if op == "na.omit":
+        return ap.na_omit(args[0])
+    if op == "nlevels":
+        return ap.nlevels(_vec1())
+    if op == "rank_within_groupby":
+        asc = args[3] if len(args) > 3 else None
+        if asc is not None and not isinstance(asc, (list, tuple, np.ndarray)):
+            asc = [asc]
+        return ap.rank_within_group_by(
+            args[0], _aslist(args[1]), _aslist(args[2]),
+            [bool(a) for a in asc] if asc is not None else None,
+            str(args[4]) if len(args) > 4 else "rank",
+            bool(args[5]) if len(args) > 5 else False)
+    if op == "relevel":
+        return _wrap(ap.relevel(_vec1(), str(args[1])))
+    if op == "relevel.by.freq":
+        return _wrap(ap.relevel_by_freq(
+            _vec1(), None, int(args[1]) if len(args) > 1 else -1))
+    if op == "rename":
+        return ap.rename(args[0], args[1], str(args[2]))
+    if op == "setDomain":
+        return _wrap(ap.set_domain(_vec1(), [str(s) for s in args[1]]))
+    if op == "setLevel":
+        return _wrap(ap.set_level(_vec1(), str(args[1])))
+    if op == "appendLevels":
+        return _wrap(ap.append_levels(_vec1(), [str(s) for s in args[1]]))
+    if op == "any.factor":
+        return float(ap.any_factor(args[0]))
+    if op == "columnsByType":
+        return ap.columns_by_type(args[0], str(args[1]))
+    if op == "apply":
+        return ap.apply_margin(args[0], int(args[1]), str(args[2]))
+    if op == "flatten":
+        return ap.flatten(args[0])
+    if op == "getrow":
+        return ap.getrow(args[0])
+    if op == "h2o.mad":
+        return ap.mad(_vec1(), float(args[1]) if len(args) > 1 else 1.4826)
+    if op == "maxNA":
+        return ap.max_na(_vec1())
+    if op == "minNA":
+        return ap.min_na(_vec1())
+    if op == "sumNA":
+        return ap.sum_na(_vec1())
+    if op == "prod.na":
+        return ap.prod_na(_vec1())
+    if op == "naCnt":
+        return ap.na_cnt(_vec1())
+    if op == "any.na":
+        return float(ap.any_na(args[0]))
+    if op == "sumaxis":
+        return ap.sum_axis(args[0], bool(args[1]) if len(args) > 1 else True,
+                           int(args[2]) if len(args) > 2 else 0)
+    if op == "topn":
+        return ap.topn(args[0], args[1], float(args[2]),
+                       "bottom" if len(args) > 3 and args[3] else "top")
+    if op == "seq":
+        return _wrap(ap.seq(float(args[0]), float(args[1]),
+                            float(args[2]) if len(args) > 2 else 1.0))
+    if op == "seq_len":
+        return _wrap(ap.seq_len(float(args[0])))
+    if op == "rep_len":
+        x = _as_vec(args[0]) if isinstance(args[0], Frame) else args[0]
+        return _wrap(ap.rep_len(x, float(args[1])))
+    if op == "match":
+        table = args[1]
+        if isinstance(table, np.ndarray):
+            table = [float(t) for t in table]
+        elif not isinstance(table, (list, tuple)):
+            table = [table]
+        nomatch = float(args[2]) if len(args) > 2 else np.nan
+        start = float(args[3]) if len(args) > 3 else 1
+        return _wrap(ap.match(_vec1(), table, nomatch, start))
+    if op == "which":
+        return _wrap(ap.which(_vec1()))
+    if op == "which.max":
+        return ap.which_max(args[0], axis=int(args[2]) if len(args) > 2 else 0)
+    if op == "which.min":
+        return ap.which_min(args[0], axis=int(args[2]) if len(args) > 2 else 0)
+    if op == "countmatches":
+        pat = args[1] if isinstance(args[1], (list, tuple)) else [str(args[1])]
+        return _colwise(args[0], lambda v: ap.count_matches(v, pat))
+    if op == "strDistance":
+        return _wrap(ap.str_distance(
+            _vec1(0), _as_vec(args[1]), str(args[2]) if len(args) > 2 else "lv",
+            bool(args[3]) if len(args) > 3 else True))
+    if op == "tokenize":
+        return ap.tokenize(args[0], str(args[1]))
+    if op == "difflag1":
+        return _wrap(ap.difflag1(_vec1()))
+    if op == "isax":
+        return ap.isax(args[0], int(args[1]), int(args[2]),
+                       bool(args[3]) if len(args) > 3 else False)
+    if op == "perfectAUC":
+        return ap.perfect_auc(_vec1(0), _as_vec(args[1]))
+    if op in ("replaceall", "replacefirst"):       # AstReplaceAll/First
+        from h2o3_tpu.rapids import strings as st
+        fn = st.gsub if op == "replaceall" else st.sub
+        ic = bool(args[3]) if len(args) > 3 else False
+        return _colwise(args[0],
+                        lambda v: fn(v, str(args[1]), str(args[2]), ic))
+    if op == "num_valid_substrings":               # AstCountSubstringsWords
+        from h2o3_tpu.rapids import strings as st
+        words = [str(wd) for wd in (args[1] if isinstance(args[1], list)
+                                    else [args[1]])]
+        return _colwise(args[0], lambda v: st.num_valid_substrings(v, words))
+    if op == "append":                             # AstAppend: add a column
+        fr, col, name = args[0], args[1], str(args[2])
+        return Frame(list(fr.names), list(fr.vecs),
+                     key=fr.key).add(name, _as_vec(col))
+    if op == "cols_py":                            # AstColPySlice
+        fr, sel = args[0], args[1]
+        names = [sel] if isinstance(sel, str) else \
+            [fr.names[int(i)] for i in np.atleast_1d(sel)]
+        return fr[names]
+    if op == "moment":                             # AstMoment → epoch ms
+        from h2o3_tpu.rapids import timeops as tt
+        return _colwise_or_scalar_moment(args)
+    if op == "getTimeZone":
+        return "UTC"      # device times are canonical UTC epoch ms
+    if op == "listTimeZones":
+        import zoneinfo
+        return sorted(zoneinfo.available_timezones())
+    if op == "setTimeZone":
+        raise ValueError("time zone is fixed to UTC in this runtime "
+                         "(reference ParseTime zone applies at parse)")
+    if op in ("mod", "%%", "intDiv", "%/%"):     # ("%" routes via _BINOPS)
+        import jax.numpy as jnp
+        fn = jnp.mod if op in ("mod", "%%") else jnp.floor_divide
+
+        def asf(x):
+            return _as_vec(x).as_float() if isinstance(x, Frame) else (
+                x.as_float() if isinstance(x, Vec) else float(x))
+        a, b = args[0], args[1]
+        if isinstance(a, (Frame, Vec)):
+            bb = asf(b)
+            return _colwise(a, lambda v: _vec_binop(v, bb, fn))
+        if isinstance(b, (Frame, Vec)):          # scalar on the left
+            aa = float(a)
+            return _colwise(b, lambda v: _vec_binop(v, aa,
+                                                    lambda x, y: fn(y, x)))
+        return float(fn(float(a), float(b)))
     raise ValueError(f"unknown rapids op {op!r}")
+
+
+def _colwise_or_scalar_moment(args):
+    """AstMoment: (moment yr mo dy hr mi se ms) of scalars and/or columns
+    → single TIME column."""
+    from h2o3_tpu.rapids import timeops as tt
+    vals = list(args[:7]) + [0.0] * (7 - len(args))
+    n = max((a.nrows for a in vals if isinstance(a, (Frame, Vec))), default=1)
+
+    def as_v(x, default):
+        if isinstance(x, Frame):
+            x = _as_vec(x)
+        if isinstance(x, Vec):
+            return x
+        return Vec.from_numpy(np.full(n, float(default if x is None else x),
+                                      np.float32))
+    y, mo, d, h, mi, s, ms = (as_v(vals[0], 1970), as_v(vals[1], 1),
+                              as_v(vals[2], 1), as_v(vals[3], 0),
+                              as_v(vals[4], 0), as_v(vals[5], 0),
+                              as_v(vals[6], 0))
+    from h2o3_tpu.frame.types import VecType
+    t = tt.mktime(y, mo, d, h, mi, s)
+    msec = ms.to_numpy().astype(np.float64)
+    vals_ms = t.host_values[: t.nrows] + msec[: t.nrows]
+    out = np.full(t.nrows, np.datetime64("NaT"), "datetime64[ms]")
+    ok = ~np.isnan(vals_ms)
+    out[ok] = vals_ms[ok].astype(np.int64).astype("datetime64[ms]")
+    return Frame(["time"], [Vec.from_numpy(out, type=VecType.TIME)])
+
+
+def _aslist(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    if isinstance(x, np.ndarray):
+        return [float(v) for v in x]
+    return [x]
+
+
+def _vec_binop(v: Vec, b, fn) -> Vec:
+    from h2o3_tpu.frame.types import VecType
+    return Vec.from_device(fn(v.as_float(), b).astype("float32"), v.nrows,
+                           VecType.NUM)
 
 
 #: ops handled by the dispatch if-chain above (kept in sync by
@@ -340,6 +596,19 @@ _CHAIN_OPS = (
     "impute", "scale", "round", "signif", "table", "GB", "groupby", "pivot",
     "melt", "as.factor", "as.character", "as.numeric", "is.na", "is.factor",
     "is.numeric", "colnames", "levels",
+    # prim closure (rapids/advprims.py)
+    "cor", "spearman", "distance", "kfold_column", "modulo_kfold_column",
+    "stratified_kfold_column", "h2o.random_stratified_split", "skewness",
+    "kurtosis", "mode", "dropdup", "x", "t", "ddply", "h2o.fillna",
+    "filterNACols", "na.omit", "nlevels", "rank_within_groupby", "relevel",
+    "relevel.by.freq", "rename", "setDomain", "setLevel", "appendLevels",
+    "any.factor", "columnsByType", "apply", "flatten", "getrow", "h2o.mad",
+    "maxNA", "minNA", "sumNA", "prod.na", "naCnt", "any.na", "sumaxis",
+    "topn", "seq", "seq_len", "rep_len", "match", "which", "which.max",
+    "which.min", "countmatches", "strDistance", "tokenize", "difflag1",
+    "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%",
+    "replaceall", "replacefirst", "num_valid_substrings", "append",
+    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone",
 )
 
 

@@ -32,8 +32,13 @@ _UNARY = {
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
     "lgamma": jax.scipy.special.gammaln,
     "digamma": jax.scipy.special.digamma,
+    "trigamma": lambda x: jax.scipy.special.polygamma(1, x),
+    "cospi": lambda x: jnp.cos(jnp.pi * x),
+    "sinpi": lambda x: jnp.sin(jnp.pi * x),
+    "tanpi": lambda x: jnp.tan(jnp.pi * x),
     "logistic": jax.nn.sigmoid,
     "not": lambda x: (x == 0).astype(jnp.float32),
+    "none": lambda x: x,                       # AstNoOp
 }
 
 

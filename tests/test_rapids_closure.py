@@ -1,0 +1,288 @@
+"""Golden tests for the rapids prim closure (reference: ast/prims families;
+each prim checked against numpy/pandas/scipy)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.rapids import advprims as ap
+from h2o3_tpu.rapids.exec import Session, rapids
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture
+def fr(rng):
+    n = 200
+    f = Frame.from_arrays({
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": (2 * rng.normal(size=n) + 1).astype(np.float32),
+        "c": rng.choice(["u", "v", "w"], size=n),
+    }, key="clos")
+    DKV.put("clos", f)
+    return f
+
+
+def test_cor_pearson_spearman(fr):
+    out = ap.cor(fr)
+    a = fr.vec("a").to_numpy()
+    b = fr.vec("b").to_numpy()
+    want = np.corrcoef(np.stack([a, b]))[0, 1]
+    got = out.vec("b").to_numpy()[0]
+    assert got == pytest.approx(want, abs=1e-5)
+
+    from scipy.stats import spearmanr
+    s = ap.cor(fr, method="Spearman").vec("b").to_numpy()[0]
+    assert s == pytest.approx(spearmanr(a, b).statistic, abs=1e-5)
+
+
+def test_distance_measures(rng):
+    X = Frame.from_arrays({"x": np.float32([0, 3]), "y": np.float32([0, 4])})
+    Y = Frame.from_arrays({"x": np.float32([0, 1]), "y": np.float32([0, 0])})
+    d = ap.distance(X, Y, "l2")
+    np.testing.assert_allclose(d.vec(0).to_numpy(), [0, 5], atol=1e-5)
+    np.testing.assert_allclose(d.vec(1).to_numpy(), [1, np.sqrt(4 + 16)],
+                               atol=1e-4)
+    d1 = ap.distance(X, Y, "l1")
+    np.testing.assert_allclose(d1.vec(0).to_numpy(), [0, 7], atol=1e-5)
+
+
+def test_moments_vs_scipy(rng):
+    from scipy.stats import kurtosis as sk_kurt, skew as sk_skew
+    a = rng.gamma(2.0, size=500).astype(np.float32)
+    v = Vec.from_numpy(a)
+    assert ap.kurtosis(v) == pytest.approx(
+        sk_kurt(a, fisher=False, bias=True), rel=1e-4)
+    assert ap.skewness(v) == pytest.approx(
+        sk_skew(a, bias=False), rel=1e-3)
+
+
+def test_kfold_columns(fr):
+    k = ap.kfold_column(fr, 5, seed=1).to_numpy()
+    assert set(np.unique(k)) <= set(range(5))
+    mk = ap.modulo_kfold_column(fr, 4).to_numpy()
+    np.testing.assert_array_equal(mk, np.arange(fr.nrows) % 4)
+    sk = ap.stratified_kfold_column(fr.vec("c"), 3, seed=2).to_numpy()
+    codes = fr.vec("c").to_numpy()
+    for cls in range(3):
+        per = np.bincount(sk[codes == cls].astype(int), minlength=3)
+        assert per.max() - per.min() <= 1     # balanced within class
+
+
+def test_stratified_split(fr):
+    sp = ap.stratified_split(fr.vec("c"), 0.25, seed=3)
+    assert sp.domain == ("train", "test")
+    codes = fr.vec("c").to_numpy()
+    s = sp.to_numpy()
+    for cls in range(3):
+        frac = (s[codes == cls] == 1).mean()
+        assert 0.15 < frac < 0.35
+
+
+def test_mode_and_nlevels(fr):
+    codes = fr.vec("c").to_numpy()
+    want = np.bincount(codes).argmax()
+    assert ap.mode(fr.vec("c")) == float(want)
+    assert ap.nlevels(fr.vec("c")) == 3.0
+
+
+def test_drop_duplicates():
+    f = Frame.from_arrays({
+        "k": np.float32([1, 2, 1, 3, 2]),
+        "v": np.float32([10, 20, 30, 40, 50])})
+    out = ap.drop_duplicates(f, by=["k"])
+    assert out.nrows == 3
+    np.testing.assert_array_equal(np.sort(out.vec("v").to_numpy()),
+                                  [10, 20, 40])
+    last = ap.drop_duplicates(f, by=["k"], keep="last")
+    np.testing.assert_array_equal(np.sort(last.vec("v").to_numpy()),
+                                  [30, 40, 50])
+
+
+def test_matrix_ops(rng):
+    A = rng.normal(size=(4, 3)).astype(np.float32)
+    B = rng.normal(size=(3, 2)).astype(np.float32)
+    fa = Frame.from_arrays({f"c{i}": A[:, i] for i in range(3)})
+    fb = Frame.from_arrays({f"c{i}": B[:, i] for i in range(2)})
+    got = np.stack([ap.mmult(fa, fb).vec(i).to_numpy() for i in range(2)], 1)
+    np.testing.assert_allclose(got, A @ B, rtol=1e-5)
+    t = ap.transpose(fa)
+    got_t = np.stack([t.vec(i).to_numpy() for i in range(4)], 1)
+    np.testing.assert_allclose(got_t, A.T, rtol=1e-6)
+
+
+def test_fillna_forward_limit():
+    a = np.float32([1, np.nan, np.nan, np.nan, 5, np.nan])
+    f = Frame.from_arrays({"a": a})
+    out = ap.fillna(f, "forward", maxlen=2).vec("a").to_numpy()
+    np.testing.assert_array_equal(np.isnan(out),
+                                  [False, False, False, True, False, False])
+    assert out[1] == 1 and out[2] == 1 and out[5] == 5
+    back = ap.fillna(f, "backward", maxlen=1).vec("a").to_numpy()
+    assert back[3] == 5 and np.isnan(back[2]) and np.isnan(back[5])
+
+
+def test_na_omit_filter_na_cols():
+    f = Frame.from_arrays({
+        "a": np.float32([1, np.nan, 3, 4]),
+        "b": np.float32([1, 2, 3, 4])})
+    assert ap.na_omit(f).nrows == 3
+    assert ap.filter_na_cols(f, 0.2) == [1.0]
+    assert ap.filter_na_cols(f, 0.5) == [0.0, 1.0]
+
+
+def test_rank_within_group_by():
+    f = Frame.from_arrays({
+        "g": np.float32([0, 0, 0, 1, 1]),
+        "v": np.float32([3, 1, 2, 9, 5])})
+    out = ap.rank_within_group_by(f, ["g"], ["v"])
+    np.testing.assert_array_equal(out.vec("rank").to_numpy(),
+                                  [3, 1, 2, 2, 1])
+
+
+def test_relevel_and_domains(fr):
+    v = fr.vec("c")
+    r = ap.relevel(v, "w")
+    assert r.domain[0] == "w"
+    np.testing.assert_array_equal(r.labels(), v.labels())  # values unchanged
+    rf = ap.relevel_by_freq(v)
+    counts = np.bincount(rf.to_numpy(), minlength=3)
+    assert (np.diff(counts) <= 0).all()     # domain ordered by freq desc
+    sd = ap.set_domain(v, ["x1", "x2", "x3"])
+    assert sd.domain == ("x1", "x2", "x3")
+    sl = ap.set_level(v, "v")
+    assert set(np.unique(sl.to_numpy())) == {1}
+    al = ap.append_levels(v, ["z"])
+    assert al.domain == ("u", "v", "w", "z")
+
+
+def test_reducer_na_variants():
+    v = Vec.from_numpy(np.float32([1, 2, np.nan]))
+    ok = Vec.from_numpy(np.float32([1, 2, 3]))
+    assert np.isnan(ap.max_na(v)) and ap.max_na(ok) == 3.0
+    assert np.isnan(ap.sum_na(v)) and ap.sum_na(ok) == 6.0
+    assert ap.na_cnt(v) == 1.0
+    f = Frame.from_arrays({"a": np.float32([1, np.nan])})
+    assert ap.any_na(f) is True
+    a = np.float32([1, 2, 3, 4, 100])
+    assert ap.mad(Vec.from_numpy(a)) == pytest.approx(
+        1.4826 * np.median(np.abs(a - np.median(a))))
+
+
+def test_topn_and_sumaxis(rng):
+    a = np.arange(100, dtype=np.float32)
+    f = Frame.from_arrays({"a": a, "b": a * 2})
+    top = ap.topn(f, "a", 10.0, "top")
+    np.testing.assert_array_equal(np.sort(top.vec("a").to_numpy()),
+                                  np.arange(90, 100))
+    rowsum = ap.sum_axis(f, axis=1).vec("sum").to_numpy()
+    np.testing.assert_allclose(rowsum, a * 3, rtol=1e-6)
+
+
+def test_repeaters():
+    np.testing.assert_allclose(ap.seq(1, 7, 2).to_numpy(), [1, 3, 5, 7])
+    np.testing.assert_allclose(ap.seq_len(4).to_numpy(), [1, 2, 3, 4])
+    v = Vec.from_numpy(np.float32([1, 2]))
+    np.testing.assert_allclose(ap.rep_len(v, 5).to_numpy(), [1, 2, 1, 2, 1])
+
+
+def test_search_prims(fr):
+    m = ap.match(fr.vec("c"), ["v", "w"]).to_numpy()
+    lab = fr.vec("c").labels()
+    want = np.array([{"v": 1, "w": 2}.get(s, np.nan) for s in lab])
+    np.testing.assert_array_equal(np.isnan(m), np.isnan(want))
+    np.testing.assert_array_equal(m[~np.isnan(m)], want[~np.isnan(want)])
+
+    v = Vec.from_numpy(np.float32([0, 1, 0, 2]))
+    np.testing.assert_array_equal(ap.which(v).to_numpy(), [1, 3])
+
+    f = Frame.from_arrays({"a": np.float32([1, 9]), "b": np.float32([5, 2])})
+    wm = ap.which_max(f, axis=1).vec("which").to_numpy()
+    np.testing.assert_array_equal(wm, [1, 0])
+
+
+def test_string_prims():
+    v = Vec.from_numpy(np.array(["abcabc", "xyz", None], dtype=object),
+                       type=VecType.STR)
+    cm = ap.count_matches(v, "abc").to_numpy()
+    assert cm[0] == 2 and cm[1] == 0 and np.isnan(cm[2])
+
+    a = Vec.from_numpy(np.array(["kitten", "abc"], dtype=object), type=VecType.STR)
+    b = Vec.from_numpy(np.array(["sitting", "abc"], dtype=object), type=VecType.STR)
+    d = ap.str_distance(a, b, "lv").to_numpy()
+    np.testing.assert_array_equal(d, [3, 0])
+
+    docs = Frame.from_arrays({"t": np.array(["a b", "c"], dtype=object)})
+    toks = ap.tokenize(docs, r"\s")
+    got = [x for x in toks.vec("token").host_values]
+    assert got == ["a", "b", None, "c", None]
+
+
+def test_timeseries_prims(rng):
+    v = Vec.from_numpy(np.float32([1, 4, 9, 16]))
+    d = ap.difflag1(v).to_numpy()
+    assert np.isnan(d[0])
+    np.testing.assert_allclose(d[1:], [3, 5, 7])
+
+    X = rng.normal(size=(5, 32)).astype(np.float32)
+    f = Frame.from_arrays({f"t{i}": X[:, i] for i in range(32)})
+    out = ap.isax(f, num_words=4, max_cardinality=4)
+    assert out.nrows == 5 and out.names[0] == "iSax_index"
+    codes = np.stack([out.vec(f"c{j}").to_numpy() for j in range(4)], 1)
+    assert codes.min() >= 0 and codes.max() <= 3
+
+
+def test_perfect_auc():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(0)
+    p = rng.random(300).astype(np.float32)
+    y = (rng.random(300) < p).astype(np.float32)
+    got = ap.perfect_auc(Vec.from_numpy(p), Vec.from_numpy(y))
+    assert got == pytest.approx(roc_auc_score(y, p), abs=1e-6)
+
+
+def test_rapids_ast_dispatch(fr):
+    """The new prims resolve through the lisp AST surface too."""
+    s = Session()
+    assert rapids("(kurtosis (cols clos 'a') 1)", s) > 1.0
+    out = rapids("(difflag1 (cols clos 'a'))", s)
+    assert out.nrows == fr.nrows
+    assert rapids("(naCnt (cols clos 'a'))", s) == 0.0
+    sq = rapids("(seq 1 5 2)", s)
+    np.testing.assert_allclose(sq.vec(0).to_numpy(), [1, 3, 5])
+    t = rapids("(t clos)", s)
+    assert t.nrows == 3     # one transposed row per source column
+    m = rapids("(% (cols clos 'a') 2)", s)
+    assert m.nrows == fr.nrows
+
+
+def test_apply_and_math_prims(fr):
+    out = ap.apply_margin(fr[["a", "b"]], 1, "sum")
+    a = fr.vec("a").to_numpy() + fr.vec("b").to_numpy()
+    np.testing.assert_allclose(out.vec("sum").to_numpy(), a, rtol=1e-5)
+
+    from h2o3_tpu.rapids import ops
+    v = Vec.from_numpy(np.float32([0.5, 1.5]))
+    np.testing.assert_allclose(ops.math_op("cospi", v).to_numpy(),
+                               np.cos(np.pi * np.float32([0.5, 1.5])),
+                               atol=1e-6)
+    from scipy.special import polygamma
+    np.testing.assert_allclose(ops.math_op("trigamma", v).to_numpy(),
+                               polygamma(1, [0.5, 1.5]).astype(np.float32),
+                               rtol=1e-4)
+
+
+def test_alias_and_time_prims(fr):
+    s = Session()
+    out = rapids("(replaceall (cols clos 'c') 'u' 'X' False)", s)
+    assert "X" in set(x for x in out.vec(0).labels() if x)
+    ap2 = rapids("(append clos (cols clos 'a') 'a2')", s)
+    assert "a2" in ap2.names
+    assert rapids("(getTimeZone)", s) == "UTC"
+    zones = rapids("(listTimeZones)", s)
+    assert "UTC" in zones
+    mo = rapids("(moment 2020 2 29 12 0 0 0)", s)
+    import pandas as pd
+    assert pd.Timestamp(mo.to_pandas()["time"][0]) == pd.Timestamp(
+        "2020-02-29T12:00:00")
