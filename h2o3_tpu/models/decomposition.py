@@ -262,6 +262,133 @@ def _apply_reg(Z, kind: str):
     return Z
 
 
+# ---------------------------------------------------------------------------
+# GLRM generalized losses (reference: hex/genmodel/algos/glrm/GlrmLoss.java —
+# loss/lgrad per enum member are reproduced exactly) and regularizer
+# proximal operators (GlrmRegularizer.java)
+# ---------------------------------------------------------------------------
+
+_LOSS_IDS = {"quadratic": 0, "absolute": 1, "huber": 2, "poisson": 3,
+             "hinge": 4, "logistic": 5, "periodic": 6,
+             "categorical": 7, "ordinal": 8}
+
+
+@jax.jit
+def _glrm_loss_and_grad(U, T, M, lid, period, blk_start, blk_last):
+    """Elementwise loss + dL/dU for the mixed per-column losses.
+
+    U = A@Y [n,K]; T = target matrix (numeric value, 0/1 for binary and
+    one-hot blocks); M observation mask; lid [K] loss id per expanded
+    column; blk_start[j] = first column of j's categorical block (j for
+    non-cat); blk_last[j] marks the final column of an ordinal block
+    (excluded from the ordinal sum, GlrmLoss.Ordinal).
+    """
+    x = U - T
+    s = 1.0 - 2.0 * T                      # binary sign (GlrmLoss Hinge/Logistic)
+    f = 2.0 * jnp.pi / period
+
+    quad_l, quad_g = x * x, 2.0 * x
+    abs_l, abs_g = jnp.abs(x), jnp.sign(x)
+    hub_l = jnp.where(x > 1, x - 0.5, jnp.where(x < -1, -x - 0.5, 0.5 * x * x))
+    hub_g = jnp.clip(x, -1.0, 1.0)
+    eu = jnp.exp(jnp.clip(U, -30, 30))
+    Tpos = jnp.maximum(T, 1e-30)
+    poi_l = eu - T * U + jnp.where(T > 0, T * jnp.log(Tpos) - T, 0.0)
+    poi_g = eu - T
+    hin_l = jnp.maximum(1.0 + s * U, 0.0)
+    hin_g = jnp.where(1.0 + s * U > 0, s, 0.0)
+    log_l = jnp.log1p(jnp.exp(jnp.clip(s * U, -30, 30)))
+    log_g = s * jax.nn.sigmoid(s * U)
+    per_l = 1.0 - jnp.cos((T - U) * f)
+    per_g = -f * jnp.sin((T - U) * f)
+    # Categorical block (one-hot T): sum_j≠a max(1+u_j,0) + max(1-u_a,0)
+    cat_l = jnp.where(T > 0, jnp.maximum(1.0 - U, 0.0),
+                      jnp.maximum(1.0 + U, 0.0))
+    cat_g = jnp.where(T > 0, -(1.0 - U > 0).astype(U.dtype),
+                      (1.0 + U > 0).astype(U.dtype))
+    # Ordinal block: for threshold col i (< d-1): a > i → max(1-u_i,0) else 1.
+    # a > i ⟺ the block's inclusive one-hot cumsum at i is 0.
+    cum = jnp.cumsum(T, axis=1)
+    base = jnp.take_along_axis(
+        jnp.pad(cum, ((0, 0), (1, 0))), blk_start[None, :], axis=1)
+    a_gt_i = (cum - base) == 0
+    ord_l = jnp.where(blk_last[None, :], 0.0,
+                      jnp.where(a_gt_i, jnp.maximum(1.0 - U, 0.0), 1.0))
+    ord_g = jnp.where(blk_last[None, :] | ~a_gt_i, 0.0,
+                      jnp.where(1.0 - U > 0, -1.0, 0.0))
+
+    # accumulate by per-column select: a stacked [9, n, K] gather would hold
+    # ~18 full matrices in HBM; this keeps two [n, K] buffers
+    L = jnp.zeros_like(U)
+    G = jnp.zeros_like(U)
+    for fid, (lf, gf) in enumerate([
+            (quad_l, quad_g), (abs_l, abs_g), (hub_l, hub_g),
+            (poi_l, poi_g), (hin_l, hin_g), (log_l, log_g),
+            (per_l, per_g), (cat_l, cat_g), (ord_l, ord_g)]):
+        sel = (lid == fid)[None, :]
+        L = jnp.where(sel, lf, L)
+        G = jnp.where(sel, gf, G)
+    return (L * M).sum(), G * M
+
+
+def _prox(Z, kind: str, step):
+    """Proximal operator of step * regularizer (GlrmRegularizer.rproxgrad)."""
+    if kind in (None, "None"):
+        return Z
+    if kind == "Quadratic":
+        return Z / (1.0 + 2.0 * step)
+    if kind == "L2":                      # group (row-wise) shrinkage
+        nrm = jnp.linalg.norm(Z, axis=-1, keepdims=True)
+        return Z * jnp.maximum(1.0 - step / jnp.maximum(nrm, 1e-30), 0.0)
+    if kind == "L1":
+        return jnp.sign(Z) * jnp.maximum(jnp.abs(Z) - step, 0.0)
+    if kind == "NonNegative":
+        return jnp.maximum(Z, 0.0)
+    if kind == "OneSparse":               # largest nonneg coordinate only
+        Zp = jnp.maximum(Z, 0.0)
+        best = jnp.argmax(Zp, axis=-1, keepdims=True)
+        oh = jnp.arange(Z.shape[-1])[None, :] == best
+        return jnp.where(oh, Zp, 0.0)
+    if kind == "UnitOneSparse":           # indicator vector
+        best = jnp.argmax(Z, axis=-1, keepdims=True)
+        return (jnp.arange(Z.shape[-1])[None, :] == best).astype(Z.dtype)
+    if kind == "Simplex":                 # Euclidean projection onto simplex
+        srt = jnp.sort(Z, axis=-1)[:, ::-1]
+        css = jnp.cumsum(srt, axis=-1) - 1.0
+        j = jnp.arange(1, Z.shape[-1] + 1)
+        cond = srt - css / j > 0
+        rho = jnp.sum(cond, axis=-1, keepdims=True)
+        theta = jnp.take_along_axis(css, rho - 1, axis=-1) / rho
+        return jnp.maximum(Z - theta, 0.0)
+    raise ValueError(f"unknown regularization {kind!r}")
+
+
+def _reg_value(Z, kind: str, gamma):
+    if kind in (None, "None", "NonNegative", "OneSparse", "UnitOneSparse",
+                "Simplex"):
+        return 0.0
+    if kind == "Quadratic":
+        return gamma * float(jax.device_get((Z * Z).sum()))
+    if kind == "L2":
+        return gamma * float(jax.device_get(
+            jnp.linalg.norm(Z, axis=-1).sum()))
+    if kind == "L1":
+        return gamma * float(jax.device_get(jnp.abs(Z).sum()))
+    return 0.0
+
+
+@jax.jit
+def _glrm_grad_A(Xt, M, A, Y, lid, period, blk_start, blk_last):
+    L, G = _glrm_loss_and_grad(A @ Y, Xt, M, lid, period, blk_start, blk_last)
+    return L, G @ Y.T
+
+
+@jax.jit
+def _glrm_grad_Y(Xt, M, A, Y, lid, period, blk_start, blk_last):
+    L, G = _glrm_loss_and_grad(A @ Y, Xt, M, lid, period, blk_start, blk_last)
+    return L, A.T @ G
+
+
 def _expand_masked(di: DataInfo, frame: Frame, row_ok) -> tuple[jax.Array, jax.Array]:
     """Expanded design + observation mask M (1=observed cell). ``expand()``
     mean-imputes NAs, so the NA positions must be read off the raw columns
@@ -318,11 +445,23 @@ class GLRMModel(Model):
 
 
 class GLRM(ModelBuilder):
-    """h2o-py surface: ``H2OGeneralizedLowRankEstimator`` (quadratic loss,
-    L2/NonNegative regularizers; alternating ridge solves)."""
+    """h2o-py surface: ``H2OGeneralizedLowRankEstimator``.
+
+    Quadratic-loss models with closed-form-friendly regularizers use exact
+    alternating ridge solves (MXU matmuls + batched [k,k] Cholesky). Any
+    other loss (Absolute/Huber/Poisson/Hinge/Logistic/Periodic per numeric
+    column, Categorical/Ordinal per enum block — reference ``GlrmLoss``) or
+    regularizer (L1/L2/OneSparse/UnitOneSparse/Simplex — ``GlrmRegularizer``)
+    runs the reference's alternating PROXIMAL gradient scheme
+    (``hex/glrm/GLRM.java`` update loop: gradient step on A, prox, gradient
+    step on Y, prox, adaptive step size — halve on objective increase, grow
+    5% on success)."""
 
     algo = "glrm"
     unsupervised = True
+
+    #: regularizers the exact quadratic ALS path can honor
+    _EXACT_REGS = (None, "None", "Quadratic", "NonNegative")
 
     @classmethod
     def defaults(cls) -> dict:
@@ -330,21 +469,81 @@ class GLRM(ModelBuilder):
             super().defaults(),
             k=1,
             transform="NONE",
-            loss="Quadratic",
-            regularization_x="None",     # None | Quadratic | NonNegative
-            regularization_y="None",
+            loss="Quadratic",            # numeric default (GlrmLoss)
+            multi_loss="Categorical",    # categorical default (Categorical|Ordinal)
+            loss_by_col=None,            # per-source-column overrides
+            loss_by_col_idx=None,
+            period=1.0,                  # Periodic loss period
+            regularization_x="None",     # None|Quadratic|L2|L1|NonNegative|
+            regularization_y="None",     # OneSparse|UnitOneSparse|Simplex
             gamma_x=0.0,
             gamma_y=0.0,
             max_iterations=100,
             init="SVD",                  # SVD | Random
         )
 
+    def _loss_ids(self, di: DataInfo, x: list[str]) -> np.ndarray:
+        """Per-expanded-column loss ids from loss/multi_loss/loss_by_col."""
+        p = self.params
+        per_col: dict[str, str] = {}
+        if p.get("loss_by_col"):
+            names = list(p["loss_by_col"])
+            idxs = list(p.get("loss_by_col_idx") or range(len(names)))
+            if len(idxs) != len(names):
+                raise ValueError("loss_by_col and loss_by_col_idx lengths "
+                                 "differ")
+            for i, nm in zip(idxs, names):
+                per_col[x[int(i)]] = str(nm)
+        K = len(di.coef_names)
+        lid = np.zeros(K, np.int32)
+        col = 0
+        for ci, c in enumerate(di.cat_domains):
+            width = len(c) - (0 if di.use_all_factor_levels else 1)
+            name = di.cat_cols[ci]
+            loss = per_col.get(name, str(p["multi_loss"])).lower()
+            if loss not in ("categorical", "ordinal"):
+                raise ValueError(f"categorical column {name!r} needs "
+                                 "Categorical or Ordinal loss")
+            lid[col:col + width] = _LOSS_IDS[loss]
+            col += width
+        for ni, c in enumerate(di.num_cols):
+            loss = per_col.get(c, str(p["loss"])).lower()
+            if loss in ("categorical", "ordinal"):
+                raise ValueError(f"numeric column {c!r} cannot use {loss}")
+            if loss not in _LOSS_IDS:
+                raise ValueError(f"unknown loss {loss!r}; have "
+                                 f"{sorted(_LOSS_IDS)}")
+            lid[col + ni] = _LOSS_IDS[loss]
+        return lid
+
+    def _block_layout(self, di: DataInfo) -> tuple[np.ndarray, np.ndarray]:
+        """(blk_start[K], blk_last[K]) for the categorical-block losses."""
+        K = len(di.coef_names)
+        start = np.arange(K, dtype=np.int32)
+        last = np.zeros(K, bool)
+        col = 0
+        for dom in di.cat_domains:
+            width = len(dom) - (0 if di.use_all_factor_levels else 1)
+            start[col:col + width] = col
+            if width > 0:
+                last[col + width - 1] = True
+            col += width
+        return start, last
+
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLRMModel:
         p = self.params
         k = int(p["k"])
-        if str(p["loss"]).lower() != "quadratic":
-            raise ValueError("only Quadratic loss implemented")
+        lb = [str(v).lower() for v in (p.get("loss_by_col") or [])]
+        has_cat = any(frame.vec(c).is_categorical for c in x)
+        nonquad = (str(p["loss"]).lower() != "quadratic" or has_cat
+                   or any(v != "quadratic" for v in lb))
+        exact_ok = (not nonquad
+                    and p["regularization_x"] in self._EXACT_REGS
+                    and p["regularization_y"] in self._EXACT_REGS)
+
+        # generalized losses need the FULL one-hot block per enum column
         di = _make_data_info(frame, x, p["transform"],
+                             use_all_factor_levels=has_cat or
                              bool(p.get("use_all_factor_levels", False)))
         Xc, M = _expand_masked(di, frame, weights > 0)
         plen, K = Xc.shape
@@ -360,20 +559,22 @@ class GLRM(ModelBuilder):
         else:
             Y = 0.1 * jax.random.normal(key, (k, K), jnp.float32)
         gx, gy = jnp.float32(p["gamma_x"]), jnp.float32(p["gamma_y"])
+        iters = max(int(p["max_iterations"]), 1)
 
-        obj_prev = np.inf
-        for it in range(max(int(p["max_iterations"]), 1)):
+        if exact_ok:
+            obj_prev = np.inf
+            for it in range(iters):
+                A = _apply_reg(_glrm_update_A(Xc, M, Y, gx), p["regularization_x"])
+                Y = _apply_reg(_glrm_update_Y(Xc, M, A, gy), p["regularization_y"])
+                obj = float(jax.device_get(_glrm_objective(Xc, M, A, Y, gx, gy)))
+                job.update((it + 1) / iters, f"iter {it+1} objective {obj:.5f}")
+                if np.isfinite(obj_prev) and abs(obj_prev - obj) <= 1e-6 * max(obj_prev, 1.0):
+                    break
+                obj_prev = obj
             A = _apply_reg(_glrm_update_A(Xc, M, Y, gx), p["regularization_x"])
-            Y = _apply_reg(_glrm_update_Y(Xc, M, A, gy), p["regularization_y"])
             obj = float(jax.device_get(_glrm_objective(Xc, M, A, Y, gx, gy)))
-            job.update((it + 1) / max(int(p["max_iterations"]), 1),
-                       f"iter {it+1} objective {obj:.5f}")
-            if np.isfinite(obj_prev) and abs(obj_prev - obj) <= 1e-6 * max(obj_prev, 1.0):
-                break
-            obj_prev = obj
-        # re-solve A against the final Y so x_factor matches archetypes
-        A = _apply_reg(_glrm_update_A(Xc, M, Y, gx), p["regularization_x"])
-        obj = float(jax.device_get(_glrm_objective(Xc, M, A, Y, gx, gy)))
+        else:
+            A, Y, obj, it = self._fit_proximal(job, di, Xc, M, Y, k, iters)
 
         from h2o3_tpu.models.model_base import ModelParameters
         return GLRMModel(
@@ -385,3 +586,43 @@ class GLRM(ModelBuilder):
                         gamma_x=float(p["gamma_x"]), gamma_y=float(p["gamma_y"]),
                         iterations=it + 1, coef_names=di.coef_names),
         )
+
+    def _fit_proximal(self, job: Job, di, Xc, M, Y, k: int, iters: int):
+        """Alternating proximal gradient (GLRM.java non-quadratic path)."""
+        p = self.params
+        lid = jnp.asarray(self._loss_ids(di, self._x_cols))
+        blk_start, blk_last = self._block_layout(di)
+        blk_start = jnp.asarray(blk_start)
+        blk_last = jnp.asarray(blk_last)
+        period = jnp.float32(p.get("period") or 1.0)
+        gx, gy = float(p["gamma_x"]), float(p["gamma_y"])
+        rx, ry = p["regularization_x"], p["regularization_y"]
+        n = max(float(jax.device_get(M.sum())), 1.0)
+
+        A = jnp.zeros((Xc.shape[0], k), jnp.float32)
+        alpha = 1.0 / n                  # ~1/Lipschitz of the summed loss
+        L_prev, _ = _glrm_grad_A(Xc, M, A, Y, lid, period, blk_start, blk_last)
+        obj_prev = float(jax.device_get(L_prev)) + _reg_value(A, rx, gx) \
+            + _reg_value(Y.T, ry, gy)
+        it = 0
+        for it in range(iters):
+            _, GA = _glrm_grad_A(Xc, M, A, Y, lid, period, blk_start, blk_last)
+            A1 = _prox(A - alpha * GA, rx, alpha * gx)
+            _, GY = _glrm_grad_Y(Xc, M, A1, Y, lid, period, blk_start, blk_last)
+            Y1 = _prox((Y - alpha * GY).T, ry, alpha * gy).T
+            L, _ = _glrm_grad_A(Xc, M, A1, Y1, lid, period, blk_start, blk_last)
+            obj = float(jax.device_get(L)) + _reg_value(A1, rx, gx) \
+                + _reg_value(Y1.T, ry, gy)
+            if np.isfinite(obj) and obj <= obj_prev:
+                A, Y = A1, Y1
+                converged = abs(obj_prev - obj) <= 1e-7 * max(obj_prev, 1.0)
+                obj_prev = obj
+                alpha *= 1.05          # reference: grow on success
+                if converged:
+                    break
+            else:
+                alpha *= 0.5           # reference: halve on failure
+                if alpha < 1e-12:
+                    break
+            job.update((it + 1) / iters, f"iter {it+1} objective {obj_prev:.5f}")
+        return A, Y, obj_prev, it

@@ -1,16 +1,26 @@
 """GAM — generalized additive models via spline basis expansion + GLM.
 
-Reference: ``hex/gam/`` (4.7 kLoC): selected numeric predictors are expanded
-into penalized cubic-regression-spline bases on quantile knots
-(``GamSplines/``), the expanded frame is handed to GLM with a per-spline-group
-ridge penalty, and the model scores by re-expanding at predict time
-(``GAMModel.java``).
+Reference: ``hex/gam/`` (4.7 kLoC): selected predictors are expanded into
+penalized spline bases on quantile knots (``GamSplines/``), the expanded
+frame is handed to GLM with a smoothness penalty, and the model scores by
+re-expanding at predict time (``GAMModel.java``). Basis families:
 
-TPU-native: the natural cubic spline basis is one closed-form elementwise map
-per (row, knot) pair — computed as a [rows, k] broadcast on device — and the
-fit IS the existing distributed IRLS (the basis columns just join the design
-matrix), so everything downstream (families, regularization, metrics) is
-inherited.
+- ``bs=0`` cubic regression splines (``CubicRegressionSplines.java``) —
+  natural cubic basis on quantile knots;
+- ``bs=1`` thin-plate regression splines (``ThinPlateRegressionUtils.java``)
+  — radial basis |r|³ (1-D) / r²·log r (2-D) on knot centers plus the
+  polynomial null space; supports MULTI-predictor smooths
+  (``gam_columns=[["x1","x2"], ...]``);
+- ``bs=2`` monotone I-splines (``NBSplinesTypeII``/ISplines) — integrated
+  M-spline basis with non-negative coefficients (enforced through GLM
+  ``beta_constraints``), giving monotone-increasing smooths
+  (``splines_non_negative``).
+
+TPU-native: every basis is a closed-form elementwise map computed as a
+[rows, k] broadcast on device, and the fit IS the existing distributed IRLS
+(basis columns join the design matrix), so families, regularization and
+metrics are inherited. Knot selection is quantile-based like the reference
+(``knot_ids`` may override with user knots).
 """
 
 from __future__ import annotations
@@ -27,7 +37,7 @@ from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
 
 
 def _ncs_basis(x: jax.Array, knots: jax.Array) -> jax.Array:
-    """Natural cubic spline basis [rows, k] on ``k`` interior knots
+    """Natural cubic spline basis [rows, k-1] on ``k`` knots
     (truncated-power construction with natural boundary constraints;
     Hastie/Tibshirani ESL eq. 5.4-5.5 — the reference's CR splines span the
     same function space)."""
@@ -46,19 +56,87 @@ def _ncs_basis(x: jax.Array, knots: jax.Array) -> jax.Array:
     return jnp.stack(cols, axis=1)   # [rows, k-1]: linear + k-2 curvature terms
 
 
+def _tp_basis_1d(x: jax.Array, knots: jax.Array) -> jax.Array:
+    """1-D thin-plate basis: η(r)=|r|³ radial terms + the linear null space
+    (reference ThinPlate* distance measure for d=1, m=2)."""
+    r = jnp.abs(x[:, None] - knots[None, :])
+    return jnp.concatenate([x[:, None], r ** 3], axis=1)
+
+
+def _tp_basis_2d(x1: jax.Array, x2: jax.Array, kx: np.ndarray) -> jax.Array:
+    """2-D thin-plate basis: η(r)=r²·log r on knot centers + linear null
+    space (reference thin-plate for d=2, m=2)."""
+    dx = x1[:, None] - kx[None, :, 0]
+    dy = x2[:, None] - kx[None, :, 1]
+    r2 = dx * dx + dy * dy
+    rad = jnp.where(r2 > 1e-24, 0.5 * r2 * jnp.log(jnp.maximum(r2, 1e-24)),
+                    0.0)
+    return jnp.concatenate([x1[:, None], x2[:, None], rad], axis=1)
+
+
+def _bspline_basis(x: jax.Array, knots: np.ndarray, degree: int = 3):
+    """Cox–de Boor B-spline basis [rows, n_basis] on an open knot vector."""
+    t = np.concatenate([[knots[0]] * degree, knots, [knots[-1]] * degree])
+    n = len(t) - degree - 1
+    # the right-open intervals exclude the last knot; clip to the largest
+    # f32 BELOW it (a 1e-9 offset rounds back to the knot in float32)
+    hi = np.nextafter(np.float32(knots[-1]), np.float32(knots[0]))
+    xs = jnp.clip(x, knots[0], hi)
+    B = [jnp.where((xs >= t[i]) & (xs < t[i + 1]), 1.0, 0.0)
+         for i in range(len(t) - 1)]
+    for d in range(1, degree + 1):
+        Bn = []
+        for i in range(len(t) - d - 1):
+            den1, den2 = t[i + d] - t[i], t[i + d + 1] - t[i + 1]
+            a = (xs - t[i]) / den1 * B[i] if den1 > 0 else 0.0
+            b = (t[i + d + 1] - xs) / den2 * B[i + 1] if den2 > 0 else 0.0
+            Bn.append(a + b)
+        B = Bn
+    return jnp.stack(B[:n], axis=1)
+
+
+def _ispline_basis(x: jax.Array, knots: np.ndarray, degree: int = 3):
+    """I-spline (monotone) basis: I_i(x) = Σ_{j>=i} B_j(x) of one-degree-
+    higher B-splines (Ramsay 1988; reference ISplines). Each I_i rises
+    monotonically 0→1, so non-negative coefficients give a monotone smooth."""
+    Bhi = _bspline_basis(x, knots, degree)
+    # cumulative from the right, dropping the constant first function
+    rev = jnp.cumsum(Bhi[:, ::-1], axis=1)[:, ::-1]
+    return rev[:, 1:]
+
+
+def _entry_name(entry) -> str:
+    return "_".join(entry) if isinstance(entry, (list, tuple)) else entry
+
+
 class GAMModel(Model):
     algo = "gam"
 
     def _expand(self, frame: Frame):
         o = self.output
         cols, names = [], []
-        for c in o["gam_columns"]:
-            x = frame.vec(c).as_float()
-            x = jnp.where(jnp.isnan(x), jnp.asarray(o["col_means"][c]), x)
-            B = _ncs_basis(x, jnp.asarray(o["knots"][c]))
+        for entry, bs in zip(o["gam_columns"], o["bs"]):
+            nm = _entry_name(entry)
+            if isinstance(entry, (list, tuple)):     # multi-dim thin plate
+                xs = []
+                for c in entry:
+                    v = frame.vec(c).as_float()
+                    xs.append(jnp.where(jnp.isnan(v),
+                                        jnp.asarray(o["col_means"][c]), v))
+                B = _tp_basis_2d(xs[0], xs[1], np.asarray(o["knots"][nm]))
+            else:
+                v = frame.vec(entry).as_float()
+                x = jnp.where(jnp.isnan(v), jnp.asarray(o["col_means"][entry]), v)
+                kn = o["knots"][nm]
+                if bs == 1:
+                    B = _tp_basis_1d(x, jnp.asarray(kn))
+                elif bs == 2:
+                    B = _ispline_basis(x, np.asarray(kn))
+                else:
+                    B = _ncs_basis(x, jnp.asarray(kn))
             for i in range(B.shape[1]):
                 cols.append(B[:, i])
-                names.append(f"{c}_gam_{i}")
+                names.append(f"{nm}_gam_{i}")
         out = Frame(list(frame.names), list(frame.vecs))
         for n, c in zip(names, cols):
             out.add(n, Vec(c.astype(jnp.float32), VecType.NUM, frame.nrows))
@@ -81,8 +159,11 @@ class GAM(ModelBuilder):
     def defaults(cls) -> dict:
         return dict(
             super().defaults(),
-            gam_columns=None,            # required: columns to spline-expand
+            gam_columns=None,            # str entries, or [c1,c2] lists (tp)
+            bs=None,                     # per-entry basis: 0=cr, 1=tp, 2=is
             num_knots=5,
+            knot_ids=None,               # {col: [user knots]} overrides
+            splines_non_negative=True,   # bs=2: monotone INCREASING
             family="AUTO",
             lambda_=0.0,
             alpha=0.0,
@@ -92,41 +173,98 @@ class GAM(ModelBuilder):
             max_iterations=50,
         )
 
+    def _select_knots(self, frame, entry, k: int, user_knots):
+        """Quantile knot selection per the reference's default placement
+        (``GamUtils.generateKnotsFromKeys``); user ``knot_ids`` override."""
+        nm = _entry_name(entry)
+        if user_knots and nm in user_knots:
+            kn = np.asarray(user_knots[nm], np.float64)
+            if kn.ndim == 1 and isinstance(entry, (list, tuple)):
+                raise ValueError(f"thin-plate entry {nm} needs 2-D knots")
+            return kn.astype(np.float32)
+        if isinstance(entry, (list, tuple)):
+            # knots = strided DATA points (reference thin-plate picks knot
+            # rows from the frame; a per-axis quantile zip would put every
+            # knot on one diagonal). NaN rows are excluded — one NaN knot
+            # would poison the whole radial basis.
+            cols = [frame.vec(c).to_numpy().astype(np.float64)
+                    for c in entry]
+            pts = np.stack(cols, axis=1)
+            pts = pts[~np.isnan(pts).any(axis=1)]
+            if len(pts) < k:
+                raise ValueError(f"thin-plate entry {nm}: only {len(pts)} "
+                                 f"complete rows for {k} knots")
+            idx = np.linspace(0, len(pts) - 1, k).astype(np.int64)
+            return pts[idx].astype(np.float32)
+        v = frame.vec(entry).as_float()
+        qs = jnp.nanquantile(v, jnp.linspace(0.02, 0.98, k))
+        kn = np.unique(np.asarray(jax.device_get(qs), np.float64))
+        if len(kn) < 3:
+            raise ValueError(f"gam column {entry!r} has too few distinct values")
+        return kn.astype(np.float32)
+
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GAMModel:
         p = self.params
         gam_cols = p["gam_columns"]
         if not gam_cols:
             raise ValueError("gam_columns is required")
-        for c in gam_cols:
-            if frame.vec(c).is_categorical:
-                raise ValueError(f"gam column {c!r} must be numeric")
+        bs = list(p["bs"]) if p.get("bs") else [0] * len(gam_cols)
+        if len(bs) != len(gam_cols):
+            raise ValueError("bs must have one entry per gam column")
+        for entry, b in zip(gam_cols, bs):
+            names = entry if isinstance(entry, (list, tuple)) else [entry]
+            if isinstance(entry, (list, tuple)):
+                if int(b) != 1:
+                    raise ValueError("multi-column gam entries require "
+                                     "bs=1 (thin plate)")
+                if len(entry) != 2:
+                    raise ValueError("thin-plate smooths support 1 or 2 "
+                                     "predictors here")
+            for c in names:
+                if frame.vec(c).is_categorical:
+                    raise ValueError(f"gam column {c!r} must be numeric")
+            if int(b) not in (0, 1, 2):
+                raise ValueError(f"bs={b} unknown (0=cr, 1=tp, 2=is)")
 
-        knots, col_means = {}, {}
         k = int(p["num_knots"])
         if k < 3:
             raise ValueError("num_knots must be >= 3")
-        for c in gam_cols:
-            v = frame.vec(c).as_float()
-            qs = jnp.nanquantile(v, jnp.linspace(0.02, 0.98, k))
-            kn = np.asarray(jax.device_get(qs), np.float64)
-            kn = np.unique(kn)
-            if len(kn) < 3:
-                raise ValueError(f"gam column {c!r} has too few distinct values")
-            knots[c] = kn.astype(np.float32)
-            col_means[c] = float(jax.device_get(jnp.nanmean(v)))
+        knots, col_means = {}, {}
+        flat_cols = []
+        for entry in gam_cols:
+            names = entry if isinstance(entry, (list, tuple)) else [entry]
+            flat_cols.extend(names)
+            knots[_entry_name(entry)] = self._select_knots(
+                frame, entry, k, p.get("knot_ids"))
+            for c in names:
+                col_means[c] = float(jax.device_get(
+                    jnp.nanmean(frame.vec(c).as_float())))
 
-        # expanded training frame: linear+spline terms replace the raw column
         model_stub = GAMModel(key="_tmp", params=self.params, data_info=None,
                               response_column=y, response_domain=None,
-                              output=dict(gam_columns=gam_cols, knots=knots,
-                                          col_means=col_means))
+                              output=dict(gam_columns=gam_cols, bs=bs,
+                                          knots=knots, col_means=col_means))
         expanded, gam_names = model_stub._expand(frame)
 
+        # bs=2 monotonicity: non-negative I-spline coefficients via GLM's
+        # box constraints (reference: splines_non_negative)
+        constraints = None
+        if any(int(b) == 2 for b in bs) and bool(p["splines_non_negative"]):
+            constraints = {}
+            for entry, b in zip(gam_cols, bs):
+                if int(b) != 2:
+                    continue
+                nm = _entry_name(entry)
+                for gname in gam_names:
+                    if gname.startswith(f"{nm}_gam_"):
+                        constraints[gname] = (0.0, None)
+
         from h2o3_tpu.models.glm import GLM
-        keep_x = [c for c in x if c not in gam_cols]
+        keep_x = [c for c in x if c not in flat_cols]
         lam = float(p["lambda_"]) + float(p["scale"])   # smoothness as ridge
         glm = GLM(family=p["family"], lambda_=lam, alpha=float(p["alpha"]),
                   standardize=bool(p["standardize"]),
+                  beta_constraints=constraints,
                   max_iterations=int(p["max_iterations"])) \
             .train(x=keep_x + gam_names, y=y, training_frame=expanded,
                    weights=weights)
@@ -137,6 +275,6 @@ class GAM(ModelBuilder):
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain if yvec.is_categorical else None,
-            output=dict(gam_columns=gam_cols, knots=knots, col_means=col_means,
-                        glm=glm, gam_names=gam_names),
+            output=dict(gam_columns=gam_cols, bs=bs, knots=knots,
+                        col_means=col_means, glm=glm, gam_names=gam_names),
         )

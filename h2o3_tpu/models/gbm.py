@@ -30,7 +30,7 @@ from jax import lax
 
 from h2o3_tpu.models.tree import (Tree, _grow_tree_device, predict_binned,
                                   predict_raw)
-from h2o3_tpu.ops.quantile import bin_features, compute_bin_edges, sample_rows_host
+from h2o3_tpu.ops.quantile import bin_features, compute_bin_edges
 
 
 def tree_matrix(frame: Frame, cols: list[str], domains: dict[str, tuple]) -> jax.Array:
@@ -393,15 +393,42 @@ class SharedTreeBuilder(ModelBuilder):
             raise ValueError(f"max_depth={depth} exceeds the dense-heap limit "
                              f"{self.MAX_TREE_DEPTH}")
         yvec = frame.vec(y)
-        X = tree_matrix(frame, x, {})
-        sample = sample_rows_host(X, frame.nrows)
+        # edges from a strided host sample assembled per COLUMN — stacking a
+        # full [rows, F] float matrix on TPU pads F to 128 lanes (4.6x HBM;
+        # 5.6GB at HIGGS-11M), so the raw design matrix is never materialized
+        nrows = frame.nrows
+        stride = max(1, nrows // 100_000)
+        idx = jnp.arange(0, nrows, stride)
+        sample_dev = jnp.stack([frame.vec(c).as_float()[idx] for c in x],
+                               axis=1)
+        sample = np.asarray(jax.device_get(sample_dev))
         edges = jnp.asarray(compute_bin_edges(sample, int(self.params["nbins"])))
         self._setup_cat_info(frame, x)
-        binned = self._apply_cat_bins(X, bin_features(X, edges))
+        binned = self._bin_frame(frame, x, edges)
         from h2o3_tpu.models.data_info import response_as_float
         yy, valid = response_as_float(yvec)
         domains = {c: frame.vec(c).domain for c in x if frame.vec(c).is_categorical}
-        return X, edges, binned, yy, valid, yvec, domains
+        return None, edges, binned, yy, valid, yvec, domains
+
+    def _bin_frame(self, frame: Frame, x: list[str], edges) -> jax.Array:
+        """Per-column binning → [rows, F] int16 (the only row-major matrix
+        training keeps; int16 + one stack keeps peak HBM at [rows*F*2B] plus
+        lane padding instead of three f32/i32 copies)."""
+        from h2o3_tpu.models.tree import cat_bins_for_codes
+        nbins = int(self.params["nbins"])
+        cc, cat_bins = (self._cat_info if self._cat_info is not None
+                        else (None, 0))
+        cols = []
+        for j, c in enumerate(x):
+            v = frame.vec(c).as_float()
+            if cc is not None and int(cc[j]) > 0:
+                b = cat_bins_for_codes(v[:, None], cc[j:j + 1], cat_bins)[:, 0]
+                b = jnp.where(jnp.isnan(v), nbins, b)
+            else:
+                b = jnp.searchsorted(edges[j], v, side="right")
+                b = jnp.where(jnp.isnan(v), nbins, b)
+            cols.append(b.astype(jnp.int16))
+        return jnp.stack(cols, axis=1)
 
     def _setup_cat_info(self, frame: Frame, x: list[str]) -> None:
         """Categorical group-split binning state (reference: DHistogram gives
@@ -434,8 +461,8 @@ class SharedTreeBuilder(ModelBuilder):
         cb = cat_bins_for_codes(X, cc, cat_bins)
         is_cat = cc[None, :] > 0
         nan = jnp.isnan(X)
-        binned = jnp.where(is_cat & ~nan, cb, binned)
-        return jnp.where(is_cat & nan, nbins, binned)
+        out = jnp.where(is_cat & ~nan, cb, binned)
+        return jnp.where(is_cat & nan, nbins, out).astype(binned.dtype)
 
     @property
     def _cat_feats(self):
@@ -642,7 +669,7 @@ class GBM(SharedTreeBuilder):
             # thresholds silently shift (reference keeps the checkpoint's
             # DHistogram bins)
             edges = cp.output["edges"]
-            binned = self._apply_cat_bins(X, bin_features(X, edges))
+            binned = self._bin_frame(frame, x, edges)
         dist = str(p["distribution"])
         if dist.lower() == "auto":   # h2o-py sends lowercase enum names
             dist = "AUTO"
@@ -693,7 +720,7 @@ class GBM(SharedTreeBuilder):
         lr = float(p["learn_rate"])
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
-        Fcur = jnp.full(X.shape[0], f0, jnp.float32)
+        Fcur = jnp.full(binned.shape[0], f0, jnp.float32)
         oc = p.get("offset_column")
         if oc:
             # per-row margin offset (reference: offset_column adds to F on
@@ -722,7 +749,7 @@ class GBM(SharedTreeBuilder):
             tweedie_power=float(p["tweedie_power"]))
         mono, reach = self._constraint_arrays(x, frame)
         kwargs.update(mono=mono, reach=reach, cat_feats=self._cat_feats)
-        fmask_base = jnp.ones(X.shape[1], bool)
+        fmask_base = jnp.ones(binned.shape[1], bool)
         valid = None
         if int(p.get("stopping_rounds") or 0) > 0:
             valid = self._valid_stop_data(
@@ -877,10 +904,23 @@ class GBM(SharedTreeBuilder):
             return [_trees_from_stacked(heap, m) for m in range(count)]
 
         if sr <= 0:
-            Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
-                                     keys, **kwargs)
-            jax.block_until_ready(heap)
-            return collect(heap, M), Fcur
+            # cap rows*trees per dispatch: a single fused program running
+            # >~90s trips the device/tunnel watchdog (observed at HIGGS-11M
+            # x 20 trees); ~1.5e8 rows*trees ≈ 60s on v5e at 64 bins, and
+            # histogram cost scales with bins. The inter-chunk host hop
+            # costs ~40ms — noise against a multi-second chunk.
+            cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
+            per = max(1, int(1.5e8 // cost))
+            out_trees = []
+            for s0 in range(0, M, per):
+                kchunk = keys[s0:s0 + per]
+                Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base,
+                                         Fcur, kchunk, **kwargs)
+                jax.block_until_ready(heap)
+                out_trees.extend(collect(heap, kchunk.shape[0]))
+                job.update(0.1 + 0.8 * min(s0 + per, M) / M,
+                           f"{len(out_trees)}/{M} trees grown")
+            return out_trees, Fcur
 
         tol = float(p.get("stopping_tolerance") or 1e-3)
         lr = float(kwargs["lr"])
@@ -933,7 +973,8 @@ class GBM(SharedTreeBuilder):
         lr = float(p["learn_rate"])
         seed = int(p["seed"]) if int(p["seed"]) >= 0 else 42
         key = jax.random.PRNGKey(seed)
-        Fcur = jnp.broadcast_to(jnp.asarray(f0)[None, :], (X.shape[0], K)).astype(jnp.float32)
+        Fcur = jnp.broadcast_to(jnp.asarray(f0)[None, :],
+                                (binned.shape[0], K)).astype(jnp.float32)
         trees_multi: list[list[Tree]] = [[] for _ in range(K)]
         done = 0
         if cp is not None:
@@ -966,7 +1007,7 @@ class GBM(SharedTreeBuilder):
                 edges, K, f0, lr, domains, yvec.domain,
                 prior_trees=trees_multi if done else None)
         rounds, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
-                                                jnp.ones(X.shape[1], bool),
+                                                jnp.ones(binned.shape[1], bool),
                                                 Fcur, keys, "multinomial", K,
                                                 kwargs, p, valid=valid)
         for per_class in rounds:
@@ -1028,13 +1069,14 @@ class DRF(SharedTreeBuilder):
         if cp is not None:
             self._check_checkpoint(cp, x, None)   # before the edges swap
             edges = cp.output["edges"]
-            binned = self._apply_cat_bins(X, bin_features(X, edges))
+            binned = self._bin_frame(frame, x, edges)
         classifier = yvec.is_categorical
         nclass = yvec.cardinality() if classifier else 0
         w = weights * valid
         yc = jnp.where(w > 0, yy, 0.0)
 
-        F = X.shape[1]
+        X = None    # training reads only `binned`
+        F = binned.shape[1]
         mtries = int(p["mtries"])
         if mtries <= 0:
             mtries = max(1, int(np.sqrt(F)) if classifier else max(F // 3, 1))
@@ -1054,7 +1096,7 @@ class DRF(SharedTreeBuilder):
             keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
             _, heap = _boost_scan(
                 binned, edges, yc, w, fmask,
-                jnp.zeros((X.shape[0], nclass), jnp.float32), keys,
+                jnp.zeros((binned.shape[0], nclass), jnp.float32), keys,
                 dist="multinomial", depth=int(p["max_depth"]),
                 n_bins=int(p["nbins"]), col_rate=mtries / F,
                 sample_rate=float(p["sample_rate"]), col_tree_rate=1.0,
@@ -1084,7 +1126,7 @@ class DRF(SharedTreeBuilder):
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
         _, heap = _boost_scan(
             binned, edges, yc, w, fmask,
-            jnp.zeros(X.shape[0], jnp.float32), keys,
+            jnp.zeros(binned.shape[0], jnp.float32), keys,
             dist="gaussian", depth=int(p["max_depth"]), n_bins=int(p["nbins"]),
             col_rate=mtries / F, sample_rate=float(p["sample_rate"]),
             col_tree_rate=1.0, min_rows=float(p["min_rows"]), reg_lambda=0.0,

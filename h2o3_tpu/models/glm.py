@@ -309,7 +309,56 @@ class GLM(ModelBuilder):
             lambda_search=False,
             nlambdas=30,
             lambda_min_ratio=1e-4,
+            beta_constraints=None,    # {name: (lower, upper)} or h2o-frame
+            #                           style [{"names","lower_bounds",...}]
         )
+
+    def _build_beta_bounds(self, di, params, family: str):
+        """[lo, hi] per coefficient (+intercept) from ``beta_constraints``
+        (reference: GLM BetaConstraints frame — names/lower_bounds/
+        upper_bounds). Bounds are given on the ORIGINAL coefficient scale;
+        with standardization they transform to the fitted scale
+        (beta_std = beta_orig / num_mul)."""
+        bc = params.get("beta_constraints")
+        if not bc:
+            return None
+        if family == "multinomial":
+            raise ValueError("beta_constraints are not supported for "
+                             "multinomial (reference: GLM.java)")
+        names = list(di.coef_names)
+        items: dict[str, tuple] = {}
+        if isinstance(bc, dict):
+            for k, v in bc.items():
+                items[k] = (v[0], v[1]) if isinstance(v, (tuple, list)) else (v, None)
+        else:
+            for row in bc:
+                items[row["names"]] = (row.get("lower_bounds"),
+                                       row.get("upper_bounds"))
+        unknown = set(items) - set(names) - {"Intercept"}
+        if unknown:
+            raise ValueError(f"beta_constraints name unknown coefficients: "
+                             f"{sorted(unknown)}")
+        K = len(names)
+        lo = np.full(K + 1, -np.inf, np.float64)
+        hi = np.full(K + 1, np.inf, np.float64)
+        for i, n in enumerate(names + ["Intercept"]):
+            if n in items:
+                l, u = items[n]
+                lo[i] = -np.inf if l is None else float(l)
+                hi[i] = np.inf if u is None else float(u)
+        if params["standardize"] and di.num_cols:
+            if "Intercept" in items and np.any(di.num_sub != 0):
+                # original intercept = b_int - Σ b_j·mul_j·sub_j: a box on it
+                # is not a box on the standardized intercept
+                raise ValueError(
+                    "an Intercept beta_constraint cannot be honored with "
+                    "standardize=True over centered numeric columns; set "
+                    "standardize=False")
+            s0, nnum = di.ncats_expanded, len(di.num_cols)
+            mul = di.num_mul.astype(np.float64)       # 1/sd, > 0
+            lo[s0:s0 + nnum] = lo[s0:s0 + nnum] / mul
+            hi[s0:s0 + nnum] = hi[s0:s0 + nnum] / mul
+        return (jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32))
 
     def _irls_fit(self, job: Job, family, tw, X, yy, w, beta, lambda_: float,
                   params) -> tuple[jax.Array, float, int]:
@@ -318,9 +367,16 @@ class GLM(ModelBuilder):
         lam = lambda_ * (1.0 - float(params["alpha"]))
         dev_prev, dev, it = np.inf, np.inf, 0
         nn = bool(params.get("non_negative"))
+        bounds = getattr(self, "_beta_bounds", None)
         for it in range(int(params["max_iterations"])):
             beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
                                        non_negative=nn)
+            if bounds is not None:
+                # projected Newton (reference: GLM.java applies the bounds
+                # inside the ADMM solve; projection after each IRLS step
+                # converges to the same box-constrained optimum for the
+                # smooth objectives handled here)
+                beta_new = jnp.clip(beta_new, bounds[0], bounds[1])
             dev = float(jax.device_get(dev))
             delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
             beta = beta_new
@@ -338,6 +394,8 @@ class GLM(ModelBuilder):
             local = ModelParameters(params)
             local["lambda_"] = lambda_
             beta = self._admm_l1(family, tw, X, yy, w, beta, local)
+            if bounds is not None:
+                beta = jnp.clip(beta, bounds[0], bounds[1])
             dev = float(jax.device_get(_deviance_at(family, tw, X, yy, w, beta)))
         return beta, dev, it
 
@@ -418,6 +476,8 @@ class GLM(ModelBuilder):
         beta = jnp.zeros(k + 1, jnp.float32)
         beta = beta.at[-1].set(float(jax.device_get(
             fam.link((w * mu0).sum() / jnp.maximum(w.sum(), 1e-30)))))
+
+        self._beta_bounds = self._build_beta_bounds(di, params, family)
 
         if bool(params.get("lambda_search")):
             beta, dev, it, lambda_best, reg_path = self._lambda_search(

@@ -68,14 +68,16 @@ def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int
 
     The MRTask analog: per-shard masked segment-sums, psum-reduced by XLA.
     """
-    ghw = jnp.stack([g, h, w], axis=1)
     active = node_local >= 0
     base = jnp.where(active, node_local * n_bins_tot, 0)
-    vals = jnp.where(active[:, None], ghw, 0.0)
+    stats = [jnp.where(active, v, 0.0) for v in (g, h, w)]
 
     def per_feature(_, binf):
         ids = base + jnp.minimum(binf, n_bins_tot - 1)
-        return None, jax.ops.segment_sum(vals, ids, num_segments=n_nodes * n_bins_tot)
+        # per 1-D stat (a [rows, 3] stack pads minor dim to 128 lanes in HBM)
+        outs = [jax.ops.segment_sum(v, ids, num_segments=n_nodes * n_bins_tot)
+                for v in stats]
+        return None, jnp.stack(outs, axis=1)
 
     _, hists = lax.scan(per_feature, None, binned.T)
     return hists
@@ -94,12 +96,14 @@ def _histograms(binned, binned_T, node_local, g, h, w, n_nodes: int,
 
 def _node_totals(node_local, g, h, w, n_nodes: int):
     """Per-node (G, H, W) sums — the feature-independent stats the final
-    level needs (cheaper than a full histogram build)."""
+    level needs (cheaper than a full histogram build). Summed per 1-D stat
+    column: a [rows, 3] stack would pad its minor dim to 128 lanes in HBM
+    (42x memory at 11M rows)."""
     active = node_local >= 0
-    ghw = jnp.stack([g, h, w], axis=1)
-    vals = jnp.where(active[:, None], ghw, 0.0)
     ids = jnp.where(active, node_local, 0)
-    return jax.ops.segment_sum(vals, ids, num_segments=n_nodes)
+    outs = [jax.ops.segment_sum(jnp.where(active, v, 0.0), ids,
+                                num_segments=n_nodes) for v in (g, h, w)]
+    return jnp.stack(outs, axis=1)
 
 
 def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma,

@@ -175,7 +175,7 @@ class XGBoost(GBM):
             reg_alpha=float(p["reg_alpha"]), gamma=float(p["gamma"]),
             min_split_improvement=float(p["min_split_improvement"]))
         mono, reach = self._constraint_arrays(x, frame)
-        fmask = jnp.ones(X.shape[1], bool)
+        fmask = jnp.ones(binned.shape[1], bool)
 
         rate_drop = float(p.get("rate_drop") or 0.0)
         skip_drop = float(p.get("skip_drop") or 0.0)
@@ -191,7 +191,7 @@ class XGBoost(GBM):
         best, since = np.inf, 0
 
         trees, wts, preds = [], [], []   # preds: per-tree [rows] leaf sums
-        Fcur = jnp.full(X.shape[0], f0, jnp.float32)
+        Fcur = jnp.full(binned.shape[0], f0, jnp.float32)
         oc = p.get("offset_column")
         if oc:
             Fcur = Fcur + jnp.nan_to_num(frame.vec(oc).as_float(), nan=0.0)

@@ -62,20 +62,23 @@ def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, N, S, T):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # ns[t, k] = (node[t] == k//3) * ghw[t, k%3]; built once per row tile
+    # ns[k, t] = (node[t] == k//3) * ghw[k%3, t]; built once per row tile.
+    # Inputs arrive ROW-MAJOR-TRANSPOSED ([3, R], [1, R]): a narrow [R, 3]
+    # array in HBM pads its 3-wide minor dim to 128 lanes (42x memory blowup
+    # at 11M rows — an OOM, not a slowdown); [3, R] pads 3 sublanes to 8.
     @pl.when(f == 0)
     def _():
-        nd = n_ref[:, 0]
-        iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, N * 3), 1)
-        ghw_rep = jnp.concatenate([s_ref[:]] * N, axis=1)
-        ns_ref[:] = jnp.where(nd[:, None] == iota_k // 3, ghw_rep, 0.0)
+        nd = n_ref[0, :]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (N * 3, 1), 0)
+        ghw_rep = jnp.concatenate([s_ref[:]] * N, axis=0)          # [N*3, T]
+        ns_ref[:] = jnp.where(nd[None, :] == iota_k // 3, ghw_rep, 0.0)
 
-    binf = b_ref[0, 0, :]                                          # [T] lanes
+    binf = b_ref[0, 0, :].astype(jnp.int32)   # i16 in HBM; upcast per tile
     iota_r = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
     bin_oh_T = (iota_r == binf[None, :]).astype(jnp.float32)       # [S, T]
     # HIGHEST: the MXU's default bf16 operand rounding loses ~0.4% on
     # gradient sums — enough to flip near-tie split decisions
-    acc = jax.lax.dot_general(bin_oh_T, ns_ref[:], (((1,), (0,)), ((), ())),
+    acc = jax.lax.dot_general(bin_oh_T, ns_ref[:], (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32,
                               precision=jax.lax.Precision.HIGHEST)  # [S, N*3]
     out_ref[pl.ds(f * S, S), :] += acc
@@ -100,8 +103,9 @@ def hist_pallas(binned_T, node, g, h, w, n_nodes: int, n_bins_tot: int):
         w = jnp.pad(w, (0, pad))
     Rp = binned_T.shape[1]
     act = node >= 0
-    ghw = jnp.stack([g, h, w], 1) * act[:, None].astype(jnp.float32)
-    nodec = jnp.where(act, node, 0)[:, None]
+    # stats-major [3, R] / [1, R]: see layout note in the kernel
+    ghw_T = jnp.stack([g, h, w], 0) * act[None, :].astype(jnp.float32)
+    nodec = jnp.where(act, node, 0)[None, :]
     out = pl.pallas_call(
         partial(_hist_kernel, N=N, S=S, T=T),
         out_shape=jax.ShapeDtypeStruct((F * S, N * 3), jnp.float32),
@@ -109,13 +113,13 @@ def hist_pallas(binned_T, node, g, h, w, n_nodes: int, n_bins_tot: int):
         in_specs=[
             pl.BlockSpec((1, 1, T), lambda i, f: (f, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, 1), lambda i, f: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, 3), lambda i, f: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda i, f: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, T), lambda i, f: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((F * S, N * 3), lambda i, f: (0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((T, N * 3), jnp.float32)],
-    )(binned_T[:, None, :], nodec, ghw)
+        scratch_shapes=[pltpu.VMEM((N * 3, T), jnp.float32)],
+    )(binned_T[:, None, :], nodec, ghw_T)
     # [F, S, N, 3] → clip bin padding → [F, N, Bt, 3] → [F, N*Bt, 3]
     out = out.reshape(F, S, N, 3)[:, :Bt].transpose(0, 2, 1, 3)
     return out.reshape(F, N * Bt, 3)

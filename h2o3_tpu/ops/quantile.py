@@ -45,15 +45,17 @@ def compute_bin_edges(X_host: np.ndarray, nbins: int) -> np.ndarray:
 
 @jax.jit
 def bin_features(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """Bin a [rows, F] matrix → int32 bins in [0, B]; NaN → B (missing bin).
+    """Bin a [rows, F] matrix → int16 bins in [0, B]; NaN → B (missing bin).
 
     B = edges.shape[1] + 1 regular bins; bin = count of edges <= x.
     """
     nbins = edges.shape[1] + 1
 
+    # int16 halves the HBM footprint of the training set's binned copy —
+    # at HIGGS-11M scale the int32 version alone is 1.2GB (nbins <= 32k)
     def one(e, col):
-        b = jnp.searchsorted(e, col, side="right").astype(jnp.int32)
-        return jnp.where(jnp.isnan(col), nbins, b)
+        b = jnp.searchsorted(e, col, side="right").astype(jnp.int16)
+        return jnp.where(jnp.isnan(col), jnp.int16(nbins), b)
 
     return jax.vmap(one, in_axes=(0, 1), out_axes=1)(edges, X)
 

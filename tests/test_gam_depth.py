@@ -1,0 +1,103 @@
+"""GAM basis families: thin plate (1-D/2-D), monotone I-splines, knots.
+
+Reference: hex/gam/GamSplines (CubicRegressionSplines, ThinPlate*, ISplines),
+splines_non_negative, knot_ids.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gam import GAM
+
+import jax.numpy as jnp
+
+
+def _wavy(rng, n=600):
+    x = rng.uniform(-3, 3, n).astype(np.float32)
+    y = (np.sin(1.7 * x) + 0.3 * x + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    return Frame.from_arrays({"x": x, "y": y}), x, y
+
+
+def _r2(pred, y):
+    return 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+
+
+def test_cr_spline_fit(rng):
+    fr, x, y = _wavy(rng)
+    m = GAM(gam_columns=["x"], num_knots=8, family="gaussian").train(
+        y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert _r2(pred, y) > 0.9
+
+
+def test_thin_plate_1d(rng):
+    fr, x, y = _wavy(rng)
+    m = GAM(gam_columns=["x"], bs=[1], num_knots=8, family="gaussian").train(
+        y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert _r2(pred, y) > 0.9
+
+
+def test_thin_plate_2d(rng):
+    n = 800
+    x1 = rng.uniform(-2, 2, n).astype(np.float32)
+    x2 = rng.uniform(-2, 2, n).astype(np.float32)
+    y = (np.sin(x1) * np.cos(x2) + 0.05 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"a": x1, "b": x2, "y": y})
+    m = GAM(gam_columns=[["a", "b"]], bs=[1], num_knots=12,
+            family="gaussian").train(y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert _r2(pred, y) > 0.85
+
+
+def test_monotone_ispline(rng):
+    n = 700
+    x = rng.uniform(0, 4, n).astype(np.float32)
+    # monotone signal with a flat stretch + noise that tempts overshoot
+    y = (np.minimum(x, 2.0) ** 2 + rng.normal(scale=0.3, size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x": x, "y": y})
+    m = GAM(gam_columns=["x"], bs=[2], num_knots=8, family="gaussian",
+            standardize=False).train(y="y", training_frame=fr)
+    grid = np.linspace(0.05, 3.95, 80).astype(np.float32)
+    gfr = Frame.from_arrays({"x": grid})
+    pred = m.predict(gfr).vec("predict").to_numpy()
+    # monotone non-decreasing fit
+    assert (np.diff(pred) >= -1e-4).all(), np.diff(pred).min()
+    # and still tracks the signal
+    fit = m.predict(fr).vec("predict").to_numpy()
+    assert _r2(fit, y) > 0.8
+
+
+def test_user_knots_and_validation(rng):
+    fr, x, y = _wavy(rng)
+    kn = np.linspace(-2.5, 2.5, 6)
+    m = GAM(gam_columns=["x"], num_knots=6, knot_ids={"x": kn},
+            family="gaussian").train(y="y", training_frame=fr)
+    np.testing.assert_allclose(m.output["knots"]["x"], kn, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="bs=1"):
+        GAM(gam_columns=[["x", "x"]], bs=[0]).train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="unknown"):
+        GAM(gam_columns=["x"], bs=[9]).train(y="y", training_frame=fr)
+
+
+def test_glm_beta_constraints_direct(rng):
+    """The GLM box-constraint machinery GAM rides on."""
+    from h2o3_tpu.models.glm import GLM
+    n = 400
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (2.0 * x1 - 1.5 * x2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+
+    m = GLM(family="gaussian",
+            beta_constraints={"x1": (0.0, 1.0), "x2": (0.0, None)}).train(
+        y="y", training_frame=fr)
+    c = m.coef()
+    assert 0.0 <= c["x1"] <= 1.0 + 1e-5
+    assert c["x2"] >= -1e-6            # truth is -1.5; clamped at 0
+
+    with pytest.raises(ValueError, match="unknown coefficients"):
+        GLM(family="gaussian", beta_constraints={"zzz": (0, 1)}).train(
+            y="y", training_frame=fr)

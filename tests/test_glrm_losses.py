@@ -1,0 +1,135 @@
+"""GLRM generalized losses + regularizers (reference: hex/glrm/GLRM.java,
+GlrmLoss.java, GlrmRegularizer.java)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.decomposition import GLRM, _prox
+
+import jax.numpy as jnp
+
+
+def _lowrank(rng, n=120, m=8, k=2, noise=0.05):
+    A = rng.normal(size=(n, k)).astype(np.float32)
+    Y = rng.normal(size=(k, m)).astype(np.float32)
+    return A @ Y + noise * rng.normal(size=(n, m)).astype(np.float32)
+
+
+def test_absolute_huber_losses_fit(rng):
+    X = _lowrank(rng)
+    # heavy outliers: robust losses should reconstruct the bulk better
+    Xc = X.copy()
+    Xc[:6, 0] += 50.0
+    fr = Frame.from_arrays({f"c{i}": Xc[:, i] for i in range(X.shape[1])})
+    for loss in ("Absolute", "Huber"):
+        m = GLRM(k=2, loss=loss, max_iterations=300, seed=1).train(
+            training_frame=fr)
+        R = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:X.shape[0]]
+        resid = np.abs(R[6:] - X[6:, :])
+        assert np.median(resid) < 0.5, (loss, np.median(resid))
+
+
+def test_poisson_loss_fit(rng):
+    lam = np.exp(_lowrank(rng, noise=0.0) * 0.5)
+    counts = rng.poisson(lam).astype(np.float32)
+    fr = Frame.from_arrays({f"c{i}": counts[:, i]
+                            for i in range(counts.shape[1])})
+    m = GLRM(k=2, loss="Poisson", max_iterations=300, seed=2).train(
+        training_frame=fr)
+    U = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:lam.shape[0]]
+    # exp(u) estimates lambda: correlation with the true rate
+    cor = np.corrcoef(np.exp(U).ravel(), lam.ravel())[0, 1]
+    assert cor > 0.6, cor
+
+
+def test_hinge_logistic_binary(rng):
+    U = _lowrank(rng, noise=0.0)
+    B = (U > 0).astype(np.float32)
+    fr = Frame.from_arrays({f"c{i}": B[:, i] for i in range(B.shape[1])})
+    for loss in ("Hinge", "Logistic"):
+        m = GLRM(k=2, loss=loss, max_iterations=300, seed=3).train(
+            training_frame=fr)
+        Uh = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:B.shape[0]]
+        acc = ((Uh > 0) == (B > 0)).mean()
+        assert acc > 0.85, (loss, acc)
+
+
+def test_categorical_multi_loss(rng):
+    n = 150
+    z = rng.normal(size=(n, 2)).astype(np.float32)
+    # two clusters of categorical behavior driven by the latent factor
+    lab = np.where(z[:, 0] > 0, "hi", "lo")
+    fr = Frame.from_arrays({
+        "cat": lab.astype(object),
+        "num": (2 * z[:, 0] + 0.1 * rng.normal(size=n)).astype(np.float32)})
+    m = GLRM(k=1, multi_loss="Categorical", max_iterations=200, seed=4).train(
+        training_frame=fr)
+    U = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:n]
+    # block argmax recovers the level (Categorical mimpute)
+    pred_level = U[:, :2].argmax(axis=1)
+    codes = fr.vec("cat").to_numpy()
+    acc = (pred_level == codes).mean()
+    assert acc > 0.9, acc
+
+
+def test_ordinal_multi_loss(rng):
+    n = 200
+    z = rng.normal(size=n).astype(np.float32)
+    lvl = np.digitize(z, [-0.5, 0.5])      # 3 ordered levels
+    fr = Frame.from_arrays({
+        "o": np.array(["l0", "l1", "l2"], dtype=object)[lvl],
+        "num": (z + 0.05 * rng.normal(size=n)).astype(np.float32)})
+    m = GLRM(k=1, multi_loss="Ordinal", max_iterations=200, seed=5).train(
+        training_frame=fr)
+    U = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:n]
+    # Ordinal mimpute: count of thresholds passed
+    pred = (U[:, :2] >= 1.0).sum(axis=1)
+    codes = fr.vec("o").to_numpy()
+    assert abs(np.corrcoef(pred, codes)[0, 1]) > 0.7
+
+
+def test_loss_by_col_override(rng):
+    X = _lowrank(rng)
+    cols = {f"c{i}": X[:, i] for i in range(X.shape[1])}
+    fr = Frame.from_arrays(cols)
+    m = GLRM(k=2, loss="Quadratic", loss_by_col=["Absolute"],
+             loss_by_col_idx=[0], max_iterations=100, seed=6).train(
+        training_frame=fr)
+    assert m.output["objective"] > 0
+    with pytest.raises(ValueError, match="unknown loss"):
+        GLRM(k=2, loss="Bogus").train(training_frame=fr)
+
+
+def test_l1_regularizer_sparsifies(rng):
+    X = _lowrank(rng)
+    fr = Frame.from_arrays({f"c{i}": X[:, i] for i in range(X.shape[1])})
+    m = GLRM(k=4, loss="Absolute", regularization_x="L1", gamma_x=2.0,
+             max_iterations=200, seed=7).train(training_frame=fr)
+    A = np.asarray(m.output["x_factor"])[:X.shape[0]]
+    assert (np.abs(A) < 1e-6).mean() > 0.2     # L1 zeroes a chunk of A
+
+
+def test_prox_operators():
+    Z = jnp.asarray(np.float32([[3.0, -1.0, 0.5], [-2.0, 2.0, 0.0]]))
+    np.testing.assert_allclose(_prox(Z, "L1", 1.0),
+                               [[2.0, 0.0, 0.0], [-1.0, 1.0, 0.0]])
+    np.testing.assert_allclose(_prox(Z, "NonNegative", 1.0),
+                               [[3.0, 0.0, 0.5], [-0.0, 2.0, 0.0]])
+    os_ = np.asarray(_prox(Z, "OneSparse", 1.0))
+    assert (os_ > 0).sum(axis=1).tolist() == [1, 1]
+    uo = np.asarray(_prox(Z, "UnitOneSparse", 1.0))
+    np.testing.assert_allclose(uo.sum(axis=1), [1.0, 1.0])
+    sx = np.asarray(_prox(Z, "Simplex", 1.0))
+    np.testing.assert_allclose(sx.sum(axis=1), [1.0, 1.0], atol=1e-5)
+    assert (sx >= 0).all()
+    q = np.asarray(_prox(Z, "Quadratic", 0.5))
+    np.testing.assert_allclose(q, np.asarray(Z) / 2.0)
+
+
+def test_quadratic_exact_path_unchanged(rng):
+    X = _lowrank(rng)
+    fr = Frame.from_arrays({f"c{i}": X[:, i] for i in range(X.shape[1])})
+    m = GLRM(k=2, max_iterations=50, seed=8).train(training_frame=fr)
+    R = np.asarray(m.output["x_factor"] @ m.output["archetypes"])[:X.shape[0]]
+    assert np.sqrt(np.mean((R - X) ** 2)) < 0.1
