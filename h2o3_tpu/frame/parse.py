@@ -168,14 +168,22 @@ def _parse_arff(path: str, key: str | None) -> Frame:
     return frame
 
 
-def _parse_svmlight(path: str, key: str | None) -> Frame:
+#: widths beyond this stay sparse end-to-end (densifying a 10k-wide text
+#: one-hot would not fit HBM — reference keeps CXI chunks sparse throughout)
+_SVMLIGHT_DENSE_MAX_COLS = 1000
+
+
+def _parse_svmlight(path: str, key: str | None):
     """SVMLight sparse format (reference: ``water/parser/SVMLightParser.java``).
 
-    Densified at ingest: TPU compute is dense-friendly; the sparse-chunk codecs
-    of the reference (CXIChunk) have no payoff in HBM for model training.
-    """
+    Narrow files densify at ingest (TPU compute is dense-friendly and every
+    munger applies); wide files return a :class:`SparseFrame` (COO in HBM +
+    matrix-free models — SURVEY.md §7 hard part (c))."""
     from sklearn.datasets import load_svmlight_file
     X, y = load_svmlight_file(path)
+    if X.shape[1] > _SVMLIGHT_DENSE_MAX_COLS:
+        from h2o3_tpu.frame.sparse import parse_svmlight_sparse
+        return parse_svmlight_sparse(path, key=key or _key_from_path(path))
     X = np.asarray(X.todense(), dtype=np.float32)
     cols = {"C0": y.astype(np.float32)}
     for j in range(X.shape[1]):
@@ -183,6 +191,14 @@ def _parse_svmlight(path: str, key: str | None) -> Frame:
     frame = Frame.from_arrays(cols, key=key or _key_from_path(path))
     DKV.put(frame.key, frame)
     return frame
+
+
+def import_svmlight(path: str, key: str | None = None, sparse: bool = True):
+    """Explicit SVMLight entry: ``sparse=True`` always yields a SparseFrame."""
+    if sparse:
+        from h2o3_tpu.frame.sparse import parse_svmlight_sparse
+        return parse_svmlight_sparse(path, key=key or _key_from_path(path))
+    return _parse_svmlight(path, key)
 
 
 def _key_from_path(path: str) -> str:

@@ -1,0 +1,146 @@
+"""Sparse frames — CSR/COO storage for wide-sparse data in HBM.
+
+Reference: sparse chunk codecs ``water/fvec/CXIChunk.java``/``CXFChunk.java``
+store (row-offset, value) pairs so a 10k-wide one-hot/text frame does not
+materialize its zeros; SVMLight ingest (``water/parser/SVMLightParser.java``)
+produces them directly.
+
+TPU-native redesign (SURVEY.md §7 hard part (c)): a padded COO triplet
+(``data``/``row``/``col``, padded with zero-weight entries to a static nnz)
+— every sparse kernel is then a dense gather + ``segment_sum``, the shapes
+XLA compiles well. The two products every linear model needs:
+
+    X @ v      = segment_sum(data * v[col], row)         (rows segments)
+    X.T @ u    = segment_sum(data * u[row], col)         (cols segments)
+
+ride one segment-sum each; a sparse GLM never forms the dense design.
+Dense auxiliary columns (response, weights, offset) stay regular
+:class:`Vec` columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.frame.vec import Vec
+
+
+@dataclasses.dataclass
+class SparseMatrix:
+    """Padded COO on device. Padding entries carry data==0 at (0, 0)."""
+    data: jax.Array      # f32 [nnz_pad]
+    row: jax.Array       # int32 [nnz_pad]
+    col: jax.Array       # int32 [nnz_pad]
+    nrows: int
+    ncols: int
+    nnz: int
+
+    @staticmethod
+    def from_scipy_like(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                        nrows: int, ncols: int, pad_to: int | None = None
+                        ) -> "SparseMatrix":
+        nnz = len(vals)
+        pad = pad_to or max(8, ((nnz + 127) // 128) * 128)
+        d = np.zeros(pad, np.float32)
+        r = np.zeros(pad, np.int32)
+        c = np.zeros(pad, np.int32)
+        d[:nnz] = vals
+        r[:nnz] = rows
+        c[:nnz] = cols
+        return SparseMatrix(jnp.asarray(d), jnp.asarray(r), jnp.asarray(c),
+                            nrows, ncols, nnz)
+
+    def matvec(self, v: jax.Array) -> jax.Array:
+        """X @ v → [nrows] (one gather + one segment_sum)."""
+        return _matvec(self.data, self.row, self.col, v, self.nrows)
+
+    def rmatvec(self, u: jax.Array) -> jax.Array:
+        """X.T @ u → [ncols]."""
+        return _matvec(self.data, self.col, self.row, u, self.ncols)
+
+    def col_sq_weighted(self, w_rows: jax.Array) -> jax.Array:
+        """Σ_r w_r x_{rj}² per column — the diagonal of X'WX (Jacobi
+        preconditioner for the CG solve)."""
+        return jax.ops.segment_sum(self.data * self.data * w_rows[self.row],
+                                   self.col, num_segments=self.ncols)
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros((self.nrows, self.ncols), jnp.float32)
+        return out.at[self.row, self.col].add(self.data)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("n_out",))
+def _matvec(data, seg, idx, v, n_out: int):
+    return jax.ops.segment_sum(data * v[idx], seg, num_segments=n_out)
+
+
+class SparseFrame:
+    """A wide-sparse design + dense side columns (response/weights/offset).
+
+    Mirrors just enough of :class:`Frame` for the sparse model paths; the
+    full munging surface intentionally stays on dense frames (reference
+    sparse chunks are likewise compute-only)."""
+
+    def __init__(self, X: SparseMatrix, dense_cols: dict[str, Vec] | None = None,
+                 key: str | None = None):
+        self.X = X
+        self.dense: dict[str, Vec] = dense_cols or {}
+        self.key = key
+
+    @property
+    def nrows(self) -> int:
+        return self.X.nrows
+
+    @property
+    def ncols(self) -> int:
+        return self.X.ncols
+
+    def vec(self, name: str) -> Vec:
+        return self.dense[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.dense
+
+    def density(self) -> float:
+        return self.X.nnz / max(self.X.nrows * self.X.ncols, 1)
+
+    def __repr__(self) -> str:
+        return (f"SparseFrame({self.nrows} x {self.ncols}, nnz={self.X.nnz}"
+                f" [{100 * self.density():.3f}%], dense={list(self.dense)})")
+
+
+def parse_svmlight_sparse(path: str, key: str | None = None) -> SparseFrame:
+    """SVMLight → SparseFrame, sparse END-TO-END (reference: SVMLightParser
+    fills CXI chunks; round-1 densified here, which OOMed wide data)."""
+    rows, cols, vals, ys = [], [], [], []
+    r = 0
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            ys.append(float(parts[0]))
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                rows.append(r)
+                cols.append(int(i))
+                vals.append(float(v))
+            r += 1
+    ncols = (max(cols) + 1) if cols else 0
+    X = SparseMatrix.from_scipy_like(
+        np.asarray(rows, np.int64), np.asarray(cols, np.int64),
+        np.asarray(vals, np.float64), r, ncols)
+    yv = Vec.from_numpy(np.asarray(ys, np.float32))
+    sf = SparseFrame(X, {"y": yv}, key=key)
+    if key:
+        from h2o3_tpu.utils.registry import DKV
+        DKV.put(key, sf)
+    return sf

@@ -212,7 +212,21 @@ def _multinomial_step(nclasses: int, X, yoh, w, B, l2, l1, non_negative: bool = 
 class GLMModel(Model):
     algo = "glm"
 
-    def _score_raw(self, frame: Frame) -> jax.Array:
+    def _score_raw(self, frame) -> jax.Array:
+        if self.output.get("sparse"):
+            from h2o3_tpu.frame.sparse import SparseFrame
+            if not isinstance(frame, SparseFrame):
+                raise ValueError("this GLM was trained on a SparseFrame; "
+                                 "score SparseFrame inputs")
+            beta = self.output["beta"]
+            eta = frame.X.matvec(beta[:-1]) + beta[-1]
+            fam = self.params["family"]
+            if fam == "binomial":
+                mu = jax.nn.sigmoid(eta)
+                return jnp.stack([1.0 - mu, mu], axis=1)
+            if fam == "poisson":
+                return jnp.exp(jnp.clip(eta, -30, 30))
+            return eta
         X = self.data_info.expand(frame)
         return _glm_score(self.params["family"], self.nclasses or 0,
                           float(self.params.get("theta", 1.0))
@@ -287,6 +301,25 @@ class GLM(ModelBuilder):
     """h2o-py surface: ``H2OGeneralizedLinearEstimator``."""
 
     algo = "glm"
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, weights=None):
+        from h2o3_tpu.frame.sparse import SparseFrame
+        if isinstance(training_frame, SparseFrame):
+            # wide-sparse path: matrix-free IRLS-CG, no dense design
+            from h2o3_tpu.models.glm_sparse import fit_sparse_glm
+            from h2o3_tpu.utils.registry import DKV
+            self.job = Job(f"glm-sparse on {training_frame.key or 'frame'}")
+            self.model = self.job.run(
+                lambda j: fit_sparse_glm(self, j, training_frame,
+                                         y or "y", weights))
+            if self.job.status == Job.FAILED:
+                raise self.job.exception
+            DKV.put(self.model.key, self.model)
+            return self.job.result
+        return super().train(x=x, y=y, training_frame=training_frame,
+                             validation_frame=validation_frame,
+                             weights=weights)
 
     @classmethod
     def defaults(cls) -> dict:

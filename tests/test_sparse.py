@@ -1,0 +1,129 @@
+"""Sparse path: COO frames, matrix-free sparse GLM, SVMLight end-to-end.
+
+Reference: CXIChunk sparse codecs + SVMLightParser; SURVEY.md §7 hard (c).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.sparse import SparseFrame, SparseMatrix, parse_svmlight_sparse
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.glm import GLM
+
+import jax.numpy as jnp
+
+
+def _random_sparse(rng, n, k, nnz_per_row, beta=None):
+    rows, cols, vals = [], [], []
+    for r in range(n):
+        cs = rng.choice(k, size=nnz_per_row, replace=False)
+        for c in cs:
+            rows.append(r)
+            cols.append(c)
+            vals.append(rng.normal())
+    X = SparseMatrix.from_scipy_like(np.asarray(rows), np.asarray(cols),
+                                     np.asarray(vals), n, k)
+    return X
+
+
+def test_sparse_products_match_dense(rng):
+    X = _random_sparse(rng, 60, 40, 5)
+    D = np.asarray(X.to_dense())
+    v = rng.normal(size=40).astype(np.float32)
+    u = rng.normal(size=60).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(X.matvec(jnp.asarray(v))), D @ v,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(X.rmatvec(jnp.asarray(u))), D.T @ u,
+                               rtol=1e-4, atol=1e-5)
+    w = rng.random(60).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(X.col_sq_weighted(jnp.asarray(w))),
+                               (w[:, None] * D * D).sum(0), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_glm_vs_sklearn(rng):
+    n, k = 2000, 300
+    X = _random_sparse(rng, n, k, 8)
+    D = np.asarray(X.to_dense())
+    true_beta = np.zeros(k)
+    true_beta[:10] = rng.normal(size=10) * 2
+    logits = D @ true_beta + 0.3
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+
+    sf = SparseFrame(X, {"y": Vec.from_numpy(y)})
+    m = GLM(family="binomial", lambda_=1e-3, max_iterations=30).train(
+        y="y", training_frame=sf)
+    assert m.output["sparse"] is True
+
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import roc_auc_score
+    sk = LogisticRegression(C=1.0 / (1e-3 * n), max_iter=200).fit(D, y)
+    sk_auc = roc_auc_score(y, sk.decision_function(D))
+    assert m.training_metrics.auc == pytest.approx(sk_auc, abs=2e-3)
+    ours = np.asarray(m.output["beta"])[:-1]
+    cor = np.corrcoef(ours, sk.coef_[0])[0, 1]
+    assert cor > 0.98, cor
+
+
+def test_sparse_glm_gaussian_poisson(rng):
+    n, k = 1000, 100
+    X = _random_sparse(rng, n, k, 6)
+    D = np.asarray(X.to_dense())
+    beta = rng.normal(size=k) * 0.3
+    yg = (D @ beta + 0.1 * rng.normal(size=n)).astype(np.float32)
+    sf = SparseFrame(X, {"y": Vec.from_numpy(yg)})
+    m = GLM(family="gaussian", lambda_=1e-4).train(y="y", training_frame=sf)
+    pred = m.predict(sf).vec("predict").to_numpy()
+    assert np.corrcoef(pred, yg)[0, 1] > 0.98
+
+    lam = np.exp(np.clip(0.3 * (D @ beta), -3, 3))
+    yp = rng.poisson(lam).astype(np.float32)
+    sfp = SparseFrame(X, {"y": Vec.from_numpy(yp)})
+    mp = GLM(family="poisson", lambda_=1e-4, max_iterations=30).train(
+        y="y", training_frame=sfp)
+    predp = mp.predict(sfp).vec("predict").to_numpy()
+    assert np.corrcoef(predp, lam)[0, 1] > 0.5
+
+
+def test_wide_sparse_10k_fits(rng):
+    """The VERDICT 'done' criterion: a 10k-wide sparse train FITS (the
+    densified path would need rows*10k*4B dense HBM plus 128-lane padding)."""
+    n, k = 5000, 10_000
+    X = _random_sparse(rng, n, k, 10)
+    informative = rng.choice(k, 40, replace=False)
+    bt = np.zeros(k)
+    bt[informative] = rng.normal(size=40) * 3
+    D_logit = np.zeros(n)
+    # sparse logit without densifying in the test either
+    d = np.asarray(X.data)[:X.nnz]
+    r = np.asarray(X.row)[:X.nnz]
+    c = np.asarray(X.col)[:X.nnz]
+    np.add.at(D_logit, r, d * bt[c])
+    y = (rng.random(n) < 1 / (1 + np.exp(-D_logit))).astype(np.float32)
+
+    sf = SparseFrame(X, {"y": Vec.from_numpy(y)})
+    assert sf.density() < 0.002
+    m = GLM(family="binomial", lambda_=1e-3, max_iterations=20).train(
+        y="y", training_frame=sf)
+    assert m.training_metrics.auc > 0.7, m.training_metrics.auc
+
+
+def test_svmlight_sparse_end_to_end(tmp_path, rng):
+    lines = []
+    for i in range(300):
+        xa, xb = rng.normal(), rng.normal()
+        label = 1 if xa - xb > 0 else -1
+        # wide indices force the sparse route through import_file too
+        lines.append(f"{label} 7:{xa:.4f} 4321:{xb:.4f}")
+    path = tmp_path / "wide.svm"
+    path.write_text("\n".join(lines) + "\n")
+
+    sf = parse_svmlight_sparse(str(path))
+    assert isinstance(sf, SparseFrame) and sf.ncols == 4322
+    m = GLM(family="binomial", max_iterations=20).train(
+        y="y", training_frame=sf)
+    assert m.training_metrics.auc > 0.95
+
+    from h2o3_tpu.frame.parse import import_file
+    auto = import_file(str(path))
+    assert isinstance(auto, SparseFrame)     # >1000 cols stays sparse
