@@ -105,6 +105,10 @@ class SparseFrame:
     def vec(self, name: str) -> Vec:
         return self.dense[name]
 
+    def row_mask(self):
+        """All rows are logical (COO carries no shard padding)."""
+        return jnp.ones(self.nrows, bool)
+
     def __contains__(self, name: str) -> bool:
         return name in self.dense
 
@@ -118,28 +122,21 @@ class SparseFrame:
 
 def parse_svmlight_sparse(path: str, key: str | None = None) -> SparseFrame:
     """SVMLight → SparseFrame, sparse END-TO-END (reference: SVMLightParser
-    fills CXI chunks; round-1 densified here, which OOMed wide data)."""
-    rows, cols, vals, ys = [], [], [], []
-    r = 0
-    with open(path) as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            ys.append(float(parts[0]))
-            for tok in parts[1:]:
-                i, v = tok.split(":")
-                rows.append(r)
-                cols.append(int(i))
-                vals.append(float(v))
-            r += 1
-    ncols = (max(cols) + 1) if cols else 0
+    fills CXI chunks; round-1 densified here, which OOMed wide data).
+
+    Parsed by sklearn's C loader (qid annotations, comments, auto one-based
+    shift — identical index conventions to the dense route) and converted
+    CSR→COO without ever densifying. The response is named ``C0`` like the
+    dense SVMLight frame, so the width threshold never changes the schema.
+    """
+    from sklearn.datasets import load_svmlight_file
+    Xs, y = load_svmlight_file(path)
+    coo = Xs.tocoo()
     X = SparseMatrix.from_scipy_like(
-        np.asarray(rows, np.int64), np.asarray(cols, np.int64),
-        np.asarray(vals, np.float64), r, ncols)
-    yv = Vec.from_numpy(np.asarray(ys, np.float32))
-    sf = SparseFrame(X, {"y": yv}, key=key)
+        coo.row.astype(np.int64), coo.col.astype(np.int64),
+        coo.data.astype(np.float64), Xs.shape[0], Xs.shape[1])
+    yv = Vec.from_numpy(np.asarray(y, np.float32))
+    sf = SparseFrame(X, {"C0": yv}, key=key)
     if key:
         from h2o3_tpu.utils.registry import DKV
         DKV.put(key, sf)

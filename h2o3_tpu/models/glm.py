@@ -309,14 +309,25 @@ class GLM(ModelBuilder):
             # wide-sparse path: matrix-free IRLS-CG, no dense design
             from h2o3_tpu.models.glm_sparse import fit_sparse_glm
             from h2o3_tpu.utils.registry import DKV
+            if x is not None:
+                raise ValueError("column selection (x) is not supported on "
+                                 "SparseFrame inputs — slice the COO instead")
             self.job = Job(f"glm-sparse on {training_frame.key or 'frame'}")
-            self.model = self.job.run(
-                lambda j: fit_sparse_glm(self, j, training_frame,
-                                         y or "y", weights))
+
+            def driver(j):
+                model = fit_sparse_glm(self, j, training_frame,
+                                       y or "C0", weights)
+                if validation_frame is not None:
+                    model.validation_metrics = model.model_performance(
+                        validation_frame)
+                DKV.put(model.key, model)
+                return model
+
+            self.job.run(driver)
             if self.job.status == Job.FAILED:
                 raise self.job.exception
-            DKV.put(self.model.key, self.model)
-            return self.job.result
+            self.model = self.job.result
+            return self.model
         return super().train(x=x, y=y, training_frame=training_frame,
                              validation_frame=validation_frame,
                              weights=weights)
