@@ -1,0 +1,307 @@
+# h2o3tpu — R client for the h2o3_tpu server (reference surface:
+# /root/reference/h2o-r/h2o-package/R/; this package mirrors the h2o.* verbs
+# h2o-r users call most: init/connect, importFile, gbm/glm/randomForest/
+# deeplearning/kmeans, predict, performance, splitFrame, ls/rm).
+#
+# Dependency-free by design: the image this framework targets carries no
+# CRAN mirror, so HTTP is hand-rolled over base-R socketConnection and JSON
+# is parsed by a small recursive-descent reader (both ~a page each). The
+# wire format is the same V3 schema JSON h2o-py consumes.
+
+.h2o3tpu <- new.env(parent = emptyenv())
+
+# ---------------------------------------------------------------------------
+# minimal JSON reader (objects, arrays, strings, numbers, true/false/null)
+
+.json_parse <- function(txt) {
+  pos <- 1L
+  n <- nchar(txt)
+  peek <- function() substr(txt, pos, pos)
+  skip_ws <- function() {
+    while (pos <= n && peek() %in% c(" ", "\t", "\n", "\r")) pos <<- pos + 1L
+  }
+  parse_value <- function() {
+    skip_ws()
+    ch <- peek()
+    if (ch == "{") return(parse_object())
+    if (ch == "[") return(parse_array())
+    if (ch == '"') return(parse_string())
+    if (ch == "t") { pos <<- pos + 4L; return(TRUE) }
+    if (ch == "f") { pos <<- pos + 5L; return(FALSE) }
+    if (ch == "n") { pos <<- pos + 4L; return(NULL) }
+    parse_number()
+  }
+  parse_object <- function() {
+    pos <<- pos + 1L  # {
+    out <- list()
+    skip_ws()
+    if (peek() == "}") { pos <<- pos + 1L; return(out) }
+    repeat {
+      skip_ws()
+      key <- parse_string()
+      skip_ws()
+      pos <<- pos + 1L  # :
+      val <- parse_value()
+      out[[key]] <- val
+      skip_ws()
+      ch <- peek()
+      pos <<- pos + 1L
+      if (ch == "}") return(out)
+    }
+  }
+  parse_array <- function() {
+    pos <<- pos + 1L  # [
+    out <- list()
+    skip_ws()
+    if (peek() == "]") { pos <<- pos + 1L; return(out) }
+    repeat {
+      out[[length(out) + 1L]] <- parse_value()
+      skip_ws()
+      ch <- peek()
+      pos <<- pos + 1L
+      if (ch == "]") return(out)
+    }
+  }
+  parse_string <- function() {
+    pos <<- pos + 1L  # opening quote
+    start <- pos
+    buf <- character(0)
+    repeat {
+      ch <- peek()
+      if (ch == "\\") {
+        buf <- c(buf, substr(txt, start, pos - 1L))
+        esc <- substr(txt, pos + 1L, pos + 1L)
+        buf <- c(buf, switch(esc, n = "\n", t = "\t", r = "\r",
+                             b = "\b", f = "\f", u = {
+                               code <- substr(txt, pos + 2L, pos + 5L)
+                               pos <<- pos + 4L
+                               intToUtf8(strtoi(code, 16L))
+                             }, esc))
+        pos <<- pos + 2L
+        start <- pos
+      } else if (ch == '"') {
+        buf <- c(buf, substr(txt, start, pos - 1L))
+        pos <<- pos + 1L
+        return(paste0(buf, collapse = ""))
+      } else {
+        pos <<- pos + 1L
+      }
+    }
+  }
+  parse_number <- function() {
+    start <- pos
+    while (pos <= n && peek() %in% c("-", "+", ".", "e", "E",
+                                     as.character(0:9))) pos <<- pos + 1L
+    as.numeric(substr(txt, start, pos - 1L))
+  }
+  parse_value()
+}
+
+.json_escape <- function(s) {
+  s <- gsub("\\\\", "\\\\\\\\", s)
+  s <- gsub('"', '\\\\"', s)
+  s <- gsub("\n", "\\\\n", s)
+  s
+}
+
+# ---------------------------------------------------------------------------
+# HTTP over socketConnection (the server is HTTP/1.1 with Content-Length)
+
+.http <- function(method, path, body = NULL) {
+  host <- .h2o3tpu$host
+  port <- .h2o3tpu$port
+  if (is.null(host)) stop("not connected: call h2o.init()/h2o.connect() first")
+  payload <- ""
+  ctype <- ""
+  if (!is.null(body)) {
+    kv <- vapply(names(body), function(k) {
+      v <- body[[k]]
+      if (is.list(v) || length(v) > 1) {
+        v <- paste0("[", paste0(
+          vapply(v, function(x) if (is.character(x))
+            paste0('"', .json_escape(x), '"') else as.character(x),
+            character(1)), collapse = ","), "]")
+      } else if (is.logical(v)) {
+        v <- if (v) "true" else "false"
+      }
+      paste0(URLencode(k, reserved = TRUE), "=",
+             URLencode(as.character(v), reserved = TRUE))
+    }, character(1))
+    payload <- paste0(kv, collapse = "&")
+    ctype <- "Content-Type: application/x-www-form-urlencoded\r\n"
+  }
+  req <- paste0(method, " ", path, " HTTP/1.1\r\n",
+                "Host: ", host, ":", port, "\r\n",
+                "Connection: close\r\n", ctype,
+                "Content-Length: ", nchar(payload, type = "bytes"), "\r\n",
+                "\r\n", payload)
+  con <- socketConnection(host = host, port = port, open = "r+b",
+                          blocking = TRUE)
+  on.exit(close(con))
+  writeBin(charToRaw(req), con)
+  raw <- raw(0)
+  repeat {
+    chunk <- readBin(con, what = "raw", n = 65536L)
+    if (length(chunk) == 0) break
+    raw <- c(raw, chunk)
+  }
+  resp <- rawToChar(raw)
+  split_at <- regexpr("\r\n\r\n", resp, fixed = TRUE)
+  headers <- substr(resp, 1, split_at - 1)
+  body_txt <- substr(resp, split_at + 4, nchar(resp))
+  status <- as.integer(strsplit(headers, " ")[[1]][2])
+  parsed <- tryCatch(.json_parse(body_txt), error = function(e) body_txt)
+  if (status >= 400) {
+    msg <- if (is.list(parsed) && !is.null(parsed$msg)) parsed$msg else body_txt
+    stop(sprintf("%s %s -> HTTP %d: %s", method, path, status, msg))
+  }
+  parsed
+}
+
+.poll_job <- function(job_key) {
+  repeat {
+    j <- .http("GET", paste0("/3/Jobs/", job_key))$jobs[[1]]
+    if (j$status %in% c("DONE", "FAILED", "CANCELLED")) {
+      if (j$status == "FAILED")
+        stop("job failed: ", if (is.null(j$exception)) "" else j$exception)
+      return(j)
+    }
+    Sys.sleep(0.2)
+  }
+}
+
+# ---------------------------------------------------------------------------
+# public surface (names match h2o-r)
+
+h2o.connect <- function(ip = "localhost", port = 54321, url = NULL) {
+  if (!is.null(url)) {
+    m <- regmatches(url, regexec("^https?://([^:/]+):([0-9]+)", url))[[1]]
+    ip <- m[2]
+    port <- as.integer(m[3])
+  }
+  .h2o3tpu$host <- ip
+  .h2o3tpu$port <- as.integer(port)
+  st <- .http("GET", "/3/Cloud")
+  message(sprintf("Connected to h2o3_tpu cloud '%s' (%d device(s), version %s)",
+                  st$cloud_name, st$cloud_size, st$version))
+  invisible(st)
+}
+
+h2o.init <- function(ip = "localhost", port = 54321, url = NULL, ...) {
+  # attach-only (the server is a python process); mirrors h2o.init's
+  # connect-if-running behavior
+  h2o.connect(ip = ip, port = port, url = url)
+}
+
+h2o.clusterStatus <- function() .http("GET", "/3/Cloud")
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  body <- list(path = path)
+  if (!is.null(destination_frame)) body$destination_frame <- destination_frame
+  out <- .http("POST", "/3/ImportFiles", body)
+  key <- out$destination_frames[[1]]
+  structure(list(frame_id = key), class = "H2OFrame")
+}
+
+h2o.getFrame <- function(id) structure(list(frame_id = id), class = "H2OFrame")
+
+.frame_info <- function(fr) {
+  .http("GET", paste0("/3/Frames/", fr$frame_id))$frames[[1]]
+}
+
+as.data.frame.H2OFrame <- function(x, ...) {
+  info <- .frame_info(x)
+  cols <- info$columns
+  out <- list()
+  for (col in cols) {
+    vals <- col$data
+    if (!is.null(col$string_data)) vals <- col$string_data
+    v <- unlist(lapply(vals, function(z) if (is.null(z)) NA else z))
+    if (!is.null(col$domain) && length(col$domain) > 0 && is.numeric(v)) {
+      v <- unlist(col$domain)[v + 1]
+    }
+    out[[col$label]] <- v
+  }
+  as.data.frame(out, stringsAsFactors = FALSE)
+}
+
+h2o.ls <- function() {
+  frames <- .http("GET", "/3/Frames")$frames
+  vapply(frames, function(f) f$frame_id$name, character(1))
+}
+
+h2o.rm <- function(id) {
+  if (inherits(id, "H2OFrame")) id <- id$frame_id
+  if (inherits(id, "H2OModel")) id <- id$model_id
+  invisible(.http("DELETE", paste0("/3/DKV/", id)))
+}
+
+h2o.removeAll <- function() invisible(.http("DELETE", "/3/DKV"))
+
+h2o.splitFrame <- function(data, ratios = 0.75, destination_frames = NULL,
+                           seed = -1) {
+  n <- length(ratios) + 1
+  if (is.null(destination_frames))
+    destination_frames <- paste0(data$frame_id, "_part", seq_len(n) - 1)
+  out <- .http("POST", "/3/SplitFrame",
+               list(dataset = data$frame_id, ratios = as.list(ratios),
+                    destination_frames = as.list(destination_frames)))
+  .poll_job(out$key$name)
+  lapply(destination_frames, h2o.getFrame)
+}
+
+.train <- function(algo, x, y, training_frame, validation_frame = NULL, ...) {
+  body <- list(training_frame = training_frame$frame_id)
+  if (!is.null(y)) body$response_column <- y
+  if (!is.null(x)) body$x <- as.list(x)
+  if (!is.null(validation_frame))
+    body$validation_frame <- validation_frame$frame_id
+  extra <- list(...)
+  for (k in names(extra)) body[[k]] <- extra[[k]]
+  out <- .http("POST", paste0("/3/ModelBuilders/", algo), body)
+  job <- .poll_job(out$job$key$name)
+  model_id <- job$dest$name
+  mj <- .http("GET", paste0("/3/Models/", model_id))$models[[1]]
+  structure(list(model_id = model_id, algo = algo, json = mj),
+            class = "H2OModel")
+}
+
+h2o.gbm <- function(x = NULL, y, training_frame, ...)
+  .train("gbm", x, y, training_frame, ...)
+
+h2o.glm <- function(x = NULL, y, training_frame, ...)
+  .train("glm", x, y, training_frame, ...)
+
+h2o.randomForest <- function(x = NULL, y, training_frame, ...)
+  .train("drf", x, y, training_frame, ...)
+
+h2o.deeplearning <- function(x = NULL, y, training_frame, ...)
+  .train("deeplearning", x, y, training_frame, ...)
+
+h2o.kmeans <- function(training_frame, x = NULL, ...)
+  .train("kmeans", x, NULL, training_frame, ...)
+
+h2o.predict <- function(object, newdata) {
+  out <- .http("POST", paste0("/3/Predictions/models/", object$model_id,
+                              "/frames/", newdata$frame_id))
+  h2o.getFrame(out$predictions_frame$name)
+}
+
+h2o.performance <- function(model, newdata = NULL) {
+  if (is.null(newdata)) {
+    mm <- model$json$output$training_metrics
+  } else {
+    out <- .http("POST", paste0("/3/ModelMetrics/models/", model$model_id,
+                                "/frames/", newdata$frame_id))
+    mm <- out$model_metrics[[1]]
+  }
+  structure(mm, class = "H2OModelMetrics")
+}
+
+h2o.auc <- function(perf) perf$auc
+h2o.rmse <- function(perf) perf$rmse
+h2o.logloss <- function(perf) perf$logloss
+
+h2o.shutdown <- function(prompt = FALSE) {
+  invisible(tryCatch(.http("POST", "/3/Shutdown"), error = function(e) NULL))
+}

@@ -1,0 +1,37 @@
+# End-to-end smoke of the h2o3tpu R client (run with the server URL as arg):
+#   Rscript clients/r/run_smoke.R http://127.0.0.1:54321 /path/to/train.csv
+# Mirrors the canonical h2o-r session: init -> importFile -> splitFrame ->
+# gbm/glm -> predict -> performance -> rm.
+
+for (f in list.files("clients/r/h2o3tpu/R", full.names = TRUE)) source(f)
+
+args <- commandArgs(trailingOnly = TRUE)
+url <- args[1]
+csv <- args[2]
+
+h2o.connect(url = url)
+stopifnot(h2o.clusterStatus()$cloud_healthy)
+
+fr <- h2o.importFile(csv, destination_frame = "r_train")
+parts <- h2o.splitFrame(fr, ratios = 0.8, seed = 42,
+                        destination_frames = c("r_tr", "r_te"))
+tr <- parts[[1]]
+te <- parts[[2]]
+
+gbm <- h2o.gbm(y = "y", training_frame = tr, ntrees = 5, max_depth = 3)
+perf <- h2o.performance(gbm, newdata = te)
+cat("GBM AUC:", h2o.auc(perf), "\n")
+stopifnot(h2o.auc(perf) > 0.7)
+
+pred <- h2o.predict(gbm, te)
+pdf_ <- as.data.frame(pred)
+stopifnot(nrow(pdf_) >= 1, "predict" %in% names(pdf_))
+
+glm <- h2o.glm(y = "y", training_frame = tr, family = "binomial")
+cat("GLM logloss:",
+    h2o.logloss(h2o.performance(glm, newdata = te)), "\n")
+
+stopifnot(length(h2o.ls()) >= 3)
+h2o.rm(pred)
+h2o.removeAll()
+cat("R_CLIENT_SMOKE_OK\n")

@@ -1,0 +1,147 @@
+"""R client slice (reference: h2o-r/h2o-package/R/).
+
+With Rscript in the image the real package runs end-to-end; without it, the
+contract test replays the exact HTTP/1.1 byte sequences the R client emits
+(hand-rolled socket HTTP, urlencoded bodies) so the server-side contract is
+pinned either way.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.utils.registry import DKV
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _csv(tmp_path, rng, n=400):
+    x = rng.normal(size=(n, 3))
+    y = np.where(x[:, 0] - x[:, 1] > 0, "yes", "no")
+    lines = ["a,b,c,y"] + [f"{r[0]:.4f},{r[1]:.4f},{r[2]:.4f},{lbl}"
+                           for r, lbl in zip(x, y)]
+    p = tmp_path / "r_train.csv"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None, reason="no R in image")
+def test_r_client_end_to_end(server, tmp_path, rng):
+    csv = _csv(tmp_path, rng)
+    proc = subprocess.run(
+        ["Rscript", os.path.join(REPO, "clients", "r", "run_smoke.R"),
+         server.url, csv],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "R_CLIENT_SMOKE_OK" in proc.stdout
+
+
+def _raw_http(server, method, path, body=None):
+    """Byte-for-byte what clients/r/h2o3tpu .http() sends."""
+    payload = ""
+    ctype = ""
+    if body is not None:
+        payload = urllib.parse.urlencode(body)
+        ctype = "Content-Type: application/x-www-form-urlencoded\r\n"
+    req = (f"{method} {path} HTTP/1.1\r\n"
+           f"Host: {server.host}:{server.port}\r\n"
+           "Connection: close\r\n" + ctype +
+           f"Content-Length: {len(payload.encode())}\r\n\r\n{payload}")
+    with socket.create_connection((server.host, server.port)) as sk:
+        sk.sendall(req.encode())
+        chunks = []
+        while True:
+            b = sk.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+    resp = b"".join(chunks).decode()
+    head, _, body_txt = resp.partition("\r\n\r\n")
+    status = int(head.split(" ")[1])
+    import json
+    try:
+        return status, json.loads(body_txt)
+    except json.JSONDecodeError:
+        return status, body_txt
+
+
+def test_r_wire_contract(server, tmp_path, rng):
+    """The exact request sequence run_smoke.R performs, over raw sockets."""
+    csv = _csv(tmp_path, rng)
+
+    st, cloud = _raw_http(server, "GET", "/3/Cloud")
+    assert st == 200 and cloud["cloud_healthy"]
+
+    st, imp = _raw_http(server, "POST", "/3/ImportFiles",
+                        {"path": csv, "destination_frame": "r_train"})
+    assert st == 200 and imp["destination_frames"] == ["r_train"]
+
+    st, split = _raw_http(server, "POST", "/3/SplitFrame",
+                          {"dataset": "r_train", "ratios": "[0.8]",
+                           "destination_frames": '["r_tr","r_te"]'})
+    assert st == 200
+    # poll like .poll_job
+    import time
+    for _ in range(100):
+        st, job = _raw_http(server, "GET",
+                            f"/3/Jobs/{split['key']['name']}")
+        if job["jobs"][0]["status"] == "DONE":
+            break
+        time.sleep(0.1)
+    assert job["jobs"][0]["status"] == "DONE"
+
+    st, tr = _raw_http(server, "POST", "/3/ModelBuilders/gbm",
+                       {"training_frame": "r_tr", "response_column": "y",
+                        "ntrees": 5, "max_depth": 3})
+    assert st == 200
+    jkey = tr["job"]["key"]["name"]
+    for _ in range(300):
+        st, job = _raw_http(server, "GET", f"/3/Jobs/{jkey}")
+        if job["jobs"][0]["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert job["jobs"][0]["status"] == "DONE", job
+    model_id = job["jobs"][0]["dest"]["name"]
+
+    st, mm = _raw_http(server, "POST",
+                       f"/3/ModelMetrics/models/{model_id}/frames/r_te")
+    assert st == 200 and mm["model_metrics"][0]["auc"] > 0.7
+
+    st, pred = _raw_http(server, "POST",
+                         f"/3/Predictions/models/{model_id}/frames/r_te")
+    assert st == 200
+    pkey = pred["predictions_frame"]["name"]
+    st, fr = _raw_http(server, "GET", f"/3/Frames/{pkey}")
+    labels = [c["label"] for c in fr["frames"][0]["columns"]]
+    assert "predict" in labels
+
+    st, _ = _raw_http(server, "DELETE", "/3/DKV")
+    assert st == 200
+    assert "r_tr" not in DKV
+
+
+def test_r_package_sources_complete():
+    """The shipped package exports every verb the smoke script uses."""
+    pkg = os.path.join(REPO, "clients", "r", "h2o3tpu")
+    ns = open(os.path.join(pkg, "NAMESPACE")).read()
+    code = open(os.path.join(pkg, "R", "h2o3tpu.R")).read()
+    for fn in ("h2o.init", "h2o.connect", "h2o.importFile", "h2o.gbm",
+               "h2o.glm", "h2o.predict", "h2o.performance", "h2o.splitFrame",
+               "h2o.auc", "h2o.removeAll"):
+        assert f"export({fn})" in ns, fn
+        assert f"{fn} <- function" in code, fn
