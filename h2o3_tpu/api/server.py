@@ -130,6 +130,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
+        auth = getattr(self.server, "_auth", None)
+        import hmac
+        if auth is not None and not hmac.compare_digest(
+                self.headers.get("Authorization") or "", auth):
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", "Basic realm=h2o3_tpu")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         try:
             for pat, m, fn in _ROUTES:
                 match = re.fullmatch(pat, path)
@@ -1110,11 +1119,21 @@ _ROUTES = [
 class H2OServer:
     """Embeddable REST server (reference: ``water.H2OApp`` + Jetty)."""
 
-    def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 54321, host: str = "127.0.0.1",
+                 username: str | None = None, password: str | None = None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd._session_id = f"_sid_{uuid.uuid4().hex[:10]}"
         self.httpd._session_props = {}
         self.httpd._rapids_sessions = {}
+        # hash-login auth (reference: water/H2O.java:242-266 -hash_login;
+        # LDAP/Kerberos/SPNEGO are JVM-infra features with no counterpart)
+        if username is not None:
+            import base64
+            token = base64.b64encode(
+                f"{username}:{password or ''}".encode()).decode()
+            self.httpd._auth = f"Basic {token}"
+        else:
+            self.httpd._auth = None
         self.host, self.port = host, self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -54,6 +54,12 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
         return _parse_svmlight(path, key)
     elif ext == "arff":
         return _parse_arff(path, key)
+    elif ext == "avro":
+        from h2o3_tpu.frame.binfmt import parse_avro
+        return parse_avro(path, key or _key_from_path(path))
+    elif ext in ("xlsx", "xls"):
+        from h2o3_tpu.frame.binfmt import parse_xlsx
+        return parse_xlsx(path, key or _key_from_path(path))
     else:
         if ext in ("csv", "txt", "data") and na_strings is None and header == 0 \
                 and (sep is None or len(sep) == 1):

@@ -114,6 +114,53 @@ def response_as_float(vec) -> tuple[jax.Array, jax.Array]:
     return yy, valid
 
 
+def expand_interactions(frame, interactions: list[str], domains=None):
+    """Pairwise interaction columns among ``interactions`` (reference:
+    ``hex/DataInfo.java`` interactions / ``CreateInteractions``):
+
+    - num × num → elementwise product column ``a_b``
+    - cat × num → one numeric column per level: ``cat.lvl_num`` (indicator
+      times the numeric value)
+    - cat × cat → combined factor ``a_b`` (level cross)
+
+    Returns an EXTENDED frame (originals untouched); both train and score
+    paths route through here so the expansion cannot drift. ``domains``
+    (``{col: train_domain}``, captured at train) pins the cat×num column
+    set: a scoring batch missing some training level still produces every
+    design column (its indicator is simply all-zero)."""
+    import itertools
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.types import VecType
+    from h2o3_tpu.frame.vec import Vec
+
+    domains = domains or {}
+    out = Frame(list(frame.names), list(frame.vecs), key=frame.key)
+    for a, b in itertools.combinations(interactions, 2):
+        va, vb = frame.vec(a), frame.vec(b)
+        name = f"{a}_{b}"
+        if va.is_categorical and vb.is_categorical:
+            from h2o3_tpu.frame.utils import interaction as cat_cross
+            crossed = cat_cross(frame, [[a, b]], pairwise=True)
+            out.add(name, crossed.vec(0))
+        elif not va.is_categorical and not vb.is_categorical:
+            out.add(name, Vec(va.as_float() * vb.as_float(), VecType.NUM,
+                              frame.nrows))
+        else:
+            cat, num = (va, vb) if va.is_categorical else (vb, va)
+            cname = a if va.is_categorical else b
+            dom = domains.get(cname, cat.domain or ())
+            codes = cat.data
+            if cat.domain != tuple(dom):
+                codes = _remap_codes(codes, cat.domain or (), tuple(dom))
+            for li, lvl in enumerate(dom):
+                ind = (codes == li).astype(jnp.float32)
+                out.add(f"{cname}.{lvl}_{name}",
+                        Vec(ind * jnp.nan_to_num(num.as_float(), nan=0.0),
+                            VecType.NUM, frame.nrows))
+    return out
+
+
 def response_adapted(vec, train_domain) -> tuple[jax.Array, jax.Array]:
     """Response as f32 + validity, remapped to the TRAIN domain when the
     frame's categorical levels differ (``Model.adaptTestForTrain`` semantics;

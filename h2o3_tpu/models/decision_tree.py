@@ -61,7 +61,7 @@ class DecisionTree(SharedTreeBuilder):
         h = w
         key = jax.random.PRNGKey(int(p.get("seed") or 0) or 5)
         tree = grow_tree(binned, edges, g, h, w, tp,
-                         jnp.ones(X.shape[1], bool), key=key)
+                         jnp.ones(binned.shape[1], bool), key=key)
         job.update(1.0, "tree grown")
 
         return DecisionTreeModel(

@@ -95,16 +95,18 @@ def _nn_solve(gram, rhs, beta0, tol: float = 1e-7, max_passes: int = 100):
 
 @partial(jax.jit, static_argnames=("family", "tweedie_p", "non_negative"))
 def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
-               non_negative: bool = False):
+               non_negative: bool = False, off=0.0):
     """One IRLS iteration: weighted Gram + Cholesky solve (all on device);
-    under ``non_negative`` the same system is solved with projected CD."""
+    under ``non_negative`` the same system is solved with projected CD.
+    ``off`` is the per-row margin offset (reference offset_column: enters
+    eta but is excluded from the working response the solve fits)."""
     fam = _fam(family, tweedie_p)
-    eta = X @ beta[:-1] + beta[-1]
+    eta = X @ beta[:-1] + beta[-1] + off
     mu = fam.linkinv(eta)
     d = fam.dmu_deta(eta)
     var = fam.variance(mu)
     W = w * d * d / jnp.maximum(var, 1e-12)
-    z = eta + (y - mu) / jnp.maximum(d, 1e-12)
+    z = eta + (y - mu) / jnp.maximum(d, 1e-12) - off
     nobs = jnp.maximum(w.sum(), 1.0)
     gram, rhs = _weighted_gram(X, W, z, l2, nobs, 1e-5)
     if non_negative:
@@ -117,10 +119,11 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
 
 
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
-def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2):
+def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2,
+                  off=0.0):
     """Per-coefficient proximal threshold lam1*nobs/(gram_jj + lam2*nobs)."""
     fam = _fam(family, tweedie_p)
-    eta = X @ beta[:-1] + beta[-1]
+    eta = X @ beta[:-1] + beta[-1] + off
     d = fam.dmu_deta(eta)
     W = w * d * d / jnp.maximum(fam.variance(fam.linkinv(eta)), 1e-12)
     nobs = jnp.maximum(w.sum(), 1.0)
@@ -152,9 +155,9 @@ def _wald_inference(family: str, tw: float, X, yy, w, beta, dev: float):
 
 
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
-def _deviance_at(family: str, tweedie_p: float, X, y, w, beta):
+def _deviance_at(family: str, tweedie_p: float, X, y, w, beta, off=0.0):
     fam = _fam(family, tweedie_p)
-    mu = fam.linkinv(X @ beta[:-1] + beta[-1])
+    mu = fam.linkinv(X @ beta[:-1] + beta[-1] + off)
     return (w * fam.deviance(y, mu)).sum()
 
 
@@ -166,11 +169,12 @@ def _null_deviance(family: str, tweedie_p: float, y, w):
 
 
 @partial(jax.jit, static_argnames=("family", "nclasses", "tweedie_p"))
-def _glm_score(family: str, nclasses: int, tweedie_p: float, X, beta):
+def _glm_score(family: str, nclasses: int, tweedie_p: float, X, beta,
+               off=0.0):
     if family == "multinomial":
         return jax.nn.softmax(X @ beta[:-1, :] + beta[-1, :][None, :], axis=1)
     fam = _fam(family, tweedie_p)
-    mu = fam.linkinv(X @ beta[:-1] + beta[-1])
+    mu = fam.linkinv(X @ beta[:-1] + beta[-1] + off)
     if nclasses == 2:
         return jnp.stack([1.0 - mu, mu], axis=1)
     return mu
@@ -227,12 +231,33 @@ class GLMModel(Model):
             if fam == "poisson":
                 return jnp.exp(jnp.clip(eta, -30, 30))
             return eta
+        if self.params["family"] == "ordinal":
+            X = self.data_info.expand(frame)
+            eta = X @ self.output["beta"]
+            theta = self.output["ordinal_theta"]
+            cum = jax.nn.sigmoid(theta[None, :] - eta[:, None])
+            cdf = jnp.concatenate(
+                [jnp.zeros((X.shape[0], 1)), cum,
+                 jnp.ones((X.shape[0], 1))], axis=1)
+            return jnp.diff(cdf, axis=1)        # [n, J] class probabilities
+        oc = self.params.get("offset_column")
+        off = 0.0
+        if oc:
+            if oc not in frame:
+                raise ValueError(f"scoring frame lacks offset column {oc!r}")
+            import jax.numpy as _jnp
+            off = _jnp.nan_to_num(frame.vec(oc).as_float(), nan=0.0)
+        if self.params.get("interactions"):
+            from h2o3_tpu.models.data_info import expand_interactions
+            frame = expand_interactions(
+                frame, self.params["interactions"],
+                self.output.get("interaction_domains"))
         X = self.data_info.expand(frame)
         return _glm_score(self.params["family"], self.nclasses or 0,
                           float(self.params.get("theta", 1.0))
                           if self.params["family"] == "negativebinomial"
                           else float(self.params["tweedie_variance_power"]),
-                          X, self.output["beta"])
+                          X, self.output["beta"], off)
 
     def coef(self):
         """Coefficients on the original scale (reference: GLMModel.coefficients()).
@@ -355,7 +380,96 @@ class GLM(ModelBuilder):
             lambda_min_ratio=1e-4,
             beta_constraints=None,    # {name: (lower, upper)} or h2o-frame
             #                           style [{"names","lower_bounds",...}]
+            offset_column=None,       # per-row margin offset
+            interactions=None,        # columns to cross (DataInfo interactions)
         )
+
+    def _fit_ordinal(self, job: Job, frame, x, y, weights, yvec) -> "GLMModel":
+        """Proportional-odds cumulative-logit fit (reference: GLM.java
+        ordinal family, ``GLMModel.GLMParameters.Family.ordinal`` — the
+        reference solves it by gradient descent too).
+
+        P(y <= j) = sigmoid(theta_j - x·beta) with ordered thresholds
+        theta_1 < ... < theta_{J-1} (parameterized theta_j = a + Σ
+        softplus(d_i) so ordering is free); full-batch Adam inside one
+        ``lax.scan``."""
+        params = self.params
+        if params.get("interactions") or params.get("offset_column"):
+            raise ValueError("interactions/offset_column are not supported "
+                             "for the ordinal family")
+        di = DataInfo.make(frame, x, standardize=params["standardize"],
+                           use_all_factor_levels=params["use_all_factor_levels"])
+        X = di.expand(frame)
+        codes = yvec.data.astype(jnp.int32)
+        valid = codes >= 0
+        w = weights * valid
+        yc = jnp.where(valid, codes, 0)
+        J = yvec.cardinality()
+        K = X.shape[1]
+        lam = float(params["lambda_"])
+
+        def unpack(p):
+            beta, a, d = p[:K], p[K], p[K + 1:]
+            theta = a + jnp.concatenate(
+                [jnp.zeros(1), jnp.cumsum(jax.nn.softplus(d))])
+            return beta, theta
+
+        def nll(p):
+            beta, theta = unpack(p)
+            eta = X @ beta
+            cum = jax.nn.sigmoid(theta[None, :] - eta[:, None])   # [n, J-1]
+            cdf = jnp.concatenate(
+                [jnp.zeros((X.shape[0], 1)), cum,
+                 jnp.ones((X.shape[0], 1))], axis=1)
+            pj = jnp.take_along_axis(cdf, yc[:, None] + 1, 1)[:, 0] \
+                - jnp.take_along_axis(cdf, yc[:, None], 1)[:, 0]
+            nobs = jnp.maximum(w.sum(), 1.0)
+            return (-(w * jnp.log(jnp.maximum(pj, 1e-12))).sum()
+                    + lam * nobs * (beta * beta).sum()) / nobs
+
+        p0 = jnp.zeros(K + J - 1, jnp.float32)
+        iters = max(int(params["max_iterations"]), 1) * 20
+        lr = 0.5
+
+        @jax.jit
+        def run(p0):
+            grad = jax.grad(nll)
+
+            def body(carry, _):
+                p, m, v, t = carry
+                g = grad(p)
+                m = 0.9 * m + 0.1 * g
+                v = 0.999 * v + 0.001 * g * g
+                t = t + 1
+                mh = m / (1 - 0.9 ** t)
+                vh = v / (1 - 0.999 ** t)
+                p = p - lr * mh / (jnp.sqrt(vh) + 1e-8)
+                return (p, m, v, t), None
+
+            (p, _, _, _), _ = jax.lax.scan(
+                body, (p0, jnp.zeros_like(p0), jnp.zeros_like(p0), 0.0),
+                None, length=iters)
+            return p, nll(p)
+
+        p, final = run(p0)
+        job.update(0.9, f"ordinal nll {float(jax.device_get(final)):.5f}")
+        beta, theta = unpack(p)
+
+        from h2o3_tpu.models.model_base import ModelParameters
+        mparams = ModelParameters(params)
+        mparams["family"] = "ordinal"
+        model = GLMModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=mparams, data_info=di, response_column=y,
+            response_domain=yvec.domain,
+            output=dict(beta=beta, coef=np.asarray(jax.device_get(beta)),
+                        coef_names=di.coef_names,
+                        ordinal_theta=theta,
+                        residual_deviance=2.0 * float(jax.device_get(final)),
+                        iterations=iters, family="ordinal",
+                        lambda_best=lam, regularization_path=None),
+        )
+        return model
 
     def _build_beta_bounds(self, di, params, family: str):
         """[lo, hi] per coefficient (+intercept) from ``beta_constraints``
@@ -412,9 +526,10 @@ class GLM(ModelBuilder):
         dev_prev, dev, it = np.inf, np.inf, 0
         nn = bool(params.get("non_negative"))
         bounds = getattr(self, "_beta_bounds", None)
+        off = getattr(self, "_offset", 0.0)
         for it in range(int(params["max_iterations"])):
             beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
-                                       non_negative=nn)
+                                       non_negative=nn, off=off)
             if bounds is not None:
                 # projected Newton (reference: GLM.java applies the bounds
                 # inside the ADMM solve; projection after each IRLS step
@@ -440,7 +555,8 @@ class GLM(ModelBuilder):
             beta = self._admm_l1(family, tw, X, yy, w, beta, local)
             if bounds is not None:
                 beta = jnp.clip(beta, bounds[0], bounds[1])
-            dev = float(jax.device_get(_deviance_at(family, tw, X, yy, w, beta)))
+            dev = float(jax.device_get(_deviance_at(family, tw, X, yy, w,
+                                                    beta, off)))
         return beta, dev, it
 
     def _lambda_search(self, job: Job, family, tw, X, yy, w, beta, params):
@@ -488,6 +604,10 @@ class GLM(ModelBuilder):
         yvec = frame.vec(y)
         family = params["family"]
         if yvec.is_categorical:
+            if family == "ordinal":
+                if yvec.cardinality() < 3:
+                    raise ValueError("ordinal family needs >= 3 ordered levels")
+                return self._fit_ordinal(job, frame, x, y, weights, yvec)
             # multinomial family is honored even for 2-level responses
             # (reference: GLM.java accepts multinomial on a binary y)
             if family == "multinomial" or yvec.cardinality() != 2:
@@ -506,6 +626,21 @@ class GLM(ModelBuilder):
         tw = (float(params.get("theta", 1.0)) if family == "negativebinomial"
               else float(params["tweedie_variance_power"]))
 
+        if params.get("interactions"):
+            from h2o3_tpu.models.data_info import expand_interactions
+            inter = list(params["interactions"])
+            bad = set(inter) - set(frame.names)
+            if bad:
+                raise ValueError(f"interactions name unknown columns: "
+                                 f"{sorted(bad)}")
+            self._interaction_domains = {
+                c: frame.vec(c).domain for c in inter
+                if frame.vec(c).is_categorical}
+            before = set(frame.names)
+            frame = expand_interactions(frame, inter,
+                                        self._interaction_domains)
+            x = list(x) + [c for c in frame.names if c not in before]
+
         di = DataInfo.make(frame, x, standardize=params["standardize"],
                            use_all_factor_levels=params["use_all_factor_levels"])
         X = di.expand(frame)
@@ -522,6 +657,14 @@ class GLM(ModelBuilder):
             fam.link((w * mu0).sum() / jnp.maximum(w.sum(), 1e-30)))))
 
         self._beta_bounds = self._build_beta_bounds(di, params, family)
+        oc = params.get("offset_column")
+        if oc:
+            if family == "multinomial":
+                raise ValueError("offset_column is not supported for "
+                                 "multinomial")
+            self._offset = jnp.nan_to_num(frame.vec(oc).as_float(), nan=0.0)
+        else:
+            self._offset = 0.0
 
         if bool(params.get("lambda_search")):
             beta, dev, it, lambda_best, reg_path = self._lambda_search(
@@ -547,7 +690,9 @@ class GLM(ModelBuilder):
         output = dict(beta=beta, coef=coef, coef_names=di.coef_names,
                       residual_deviance=dev, null_deviance=null_dev,
                       iterations=it + 1, family=family,
-                      lambda_best=lambda_best, regularization_path=reg_path)
+                      lambda_best=lambda_best, regularization_path=reg_path,
+                      interaction_domains=getattr(
+                          self, "_interaction_domains", None))
         if bool(params.get("compute_p_values")):
             if float(params["lambda_"]) > 0 or bool(params.get("lambda_search")):
                 raise ValueError("compute_p_values requires no regularization "
@@ -642,9 +787,11 @@ class GLM(ModelBuilder):
         lam1 = float(params["lambda_"]) * float(params["alpha"])
         lam2 = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
         nn = bool(params.get("non_negative"))
+        off = getattr(self, "_offset", 0.0)
         for _ in range(10):
-            beta, _ = _irls_step(family, tw, X, yy, w, beta, lam2, non_negative=nn)
-            thr = _l1_threshold(family, tw, X, yy, w, beta, lam1, lam2)
+            beta, _ = _irls_step(family, tw, X, yy, w, beta, lam2,
+                                 non_negative=nn, off=off)
+            thr = _l1_threshold(family, tw, X, yy, w, beta, lam1, lam2, off)
             mag = jnp.abs(beta[:-1])
             beta = beta.at[:-1].set(jnp.sign(beta[:-1]) * jnp.maximum(mag - thr, 0.0))
         return beta

@@ -80,6 +80,9 @@ class Model:
         self.cv_holdout_predictions = None   # [plen] or [plen, K] OOF preds
         self.cv_holdout_mask = None
         self.run_time_ms: int = 0
+        # transformers applied to every scoring frame (reference: AutoML
+        # bundles the TargetEncoder into the model's scoring pipeline)
+        self.preprocessors: list = []
 
     # -- problem type --------------------------------------------------------
 
@@ -98,8 +101,17 @@ class Model:
         for classification. Implemented per algorithm."""
         raise NotImplementedError
 
+    def _preprocess(self, frame: Frame) -> Frame:
+        for p in self.preprocessors:
+            added = [f"{c}_te" for c in p.output.get("columns", [])]
+            if added and all(c in frame for c in added):
+                continue                      # already transformed
+            frame = p.transform(frame)
+        return frame
+
     def predict(self, frame: Frame) -> Frame:
         """Score a frame (reference: ``Model.score`` → prediction frame)."""
+        frame = self._preprocess(frame)
         raw = self._score_raw(frame)
         n = frame.nrows
         if not self.is_classifier:
@@ -116,6 +128,7 @@ class Model:
         ``ModelMetrics`` builders run inside BigScore)."""
         if self.response_column not in frame:
             raise ValueError(f"frame lacks response column {self.response_column!r}")
+        frame = self._preprocess(frame)
         raw = self._score_raw(frame)
         yvec = frame.vec(self.response_column)
         mask = frame.row_mask()
@@ -193,6 +206,7 @@ class ModelBuilder:
             max_runtime_secs=0.0,
             keep_cross_validation_predictions=False,
             checkpoint=None,     # prior model (key or Model) to resume from
+            custom_metric_func=None,   # python callable (preds, y, w) -> value
         )
 
     def _resolve_checkpoint(self) -> "Model | None":
@@ -217,6 +231,31 @@ class ModelBuilder:
              weights: jax.Array) -> Model:
         """Train on rows where weights>0; must honor job.update/cancel."""
         raise NotImplementedError
+
+    def _apply_custom_metric(self, model: Model, frame: Frame, y: str,
+                             weights, fn) -> None:
+        """Evaluate a user metric callable on the training predictions and
+        attach it to the metrics object (reference: custom_metric_func via
+        water/udf — here a plain python function, no jar upload)."""
+        import numpy as np
+
+        from h2o3_tpu.models.data_info import response_adapted
+        from h2o3_tpu.parallel.distributed import fetch
+        raw = fetch(model._score_raw(frame))[: frame.nrows]
+        yv, valid = response_adapted(
+            frame.vec(y),
+            model.response_domain if model.is_classifier else None)
+        ok = fetch(frame.row_mask() & valid)[: frame.nrows]
+        w = fetch(weights)[: frame.nrows] * ok
+        value = fn(np.asarray(raw), fetch(yv)[: frame.nrows], np.asarray(w))
+        mm = model.training_metrics
+        try:
+            mm.custom_metric_name = getattr(fn, "__name__", "custom")
+            mm.custom_metric_value = float(value)
+        except AttributeError:   # frozen dataclass
+            object.__setattr__(mm, "custom_metric_name",
+                               getattr(fn, "__name__", "custom"))
+            object.__setattr__(mm, "custom_metric_value", float(value))
 
     # -- public train API (mirrors h2o-py estimator.train) -------------------
 
@@ -259,6 +298,11 @@ class ModelBuilder:
             model.run_time_ms = int((time.time() - t0) * 1000)
             if y is not None:
                 model.training_metrics = self._holdout_metrics(model, frame, y, base_w)
+                cmf = self.params.get("custom_metric_func")
+                if cmf is not None and model.training_metrics is not None:
+                    # user UDF metric (reference: water/udf CFuncRef custom
+                    # metrics; here a python callable (preds, y, w) -> value)
+                    self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
             nfolds = int(self.params.get("nfolds") or 0)

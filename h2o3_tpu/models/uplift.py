@@ -168,7 +168,7 @@ class UpliftDRF(SharedTreeBuilder):
                 ws.append(wk)
             grown, _ = grow_trees_batched(
                 binned, edges, jnp.stack(gs), jnp.stack(hs), jnp.stack(ws),
-                tp, jnp.ones(X.shape[1], bool), col_rate, keys[-1])
+                tp, jnp.ones(binned.shape[1], bool), col_rate, keys[-1])
             trees.extend(grown)
             job.update((s + k) / ntrees, f"{s + k}/{ntrees} trees")
 

@@ -46,7 +46,9 @@ class AutoML:
     def __init__(self, max_models: int = 0, max_runtime_secs: float = 0.0,
                  seed: int = -1, nfolds: int = 5, sort_metric: str | None = None,
                  exclude_algos: Sequence[str] = (), include_algos: Sequence[str] | None = None,
-                 project_name: str | None = None):
+                 project_name: str | None = None,
+                 preprocessing: Sequence[str] | None = None,
+                 exploitation_ratio: float = 0.1):
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0   # reference default budget
         self.max_models = int(max_models)
@@ -58,6 +60,8 @@ class AutoML:
         self.include_algos = ({a.upper() for a in include_algos}
                               if include_algos is not None else None)
         self.project_name = project_name or f"automl_{int(time.time())}"
+        self.preprocessing = list(preprocessing or [])
+        self.exploitation_ratio = float(exploitation_ratio)
         self.leaderboard: Leaderboard | None = None
         self.event_log = EventLog()
         self._t0 = 0.0
@@ -66,7 +70,8 @@ class AutoML:
     # -- budget --------------------------------------------------------------
 
     def _budget_left(self) -> bool:
-        if self.max_models and self._n_built >= self.max_models:
+        cap = getattr(self, "_cap", 0) or self.max_models
+        if cap and self._n_built >= cap:
             return False
         if self.max_runtime_secs and time.time() - self._t0 > self.max_runtime_secs:
             return False
@@ -145,7 +150,39 @@ class AutoML:
         common = dict(nfolds=self.nfolds, seed=self.seed,
                       keep_cross_validation_predictions=True)
         base_models: list[Model] = []
+        # reserve the exploitation share of the model budget (reference:
+        # WorkAllocations gives the exploitation steps their own allocation)
+        reserved = (max(1, int(round(self.max_models * self.exploitation_ratio)))
+                    if self.max_models and self.exploitation_ratio > 0 else 0)
+        self._cap = (self.max_models - reserved) if self.max_models else 0
 
+        # preprocessing phase (reference: ai/h2o/automl/preprocessing/
+        # TargetEncoding.java — CV-aware TE on high-cardinality enums, fed
+        # to the TREE steps; linear/DL steps keep the raw frame)
+        tree_frame, tree_x, te_model = training_frame, x, None
+        if "target_encoding" in self.preprocessing and y is not None:
+            hi_card = [c for c in training_frame.names
+                       if c != y and training_frame.vec(c).is_categorical
+                       and training_frame.vec(c).cardinality() > 10]
+            if hi_card and classification:
+                try:
+                    from h2o3_tpu.models.target_encoder import TargetEncoder
+                    te = TargetEncoder(data_leakage_handling="KFold",
+                                       blending=True, seed=self.seed).train(
+                        x=hi_card, y=y, training_frame=training_frame)
+                    te_model = te
+                    tree_frame = te.transform(training_frame)
+                    tree_x = [c for c in tree_frame.names if c != y
+                              and c not in hi_card] if x is None else \
+                        [c for c in x if c not in hi_card] + \
+                        [f"{c}_te" for c in hi_card]
+                    log.log("preprocess",
+                            f"target-encoded {hi_card} for tree steps")
+                except Exception as e:
+                    log.log("error", f"target encoding failed: "
+                                     f"{type(e).__name__}: {e}")
+
+        tree_algos = {"GBM", "XGBOOST", "DRF"}
         for algo, cls, params in self._steps():
             if not self._budget_left():
                 break
@@ -153,8 +190,12 @@ class AutoML:
                 continue
             try:
                 t = time.time()
-                m = cls(**{**params, **common}).train(x=x, y=y,
-                                                      training_frame=training_frame)
+                fr_s, x_s = ((tree_frame, tree_x) if algo in tree_algos
+                             else (training_frame, x))
+                m = cls(**{**params, **common}).train(x=x_s, y=y,
+                                                      training_frame=fr_s)
+                if te_model is not None and algo in tree_algos:
+                    m.preprocessors.append(te_model)
                 self._n_built += 1
                 base_models.append(m)
                 self.leaderboard.add(m)
@@ -178,12 +219,56 @@ class AutoML:
                                                  max_runtime_secs=max(remaining_secs, 0.0),
                                                  seed=gseed),
                             **{**fixed, **common})
-            grid = gs.train(x=x, y=y, training_frame=training_frame)
+            # grids are tree families: same TE frame as the base tree steps
+            grid = gs.train(x=tree_x, y=y, training_frame=tree_frame)
             for m in grid.models:
+                if te_model is not None:
+                    m.preprocessors.append(te_model)
                 self._n_built += 1
                 base_models.append(m)
                 self.leaderboard.add(m)
                 log.log("model", f"{m.key} ({algo} grid)")
+
+        # exploitation phase (reference: ModelingPlans exploitation steps —
+        # learning-rate annealing on the best GBM/XGBoost: retrain the
+        # incumbent with halved learn_rate and doubled trees under the
+        # remaining ~exploitation_ratio of the budget)
+        self._cap = self.max_models      # release the reserved share
+        if self.exploitation_ratio > 0 and self._budget_left() \
+                and self.leaderboard is not None:
+            for fam in ("gbm", "xgboost"):
+                if not self._budget_left() or not self._algo_enabled(fam):
+                    continue
+                cands = [m for m in self.leaderboard.models if m.algo == fam]
+                if not cands:
+                    continue
+                best = cands[0]     # leaderboard models are rank-sorted
+                p = dict(best.params)
+                anneal = {k: p[k] for k in
+                          ("max_depth", "sample_rate", "col_sample_rate",
+                           "col_sample_rate_per_tree", "nbins") if k in p}
+                anneal["learn_rate"] = float(p.get("learn_rate", 0.1)) / 2
+                anneal["ntrees"] = int(p.get("ntrees", 50)) * 2
+                try:
+                    t = time.time()
+                    from h2o3_tpu.models.gbm import GBM
+                    from h2o3_tpu.models.xgboost import XGBoost
+                    bcls = XGBoost if fam == "xgboost" else GBM
+                    fr_s, x_s = ((tree_frame, tree_x)
+                                 if fam.upper() in tree_algos else
+                                 (training_frame, x))
+                    m = bcls(**{**anneal, **common}).train(
+                        x=x_s, y=y, training_frame=fr_s)
+                    if te_model is not None:
+                        m.preprocessors.append(te_model)
+                    self._n_built += 1
+                    base_models.append(m)
+                    self.leaderboard.add(m)
+                    log.log("exploit", f"lr-annealed {fam}: {m.key} in "
+                                       f"{time.time() - t:.1f}s")
+                except Exception as e:
+                    log.log("error", f"exploitation {fam} failed: "
+                                     f"{type(e).__name__}: {e}")
 
         # ensemble phase (reference: StackedEnsembleStepsProvider — BestOfFamily + All)
         if self._algo_enabled("STACKEDENSEMBLE") and len(base_models) >= 2:

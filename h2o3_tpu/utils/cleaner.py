@@ -1,0 +1,122 @@
+"""Cleaner — automatic LRU spill of cold frames under HBM pressure.
+
+Reference: ``water/Cleaner.java:10-12`` — a background sweeper that writes
+the least-recently-used DKV byte[] values to the ice_root when the heap
+crosses a watermark, transparently reloading them on next access
+(``water/Value.java`` spill state); ``water/MemoryManager.java`` tracks the
+budget.
+
+TPU-native: HBM is the scarce memory. The Cleaner tracks the device bytes
+of every DKV-resident Frame, and past a configurable budget swaps the
+least-recently-USED frames to the spill directory via the frame persist
+format. A swapped key holds a :class:`SwappedFrame` stub; ``DKV.get``
+resolves stubs by reloading (and sweeps again, possibly evicting something
+else). Enable with ``enable_cleaner(budget_bytes)`` or the
+``H2O3TPU_HBM_BUDGET`` env var (bytes; off by default — a single-chip v5e
+holds 16GB and most jobs never need spill).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from h2o3_tpu.utils.registry import DKV
+
+
+class SwappedFrame:
+    """DKV stub for a spilled frame (reference: Value on-disk state)."""
+
+    def __init__(self, key: str, path: str, nrows: int, ncols: int):
+        self.key = key
+        self.path = path
+        self.nrows = nrows
+        self.ncols = ncols
+
+    def __repr__(self) -> str:
+        return f"SwappedFrame({self.key} @ {self.path})"
+
+
+class Cleaner:
+    def __init__(self, budget_bytes: int | None = None,
+                 ice_root: str | None = None):
+        env = os.environ.get("H2O3TPU_HBM_BUDGET")
+        self.budget = budget_bytes if budget_bytes is not None else (
+            int(env) if env else None)
+        self.ice_root = ice_root or os.path.join(
+            tempfile.gettempdir(), "h2o3_tpu_ice")
+        self._touch: dict[str, float] = {}
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    @staticmethod
+    def _frame_bytes(fr) -> int:
+        total = 0
+        for v in getattr(fr, "vecs", []):
+            if v.data is not None:
+                total += v.data.size * v.data.dtype.itemsize
+        return total
+
+    def touch(self, key: str) -> None:
+        self._touch[key] = time.monotonic()
+
+    def resident_frames(self):
+        from h2o3_tpu.frame.frame import Frame
+        out = []
+        with DKV._lock:   # RAW store: DKV.get would re-inflate swapped stubs
+            items = list(DKV._store.items())
+        for k, v in items:
+            if isinstance(v, Frame):
+                out.append((k, v))
+        return out
+
+    # -- sweep ---------------------------------------------------------------
+
+    def sweep(self, protect: str | None = None) -> list[str]:
+        """Spill LRU frames until under budget; returns spilled keys."""
+        if self.budget is None:
+            return []
+        frames = self.resident_frames()
+        total = sum(self._frame_bytes(f) for _, f in frames)
+        if total <= self.budget:
+            return []
+        os.makedirs(self.ice_root, exist_ok=True)
+        order = sorted(frames, key=lambda kv: self._touch.get(kv[0], 0.0))
+        spilled = []
+        from h2o3_tpu.persist.frame_io import save_frame
+        for k, fr in order:
+            if total <= self.budget:
+                break
+            if k == protect:
+                continue
+            path = os.path.join(self.ice_root, k)
+            save_frame(fr, path)
+            DKV.put(k, SwappedFrame(k, path, fr.nrows, fr.ncols))
+            total -= self._frame_bytes(fr)
+            spilled.append(k)
+        return spilled
+
+    def resolve(self, key: str, stub: SwappedFrame):
+        """Reload a spilled frame (sweeping others to stay under budget)."""
+        from h2o3_tpu.persist.frame_io import load_frame
+        fr = load_frame(stub.path, key=key)
+        DKV.put(key, fr)
+        self.touch(key)
+        self.sweep(protect=key)
+        return fr
+
+
+CLEANER = Cleaner()
+
+
+def enable_cleaner(budget_bytes: int, ice_root: str | None = None) -> Cleaner:
+    """Turn on automatic spill with the given HBM budget (bytes)."""
+    CLEANER.budget = int(budget_bytes)
+    if ice_root:
+        CLEANER.ice_root = ice_root
+    return CLEANER
+
+
+def disable_cleaner() -> None:
+    CLEANER.budget = None

@@ -26,19 +26,49 @@ class KeyedStore:
             return None
         with self._lock:
             self._store[key] = value
+        if type(value).__name__ == "Frame":
+            # Cleaner hook (reference: Cleaner LRU sweep on heap pressure);
+            # no-op unless a budget is enabled
+            from h2o3_tpu.utils.cleaner import CLEANER
+            CLEANER.touch(key)
+            CLEANER.sweep(protect=key)
         return key
+
+    def _resolve(self, key: str, value: Any) -> Any:
+        if value is None:
+            return value
+        tname = type(value).__name__
+        if tname == "SwappedFrame":
+            from h2o3_tpu.utils.cleaner import CLEANER
+            return CLEANER.resolve(key, value)
+        if tname == "Frame":
+            from h2o3_tpu.utils.cleaner import CLEANER
+            if CLEANER.budget is not None:
+                CLEANER.touch(key)
+        return value
 
     def get(self, key: str, default: Any = None) -> Any:
         with self._lock:
-            return self._store.get(key, default)
+            v = self._store.get(key, default)
+        return self._resolve(key, v)
 
     def __getitem__(self, key: str) -> Any:
         with self._lock:
-            return self._store[key]
+            v = self._store[key]
+        return self._resolve(key, v)
 
     def remove(self, key: str) -> Any:
         with self._lock:
-            return self._store.pop(key, None)
+            v = self._store.pop(key, None)
+        if type(v).__name__ == "SwappedFrame":
+            import contextlib
+            import os
+            from h2o3_tpu.utils.cleaner import CLEANER
+            with contextlib.suppress(OSError):
+                os.remove(v.path)
+            CLEANER._touch.pop(key, None)
+            return None
+        return v
 
     def keys(self) -> list[str]:
         with self._lock:

@@ -1,0 +1,225 @@
+"""Avro/XLSX ingestion + the Cleaner LRU spill.
+
+Reference: h2o-parsers/h2o-avro-parser, water/parser/XlsParser.java,
+water/Cleaner.java.
+"""
+
+import json
+import os
+import struct
+import zipfile
+import zlib
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils.registry import DKV
+
+
+# -- tiny Avro writer (test-only): zigzag varints, one block ---------------
+
+def _zz(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_bytes(b: bytes) -> bytes:
+    return _zz(len(b)) + b
+
+
+def _write_avro(path, schema: dict, rows: list[dict], codec=b"null"):
+    def encode_val(t, v):
+        if isinstance(t, list):              # nullable union
+            if v is None:
+                return _zz(t.index("null"))
+            other = [x for x in t if x != "null"][0]
+            return _zz(t.index(other)) + encode_val(other, v)
+        if t == "double":
+            return struct.pack("<d", v)
+        if t == "long":
+            return _zz(int(v))
+        if t == "string":
+            return _avro_bytes(v.encode())
+        if t == "boolean":
+            return b"\x01" if v else b"\x00"
+        raise ValueError(t)
+
+    body = b"".join(
+        b"".join(encode_val(f["type"], row[f["name"]])
+                 for f in schema["fields"])
+        for row in rows)
+    if codec == b"deflate":
+        comp = zlib.compressobj(9, zlib.DEFLATED, -15)
+        body = comp.compress(body) + comp.flush()
+    sync = b"S" * 16
+    meta = {"avro.schema": json.dumps(schema).encode(), "avro.codec": codec}
+    with open(path, "wb") as f:
+        f.write(b"Obj\x01")
+        f.write(_zz(len(meta)))
+        for k, v in meta.items():
+            f.write(_avro_bytes(k.encode()) + _avro_bytes(v))
+        f.write(_zz(0))
+        f.write(sync)
+        f.write(_zz(len(rows)) + _zz(len(body)) + body + sync)
+
+
+@pytest.mark.parametrize("codec", [b"null", b"deflate"])
+def test_avro_ingest(tmp_path, codec):
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "num", "type": "double"},
+        {"name": "cnt", "type": "long"},
+        {"name": "lbl", "type": "string"},
+        {"name": "opt", "type": ["null", "double"]},
+    ]}
+    rows = [{"num": 1.5, "cnt": 7, "lbl": "a", "opt": 2.0},
+            {"num": -0.5, "cnt": 9, "lbl": "b", "opt": None}]
+    p = tmp_path / f"t_{codec.decode()}.avro"
+    _write_avro(str(p), schema, rows, codec)
+
+    from h2o3_tpu.frame.parse import import_file
+    fr = import_file(str(p))
+    assert fr.nrows == 2
+    np.testing.assert_allclose(fr.vec("num").to_numpy(), [1.5, -0.5])
+    np.testing.assert_allclose(fr.vec("cnt").to_numpy(), [7, 9])
+    assert list(fr.vec("lbl").labels()) == ["a", "b"]
+    opt = fr.vec("opt").to_numpy()
+    assert opt[0] == 2.0 and np.isnan(opt[1])
+
+
+def _write_xlsx(path, header, rows):
+    def cell(ref, v):
+        if isinstance(v, str):
+            return f'<c r="{ref}" t="inlineStr"><is><t>{v}</t></is></c>'
+        return f'<c r="{ref}"><v>{v}</v></c>'
+
+    def colname(j):
+        s = ""
+        j += 1
+        while j:
+            j, r = divmod(j - 1, 26)
+            s = chr(65 + r) + s
+        return s
+
+    all_rows = [header] + rows
+    xml_rows = []
+    for i, row in enumerate(all_rows, 1):
+        cells = "".join(cell(f"{colname(j)}{i}", v)
+                        for j, v in enumerate(row) if v is not None)
+        xml_rows.append(f'<row r="{i}">{cells}</row>')
+    sheet = ('<?xml version="1.0"?><worksheet xmlns='
+             '"http://schemas.openxmlformats.org/spreadsheetml/2006/main">'
+             f'<sheetData>{"".join(xml_rows)}</sheetData></worksheet>')
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+
+
+def test_xlsx_ingest(tmp_path):
+    p = tmp_path / "t.xlsx"
+    _write_xlsx(str(p), ["x", "name", "v"],
+                [[1.0, "foo", 10.5], [2.0, "bar", None], [3.0, "foo", -1.0]])
+    from h2o3_tpu.frame.parse import import_file
+    fr = import_file(str(p))
+    assert fr.nrows == 3 and fr.ncols == 3
+    np.testing.assert_allclose(fr.vec("x").to_numpy(), [1, 2, 3])
+    v = fr.vec("v").to_numpy()
+    assert v[0] == 10.5 and np.isnan(v[1]) and v[2] == -1.0
+    assert list(fr.vec("name").labels()) == ["foo", "bar", "foo"]
+
+    xls = tmp_path / "legacy.xls"
+    xls.write_bytes(b"\xd0\xcf\x11\xe0junk")
+    with pytest.raises(ValueError, match="xlsx"):
+        import_file(str(xls))
+
+
+def test_cleaner_lru_spill(tmp_path, rng):
+    from h2o3_tpu.utils.cleaner import (CLEANER, SwappedFrame, disable_cleaner,
+                                        enable_cleaner)
+
+    def mk(key, n=4096):
+        f = Frame.from_arrays(
+            {f"c{i}": rng.normal(size=n).astype(np.float32)
+             for i in range(4)}, key=key)
+        DKV.put(key, f)
+        return f
+
+    try:
+        # budget fits ~2 of the 3 frames (4 cols x 4096 rows x 4B ≈ 66KB)
+        enable_cleaner(150_000, ice_root=str(tmp_path))
+        a = mk("fr_a")
+        b = mk("fr_b")
+        want_a = a.vec("c0").to_numpy().copy()
+        DKV.get("fr_b")                      # b is now most recent
+        mk("fr_c")                           # over budget → LRU (a) spills
+
+        with DKV._lock:
+            raw = DKV._store["fr_a"]
+        assert isinstance(raw, SwappedFrame)
+        assert os.path.exists(raw.path)
+
+        # transparent reload on access, content intact
+        back = DKV["fr_a"]
+        assert isinstance(back, Frame)
+        np.testing.assert_allclose(back.vec("c0").to_numpy(), want_a,
+                                   rtol=1e-6)
+        # reloading a pushed something else out (still under budget)
+        resident = [k for k, _ in CLEANER.resident_frames()]
+        total = sum(CLEANER._frame_bytes(f)
+                    for _, f in CLEANER.resident_frames())
+        assert total <= 150_000, (resident, total)
+    finally:
+        disable_cleaner()
+        DKV.clear()
+
+
+def test_custom_metric_and_auth(rng):
+    """Custom UDF metric (water/udf equivalent) + REST basic auth
+    (H2O.java -hash_login equivalent)."""
+    from h2o3_tpu.models.gbm import GBM
+
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x": x, "y": y})
+
+    def mean_abs_err(preds, yv, w):
+        ok = w > 0
+        return float(np.abs(preds[ok] - yv[ok]).mean())
+
+    m = GBM(ntrees=5, max_depth=3, seed=1,
+            custom_metric_func=mean_abs_err).train(y="y", training_frame=fr)
+    assert m.training_metrics.custom_metric_name == "mean_abs_err"
+    assert 0 < m.training_metrics.custom_metric_value < 1.0
+
+    # REST auth: wrong/absent credentials → 401; correct → 200
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api import H2OServer
+    s = H2OServer(port=0, username="alice", password="s3cret").start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{s.url}/3/Cloud")
+        assert ei.value.code == 401
+        import base64
+        tok = base64.b64encode(b"alice:s3cret").decode()
+        req = urllib.request.Request(f"{s.url}/3/Cloud",
+                                     headers={"Authorization": f"Basic {tok}"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        # shutdown is likewise gated
+        req = urllib.request.Request(f"{s.url}/3/Shutdown", data=b"",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401
+    finally:
+        s.stop()

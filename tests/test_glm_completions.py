@@ -1,0 +1,132 @@
+"""GLM completions: offset_column, ordinal family, interactions.
+
+Reference: GLMModel.GLMParameters (_offset, Family.ordinal, _interactions).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.glm import GLM
+
+
+def test_glm_offset_column(rng):
+    n = 800
+    x = rng.normal(size=n).astype(np.float32)
+    off = rng.normal(size=n).astype(np.float32) * 2
+    logit = 1.5 * x + off + 0.2
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    fr = Frame.from_arrays({
+        "x": x, "off": off,
+        "y": np.array(["no", "yes"], dtype=object)[y]})
+
+    m = GLM(family="binomial", offset_column="off", lambda_=0.0).train(
+        y="y", training_frame=fr)
+    # offset must NOT be a feature; slope recovered near truth
+    assert m.output["coef_names"] == ["x"]
+    assert m.coef()["x"] == pytest.approx(1.5, abs=0.3)
+
+    # without the offset the slope absorbs nothing of it (weaker fit)
+    fr2 = Frame.from_arrays({
+        "x": x, "y": np.array(["no", "yes"], dtype=object)[y]})
+    m2 = GLM(family="binomial", lambda_=0.0).train(y="y", training_frame=fr2)
+    assert m.model_performance(fr).logloss < m2.model_performance(fr2).logloss
+
+    # scoring without the offset column fails loudly
+    with pytest.raises(ValueError, match="offset"):
+        m.predict(fr2)
+
+
+def test_glm_ordinal_family(rng):
+    n = 1500
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    latent = 2.0 * x1 - 1.0 * x2 + rng.logistic(size=n)
+    codes = np.digitize(latent, [-1.5, 1.5])     # 3 ordered levels
+    fr = Frame.from_arrays({
+        "x1": x1, "x2": x2,
+        "y": np.array(["l0_low", "l1_mid", "l2_high"], dtype=object)[codes]})
+
+    m = GLM(family="ordinal", standardize=False, max_iterations=50).train(
+        y="y", training_frame=fr)
+    # proportional-odds slopes match the generating model
+    c = dict(zip(m.output["coef_names"], np.asarray(m.output["beta"])))
+    assert c["x1"] == pytest.approx(2.0, abs=0.4)
+    assert c["x2"] == pytest.approx(-1.0, abs=0.35)
+    th = np.asarray(m.output["ordinal_theta"])
+    assert th[0] < th[1]                          # ordered thresholds
+
+    pred = m.predict(fr)
+    assert pred.vec("predict").domain == ("l0_low", "l1_mid", "l2_high")
+    probs = np.stack([pred.vec(f"p{d}").to_numpy()
+                      for d in ("l0_low", "l1_mid", "l2_high")], 1)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+    acc = (pred.vec("predict").to_numpy() == codes).mean()
+    assert acc > 0.6, acc
+
+    with pytest.raises(ValueError, match="3 ordered"):
+        GLM(family="ordinal").train(y="y", training_frame=Frame.from_arrays({
+            "x": x1, "y": np.array(["a", "b"], dtype=object)[codes.clip(0, 1)]}))
+
+
+def test_glm_interactions(rng):
+    n = 1200
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    y = (1.0 * a + 0.5 * b + 2.0 * a * b
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"a": a, "b": b, "y": y})
+
+    plain = GLM(family="gaussian").train(y="y", training_frame=fr)
+    inter = GLM(family="gaussian", interactions=["a", "b"]).train(
+        y="y", training_frame=fr)
+    assert "a_b" in inter.output["coef_names"]
+    assert inter.coef()["a_b"] == pytest.approx(2.0, abs=0.1)
+    # interaction model fits what the additive model cannot
+    assert inter.model_performance(fr).rmse < 0.5 * plain.model_performance(fr).rmse
+
+    # scoring re-applies the expansion transparently
+    pred = inter.predict(fr).vec("predict").to_numpy()
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.2
+
+
+def test_glm_cat_num_interaction(rng):
+    n = 900
+    g = rng.choice(["u", "v"], size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    slope = np.where(g == "u", 2.0, -1.0)
+    y = (slope * x + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"g": g, "x": x, "y": y})
+
+    m = GLM(family="gaussian", interactions=["g", "x"]).train(
+        y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.2
+
+
+def test_glm_interaction_scoring_missing_level(rng):
+    """A scoring batch that lacks a training level must still produce every
+    interaction design column (review regression)."""
+    n = 600
+    g = rng.choice(["a", "b", "c"], size=n)
+    x = rng.normal(size=n).astype(np.float32)
+    slope = {"a": 2.0, "b": -1.0, "c": 0.5}
+    y = (np.array([slope[s] for s in g]) * x
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"g": g, "x": x, "y": y})
+    m = GLM(family="gaussian", interactions=["g", "x"]).train(
+        y="y", training_frame=fr)
+
+    sub = Frame.from_arrays({           # only levels a, b present
+        "g": np.array(["a", "b", "a"], dtype=object),
+        "x": np.float32([1.0, 1.0, -2.0])})
+    pred = m.predict(sub).vec("predict").to_numpy()
+    np.testing.assert_allclose(pred, [2.0, -1.0, -4.0], atol=0.3)
+
+
+def test_glm_ordinal_rejects_interactions():
+    with pytest.raises(ValueError, match="ordinal"):
+        GLM(family="ordinal", interactions=["a", "b"]).train(
+            y="y", training_frame=Frame.from_arrays({
+                "a": np.float32([1, 2, 3, 4]), "b": np.float32([1, 2, 3, 4]),
+                "y": np.array(["l0", "l1", "l2", "l0"], dtype=object)}))

@@ -154,3 +154,30 @@ def test_automl_small(rng):
     for r in aml.leaderboard._sorted():
         aucs.append(r["auc"])
     assert aucs == sorted(aucs, reverse=True)
+
+
+def test_automl_exploitation_and_te(rng):
+    """Exploitation phase (lr-annealed incumbent) + TE preprocessing
+    (reference: ModelingPlans exploitation, automl/preprocessing)."""
+    from h2o3_tpu.orchestration.automl import AutoML
+
+    n = 500
+    levels = [f"city{i:02d}" for i in range(30)]     # high-cardinality enum
+    city = rng.choice(levels, size=n)
+    effect = {lv: rng.normal() for lv in levels}
+    x1 = rng.normal(size=n).astype(np.float32)
+    logit = np.array([effect[c] for c in city]) + x1
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    fr = Frame.from_arrays({
+        "city": city, "x1": x1,
+        "y": np.array(["no", "yes"], dtype=object)[y.astype(int)]})
+
+    aml = AutoML(max_models=3, nfolds=0, seed=7,
+                 include_algos=["GBM", "STACKEDENSEMBLE"],
+                 preprocessing=["target_encoding"],
+                 exploitation_ratio=0.2)
+    leader = aml.train(y="y", training_frame=fr)
+    assert leader is not None
+    events = " ".join(aml.event_log.as_list())
+    assert "target-encoded" in events
+    assert "lr-annealed" in events
