@@ -1,10 +1,12 @@
 """Flow — the built-in web console served from the node.
 
 Reference: ``h2o-web/`` packages the Flow notebook (CoffeeScript app served
-by the node at ``/``; ``h2o-web/README.md:1-8``). The TPU build ships a
-dependency-free single-page console over the same V3 REST surface: cluster
-status, frames, models, jobs, and a Rapids prompt — the day-to-day Flow
-operations — rendered client-side from ``/3/*`` JSON.
+by the node at ``/``; ``h2o-web/README.md:1-8``): assist-driven cells for
+importFiles/parse/buildModel/predict/inspect. The TPU build ships a
+dependency-free single-page console over the same V3 REST surface with the
+same workflow cells — import → frames (+per-column summaries) → build model
+(algo/params form) → job polling → model inspection (metrics) → predict →
+Rapids console — rendered client-side from ``/3/*`` JSON.
 """
 
 FLOW_HTML = """<!DOCTYPE html>
@@ -17,53 +19,230 @@ FLOW_HTML = """<!DOCTYPE html>
  main{padding:16px 20px;display:grid;grid-template-columns:1fr 1fr;gap:16px}
  section{background:#fff;border:1px solid #dde4ea;border-radius:6px;padding:12px}
  h2{font-size:13px;text-transform:uppercase;letter-spacing:.06em;color:#5a6b7b;margin:0 0 8px}
- table{width:100%;border-collapse:collapse;font-size:13px}
+ table{width:100%;border-collapse:collapse;font-size:12px}
  td,th{text-align:left;padding:4px 6px;border-bottom:1px solid #eef2f5}
  th{color:#5a6b7b;font-weight:600}
- #rapids{grid-column:1/3}
- input[type=text]{width:80%;padding:6px;border:1px solid #cfd8e0;border-radius:4px}
- button{padding:6px 12px;border:0;border-radius:4px;background:#2f6fed;color:#fff;cursor:pointer}
- pre{background:#f4f6f8;padding:8px;border-radius:4px;overflow:auto;max-height:200px}
+ .wide{grid-column:1/3}
+ input[type=text],select{padding:6px;border:1px solid #cfd8e0;border-radius:4px;font-size:13px}
+ input[type=text]{width:60%}
+ button{padding:6px 12px;border:0;border-radius:4px;background:#2f6fed;color:#fff;cursor:pointer;font-size:13px}
+ button.small{padding:2px 8px;font-size:11px;background:#5a6b7b}
+ pre{background:#f4f6f8;padding:8px;border-radius:4px;overflow:auto;max-height:240px;font-size:12px}
  .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px;background:#e7f0e7;color:#2b6a2b}
+ .err{color:#b32020}
+ .row{display:flex;gap:8px;margin:4px 0;flex-wrap:wrap;align-items:center}
+ label{font-size:12px;color:#5a6b7b}
 </style></head><body>
 <header><h1>h2o3-tpu Flow</h1><span id="cloud">connecting…</span></header>
 <main>
- <section><h2>Frames</h2><table id="frames"><tr><th>key</th><th>rows</th><th>cols</th></tr></table></section>
- <section><h2>Models</h2><table id="models"><tr><th>key</th><th>algo</th></tr></table></section>
- <section><h2>Jobs</h2><table id="jobs"><tr><th>key</th><th>status</th><th>progress</th></tr></table></section>
- <section><h2>Timeline (last events)</h2><table id="timeline"><tr><th>kind</th><th>what</th><th>ms</th></tr></table></section>
- <section id="rapids"><h2>Rapids</h2>
-  <input type="text" id="expr" placeholder="(+ 1 2)"> <button onclick="runRapids()">Run</button>
-  <pre id="result"></pre></section>
+<section class="wide"><h2>Import / Parse</h2>
+ <div class="row">
+  <input type="text" id="path" placeholder="/path/to/data.csv (csv, parquet, orc, arff, svmlight, avro, xlsx)">
+  <input type="text" id="dest" placeholder="destination key (optional)" style="width:20%">
+  <button onclick="importFile()">Import</button>
+  <span id="importmsg"></span>
+ </div>
+</section>
+
+<section><h2>Frames</h2><div id="frames"></div><div id="framedetail"></div></section>
+
+<section><h2>Models</h2><div id="models"></div><div id="modeldetail"></div></section>
+
+<section class="wide"><h2>Build Model</h2>
+ <div class="row">
+  <label>algo</label>
+  <select id="algo"><option>gbm</option><option>drf</option><option>glm</option>
+   <option>xgboost</option><option>deeplearning</option><option>kmeans</option>
+   <option>naivebayes</option><option>isolationforest</option></select>
+  <label>training frame</label><select id="trainframe"></select>
+  <label>response</label><select id="ycol"></select>
+  <label>params (k=v, comma sep)</label>
+  <input type="text" id="params" placeholder="ntrees=20, max_depth=5" style="width:30%">
+  <button onclick="buildModel()">Train</button>
+  <span id="trainmsg"></span>
+ </div>
+ <div id="jobs"></div>
+</section>
+
+<section class="wide"><h2>Predict</h2>
+ <div class="row">
+  <label>model</label><select id="pmodel"></select>
+  <label>frame</label><select id="pframe"></select>
+  <button onclick="runPredict()">Predict</button>
+  <span id="predmsg"></span>
+ </div>
+</section>
+
+<section class="wide"><h2>Rapids console</h2>
+ <div class="row">
+  <input type="text" id="ast" placeholder="(mean (cols frame_key 'col'))" style="width:70%">
+  <button onclick="runRapids()">Eval</button>
+ </div>
+ <pre id="rapidsout"></pre>
+</section>
 </main>
 <script>
-async function j(p, opt){const r = await fetch(p, opt); return r.json();}
-function row(t, cells){const tr = document.createElement('tr');
- for(const c of cells){const td = document.createElement('td'); td.textContent = c; tr.appendChild(td);}
- t.appendChild(tr);}
-function reset(t){while(t.rows.length > 1) t.deleteRow(1);}
-async function refresh(){
- try{
-  const c = await j('/3/Cloud');
-  document.getElementById('cloud').textContent =
-    `cloud ${c.cloud_name ?? ''} · ${c.cloud_size} node(s) · ` +
-    (c.cloud_healthy ? 'healthy' : 'unhealthy') + ` · v${c.version ?? ''}`;
-  const fr = await j('/3/Frames'); const ft = document.getElementById('frames'); reset(ft);
-  for(const f of (fr.frames ?? [])) row(ft, [f.frame_id?.name ?? f.key, f.rows, f.column_count]);
-  const mo = await j('/3/Models'); const mt = document.getElementById('models'); reset(mt);
-  for(const m of (mo.models ?? [])) row(mt, [m.model_id?.name ?? m.key, m.algo]);
-  const tl = await j('/3/Timeline'); const tt = document.getElementById('timeline'); reset(tt);
-  for(const e of (tl.events ?? []).slice(-12).reverse())
-    row(tt, [e.kind, e.what, (e.dur_ns/1e6).toFixed(2)]);
- }catch(e){document.getElementById('cloud').textContent = 'disconnected: '+e;}
+const J = (m, p, body) => fetch(p, body ? {method: m,
+  headers: {"Content-Type": "application/json"}, body: JSON.stringify(body)}
+  : {method: m}).then(r => r.json());
+
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;').replace(/"/g,'&quot;').replace(/'/g,'&#39;')}
+
+async function refreshCloud(){
+  try{
+    const c = await J("GET", "/3/Cloud");
+    document.getElementById("cloud").innerHTML =
+      `cloud <b>${esc(c.cloud_name)}</b> · ${c.cloud_size} device(s) · v${esc(c.version)} <span class="pill">healthy</span>`;
+  }catch(e){document.getElementById("cloud").textContent = "unreachable";}
 }
+
+async function refreshFrames(){
+  const out = await J("GET", "/3/Frames");
+  const rows = out.frames.map(f =>
+    `<tr><td><a href="#" onclick="frameDetail('${esc(f.frame_id.name)}');return false">${esc(f.frame_id.name)}</a></td>
+     <td>${f.rows}</td><td>${f.column_count}</td>
+     <td><button class="small" onclick="rmKey('${esc(f.frame_id.name)}')">rm</button></td></tr>`).join("");
+  document.getElementById("frames").innerHTML =
+    `<table><tr><th>key</th><th>rows</th><th>cols</th><th></th></tr>${rows}</table>`;
+  const opts = out.frames.map(f => `<option>${esc(f.frame_id.name)}</option>`).join("");
+  document.getElementById("trainframe").innerHTML = opts;
+  document.getElementById("pframe").innerHTML = opts;
+  refreshCols();
+}
+
+async function refreshCols(){
+  const key = document.getElementById("trainframe").value;
+  if(!key) return;
+  try{
+    const out = await J("GET", `/3/Frames/${key}/columns`);
+    document.getElementById("ycol").innerHTML =
+      out.columns.map(c => `<option>${esc(c.label)}</option>`).join("");
+  }catch(e){}
+}
+document.getElementById("trainframe") && document.addEventListener("change",
+  e => {if(e.target.id === "trainframe") refreshCols();});
+
+async function frameDetail(key){
+  const out = await J("GET", `/3/Frames/${key}`);
+  const f = out.frames[0];
+  const head = f.columns.map(c => `<th>${esc(c.label)}<br><span style="font-weight:400">${esc(c.type)}</span></th>`).join("");
+  const n = Math.min(8, Math.max(...f.columns.map(c => (c.data||c.string_data||[]).length)));
+  let body = "";
+  for(let i = 0; i < n; i++){
+    body += "<tr>" + f.columns.map(c => {
+      let v = (c.string_data || c.data || [])[i];
+      if(v !== null && c.domain && c.data) v = c.domain[c.data[i]] ?? v;
+      return `<td>${v === null || v === undefined ? "·" : esc(typeof v === "number" ? +v.toFixed(4) : v)}</td>`;
+    }).join("") + "</tr>";
+  }
+  const stats = f.columns.map(c =>
+    `<tr><td>${esc(c.label)}</td><td>${c.mean==null?"·":(+c.mean).toFixed(4)}</td>
+     <td>${c.sigma==null?"·":(+c.sigma).toFixed(4)}</td><td>${c.missing_count}</td>
+     <td>${c.domain ? c.domain.length + " levels" : "·"}</td></tr>`).join("");
+  document.getElementById("framedetail").innerHTML =
+    `<h2 style="margin-top:10px">${esc(key)} — ${f.rows} rows</h2>
+     <table><tr>${head}</tr>${body}</table>
+     <h2 style="margin-top:10px">column summary</h2>
+     <table><tr><th>col</th><th>mean</th><th>sigma</th><th>NAs</th><th>domain</th></tr>${stats}</table>`;
+}
+
+async function refreshModels(){
+  const out = await J("GET", "/3/Models");
+  const rows = out.models.map(m =>
+    `<tr><td><a href="#" onclick="modelDetail('${esc(m.model_id.name)}');return false">${esc(m.model_id.name)}</a></td>
+     <td>${esc(m.algo)}</td>
+     <td><button class="small" onclick="rmKey('${esc(m.model_id.name)}')">rm</button></td></tr>`).join("");
+  document.getElementById("models").innerHTML =
+    `<table><tr><th>key</th><th>algo</th><th></th></tr>${rows}</table>`;
+  document.getElementById("pmodel").innerHTML =
+    out.models.map(m => `<option>${esc(m.model_id.name)}</option>`).join("");
+}
+
+async function modelDetail(key){
+  const out = await J("GET", `/3/Models/${key}`);
+  const m = out.models[0];
+  const mm = m.output.training_metrics || {};
+  const metrics = Object.entries(mm).filter(([k,v]) => typeof v === "number")
+    .map(([k,v]) => `<tr><td>${esc(k)}</td><td>${(+v).toFixed(5)}</td></tr>`).join("");
+  document.getElementById("modeldetail").innerHTML =
+    `<h2 style="margin-top:10px">${esc(key)} (${esc(m.algo)}, ${esc(m.output.model_category||"")})</h2>
+     <table><tr><th>training metric</th><th>value</th></tr>${metrics}</table>`;
+}
+
+async function rmKey(k){ await fetch(`/3/DKV/${k}`, {method: "DELETE"}); refreshAll(); }
+
+async function importFile(){
+  const path = document.getElementById("path").value.trim();
+  const dest = document.getElementById("dest").value.trim();
+  const msg = document.getElementById("importmsg");
+  if(!path){ msg.innerHTML = '<span class="err">enter a path</span>'; return; }
+  msg.textContent = "importing…";
+  try{
+    const body = {path}; if(dest) body.destination_frame = dest;
+    const out = await J("POST", "/3/ImportFiles", body);
+    if(out.msg) throw new Error(out.msg);
+    msg.innerHTML = `<span class="pill">${esc(out.destination_frames[0])}</span>`;
+    refreshAll();
+  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+
+async function pollJob(key, into){
+  for(;;){
+    const out = await J("GET", `/3/Jobs/${key}`);
+    const j = out.jobs[0];
+    into.textContent = `${j.status} ${(100*j.progress).toFixed(0)}% — ${j.progress_msg||""}`;
+    if(["DONE","FAILED","CANCELLED"].includes(j.status)) return j;
+    await new Promise(r => setTimeout(r, 500));
+  }
+}
+
+async function buildModel(){
+  const algo = document.getElementById("algo").value;
+  const frame = document.getElementById("trainframe").value;
+  const y = document.getElementById("ycol").value;
+  const msg = document.getElementById("trainmsg");
+  const body = {training_frame: frame, response_column: y};
+  for(const kv of document.getElementById("params").value.split(",")){
+    const [k, v] = kv.split("=").map(s => s && s.trim());
+    if(k && v !== undefined) body[k] = v;
+  }
+  msg.textContent = "submitting…";
+  try{
+    const out = await J("POST", `/3/ModelBuilders/${algo}`, body);
+    if(out.msg) throw new Error(out.msg);
+    const j = await pollJob(out.job.key.name, msg);
+    if(j.exception) msg.innerHTML = `<span class="err">${esc(j.exception)}</span>`;
+    else { msg.innerHTML = `<span class="pill">${esc(j.dest.name)}</span>`; modelDetail(j.dest.name); }
+    refreshModels();
+  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+
+async function runPredict(){
+  const m = document.getElementById("pmodel").value;
+  const f = document.getElementById("pframe").value;
+  const msg = document.getElementById("predmsg");
+  msg.textContent = "scoring…";
+  try{
+    const out = await J("POST", `/3/Predictions/models/${m}/frames/${f}`);
+    if(out.msg) throw new Error(out.msg);
+    const key = out.predictions_frame.name;
+    msg.innerHTML = `<span class="pill">${esc(key)}</span>`;
+    refreshFrames(); frameDetail(key);
+  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+
 async function runRapids(){
- const ast = document.getElementById('expr').value;
- const out = await j('/99/Rapids', {method:'POST',
-   headers:{'Content-Type':'application/json'}, body: JSON.stringify({ast})});
- document.getElementById('result').textContent = JSON.stringify(out, null, 2);
- refresh();
+  const ast = document.getElementById("ast").value;
+  const out = document.getElementById("rapidsout");
+  try{
+    const r = await J("POST", "/99/Rapids", {ast});
+    out.textContent = JSON.stringify(r, null, 1);
+    refreshFrames();
+  }catch(e){ out.textContent = "error: " + e.message; }
 }
-refresh(); setInterval(refresh, 4000);
+
+function refreshAll(){ refreshCloud(); refreshFrames(); refreshModels(); }
+refreshAll();
+setInterval(refreshCloud, 10000);
 </script></body></html>
 """

@@ -98,9 +98,11 @@ def frame_v3(key: str, frame, rows: int = 10) -> dict:
 
 def frames_list_v3(store) -> dict:
     from h2o3_tpu.frame.frame import Frame
+    # raw_items: spilled frames list from their stubs (nrows/ncols carried)
+    # instead of being re-inflated from disk just for a listing
     frames = [{"frame_id": {"name": k}, "rows": v.nrows, "column_count": v.ncols}
-              for k, v in ((k, store.get(k)) for k in store.keys())
-              if isinstance(v, Frame)]
+              for k, v in store.raw_items()
+              if isinstance(v, Frame) or type(v).__name__ == "SwappedFrame"]
     return {**_meta("FramesV3"), "frames": frames}
 
 
@@ -157,7 +159,7 @@ def model_v3(model) -> dict:
 def models_list_v3(store) -> dict:
     from h2o3_tpu.models.model_base import Model
     models = [{"model_id": {"name": k}, "algo": v.algo}
-              for k, v in ((k, store.get(k)) for k in store.keys())
+              for k, v in store.raw_items()
               if isinstance(v, Model)]
     return {**_meta("ModelsV3"), "models": models}
 

@@ -743,9 +743,9 @@ class _Handler(BaseHTTPRequestHandler):
                      "frame_id": {"name": fr.key}})
 
     def r_frames_delete_all(self):
-        for k in list(DKV.keys()):
-            if isinstance(DKV.get(k), Frame):
-                DKV.remove(k)
+        for k, v in DKV.raw_items():
+            if isinstance(v, Frame) or type(v).__name__ == "SwappedFrame":
+                DKV.remove(k)      # stub-aware: deletes spill files too
         self._reply({"__meta": {"schema_type": "FramesV3"}})
 
     def r_dkv_delete(self, key):

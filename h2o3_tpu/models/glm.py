@@ -131,12 +131,13 @@ def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2,
     return lam1 * nobs / jnp.maximum(gram_diag, 1e-12)
 
 
-def _wald_inference(family: str, tw: float, X, yy, w, beta, dev: float):
+def _wald_inference(family: str, tw: float, X, yy, w, beta, dev: float,
+                    off=0.0):
     """Wald standard errors / z / p per coefficient (reference: GLM.java
     ``computePValues`` — inverse information matrix at the MLE; dispersion
     estimated for gaussian/gamma/tweedie, fixed 1 for binomial/poisson)."""
     fam = _fam(family, tw)
-    eta = X @ beta[:-1] + beta[-1]
+    eta = X @ beta[:-1] + beta[-1] + off
     d = fam.dmu_deta(eta)
     var = fam.variance(fam.linkinv(eta))
     W = w * d * d / jnp.maximum(var, 1e-12)
@@ -454,6 +455,17 @@ class GLM(ModelBuilder):
         p, final = run(p0)
         job.update(0.9, f"ordinal nll {float(jax.device_get(final)):.5f}")
         beta, theta = unpack(p)
+        # destandardize like the main path: coef_orig = beta_std * mul;
+        # centering shifts the thresholds (theta absorbs x·sub terms)
+        b = np.asarray(jax.device_get(beta), np.float64)
+        coef = b.copy()
+        th = np.asarray(jax.device_get(theta), np.float64)
+        if params["standardize"] and di.num_cols:
+            s0, nnum = di.ncats_expanded, len(di.num_cols)
+            mul = di.num_mul.astype(np.float64)
+            sub = di.num_sub.astype(np.float64)
+            coef[s0:s0 + nnum] = b[s0:s0 + nnum] * mul
+            th = th + float((b[s0:s0 + nnum] * mul * sub).sum())
 
         from h2o3_tpu.models.model_base import ModelParameters
         mparams = ModelParameters(params)
@@ -462,9 +474,9 @@ class GLM(ModelBuilder):
             key=make_model_key(self.algo, self.model_id),
             params=mparams, data_info=di, response_column=y,
             response_domain=yvec.domain,
-            output=dict(beta=beta, coef=np.asarray(jax.device_get(beta)),
+            output=dict(beta=beta, coef=coef,
                         coef_names=di.coef_names,
-                        ordinal_theta=theta,
+                        ordinal_theta=theta, ordinal_theta_orig=th,
                         residual_deviance=2.0 * float(jax.device_get(final)),
                         iterations=iters, family="ordinal",
                         lambda_best=lam, regularization_path=None),
@@ -697,7 +709,8 @@ class GLM(ModelBuilder):
             if float(params["lambda_"]) > 0 or bool(params.get("lambda_search")):
                 raise ValueError("compute_p_values requires no regularization "
                                  "(reference: GLM.java p-values need lambda=0)")
-            se, zv, pv, cov = _wald_inference(family, tw, X, yy, w, beta, dev)
+            se, zv, pv, cov = _wald_inference(family, tw, X, yy, w, beta,
+                                              dev, self._offset)
             if params["standardize"] and di.num_cols:
                 # SEs must be on the same (de-standardized) scale as `coef`:
                 # se_orig[num] = se_std[num] * mul; intercept via the delta
@@ -727,6 +740,9 @@ class GLM(ModelBuilder):
         """Softmax regression via cyclic per-class IRLS blocks (reference:
         GLM.java multinomial path)."""
         params = self.params
+        if params.get("interactions") or params.get("offset_column"):
+            raise ValueError("interactions/offset_column are not supported "
+                             "for multinomial")
         di = DataInfo.make(frame, x, standardize=params["standardize"],
                            use_all_factor_levels=params["use_all_factor_levels"])
         X = di.expand(frame)

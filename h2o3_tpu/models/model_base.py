@@ -103,9 +103,8 @@ class Model:
 
     def _preprocess(self, frame: Frame) -> Frame:
         for p in self.preprocessors:
-            added = [f"{c}_te" for c in p.output.get("columns", [])]
-            if added and all(c in frame for c in added):
-                continue                      # already transformed
+            if hasattr(p, "is_applied") and p.is_applied(frame):
+                continue
             frame = p.transform(frame)
         return frame
 

@@ -38,6 +38,11 @@ def _blend(sum_y, cnt, prior, inflection_point, smoothing):
 class TargetEncoderModel(Model):
     algo = "targetencoder"
 
+    def is_applied(self, frame) -> bool:
+        """True when every encoded column this transformer adds is already
+        present (scoring-pipeline idempotence hook)."""
+        return all(f"{c}_te" in frame for c in self.output["columns"])
+
     def transform(self, frame: Frame, as_training: bool = False) -> Frame:
         """Append ``<col>_te`` columns (h2o-py:
         ``H2OTargetEncoderEstimator.transform``). ``as_training`` applies the

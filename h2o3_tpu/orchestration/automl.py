@@ -70,7 +70,9 @@ class AutoML:
     # -- budget --------------------------------------------------------------
 
     def _budget_left(self) -> bool:
-        cap = getattr(self, "_cap", 0) or self.max_models
+        cap = getattr(self, "_cap", None)
+        if cap is None:
+            cap = self.max_models
         if cap and self._n_built >= cap:
             return False
         if self.max_runtime_secs and time.time() - self._t0 > self.max_runtime_secs:
@@ -153,8 +155,9 @@ class AutoML:
         # reserve the exploitation share of the model budget (reference:
         # WorkAllocations gives the exploitation steps their own allocation)
         reserved = (max(1, int(round(self.max_models * self.exploitation_ratio)))
-                    if self.max_models and self.exploitation_ratio > 0 else 0)
-        self._cap = (self.max_models - reserved) if self.max_models else 0
+                    if self.max_models > 1 and self.exploitation_ratio > 0
+                    else 0)
+        self._cap = (self.max_models - reserved) if self.max_models else None
 
         # preprocessing phase (reference: ai/h2o/automl/preprocessing/
         # TargetEncoding.java — CV-aware TE on high-cardinality enums, fed
@@ -233,7 +236,7 @@ class AutoML:
         # learning-rate annealing on the best GBM/XGBoost: retrain the
         # incumbent with halved learn_rate and doubled trees under the
         # remaining ~exploitation_ratio of the budget)
-        self._cap = self.max_models      # release the reserved share
+        self._cap = self.max_models or None  # release the reserved share
         if self.exploitation_ratio > 0 and self._budget_left() \
                 and self.leaderboard is not None:
             for fam in ("gbm", "xgboost"):

@@ -68,11 +68,19 @@ class KeyedStore:
                 os.remove(v.path)
             CLEANER._touch.pop(key, None)
             return None
+        from h2o3_tpu.utils.cleaner import CLEANER
+        CLEANER._touch.pop(key, None)
         return v
 
     def keys(self) -> list[str]:
         with self._lock:
             return list(self._store.keys())
+
+    def raw_items(self) -> list[tuple[str, Any]]:
+        """Snapshot WITHOUT resolving spilled stubs — listings must not
+        re-inflate swapped frames just to read their metadata."""
+        with self._lock:
+            return list(self._store.items())
 
     def __contains__(self, key: str) -> bool:
         with self._lock:
@@ -83,7 +91,16 @@ class KeyedStore:
 
     def clear(self) -> None:
         with self._lock:
+            items = list(self._store.items())
             self._store.clear()
+        import contextlib
+        import os
+        for _k, v in items:
+            if type(v).__name__ == "SwappedFrame":
+                with contextlib.suppress(OSError):
+                    os.remove(v.path)
+        from h2o3_tpu.utils.cleaner import CLEANER
+        CLEANER._touch.clear()
 
 
 # Global registry (reference: the DKV singleton).
