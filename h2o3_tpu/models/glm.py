@@ -611,8 +611,11 @@ class GLM(ModelBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
-        if int(params["max_iterations"]) < 1:
-            raise ValueError("max_iterations must be >= 1")
+        if int(params["max_iterations"]) == -1:
+            # reference: -1 means solver-chosen default (GLM.java auto)
+            params["max_iterations"] = 50
+        elif int(params["max_iterations"]) < 1:
+            raise ValueError("max_iterations must be >= 1 (or -1 for auto)")
         yvec = frame.vec(y)
         family = params["family"]
         if yvec.is_categorical:

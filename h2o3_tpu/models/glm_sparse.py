@@ -84,6 +84,11 @@ def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
     if family not in ("gaussian", "binomial", "poisson"):
         raise ValueError(f"sparse GLM supports gaussian/binomial/poisson, "
                          f"got {family!r} (densify for other families)")
+    mi = int(p.get("max_iterations") or 50)
+    if mi == -1:
+        mi = 50
+    elif mi < 1:
+        raise ValueError("max_iterations must be >= 1 (or -1 for auto)")
     if float(p.get("alpha") or 0.0) > 0:
         raise ValueError("sparse GLM is L2-only (alpha=0); the reference's "
                          "sparse path likewise solves ridge IRLS")
@@ -104,13 +109,13 @@ def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
     lam = float(p.get("lambda_") or 0.0)
     dev_prev = np.inf
     it = 0
-    for it in range(int(p.get("max_iterations") or 50)):
+    for it in range(mi):
         beta_new, dev = _sparse_irls_step(
             family, X.data, X.row, X.col, X.nrows, X.ncols, yy, w, beta, lam)
         dev = float(jax.device_get(dev))
         delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
         beta = beta_new
-        job.update((it + 1) / int(p.get("max_iterations") or 50),
+        job.update((it + 1) / mi,
                    f"sparse IRLS iter {it} deviance {dev:.4f}")
         if family == "gaussian" and it >= 1:
             break

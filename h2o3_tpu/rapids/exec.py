@@ -517,6 +517,11 @@ def _eval(node, s: Session):
     if op == "moment":                             # AstMoment → epoch ms
         from h2o3_tpu.rapids import timeops as tt
         return _colwise_or_scalar_moment(args)
+    if op == "ls":                                 # AstLs → key listing
+        from h2o3_tpu.frame.types import VecType
+        keys = DKV.keys()
+        return Frame(["key"], [Vec.from_numpy(
+            np.array(keys, dtype=object), type=VecType.STR)])
     if op == "getTimeZone":
         return "UTC"      # device times are canonical UTC epoch ms
     if op == "listTimeZones":
@@ -608,7 +613,7 @@ _CHAIN_OPS = (
     "which.min", "countmatches", "strDistance", "tokenize", "difflag1",
     "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%",
     "replaceall", "replacefirst", "num_valid_substrings", "append",
-    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone",
+    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls",
 )
 
 

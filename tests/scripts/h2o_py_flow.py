@@ -53,6 +53,29 @@ assert pred.nrow == te.nrow
 perf = gbm.model_performance(te)
 assert 0.7 < perf.auc() <= 1.0, perf.auc()
 
+# broader estimator surface
+from h2o.estimators import (H2OGeneralizedLinearEstimator,
+                            H2OKMeansEstimator,
+                            H2ORandomForestEstimator)
+
+glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=1e-3)
+glm.train(x=["x1", "x2"], y="y", training_frame=tr)
+assert 0.7 < glm.model_performance(te).auc() <= 1.0
+
+drf = H2ORandomForestEstimator(ntrees=8, max_depth=4)
+drf.train(x=["x1", "x2"], y="y", training_frame=tr)
+assert 0.65 < drf.model_performance(te).auc() <= 1.0
+
+km = H2OKMeansEstimator(k=3, seed=1)
+km.train(x=["x1", "x2"], training_frame=tr)
+
+# frame round-trips the client relies on
+df = te.as_data_frame()
+assert list(df.columns) == ["x1", "x2", "y"] and len(df) == te.nrow
+fr2 = h2o.get_frame(fr.frame_id)
+assert fr2.nrow == 300
+assert fr.frame_id in h2o.ls()["key"].tolist()
+
 h2o.remove_all()
 print("H2O_PY_COMPAT_OK")
 # skip h2o-py's atexit session teardown (its ExprNode.__del__ chain assumes
