@@ -150,6 +150,73 @@ def shap_summary(model, frame: Frame, top_n: int = 20):
     return rows[:top_n]
 
 
+def permutation_varimp(model, frame: Frame, metric: str | None = None,
+                       n_repeats: int = 1, seed: int = -1,
+                       features: list[str] | None = None,
+                       n_samples: int = -1):
+    """Permutation feature importance (reference: ``AstPermutationVarImp`` /
+    h2o-py ``model.permutation_importance``): shuffle one column at a time,
+    rescore, and report the metric degradation.
+
+    ``n_repeats == 1`` → rows (variable, relative_importance,
+    scaled_importance, percentage); ``n_repeats > 1`` → per-run rows
+    (variable, run_1..run_N), the reference's repeated-run table shape.
+    ``n_samples`` > 0 subsamples that many rows first (speed knob)."""
+    from h2o3_tpu.rapids.munge import gather_rows
+
+    rng = np.random.default_rng(None if seed in (-1, None) else int(seed))
+    if n_samples and 0 < n_samples < frame.nrows:
+        idx = np.sort(rng.choice(frame.nrows, int(n_samples), replace=False))
+        frame = gather_rows(frame, idx)
+    base_mm = model.model_performance(frame)
+    if not metric or metric.upper() == "AUTO":
+        metric = "logloss" if model.is_classifier else "rmse"
+    higher_is_better = metric.lower() in ("auc", "pr_auc", "r2", "accuracy")
+
+    def mval(mm):
+        v = getattr(mm, metric.lower(), None)
+        if v is None:
+            raise ValueError(f"metric {metric!r} not available")
+        return float(v() if callable(v) else v)
+
+    base = mval(base_mm)
+    cols = features or [c for c in model.output.get("x_cols", frame.names)
+                        if c in frame and c != model.response_column]
+    reps = max(1, int(n_repeats))
+    rows = []
+    for c in cols:
+        deltas = []
+        v = frame.vec(c)
+        host = v.to_numpy()
+        for _ in range(reps):
+            perm = host.copy()
+            rng.shuffle(perm)
+            shuffled = Frame(list(frame.names),
+                             [Vec.from_numpy(perm, type=v.type,
+                                             domain=v.domain)
+                              if n == c else frame.vec(n)
+                              for n in frame.names])
+            d = mval(model.model_performance(shuffled)) - base
+            deltas.append(-d if higher_is_better else d)
+        rows.append({"variable": c, "deltas": deltas,
+                     "relative_importance": float(np.mean(deltas))})
+    if reps > 1:
+        # reference repeated-run shape: Variable + Run 1..Run N
+        return [{"variable": r["variable"],
+                 **{f"run_{i + 1}": float(d)
+                    for i, d in enumerate(r["deltas"])}} for r in rows]
+    for r in rows:
+        del r["deltas"]
+    mx = max((r["relative_importance"] for r in rows), default=0.0)
+    tot = sum(max(r["relative_importance"], 0.0) for r in rows) or 1.0
+    for r in rows:
+        r["scaled_importance"] = (r["relative_importance"] / mx
+                                  if mx > 0 else 0.0)
+        r["percentage"] = max(r["relative_importance"], 0.0) / tot
+    rows.sort(key=lambda r: -r["relative_importance"])
+    return rows
+
+
 def varimp_heatmap(models) -> dict:
     """Scaled variable importances per model (h2o-py ``varimp_heatmap`` data):
     {'columns': [...], 'models': [...], 'matrix': [[...]]}."""

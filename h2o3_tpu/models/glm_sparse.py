@@ -84,7 +84,7 @@ def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
     if family not in ("gaussian", "binomial", "poisson"):
         raise ValueError(f"sparse GLM supports gaussian/binomial/poisson, "
                          f"got {family!r} (densify for other families)")
-    mi = int(p.get("max_iterations") or 50)
+    mi = int(50 if p.get("max_iterations") is None else p["max_iterations"])
     if mi == -1:
         mi = 50
     elif mi < 1:

@@ -517,6 +517,31 @@ def _eval(node, s: Session):
     if op == "moment":                             # AstMoment → epoch ms
         from h2o3_tpu.rapids import timeops as tt
         return _colwise_or_scalar_moment(args)
+    if op == "PermutationVarImp":
+        # AstPermutationVarImp args: (model frame metric n_samples n_repeats
+        # features seed) — h2o-py model_base.py:1788 sends exactly this order
+        from h2o3_tpu.explanation import permutation_varimp
+        from h2o3_tpu.frame.types import VecType
+        model = DKV[str(args[0])] if isinstance(args[0], str) else args[0]
+        feats = args[5] if len(args) > 5 and isinstance(args[5], list) \
+            else None
+        rows = permutation_varimp(
+            model, args[1], metric=str(args[2]) if len(args) > 2 else None,
+            n_samples=int(args[3]) if len(args) > 3 else -1,
+            n_repeats=int(args[4]) if len(args) > 4 else 1,
+            features=feats,
+            seed=int(args[6]) if len(args) > 6 else -1)
+        names = ["Variable"] + [k for k in rows[0] if k != "variable"]
+        titles = {"relative_importance": "Relative Importance",
+                  "scaled_importance": "Scaled Importance",
+                  "percentage": "Percentage"}
+        vecs = [Vec.from_numpy(np.array([r["variable"] for r in rows],
+                                        dtype=object), type=VecType.STR)]
+        out_names = ["Variable"]
+        for k in names[1:]:
+            out_names.append(titles.get(k, k.replace("run_", "Run ")))
+            vecs.append(Vec.from_numpy(np.float32([r[k] for r in rows])))
+        return Frame(out_names, vecs)
     if op == "ls":                                 # AstLs → key listing
         from h2o3_tpu.frame.types import VecType
         keys = DKV.keys()
@@ -613,7 +638,7 @@ _CHAIN_OPS = (
     "which.min", "countmatches", "strDistance", "tokenize", "difflag1",
     "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%",
     "replaceall", "replacefirst", "num_valid_substrings", "append",
-    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls",
+    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls", "PermutationVarImp",
 )
 
 

@@ -87,3 +87,52 @@ def test_sklearn_regressor_and_setparams(rng):
     reg.set_params(max_iterations=20)
     reg.fit(X, y)
     assert reg.score(X, y) > 0.95
+
+
+def test_permutation_varimp(rng):
+    """Reference: AstPermutationVarImp / model.permutation_importance."""
+    from h2o3_tpu.explanation import permutation_varimp
+    from h2o3_tpu.models.gbm import GBM
+
+    n = 600
+    x1 = rng.normal(size=n).astype(np.float32)     # strong signal
+    x2 = rng.normal(size=n).astype(np.float32)     # weak signal
+    x3 = rng.normal(size=n).astype(np.float32)     # noise
+    y = (3 * x1 + 0.5 * x2 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "x3": x3, "y": y})
+    m = GBM(ntrees=20, max_depth=4, seed=1).train(y="y", training_frame=fr)
+
+    rows = permutation_varimp(m, fr, metric="rmse", seed=2)
+    order = [r["variable"] for r in rows]
+    assert order[0] == "x1"                        # dominant feature first
+    imp = {r["variable"]: r["relative_importance"] for r in rows}
+    assert imp["x1"] > imp["x2"] > imp["x3"] - 1e-6
+    assert rows[0]["scaled_importance"] == pytest.approx(1.0)
+    assert sum(r["percentage"] for r in rows) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_permutation_varimp_rapids_contract(rng):
+    """The AstPermutationVarImp wire shape: (model frame metric n_samples
+    n_repeats features seed) → Variable + capitalized columns."""
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.rapids.exec import Session, rapids
+    from h2o3_tpu.utils.registry import DKV
+
+    n = 300
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (2 * x1 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y}, key="pvi_fr")
+    DKV.put("pvi_fr", fr)
+    m = GBM(ntrees=10, max_depth=3, seed=1, model_id="pvi_m").train(
+        y="y", training_frame=fr)
+    DKV.put("pvi_m", m)
+
+    s = Session()
+    out = rapids("(PermutationVarImp 'pvi_m' pvi_fr 'AUTO' 100 1 [] 5)", s)
+    assert out.names[:2] == ["Variable", "Relative Importance"]
+    assert "Scaled Importance" in out.names and "Percentage" in out.names
+    assert list(out.vec("Variable").host_values[:1]) == ["x1"]
+
+    reps = rapids("(PermutationVarImp 'pvi_m' pvi_fr 'rmse' -1 3 [] 5)", s)
+    assert reps.names == ["Variable", "Run 1", "Run 2", "Run 3"]
