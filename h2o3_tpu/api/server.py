@@ -40,6 +40,7 @@ def _algo_registry():
                                      ModelSelection, NaiveBayes, PCA, RuleFit,
                                      Infogram, PSVM, TargetEncoder, UpliftDRF,
                                      Word2Vec, XGBoost)
+        from h2o3_tpu.models.hglm import HGLM
         _ALGOS = {"gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
                   "xgboost": XGBoost, "kmeans": KMeans, "pca": PCA, "svd": SVD,
                   "glrm": GLRM, "naivebayes": NaiveBayes, "coxph": CoxPH,
@@ -50,7 +51,8 @@ def _algo_registry():
                   "rulefit": RuleFit, "decisiontree": DecisionTree,
                   "aggregator": Aggregator, "grep": Grep, "gam": GAM,
                   "modelselection": ModelSelection, "anovaglm": ANOVAGLM,
-                  "upliftdrf": UpliftDRF, "psvm": PSVM, "infogram": Infogram}
+                  "upliftdrf": UpliftDRF, "psvm": PSVM, "infogram": Infogram,
+                  "hglm": HGLM}
     return _ALGOS
 
 

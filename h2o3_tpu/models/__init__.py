@@ -9,6 +9,7 @@ producing a ``Model`` with metrics, prediction, and export.
 from h2o3_tpu.models.model_base import Model, ModelBuilder, ModelParameters
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.glm import GLM, GLMModel
+from h2o3_tpu.models.hglm import HGLM, HGLMModel
 from h2o3_tpu.models.gbm import GBM, GBMModel, DRF, DRFModel
 from h2o3_tpu.models.xgboost import XGBoost, XGBoostModel
 from h2o3_tpu.models.deeplearning import AutoEncoder, DeepLearning, DeepLearningModel
@@ -34,7 +35,7 @@ from h2o3_tpu.models.psvm import PSVM, PSVMModel
 from h2o3_tpu.models.infogram import Infogram, InfogramModel
 
 __all__ = ["Model", "ModelBuilder", "ModelParameters", "Job",
-           "GLM", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
+           "GLM", "HGLM", "HGLMModel", "GLMModel", "GBM", "GBMModel", "DRF", "DRFModel",
            "XGBoost", "XGBoostModel",
            "DeepLearning", "DeepLearningModel", "AutoEncoder",
            "KMeans", "KMeansModel", "PCA", "PCAModel", "SVD", "SVDModel",

@@ -1,0 +1,85 @@
+"""HGLM (mixed-effects GLM) — reference GLMModel.java HGLM surface."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.hglm import HGLM
+
+
+def _mixed_data(rng, n_groups=20, per=60, sig_u=2.0, sig_e=0.5,
+                rand_slope=False):
+    g = np.repeat(np.arange(n_groups), per)
+    x = rng.normal(size=n_groups * per).astype(np.float32)
+    u0 = rng.normal(scale=sig_u, size=n_groups)
+    y = 1.5 * x + 0.7 + u0[g]
+    if rand_slope:
+        u1 = rng.normal(scale=1.0, size=n_groups)
+        y = y + u1[g] * x
+    y = (y + rng.normal(scale=sig_e, size=len(g))).astype(np.float32)
+    fr = Frame.from_arrays({
+        "grp": np.array([f"g{i:02d}" for i in range(n_groups)],
+                        dtype=object)[g],
+        "x": x, "y": y})
+    return fr, u0, g
+
+
+def test_hglm_random_intercept(rng):
+    fr, u0, g = _mixed_data(rng)
+    m = HGLM(group_column="grp", max_iterations=60).train(
+        y="y", training_frame=fr)
+
+    # fixed effects recovered
+    coef = dict(zip(m.output["coef_names"], m.output["coef"]))
+    assert coef["x"] == pytest.approx(1.5, abs=0.1)
+    # variance components near truth (sig_u^2=4, sig_e^2=0.25)
+    assert m.output["sig_u"] == pytest.approx(4.0, rel=0.6)
+    assert m.output["sig_e"] == pytest.approx(0.25, rel=0.4)
+    # BLUPs track the simulated group intercepts (shrunken)
+    u = np.array([m.ranef()[f"g{i:02d}"]["intercept"] for i in range(20)])
+    assert np.corrcoef(u, u0)[0, 1] > 0.95
+
+    # group-aware predictions beat fixed-only predictions
+    pred = m.predict(fr).vec("predict").to_numpy()
+    y = fr.vec("y").to_numpy()
+    resid = np.sqrt(np.mean((pred - y) ** 2))
+    assert resid < 0.7, resid
+
+    from h2o3_tpu.models.glm import GLM
+    plain = GLM(family="gaussian").train(y="y", x=["x"], training_frame=fr)
+    plain_res = np.sqrt(np.mean(
+        (plain.predict(fr).vec("predict").to_numpy() - y) ** 2))
+    assert resid < 0.5 * plain_res
+
+
+def test_hglm_random_slope(rng):
+    fr, _, _ = _mixed_data(rng, rand_slope=True)
+    m = HGLM(group_column="grp", random_columns=["x"],
+             max_iterations=60).train(y="y", training_frame=fr)
+    pred = m.predict(fr).vec("predict").to_numpy()
+    y = fr.vec("y").to_numpy()
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.7
+    # ranef carries both intercept and slope entries
+    r = m.ranef()["g00"]
+    assert set(r) == {"intercept", "x"}
+
+
+def test_hglm_unseen_group_scores_fixed_only(rng):
+    fr, _, _ = _mixed_data(rng)
+    m = HGLM(group_column="grp", max_iterations=40).train(
+        y="y", training_frame=fr)
+    new = Frame.from_arrays({
+        "grp": np.array(["zz_new"] * 4, dtype=object),
+        "x": np.float32([0, 1, -1, 2])})
+    pred = m.predict(new).vec("predict").to_numpy()
+    coef = dict(zip(m.output["coef_names"], m.output["coef"]))
+    want = coef["x"] * np.float32([0, 1, -1, 2]) + m.output["coef"][-1]
+    np.testing.assert_allclose(pred, want, atol=1e-4)
+
+
+def test_hglm_validation(rng):
+    fr, _, _ = _mixed_data(rng, n_groups=4, per=10)
+    with pytest.raises(ValueError, match="group_column"):
+        HGLM().train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="categorical"):
+        HGLM(group_column="x").train(y="y", training_frame=fr)
