@@ -688,3 +688,47 @@ def perfect_auc(probs: Vec, acts: Vec) -> float:
     if npos == 0 or nneg == 0:
         return 1.0
     return float((ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+def grouped_permute(frame: Frame, perm_col, group_by, permute_by, keep_col
+                    ) -> Frame:
+    """AstGroupedPermute: per group, cross-join the ``perm_col`` ids whose
+    ``permute_by`` level is "D" (→ In) against the rest (→ Out), carrying
+    summed ``keep_col`` amounts — output (group…, In, Out, InAmnt, OutAmnt).
+    Plan-shaped (dynamic output size): grouped host pass like the
+    reference's per-node hash build."""
+    def col(i):
+        return frame.names[int(i)] if isinstance(i, (int, float)) else i
+
+    perm_col, keep_col = col(perm_col), col(keep_col)
+    pb = frame.vec(col(permute_by))
+    gcols = [col(g) for g in (group_by if isinstance(group_by, (list, tuple,
+                                                               np.ndarray))
+                              else [group_by])]
+    if not pb.is_categorical:
+        raise ValueError("permuteBy must be categorical")
+    is_in = np.array([lbl == "D" for lbl in pb.labels()])
+    gvals = np.stack([frame.vec(g).to_numpy().astype(np.float64)
+                      for g in gcols], 1)
+    rid = frame.vec(perm_col).to_numpy().astype(np.float64)
+    amt = frame.vec(keep_col).to_numpy().astype(np.float64)
+
+    groups: dict = {}
+    for r in range(frame.nrows):
+        key = tuple(gvals[r])
+        ins, outs = groups.setdefault(key, ({}, {}))
+        side = ins if is_in[r] else outs
+        side[rid[r]] = side.get(rid[r], 0.0) + amt[r]
+
+    rows: list[list[float]] = []
+    for key, (ins, outs) in groups.items():
+        for i_id, i_amt in ins.items():
+            for o_id, o_amt in outs.items():
+                rows.append(list(key) + [i_id, o_id, i_amt, o_amt])
+    names = gcols + ["In", "Out", "InAmnt", "OutAmnt"]
+    if not rows:
+        return Frame(names, [Vec.from_numpy(np.zeros(0, np.float32))
+                             for _ in names])
+    arr = np.asarray(rows, np.float32)
+    return Frame(names, [Vec.from_numpy(arr[:, j])
+                         for j in range(arr.shape[1])])

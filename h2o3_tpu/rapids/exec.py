@@ -517,6 +517,9 @@ def _eval(node, s: Session):
     if op == "moment":                             # AstMoment → epoch ms
         from h2o3_tpu.rapids import timeops as tt
         return _colwise_or_scalar_moment(args)
+    if op == "grouped_permute":                    # AstGroupedPermute
+        return ap.grouped_permute(args[0], args[1], args[2], args[3],
+                                  args[4])
     if op == "PermutationVarImp":
         # AstPermutationVarImp args: (model frame metric n_samples n_repeats
         # features seed) — h2o-py model_base.py:1788 sends exactly this order
@@ -638,7 +641,7 @@ _CHAIN_OPS = (
     "which.min", "countmatches", "strDistance", "tokenize", "difflag1",
     "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%",
     "replaceall", "replacefirst", "num_valid_substrings", "append",
-    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls", "PermutationVarImp",
+    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls", "PermutationVarImp", "grouped_permute",
 )
 
 

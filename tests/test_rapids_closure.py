@@ -286,3 +286,17 @@ def test_alias_and_time_prims(fr):
     import pandas as pd
     assert pd.Timestamp(mo.to_pandas()["time"][0]) == pd.Timestamp(
         "2020-02-29T12:00:00")
+
+
+def test_grouped_permute():
+    f = Frame.from_arrays({
+        "grp": np.float32([1, 1, 1, 2, 2]),
+        "id": np.float32([10, 11, 12, 20, 21]),
+        "side": np.array(["D", "D", "C", "D", "C"], dtype=object),
+        "amt": np.float32([5, 7, 3, 2, 9])})
+    out = ap.grouped_permute(f, "id", ["grp"], "side", "amt")
+    assert out.names == ["grp", "In", "Out", "InAmnt", "OutAmnt"]
+    rows = {tuple(out.vec(n).to_numpy()[i] for n in out.names)
+            for i in range(out.nrows)}
+    # group 1: In {10:5, 11:7} x Out {12:3}; group 2: In {20:2} x Out {21:9}
+    assert rows == {(1, 10, 12, 5, 3), (1, 11, 12, 7, 3), (2, 20, 21, 2, 9)}
