@@ -131,8 +131,8 @@ def metrics_v3(mm) -> dict | None:
             out[upper] = _clean(v)
     out.setdefault("nobs", _clean(getattr(mm, "nobs", 0)))
     out["description"] = None
-    out["custom_metric_name"] = None
-    out["custom_metric_value"] = 0.0
+    out["custom_metric_name"] = getattr(mm, "custom_metric_name", None)
+    out["custom_metric_value"] = _clean(getattr(mm, "custom_metric_value", 0.0))
     out["scoring_time"] = 0
     return {**_meta(schema), **out}
 

@@ -127,19 +127,34 @@ class _Handler(BaseHTTPRequestHandler):
         self._route("DELETE")
 
     def do_HEAD(self):
+        # same auth gate as every other method (HEAD must not leak liveness
+        # past the hash-login check)
+        if not self._check_auth():
+            return
         self.send_response(200)
         self.end_headers()
 
+    def _check_auth(self) -> bool:
+        """Constant-time credential check; replies 401 and returns False on
+        failure. Bytes comparison: header values arrive latin-1-decoded and
+        ``hmac.compare_digest`` rejects non-ASCII str."""
+        auth = getattr(self.server, "_auth", None)
+        if auth is None:
+            return True
+        import hmac
+        got = (self.headers.get("Authorization") or "").encode("latin-1",
+                                                               "replace")
+        if hmac.compare_digest(got, auth.encode("latin-1", "replace")):
+            return True
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", "Basic realm=h2o3_tpu")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
+
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
-        auth = getattr(self.server, "_auth", None)
-        import hmac
-        if auth is not None and not hmac.compare_digest(
-                self.headers.get("Authorization") or "", auth):
-            self.send_response(401)
-            self.send_header("WWW-Authenticate", "Basic realm=h2o3_tpu")
-            self.send_header("Content-Length", "0")
-            self.end_headers()
+        if not self._check_auth():
             return
         try:
             for pat, m, fn in _ROUTES:
@@ -174,7 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
         p = self._params()
         paths = p.get("paths", "")
         if isinstance(paths, str):
-            paths = [s.strip() for s in paths.strip("[]").split(",") if s.strip()]
+            try:          # JSON list first — handles quoted paths with commas
+                parsed = json.loads(paths)
+                paths = parsed if isinstance(parsed, list) else [str(parsed)]
+            except (json.JSONDecodeError, ValueError):
+                paths = [s.strip() for s in paths.strip("[]").split(",")
+                         if s.strip()]
         from h2o3_tpu.frame.parse import import_file
         keys, fails = [], []
         for path in paths:

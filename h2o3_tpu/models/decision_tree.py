@@ -18,7 +18,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.gbm import SharedTreeBuilder, SharedTreeModel, tree_matrix
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import make_model_key
-from h2o3_tpu.models.tree import TreeParams, grow_tree, predict_raw
+from h2o3_tpu.models.tree import TreeParams, grow_trees_batched
 from h2o3_tpu.models.data_info import response_as_float
 
 
@@ -60,13 +60,15 @@ class DecisionTree(SharedTreeBuilder):
         g = -w * yy
         h = w
         key = jax.random.PRNGKey(int(p.get("seed") or 0) or 5)
-        tree = grow_tree(binned, edges, g, h, w, tp,
-                         jnp.ones(binned.shape[1], bool), key=key)
+        trees, _ = grow_trees_batched(binned, edges, g[None], h[None], w[None],
+                                      tp, jnp.ones(binned.shape[1], bool),
+                                      key=key, cat_feats=self._cat_feats)
         job.update(1.0, "tree grown")
 
         return DecisionTreeModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain if yvec.is_categorical else None,
-            output=dict(trees=[tree], x_cols=list(x), feat_domains=domains),
+            output=dict(trees=trees, x_cols=list(x), feat_domains=domains,
+                        **self._cat_output()),
         )

@@ -168,7 +168,8 @@ class UpliftDRF(SharedTreeBuilder):
                 ws.append(wk)
             grown, _ = grow_trees_batched(
                 binned, edges, jnp.stack(gs), jnp.stack(hs), jnp.stack(ws),
-                tp, jnp.ones(binned.shape[1], bool), col_rate, keys[-1])
+                tp, jnp.ones(binned.shape[1], bool), col_rate, keys[-1],
+                cat_feats=self._cat_feats)
             trees.extend(grown)
             job.update((s + k) / ntrees, f"{s + k}/{ntrees} trees")
 
@@ -177,7 +178,8 @@ class UpliftDRF(SharedTreeBuilder):
             params=self.params, data_info=None, response_column=y,
             response_domain=yvec.domain,
             output=dict(trees=trees, x_cols=list(x), feat_domains=domains,
-                        treatment_column=tc, propensity=pt),
+                        treatment_column=tc, propensity=pt,
+                        **self._cat_output()),
         )
         return model
 

@@ -142,10 +142,11 @@ class Session:
         return value
 
     def remove(self, name: str):
-        """Drop a temp or DKV key (reference: ``AstRm``)."""
+        """Drop a temp or DKV key (reference: ``AstRm``). Temps are also
+        DKV-resident (assign puts them there), so both stores are cleared."""
         if name in self._tmp:
             del self._tmp[name]
-        elif name in DKV:
+        if name in DKV:
             DKV.remove(name)
 
     def end(self):

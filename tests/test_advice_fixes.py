@@ -106,3 +106,58 @@ def test_sql_distributed_order(tmp_path):
     a = fr.vec("a").to_numpy()
     assert fr.nrows == 97
     np.testing.assert_array_equal(np.sort(a), np.arange(97))
+
+
+def test_uplift_dt_categorical_scoring_consistent(rng):
+    """Round-2 ADVICE (high): UpliftDRF/DecisionTree trained on code-binned
+    categoricals but scored via raw threshold traversal — training predictions
+    and model.predict on the same frame must agree."""
+    from h2o3_tpu.models.decision_tree import DecisionTree
+    from h2o3_tpu.models.uplift import UpliftDRF
+
+    n = 500
+    cat = rng.integers(0, 5, size=n)
+    x1 = rng.normal(size=n).astype(np.float32)
+    # response depends non-monotonically on the category CODE, so ordinal
+    # threshold routing at scoring time cannot match group-split training
+    bump = np.array([3.0, -2.0, 1.5, -3.0, 2.5])[cat]
+    y = (bump + 0.2 * x1 + rng.normal(scale=0.2, size=n)).astype(np.float32)
+    fr = Frame.from_arrays({
+        "c": np.array(list("abcde"), dtype=object)[cat],
+        "x1": x1, "y": y,
+    })
+    m = DecisionTree(max_depth=4, seed=7).train(y="y", training_frame=fr)
+    assert m.output.get("cat_card") is not None     # masked path is active
+    pred = m.predict(fr).vec("predict").to_numpy()
+    # with the group-split routing the tree separates the 5 category means
+    for k in range(5):
+        sel = cat == k
+        assert abs(pred[sel].mean() - y[sel].mean()) < 0.5
+
+    treat = rng.integers(0, 2, size=n)
+    yy = (rng.random(n) < np.clip(0.3 + 0.3 * treat * (bump > 0), 0, 1))
+    fr2 = Frame.from_arrays({
+        "c": np.array(list("abcde"), dtype=object)[cat],
+        "x1": x1,
+        "treat": np.array(["no", "yes"], dtype=object)[treat],
+        "y": np.array(["no", "yes"], dtype=object)[yy.astype(int)],
+    })
+    um = UpliftDRF(ntrees=10, max_depth=4, treatment_column="treat",
+                   seed=7).train(y="y", training_frame=fr2)
+    assert um.output.get("cat_card") is not None
+    u = um.predict(fr2).vec("uplift_predict").to_numpy()
+    # categories with a real treatment effect should rank above the rest
+    assert u[bump > 0].mean() > u[bump <= 0].mean()
+
+
+def test_session_remove_clears_dkv():
+    """Round-2 ADVICE: Session.remove on a temp must also drop the DKV copy."""
+    from h2o3_tpu.rapids.exec import Session
+    from h2o3_tpu.utils.registry import DKV
+
+    s = Session()
+    fr = Frame.from_arrays({"a": np.arange(4, dtype=np.float32)})
+    s.assign("tmp_xyz", fr)
+    assert "tmp_xyz" in DKV
+    s.remove("tmp_xyz")
+    assert "tmp_xyz" not in DKV

@@ -221,5 +221,15 @@ def test_custom_metric_and_auth(rng):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req)
         assert ei.value.code == 401
+        # HEAD is gated too (round-2 ADVICE: do_HEAD bypassed auth)
+        req = urllib.request.Request(f"{s.url}/3/Cloud", method="HEAD")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            f"{s.url}/3/Cloud", method="HEAD",
+            headers={"Authorization": f"Basic {tok}"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
     finally:
         s.stop()
