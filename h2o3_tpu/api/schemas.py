@@ -151,8 +151,17 @@ def model_v3(model) -> dict:
                "training_metrics": metrics_v3(model.training_metrics),
                "validation_metrics": metrics_v3(model.validation_metrics),
                "cross_validation_metrics": metrics_v3(model.cross_validation_metrics),
+               # folds share one compiled program (CV by weight masking), so
+               # no per-fold model keys exist; h2o-py reads this key
+               # unconditionally when CV metrics are present
+               "cross_validation_models": None,
                "run_time_ms": model.run_time_ms,
            }}
+    meta_model = (model.output or {}).get("metalearner")
+    if meta_model is not None:
+        # h2o-py's H2OStackedEnsembleEstimator.metalearner() fetches this key
+        out["output"]["metalearner"] = {"name": meta_model.key}
+        out["output"]["stacking_strategy"] = "cross_validation"
     return out
 
 
@@ -162,6 +171,77 @@ def models_list_v3(store) -> dict:
               for k, v in store.raw_items()
               if isinstance(v, Model)]
     return {**_meta("ModelsV3"), "models": models}
+
+
+def twodim_table_v3(name: str, description: str,
+                    columns: list[tuple[str, str, str]],
+                    rows: list[list]) -> dict:
+    """TwoDimTableV3 wire format (reference:
+    ``water/api/schemas3/TwoDimTableV3.java:55`` ``fillFromImpl``): a leading
+    row-header column (name ``""`` after pythonify("#"), type string) then the
+    payload columns; ``data`` is column-major. h2o-py's ``H2OTwoDimTable.make``
+    keeps the row-header column in ``cell_values`` (its name is non-None) and
+    ``_fetch_table`` drops it via ``fr[1:]``."""
+    cols = [{"name": "", "type": "string", "format": "%s", "description": "#"}]
+    cols += [{"name": n, "type": t, "format": f, "description": n}
+             for n, t, f in columns]
+    data = [[str(i) for i in range(len(rows))]]
+    for c in range(len(columns)):
+        data.append([_clean(r[c]) for r in rows])
+    return {"__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
+                       "schema_type": "TwoDimTable"},
+            "name": name, "description": description,
+            "columns": cols, "rowcount": len(rows), "data": data}
+
+
+def leaderboard_v99(aml, extensions: list[str] | None = None) -> dict:
+    """LeaderboardV99 (reference:
+    ``water/automl/api/schemas3/LeaderboardV99.java:11``)."""
+    lb = aml.leaderboard
+    cols, rows, sort_metric, sort_dec, sort_vals, model_ids = (
+        lb.table(extensions) if lb is not None
+        else ([("model_id", "string", "%s")], [], "auc", True, [], []))
+    table = twodim_table_v3(
+        f"Leaderboard for project {aml.project_name}",
+        (f"models sorted in order of {sort_metric}, best first"
+         if rows else "no models in this leaderboard"),
+        cols, rows)
+    return {"__meta": {"schema_version": 99, "schema_name": "LeaderboardV99",
+                       "schema_type": "Leaderboard"},
+            "project_name": aml.project_name,
+            "models": [{"name": k} for k in model_ids],
+            "sort_metric": sort_metric,
+            "sort_metrics": _clean(sort_vals),
+            "sort_decreasing": sort_dec,
+            "table": table}
+
+
+def automl_v99(aml, job_key: str | None = None) -> dict:
+    """AutoMLV99 state (reference:
+    ``water/automl/api/schemas3/AutoMLV99.java:17``): the exact fields
+    h2o-py's ``_fetch_state`` reads — project_name, leaderboard.models,
+    leaderboard_table, event_log_table."""
+    lbv = leaderboard_v99(aml)
+    ev_cols = [("timestamp", "string", "%s"), ("level", "string", "%s"),
+               ("stage", "string", "%s"), ("message", "string", "%s"),
+               ("name", "string", "%s"), ("value", "string", "%s")]
+    ev_rows = aml.event_log.table_rows()
+    return {"__meta": {"schema_version": 99, "schema_name": "AutoMLV99",
+                       "schema_type": "AutoML"},
+            "automl_id": {"name": job_key or aml.project_name},
+            "project_name": aml.project_name,
+            "leaderboard": lbv,
+            "leaderboard_table": lbv["table"],
+            "event_log": {"name": f"{aml.project_name}_eventlog"},
+            "event_log_table": twodim_table_v3(
+                f"Event Log for:{aml.project_name}",
+                "Actions taken and discoveries made by AutoML",
+                ev_cols, ev_rows),
+            "sort_metric": lbv["sort_metric"],
+            "modeling_steps": [
+                {"name": name, "steps": [{"id": s, "weight": 10, "group": 1}
+                                         for s in steps]}
+                for name, steps in aml.modeling_steps()]}
 
 
 def job_v3(job_id: str, job) -> dict:

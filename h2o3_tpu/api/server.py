@@ -41,7 +41,9 @@ def _algo_registry():
                                      Infogram, PSVM, TargetEncoder, UpliftDRF,
                                      Word2Vec, XGBoost)
         from h2o3_tpu.models.hglm import HGLM
-        _ALGOS = {"gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
+        from h2o3_tpu.orchestration.stacked_ensemble import StackedEnsemble
+        _ALGOS = {"stackedensemble": StackedEnsemble,
+                  "gbm": GBM, "drf": DRF, "glm": GLM, "deeplearning": DeepLearning,
                   "xgboost": XGBoost, "kmeans": KMeans, "pca": PCA, "svd": SVD,
                   "glrm": GLRM, "naivebayes": NaiveBayes, "coxph": CoxPH,
                   "isolationforest": IsolationForest,
@@ -59,6 +61,17 @@ def _algo_registry():
 def _name(x):
     """Unwrap h2o-py's KeyV3 payloads: {"name": k} → k."""
     return x.get("name") if isinstance(x, dict) else x
+
+
+def _parse_list(v: str) -> list:
+    """Bracketed list payload: JSON first, else h2o-py's unquoted
+    ``stringify_list`` format ``[a,b,c]``."""
+    try:
+        out = json.loads(v)
+        return out if isinstance(out, list) else [out]
+    except (json.JSONDecodeError, ValueError):
+        return [s.strip().strip('"') for s in v.strip("[]").split(",")
+                if s.strip()]
 
 
 def _done_job(description: str, dest_key: str | None = None) -> dict:
@@ -189,12 +202,7 @@ class _Handler(BaseHTTPRequestHandler):
         p = self._params()
         paths = p.get("paths", "")
         if isinstance(paths, str):
-            try:          # JSON list first — handles quoted paths with commas
-                parsed = json.loads(paths)
-                paths = parsed if isinstance(parsed, list) else [str(parsed)]
-            except (json.JSONDecodeError, ValueError):
-                paths = [s.strip() for s in paths.strip("[]").split(",")
-                         if s.strip()]
+            paths = _parse_list(paths)
         from h2o3_tpu.frame.parse import import_file
         keys, fails = [], []
         for path in paths:
@@ -205,6 +213,40 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "ImportFilesV3"},
                      "destination_frames": keys, "fails": fails})
 
+    def r_postfile(self):
+        """Reference PostFileHandler (``water/api/PostFileHandler.java``,
+        used by ``h2o.upload_file``): store the multipart body's file part as
+        a raw key for ParseSetup/Parse. Uploads are size-capped (the
+        reference relies on Jetty limits)."""
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        dest = (q.get("destination_frame") or [None])[0]
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > 1 << 30:
+            self._error(413, f"upload of {length} bytes exceeds the 1GiB cap")
+            return
+        body = self.rfile.read(length)
+        ctype = self.headers.get("Content-Type", "")
+        data, fname = body, "upload.csv"
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if m:
+            for part in body.split(b"--" + m.group(1).encode()):
+                if b"\r\n\r\n" not in part:
+                    continue
+                hdrs, content = part.split(b"\r\n\r\n", 1)
+                if b"filename=" not in hdrs:
+                    continue
+                fm = re.search(rb'filename="?([^";\r\n]+)"?', hdrs)
+                if fm:
+                    fname = os.path.basename(fm.group(1).decode("utf-8",
+                                                                "replace"))
+                data = content[:-2] if content.endswith(b"\r\n") else content
+                break
+        from h2o3_tpu.frame.parse import RawFile
+        key = dest or f"{fname.replace('.', '_')}_{uuid.uuid4().hex[:8]}"
+        DKV.put(key, RawFile(data, name=fname))
+        self._reply({"__meta": {"schema_type": "PostFileV3"},
+                     "destination_frame": key, "total_bytes": len(data)})
+
     def r_parse(self):
         # the reference splits guess (ParseSetup) and parse; import_file did
         # both, so Parse re-keys the already-parsed frame and hands back an
@@ -214,7 +256,10 @@ class _Handler(BaseHTTPRequestHandler):
             p.get("source_frames"), str) else p.get("source_frames", [])
         src_key = (src[0] if src else p.get("source_key", ""))
         src_key = _name(src_key)
+        from h2o3_tpu.frame.parse import RawFile
         fr = DKV[src_key]
+        if isinstance(fr, RawFile):
+            fr = fr.frame()
         dest = _name(p.get("destination_frame")) or src_key
         if dest != src_key:
             DKV.remove(src_key)
@@ -282,8 +327,16 @@ class _Handler(BaseHTTPRequestHandler):
                 elif isinstance(d, float):
                     v = float(v)
                 elif isinstance(d, (list, tuple)) or v.startswith("["):
+                    v = _parse_list(v)
+                elif k == "metalearner_params" and v.startswith("{"):
                     v = json.loads(v)
             kwargs[k] = v
+        if algo.lower() == "stackedensemble":
+            # base_models arrive as ids (h2o-py _keyify; possibly _quoted or
+            # KeyV3 dicts) — resolve to the DKV-registered Model objects
+            kwargs["base_models"] = [
+                DKV[str(_name(b)).strip('"')]
+                for b in (kwargs.get("base_models") or [])]
         builder = cls(**kwargs)
         # pre-assign the model key: h2o-py's H2OJob reads dest.name from the
         # INITIAL builder response, before the background train finishes
@@ -399,32 +452,91 @@ class _Handler(BaseHTTPRequestHandler):
                      "failure_details": [d for _, d in g.failures]})
 
     def r_automl(self):
+        """Reference AutoMLBuilderHandler (``water/automl/api/
+        AutoMLBuilderHandler.java``): h2o-py POSTs a JSON body of
+        build_control / build_models / input_spec; our own client may send
+        the flat form. The run object registers in DKV under the job's
+        dest key so ``GET /99/AutoML/{key}`` can serve state mid-run."""
         p = self._params()
         from h2o3_tpu.orchestration import AutoML
-        spec = p.get("build_control", {})
-        if isinstance(spec, str):
-            spec = json.loads(spec)
-        # h2o-py nests budgets under build_control.stopping_criteria; flat
-        # fields win when both are present
-        crit = dict(spec.get("stopping_criteria") or {})
+        for k in ("build_control", "build_models", "input_spec"):
+            if isinstance(p.get(k), str):
+                p[k] = json.loads(p[k])
+        bc = dict(p.get("build_control") or {})
+        bm = dict(p.get("build_models") or {})
+        ispec = dict(p.get("input_spec") or {})
+        # flat budget fields win when both are present
+        crit = dict(bc.get("stopping_criteria") or {})
         crit.update({k: p[k] for k in ("max_models", "max_runtime_secs",
                                        "seed") if k in p})
-        frame = DKV[p.pop("training_frame")]
-        y = p.pop("response_column", None)
+        frame_key = _name(ispec.get("training_frame") or p["training_frame"])
+        frame = DKV[frame_key]
+        y = _name(ispec.get("response_column") or p.get("response_column"))
+        drop = set(ispec.get("ignored_columns") or [])
+        for c in (ispec.get("fold_column"), ispec.get("weights_column")):
+            if _name(c):
+                drop.add(_name(c))
+        x = ([c for c in frame.names if c != y and c not in drop]
+             if drop else None)
+        sort_metric = ispec.get("sort_metric") or p.get("sort_metric")
+        if sort_metric:
+            # wire names are uppercase/alias forms; Leaderboard rows key on
+            # the lowercase metric attrs (aucpr is stored as pr_auc)
+            sort_metric = {"aucpr": "pr_auc", "auto": None}.get(
+                sort_metric.lower(), sort_metric.lower())
+        project = (bc.get("project_name") or p.get("project_name")
+                   or f"AutoML_{uuid.uuid4().hex[:10]}")
+        nf = p.get("nfolds", bc.get("nfolds"))
+        nfolds = -1 if nf is None else int(nf)
+        if nfolds < 0:          # reference AUTO: -1 → 5-fold CV; 0 disables
+            nfolds = 5
+        seed = crit.get("seed")
         aml = AutoML(max_models=int(crit.get("max_models", 0) or 0),
                      max_runtime_secs=float(crit.get("max_runtime_secs", 0) or 0),
-                     nfolds=int(p.get("nfolds", spec.get("nfolds", 5)) or 5),
-                     seed=int(crit.get("seed", -1) or -1))
-        job = Job("AutoML via REST")
+                     nfolds=nfolds,
+                     seed=-1 if seed is None else int(seed),
+                     sort_metric=sort_metric,
+                     exclude_algos=bm.get("exclude_algos") or (),
+                     include_algos=bm.get("include_algos"),
+                     project_name=project)
+        DKV.put(project, aml)
+        lb_key = _name(ispec.get("leaderboard_frame"))
+        job = Job("AutoML via REST", key=f"job_{uuid.uuid4().hex[:12]}")
+        job.dest_key = project
 
         def driver(j: Job):
-            leader = aml.train(y=y, training_frame=frame)
-            j.dest_key = leader.key if leader else None
+            aml.train(x=x, y=y, training_frame=frame,
+                      leaderboard_frame=DKV[lb_key] if lb_key else None)
+            j.dest_key = project
             return aml
 
         job.run(driver, background=True)
         self._reply({"__meta": {"schema_type": "AutoMLBuilderV99"},
-                     "job": schemas.job_v3(job.key, job)})
+                     "job": schemas.job_v3(job.key, job),
+                     "build_control": {"project_name": project},
+                     "build_models": bm, "input_spec": ispec})
+
+    def r_automl_get(self, key):
+        """Reference AutoMLHandler.fetch (``water/automl/api/
+        AutoMLHandler.java``) — the state h2o-py's ``_fetch_state`` reads."""
+        from h2o3_tpu.orchestration import AutoML
+        aml = DKV[key]
+        if not isinstance(aml, AutoML):
+            raise KeyError(f"{key} is not an AutoML run")
+        self._reply(schemas.automl_v99(aml, job_key=key))
+
+    def r_leaderboards(self, project):
+        """Reference LeaderboardsHandler.fetch (``water/automl/api/
+        LeaderboardsHandler.java``)."""
+        from h2o3_tpu.orchestration import AutoML
+        p = self._params()
+        aml = DKV[project]
+        if not isinstance(aml, AutoML):
+            raise KeyError(f"{project} is not an AutoML run")
+        ext = p.get("extensions") or []
+        if isinstance(ext, str):
+            ext = _parse_list(ext)
+        self._reply(schemas.leaderboard_v99(aml, ext))
 
     def r_shutdown(self):
         self._reply({"__meta": {"schema_type": "ShutdownV3"}})
@@ -516,12 +628,15 @@ class _Handler(BaseHTTPRequestHandler):
         keys = [s.get("name") if isinstance(s, dict) else s for s in src]
         if not keys:
             raise KeyError("source_frames is required")
+        from h2o3_tpu.frame.parse import RawFile, import_file
         frames = []
         for k in keys:
-            if k in DKV and isinstance(DKV[k], Frame):
-                frames.append(DKV[k])
+            obj = DKV.get(k)
+            if isinstance(obj, RawFile):
+                frames.append(obj.frame())
+            elif isinstance(obj, Frame):
+                frames.append(obj)
             else:
-                from h2o3_tpu.frame.parse import import_file
                 frames.append(import_file(k))
         fr = frames[0]
         type_names = {"real": "Numeric", "int": "Numeric", "enum": "Enum",
@@ -1065,6 +1180,12 @@ _ROUTES = [
     (r"/99/Grid/([^/]+)", "POST", _Handler.r_grid),
     (r"/99/Grids/([^/]+)", "GET", _Handler.r_grid_get),
     (r"/99/AutoMLBuilder", "POST", _Handler.r_automl),
+    (r"/99/AutoML/([^/]+)", "GET", _Handler.r_automl_get),
+    (r"/99/Leaderboards/([^/]+)", "GET", _Handler.r_leaderboards),
+    (r"/99/ModelBuilders/([^/]+)", "POST", _Handler.r_train),
+    (r"/99/Models/([^/]+)", "GET", _Handler.r_model),
+    (r"/3/PostFile", "POST", _Handler.r_postfile),
+    (r"/3/PostFile\.bin", "POST", _Handler.r_postfile),
     (r"/3/Shutdown", "POST", _Handler.r_shutdown),
     (r"/3/GarbageCollect", "POST", _Handler.r_gc),
     (r"/3/Timeline", "GET", _Handler.r_timeline),

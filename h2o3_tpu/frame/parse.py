@@ -107,6 +107,39 @@ def upload_file(path: str, key: str | None = None, **kw) -> Frame:
     return import_file(path, key=key, **kw)
 
 
+class RawFile:
+    """Unparsed uploaded bytes (reference: ``water/fvec/UploadFileVec`` — the
+    raw key ``POST /3/PostFile`` creates, later consumed by ParseSetup/Parse).
+    Parsing is lazy and cached: ParseSetup triggers it for the type guess and
+    Parse re-keys the same Frame."""
+
+    nrows = 0
+    ncols = 0
+
+    def __init__(self, data: bytes, name: str = "upload"):
+        self.data = data
+        self.name = name
+        self._frame: Frame | None = None
+
+    def frame(self) -> Frame:
+        if self._frame is None:
+            import tempfile
+            suffix = os.path.splitext(self.name)[1] or ".csv"
+            fd, tmp = tempfile.mkstemp(suffix=suffix)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(self.data)
+                self._frame = import_file(tmp, key=self.name)
+            finally:
+                os.unlink(tmp)
+            # import_file registers its result; only Parse's destination key
+            # should be visible — the raw upload must not leave a phantom
+            # entry under the original filename
+            if self.name in DKV:
+                DKV.remove(self.name)
+        return self._frame
+
+
 def parse_raw(text: str, key: str | None = None, **kw) -> Frame:
     """Parse CSV text from memory (test fixture convenience)."""
     import pandas as pd

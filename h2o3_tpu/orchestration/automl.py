@@ -27,17 +27,31 @@ from h2o3_tpu.orchestration.leaderboard import Leaderboard
 
 
 class EventLog:
-    """Timestamped AutoML event record (reference: ai/h2o/automl/events/)."""
+    """Timestamped AutoML event record (reference: ai/h2o/automl/events/
+    EventLogEntry.java — rows of timestamp/level/stage/message/name/value;
+    the name/value pairs feed h2o-py's ``aml.training_info``)."""
 
     def __init__(self):
-        self.events: list[tuple[float, str, str]] = []
+        self.events: list[tuple[float, str, str, str, str, str]] = []
 
-    def log(self, stage: str, message: str) -> None:
-        self.events.append((time.time(), stage, message))
+    def log(self, stage: str, message: str, level: str = "Info",
+            name: str = "", value: str = "") -> None:
+        self.events.append((time.time(), level, stage, message,
+                            str(name), str(value)))
+
+    def info(self, name: str, value) -> None:
+        """A training_info entry (reference: EventLogEntry name/value rows)."""
+        self.log("TrainingInfo", "", name=name, value=value)
+
+    def table_rows(self) -> list[list[str]]:
+        return [[time.strftime("%Y.%m.%d %H:%M:%S", time.localtime(t)),
+                 lvl, s, m, n, v]
+                for t, lvl, s, m, n, v in self.events]
 
     def as_list(self) -> list[str]:
-        return [f"[{time.strftime('%H:%M:%S', time.localtime(t))}] {s}: {m}"
-                for t, s, m in self.events]
+        return [f"[{time.strftime('%H:%M:%S', time.localtime(t))}] {s}: "
+                f"{m or f'{n}={v}'}"
+                for t, _lvl, s, m, n, v in self.events]
 
 
 class AutoML:
@@ -140,6 +154,8 @@ class AutoML:
         if y is None or training_frame is None:
             raise ValueError("y and training_frame are required")
         self._t0 = time.time()
+        self.event_log.info("creation_epoch", int(self._t0))
+        self.event_log.info("start_epoch", int(self._t0))
         yvec = training_frame.vec(y)
         classification = yvec.is_categorical
         self.leaderboard = Leaderboard(self.sort_metric, leaderboard_frame)
@@ -153,9 +169,12 @@ class AutoML:
                       keep_cross_validation_predictions=True)
         base_models: list[Model] = []
         # reserve the exploitation share of the model budget (reference:
-        # WorkAllocations gives the exploitation steps their own allocation)
+        # WorkAllocations gives the exploitation steps their own allocation).
+        # Tiny budgets (< 5 models) skip the reserve: annealing one of two
+        # models would starve the base plan and the ensembles behind it
         reserved = (max(1, int(round(self.max_models * self.exploitation_ratio)))
-                    if self.max_models > 1 and self.exploitation_ratio > 0
+                    if self.max_models >= 5 and self.exploitation_ratio > 0
+                    and (self._algo_enabled("GBM") or self._algo_enabled("XGBOOST"))
                     else 0)
         self._cap = (self.max_models - reserved) if self.max_models else None
 
@@ -316,7 +335,25 @@ class AutoML:
 
         log.log("done", f"{len(self.leaderboard)} models in "
                         f"{time.time() - self._t0:.1f}s")
+        log.info("stop_epoch", int(time.time()))
+        log.info("duration_secs", round(time.time() - self._t0, 1))
         return self.leader
+
+    def modeling_steps(self) -> list[tuple[str, list[str]]]:
+        """Effective plan by provider family (reference:
+        ``StepDefinition``/``ModelingPlans.java``; surfaced as
+        ``aml.modeling_steps`` in h2o-py)."""
+        fams: dict[str, list[str]] = {}
+        for algo, _cls, _p in self._steps():
+            if self._algo_enabled(algo):
+                lst = fams.setdefault(algo, [])
+                lst.append(f"def_{len(lst) + 1}")
+        for algo, _cls, _f, _h, _s in self._grids():
+            if self._algo_enabled(algo):
+                fams.setdefault(algo, []).append("grid_1")
+        if self._algo_enabled("STACKEDENSEMBLE"):
+            fams["StackedEnsemble"] = ["best_of_family", "all"]
+        return [(k, v) for k, v in fams.items()]
 
     @property
     def leader(self) -> Model | None:

@@ -35,7 +35,8 @@ class Leaderboard:
         row = {"model_id": model.key, "algo": model.algo,
                "training_time_ms": model.run_time_ms, "_model": model}
         for f in ("auc", "pr_auc", "logloss", "mean_per_class_error", "rmse",
-                  "mse", "mae", "r2", "accuracy"):
+                  "mse", "mae", "r2", "accuracy", "rmsle",
+                  "mean_residual_deviance"):
             if hasattr(mm, f):
                 v = getattr(mm, f)
                 row[f] = float(v() if callable(v) else v)
@@ -70,6 +71,62 @@ class Leaderboard:
                             dtype=object if c in ("model_id", "algo") else float)
                 for c in cols}
         return Frame.from_arrays(data)
+
+    def table(self, extensions: Sequence[str] | None = None):
+        """Wire-format table spec (reference: ``Leaderboard.toTwoDimTable``,
+        ``hex/leaderboard/Leaderboard.java:776``): column specs, row-major
+        cells, sort metric/direction/values, ranked model ids. The metric
+        column set follows ``defaultMetricsForModel``
+        (``Leaderboard.java:681``); ``extensions`` ("ALL" or named) appends
+        the extension columns (``hex/leaderboard/TrainingTime.java`` etc.)."""
+        rows = self._sorted()
+        if not rows:
+            return ([("model_id", "string", "%s")], [], self.sort_metric or "auc",
+                    True, [], [])
+        model = rows[0]["_model"]
+        if model.nclasses == 2:
+            metrics = ["auc", "logloss", "aucpr", "mean_per_class_error",
+                       "rmse", "mse"]
+        elif model.nclasses > 2:
+            metrics = ["mean_per_class_error", "logloss", "rmse", "mse"]
+        else:
+            metrics = ["rmse", "mse", "mae", "rmsle", "mean_residual_deviance"]
+        sort_metric = self.sort_metric or default_metric(model)
+        if sort_metric in metrics and metrics[0] != sort_metric:
+            metrics.remove(sort_metric)
+            metrics.insert(0, sort_metric)
+        elif sort_metric not in metrics:
+            metrics.insert(0, sort_metric)
+        ext = [e.lower() for e in (extensions or [])]
+        known_ext = ("training_time_ms", "predict_time_per_row_ms", "algo")
+        ext_cols = (list(known_ext) if "all" in ext
+                    else [e for e in ext if e in known_ext])
+
+        def cell(r, m):
+            # wire names that differ from our metric attr names
+            attr = {"aucpr": "pr_auc",
+                    "mean_residual_deviance": "mean_residual_deviance"}.get(m, m)
+            v = r.get(attr, np.nan)
+            return float(v) if v is not None else np.nan
+
+        cols = [("model_id", "string", "%s")]
+        cols += [(m, "double", "%.6f") for m in metrics]
+        cols += [(("algo", "string", "%s") if e == "algo" else
+                  (e, "double", "%.1f")) for e in ext_cols]
+        out_rows = []
+        for r in rows:
+            row = [r["model_id"]] + [cell(r, m) for m in metrics]
+            for e in ext_cols:
+                if e == "algo":
+                    row.append(r.get("algo", ""))
+                else:
+                    v = r.get(e)
+                    row.append(np.nan if v is None else float(v))
+            out_rows.append(row)
+        sort_vals = [cell(r, sort_metric) for r in rows]
+        return (cols, out_rows, sort_metric,
+                metric_higher_is_better(sort_metric), sort_vals,
+                [r["model_id"] for r in rows])
 
     def __len__(self) -> int:
         return len(self._rows)

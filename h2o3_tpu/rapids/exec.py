@@ -63,8 +63,9 @@ def _parse(tokens: list[str]) -> Any:
         tokens.pop(0)
         # numeric literals stay an ndarray (row/col index lists); string
         # lists (domains, match tables, pattern lists) stay Python lists
-        if all(isinstance(x, float) for x in lst):
-            return np.array(lst, dtype=np.float64)
+        if all(isinstance(x, (float, np.ndarray)) for x in lst):
+            return (np.concatenate([np.atleast_1d(x) for x in lst])
+                    if lst else np.array([], dtype=np.float64))
         return [x[1] if isinstance(x, tuple) else x for x in lst]
     if tok[0] in "\"'":
         return ("str", tok[1:-1])
@@ -76,6 +77,22 @@ def _parse(tokens: list[str]) -> Any:
         return float("nan")
     if tok.startswith("#"):      # reference numeric literal syntax
         tok = tok[1:]
+    if ":" in tok:
+        # AstNumList range entry (reference: water/rapids/ast/params/
+        # AstNumList.java:16 — base:cnt or base:cnt:stride); h2o-py emits
+        # these for frame slices (expr.py serializes fr[1:] as "[1:N]")
+        parts = tok.split(":")
+        if 2 <= len(parts) <= 3:
+            try:
+                base = float(parts[0])
+                cnt = float(parts[1])
+                stride = float(parts[2]) if len(parts) == 3 else 1.0
+                if not np.isfinite(cnt):
+                    raise ValueError(f"open-ended range {tok!r} unsupported")
+                return base + stride * np.arange(int(cnt), dtype=np.float64)
+            except ValueError as e:
+                if "open-ended" in str(e):
+                    raise
     try:
         return float(tok)
     except ValueError:
@@ -159,8 +176,25 @@ def rapids(expr: str, session: Session | None = None):
     return _eval(_parse(_tokenize(expr)), session)
 
 
+def _sel_names(fr, sel) -> list[str]:
+    """Column selection: name, list of names, or numeric index array."""
+    if isinstance(sel, str):
+        return [sel]
+    if isinstance(sel, list):          # string-list literal ['name' 'value']
+        return [str(x) for x in sel]
+    return [fr.names[int(i)] for i in np.atleast_1d(sel)]
+
+
 def _eval(node, s: Session):
     if isinstance(node, float) or isinstance(node, np.ndarray):
+        return node
+    if isinstance(node, str):
+        return node
+    if isinstance(node, list) and (not node or
+                                   not isinstance(node[0], (tuple, list))):
+        # literal list from _parse (string lists: domains, column names) —
+        # expression nodes always head with an ('id', op) tuple or a nested
+        # list, so a plain-value head means this IS the value
         return node
     if isinstance(node, tuple):
         kind, val = node
@@ -215,9 +249,7 @@ def _eval(node, s: Session):
             _as_vec(no) if isinstance(no, Frame) else no))
     if op == "cols":
         fr, sel = args
-        names = [sel] if isinstance(sel, str) else \
-            [fr.names[int(i)] for i in np.atleast_1d(sel)]
-        return fr[names]
+        return fr[_sel_names(fr, sel)]
     if op == "rows":
         fr, sel = args
         if isinstance(sel, Frame):
@@ -235,8 +267,7 @@ def _eval(node, s: Session):
         return munge.unique(args[0])
     if op == "sort":
         fr, sel = args[0], args[1]
-        cols = [sel] if isinstance(sel, str) else \
-            [fr.names[int(i)] for i in np.atleast_1d(sel)]
+        cols = _sel_names(fr, sel)
         asc = [bool(a) for a in np.atleast_1d(args[2])] if len(args) > 2 else True
         return munge.sort(fr, cols, asc)
     if op == "merge":
@@ -512,9 +543,7 @@ def _eval(node, s: Session):
                      key=fr.key).add(name, _as_vec(col))
     if op == "cols_py":                            # AstColPySlice
         fr, sel = args[0], args[1]
-        names = [sel] if isinstance(sel, str) else \
-            [fr.names[int(i)] for i in np.atleast_1d(sel)]
-        return fr[names]
+        return fr[_sel_names(fr, sel)]
     if op == "moment":                             # AstMoment → epoch ms
         from h2o3_tpu.rapids import timeops as tt
         return _colwise_or_scalar_moment(args)

@@ -69,6 +69,47 @@ assert 0.65 < drf.model_performance(te).auc() <= 1.0
 km = H2OKMeansEstimator(k=3, seed=1)
 km.train(x=["x1", "x2"], training_frame=tr)
 
+# upload_file: POST /3/PostFile + ParseSetup + Parse on the raw key
+up = h2o.upload_file(csv)
+assert up.nrow == 300 and up.ncol == 3, (up.nrow, up.ncol)
+assert up.types == {"x1": "real", "x2": "real", "y": "enum"}, up.types
+
+# AutoML over the wire: POST /99/AutoMLBuilder + job poll + GET /99/AutoML
+# + leaderboard/event-log TwoDimTable → H2OFrame round-trip
+from h2o.automl import H2OAutoML, get_leaderboard
+
+aml = H2OAutoML(max_models=3, seed=1, verbosity=None)
+aml.train(y="y", training_frame=tr)
+assert aml.leader is not None
+lb = aml.leaderboard
+assert lb.nrow >= 3, lb.nrow
+assert lb.col_names[0] == "model_id" and "auc" in lb.col_names, lb.col_names
+se_rows = [r for r in lb["model_id"].as_data_frame()["model_id"]
+           if "StackedEnsemble" in r]
+assert len(se_rows) >= 2, "AutoML must rank its two ensembles"
+assert "start_epoch" in aml.training_info
+lb_all = get_leaderboard(aml, extra_columns="ALL")   # GET /99/Leaderboards
+assert "algo" in lb_all.col_names, lb_all.col_names
+apred = aml.predict(te)
+assert apred.nrow == te.nrow
+
+# StackedEnsemble over the wire: POST /99/ModelBuilders/stackedensemble
+from h2o.estimators import H2OStackedEnsembleEstimator
+
+cv_gbm = H2OGradientBoostingEstimator(
+    ntrees=5, max_depth=3, nfolds=3, seed=1,
+    keep_cross_validation_predictions=True)
+cv_gbm.train(x=["x1", "x2"], y="y", training_frame=tr)
+cv_drf = H2ORandomForestEstimator(
+    ntrees=5, max_depth=4, nfolds=3, seed=1,
+    keep_cross_validation_predictions=True)
+cv_drf.train(x=["x1", "x2"], y="y", training_frame=tr)
+se = H2OStackedEnsembleEstimator(base_models=[cv_gbm, cv_drf])
+se.train(x=["x1", "x2"], y="y", training_frame=tr)
+assert se.metalearner() is not None
+assert 0.7 < se.model_performance(te).auc() <= 1.0
+assert se.predict(te).col_names == ["predict", "pno", "pyes"]
+
 # frame round-trips the client relies on
 df = te.as_data_frame()
 assert list(df.columns) == ["x1", "x2", "y"] and len(df) == te.nrow

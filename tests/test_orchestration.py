@@ -148,7 +148,7 @@ def test_automl_small(rng):
     assert len(aml.leaderboard) >= 4
     algos = {m.algo for m in aml.leaderboard.models}
     assert "gbm" in algos and "glm" in algos
-    assert any("model" == s for _, s, _ in aml.event_log.events)
+    assert any("model" == s for _, _, s, _, _, _ in aml.event_log.events)
     # leaderboard sorted by AUC descending
     aucs = []
     for r in aml.leaderboard._sorted():
