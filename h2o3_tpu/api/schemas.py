@@ -106,10 +106,13 @@ def frames_list_v3(store) -> dict:
     return {**_meta("FramesV3"), "frames": frames}
 
 
-def metrics_v3(mm) -> dict | None:
+def metrics_v3(mm, domain=None) -> dict | None:
     if mm is None:
         return None
     out = {}
+    if domain is not None and hasattr(mm, "auc"):
+        # h2o-py's perf.confusion_matrix() reads the class labels here
+        out["domain"] = list(domain)
     for f in ("mse", "rmse", "mae", "r2", "logloss", "auc", "pr_auc",
               "mean_per_class_error", "residual_deviance", "null_deviance",
               "accuracy", "mean_residual_deviance", "totss", "tot_withinss",
@@ -130,6 +133,22 @@ def metrics_v3(mm) -> dict | None:
         if v is not None and not callable(v):
             out[upper] = _clean(v)
     out.setdefault("nobs", _clean(getattr(mm, "nobs", 0)))
+    if hasattr(mm, "threshold_table"):
+        # AUC2 criteria tables (reference: hex/AUC2.java; h2o-py's
+        # perf.F1()/mcc()/find_threshold_by_max_metric read these)
+        tcols, trows = mm.threshold_table()
+        if trows:
+            out["thresholds_and_metric_scores"] = twodim_table_v3(
+                "Metrics for Thresholds", "Binomial metrics as a function of "
+                "classification thresholds",
+                [(c, "long" if c == "idx" else "double", "%f")
+                 for c in tcols], trows)
+            _, mrows = mm.max_criteria_and_metric_scores((tcols, trows))
+            out["max_criteria_and_metric_scores"] = twodim_table_v3(
+                "Maximum Metrics", "Maximum metrics at their respective "
+                "thresholds",
+                [("metric", "string", "%s"), ("threshold", "double", "%f"),
+                 ("value", "double", "%f"), ("idx", "long", "%d")], mrows)
     out["description"] = None
     out["custom_metric_name"] = getattr(mm, "custom_metric_name", None)
     out["custom_metric_value"] = _clean(getattr(mm, "custom_metric_value", 0.0))
@@ -148,15 +167,22 @@ def model_v3(model) -> dict:
                "model_category": ("Binomial" if model.nclasses == 2 else
                                   "Multinomial" if model.nclasses > 2 else
                                   "Regression"),
-               "training_metrics": metrics_v3(model.training_metrics),
-               "validation_metrics": metrics_v3(model.validation_metrics),
-               "cross_validation_metrics": metrics_v3(model.cross_validation_metrics),
+               "training_metrics": metrics_v3(model.training_metrics,
+                                              model.response_domain),
+               "validation_metrics": metrics_v3(model.validation_metrics,
+                                                model.response_domain),
+               "cross_validation_metrics": metrics_v3(
+                   model.cross_validation_metrics, model.response_domain),
                # folds share one compiled program (CV by weight masking), so
                # no per-fold model keys exist; h2o-py reads this key
                # unconditionally when CV metrics are present
                "cross_validation_models": None,
                "run_time_ms": model.run_time_ms,
            }}
+    if model.scoring_history is not None:
+        cols, rows = model.scoring_history
+        out["output"]["scoring_history"] = twodim_table_v3(
+            "Scoring History", "", cols, rows)
     meta_model = (model.output or {}).get("metalearner")
     if meta_model is not None:
         # h2o-py's H2OStackedEnsembleEstimator.metalearner() fetches this key
@@ -175,17 +201,20 @@ def models_list_v3(store) -> dict:
 
 def twodim_table_v3(name: str, description: str,
                     columns: list[tuple[str, str, str]],
-                    rows: list[list]) -> dict:
+                    rows: list[list], row_headers: bool = False) -> dict:
     """TwoDimTableV3 wire format (reference:
-    ``water/api/schemas3/TwoDimTableV3.java:55`` ``fillFromImpl``): a leading
-    row-header column (name ``""`` after pythonify("#"), type string) then the
-    payload columns; ``data`` is column-major. h2o-py's ``H2OTwoDimTable.make``
-    keeps the row-header column in ``cell_values`` (its name is non-None) and
-    ``_fetch_table`` drops it via ``fr[1:]``."""
-    cols = [{"name": "", "type": "string", "format": "%s", "description": "#"}]
+    ``water/api/schemas3/TwoDimTableV3.java:55`` ``fillFromImpl``); ``data``
+    is column-major. With ``row_headers`` a leading row-index column (name
+    ``""`` after pythonify("#"), type string) is embedded — the
+    leaderboard/event-log convention, where h2o-py's ``_fetch_table`` drops
+    it via ``fr[1:]``. Metric/scoring tables ship WITHOUT it (the reference
+    passes a null colHeaderForRowHeaders; h2o-py indexes ``cell_values[0]``
+    as the first real column)."""
+    cols = ([{"name": "", "type": "string", "format": "%s", "description": "#"}]
+            if row_headers else [])
     cols += [{"name": n, "type": t, "format": f, "description": n}
              for n, t, f in columns]
-    data = [[str(i) for i in range(len(rows))]]
+    data = [[str(i) for i in range(len(rows))]] if row_headers else []
     for c in range(len(columns)):
         data.append([_clean(r[c]) for r in rows])
     return {"__meta": {"schema_version": 3, "schema_name": "TwoDimTableV3",
@@ -205,7 +234,7 @@ def leaderboard_v99(aml, extensions: list[str] | None = None) -> dict:
         f"Leaderboard for project {aml.project_name}",
         (f"models sorted in order of {sort_metric}, best first"
          if rows else "no models in this leaderboard"),
-        cols, rows)
+        cols, rows, row_headers=True)
     return {"__meta": {"schema_version": 99, "schema_name": "LeaderboardV99",
                        "schema_type": "Leaderboard"},
             "project_name": aml.project_name,
@@ -236,7 +265,7 @@ def automl_v99(aml, job_key: str | None = None) -> dict:
             "event_log_table": twodim_table_v3(
                 f"Event Log for:{aml.project_name}",
                 "Actions taken and discoveries made by AutoML",
-                ev_cols, ev_rows),
+                ev_cols, ev_rows, row_headers=True),
             "sort_metric": lbv["sort_metric"],
             "modeling_steps": [
                 {"name": name, "steps": [{"id": s, "weight": 10, "group": 1}

@@ -141,33 +141,101 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_HEAD(self):
         # same auth gate as every other method (HEAD must not leak liveness
-        # past the hash-login check)
+        # past the login check)
         if not self._check_auth():
             return
         self.send_response(200)
         self.end_headers()
 
+    def _session_token(self) -> str | None:
+        cookie = self.headers.get("Cookie") or ""
+        for part in cookie.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == "h2o3_session":
+                return v
+        return None
+
     def _check_auth(self) -> bool:
-        """Constant-time credential check; replies 401 and returns False on
-        failure. Bytes comparison: header values arrive latin-1-decoded and
-        ``hmac.compare_digest`` rejects non-ASCII str."""
-        auth = getattr(self.server, "_auth", None)
-        if auth is None:
+        """Credential gate (reference: ``water/H2O.java:242-266`` hash/LDAP
+        login + ``water/webserver`` form auth): a valid form-login session
+        cookie OR Basic credentials accepted by the server's pluggable
+        authenticator. Replies 401 and returns False on failure."""
+        authfn = getattr(self.server, "_authenticate", None)
+        if authfn is None:
             return True
-        import hmac
-        got = (self.headers.get("Authorization") or "").encode("latin-1",
-                                                               "replace")
-        if hmac.compare_digest(got, auth.encode("latin-1", "replace")):
-            return True
+        tok = self._session_token()
+        sessions = getattr(self.server, "_login_sessions", {})
+        if tok in sessions:
+            import time as _t
+            if _t.time() < sessions[tok]:
+                return True
+            del sessions[tok]          # expired
+        hdr = self.headers.get("Authorization") or ""
+        if hdr.startswith("Basic "):
+            import base64
+            try:
+                user, _, pw = base64.b64decode(
+                    hdr[6:]).decode("utf-8", "replace").partition(":")
+            except Exception:
+                user = pw = None
+            if user is not None and authfn(user, pw):
+                return True
         self.send_response(401)
         self.send_header("WWW-Authenticate", "Basic realm=h2o3_tpu")
         self.send_header("Content-Length", "0")
         self.end_headers()
         return False
 
+    def r_login_page(self):
+        """Minimal form-login page (reference: ``login.html`` served by the
+        reference's Jetty when form auth is on)."""
+        body = (b"<html><body><form method='POST' action='/login'>"
+                b"<input name='username' placeholder='username'/>"
+                b"<input name='password' type='password'/>"
+                b"<button type='submit'>Log in</button></form></body></html>")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_login(self):
+        """Form login → session cookie (reference: j_security_check)."""
+        p = self._params()
+        authfn = getattr(self.server, "_authenticate", None)
+        if authfn is not None and not authfn(str(p.get("username") or ""),
+                                             str(p.get("password") or "")):
+            self._error(401, "invalid credentials")
+            return
+        import time as _t
+        sessions = self.server._login_sessions
+        now = _t.time()
+        for k in [k for k, exp in sessions.items() if exp < now]:
+            del sessions[k]            # sweep expired tokens
+        if len(sessions) >= 10_000:    # cap: a login-per-request client
+            sessions.clear()           # must fall back to re-auth, not OOM us
+        tok = uuid.uuid4().hex
+        sessions[tok] = now + self.server._session_ttl
+        body = b'{"status": "ok"}'
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Set-Cookie",
+                         f"h2o3_session={tok}; HttpOnly; Path=/")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_logout(self):
+        tok = self._session_token()
+        self.server._login_sessions.pop(tok, None)
+        self._reply({"__meta": {"schema_type": "LogoutV3"}, "status": "ok"})
+
+    #: paths reachable without credentials (the login flow itself)
+    _AUTH_EXEMPT = {"/login", "/logout"}
+
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
-        if not self._check_auth():
+        if path not in self._AUTH_EXEMPT and not self._check_auth():
             return
         try:
             for pat, m, fn in _ROUTES:
@@ -934,7 +1002,7 @@ class _Handler(BaseHTTPRequestHandler):
     def r_model_metrics_compute(self, model_key, frame_key):
         m, fr = DKV[model_key], DKV[frame_key]
         mm = m.model_performance(fr)
-        item = schemas.metrics_v3(mm)
+        item = schemas.metrics_v3(mm, getattr(m, "response_domain", None))
         item["frame"] = {"name": frame_key}     # h2o-py filters on these
         item["model"] = {"name": model_key}
         self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
@@ -942,9 +1010,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def r_model_metrics_get(self, model_key):
         m = DKV[model_key]
-        mms = [schemas.metrics_v3(mm) for mm in
-               (m.training_metrics, m.validation_metrics,
-                m.cross_validation_metrics) if mm is not None]
+        mms = [schemas.metrics_v3(mm, getattr(m, "response_domain", None))
+               for mm in (m.training_metrics, m.validation_metrics,
+                          m.cross_validation_metrics) if mm is not None]
         self._reply({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
                      "model_metrics": mms})
 
@@ -1256,33 +1324,58 @@ _ROUTES = [
     (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
     (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
     (r"/3/NetworkTest", "GET", _Handler.r_network_test),
+    (r"/login", "GET", _Handler.r_login_page),
+    (r"/login", "POST", _Handler.r_login),
+    (r"/logout", "POST", _Handler.r_logout),
 ]
 
 
 class H2OServer:
-    """Embeddable REST server (reference: ``water.H2OApp`` + Jetty)."""
+    """Embeddable REST server (reference: ``water.H2OApp`` + Jetty).
+
+    Auth (reference ``water/H2O.java:242-266``): ``username``/``password``
+    is the built-in hash login; ``authenticator`` is the pluggable hook —
+    any ``(user, password) -> bool`` (an LDAP bind, a PAM check, a htpasswd
+    file) slots in where the reference accepts a JAAS login module. Form
+    login (POST /login → session cookie) works with either.
+
+    TLS (reference ``h2o-internal-security``): pass ``ssl_certfile`` (+
+    optional ``ssl_keyfile``) to serve https.
+    """
 
     def __init__(self, port: int = 54321, host: str = "127.0.0.1",
-                 username: str | None = None, password: str | None = None):
+                 username: str | None = None, password: str | None = None,
+                 authenticator=None, ssl_certfile: str | None = None,
+                 ssl_keyfile: str | None = None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd._session_id = f"_sid_{uuid.uuid4().hex[:10]}"
         self.httpd._session_props = {}
         self.httpd._rapids_sessions = {}
-        # hash-login auth (reference: water/H2O.java:242-266 -hash_login;
-        # LDAP/Kerberos/SPNEGO are JVM-infra features with no counterpart)
-        if username is not None:
-            import base64
-            token = base64.b64encode(
-                f"{username}:{password or ''}".encode()).decode()
-            self.httpd._auth = f"Basic {token}"
+        self.httpd._login_sessions = {}    # token → expiry epoch
+        self.httpd._session_ttl = 8 * 3600.0   # Jetty-like session TTL
+        if authenticator is not None:
+            self.httpd._authenticate = authenticator
+        elif username is not None:
+            import hmac
+            stored = f"{username}:{password or ''}".encode()
+            self.httpd._authenticate = (
+                lambda u, p: hmac.compare_digest(f"{u}:{p}".encode(), stored))
         else:
-            self.httpd._auth = None
+            self.httpd._authenticate = None
+        self.scheme = "http"
+        if ssl_certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_certfile, ssl_keyfile)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
+            self.scheme = "https"
         self.host, self.port = host, self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return f"{self.scheme}://{self.host}:{self.port}"
 
     def start(self) -> "H2OServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,

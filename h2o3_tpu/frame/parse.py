@@ -124,19 +124,22 @@ class RawFile:
     def frame(self) -> Frame:
         if self._frame is None:
             import tempfile
+            import uuid
             suffix = os.path.splitext(self.name)[1] or ".csv"
             fd, tmp = tempfile.mkstemp(suffix=suffix)
+            # a transient unique key: import_file registers its result, and
+            # parsing under the upload's basename would clobber any existing
+            # frame a user keyed by that name; only Parse's destination key
+            # should ever be visible
+            tkey = f"_upload_{uuid.uuid4().hex[:12]}"
             try:
                 with os.fdopen(fd, "wb") as f:
                     f.write(self.data)
-                self._frame = import_file(tmp, key=self.name)
+                self._frame = import_file(tmp, key=tkey)
             finally:
                 os.unlink(tmp)
-            # import_file registers its result; only Parse's destination key
-            # should be visible — the raw upload must not leave a phantom
-            # entry under the original filename
-            if self.name in DKV:
-                DKV.remove(self.name)
+            if tkey in DKV:
+                DKV.remove(tkey)
         return self._frame
 
 

@@ -422,6 +422,18 @@ class DeepLearning(ModelBuilder):
         if not self.params.get("autoencoder"):
             super()._validate(frame, x, y)
 
+    def _scoring_history(self, model):
+        """Per-epoch rows (reference: ``DeepLearningScoringInfo`` →
+        ``createScoringHistoryTable``)."""
+        hist = model.output.get("score_history") or []
+        if not hist:
+            return None
+        return self._history_table(
+            model,
+            [("epochs", "double", "%.1f"),
+             ("training_loss", "double", "%.5f")],
+            [[float(h["epoch"]), float(h["train_loss"])] for h in hist])
+
 
 class AutoEncoder(DeepLearning):
     """Convenience alias (h2o-py: H2OAutoEncoderEstimator)."""

@@ -122,6 +122,91 @@ def _train_deviance(dist: str, F, y, w):
     return (w * (F - y) ** 2).sum() / n    # gaussian & robust families
 
 
+def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
+    """Stopping/score metric as traced device code (less-is-better; AUC is
+    negated), so the fused scan can emit one scalar per tree with zero host
+    round-trips (reference: ``ScoreKeeper`` scores between driver
+    iterations). ``dist="drf_prob"`` means F already IS the prediction
+    (probability / mean), the DRF averaging semantics."""
+    n = jnp.maximum(w.sum(), 1e-30)
+    if nclass > 1:
+        prob = F if dist == "drf_prob" else jax.nn.softmax(F, axis=1)
+        prob = jnp.clip(prob, 1e-15, 1.0)
+        if metric in ("AUTO", "deviance", "logloss"):
+            picked = jnp.take_along_axis(
+                jnp.log(prob), y.astype(jnp.int32)[:, None], 1)[:, 0]
+            return -(w * picked).sum() / n
+        if metric in ("MSE", "RMSE"):
+            ptrue = jnp.take_along_axis(prob, y.astype(jnp.int32)[:, None],
+                                        1)[:, 0]
+            mse = (w * (1.0 - ptrue) ** 2).sum() / n
+            return jnp.sqrt(mse) if metric == "RMSE" else mse
+        if metric == "misclassification":
+            pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
+            return (w * (pred != y)).sum() / n
+        raise ValueError(f"unsupported multinomial stopping_metric {metric!r}")
+    if dist == "bernoulli":
+        prob = jax.nn.sigmoid(F)
+    elif dist == "drf_prob":
+        prob = jnp.clip(F, 0.0, 1.0)
+    elif dist in ("poisson", "gamma", "tweedie"):
+        prob = None
+        mu = jnp.exp(jnp.clip(F, -30, 30))
+    else:
+        prob = None
+        mu = F
+    if metric in ("AUTO", "deviance", "logloss"):
+        if prob is not None:         # bernoulli margins or DRF probabilities
+            pc = jnp.clip(prob, 1e-15, 1 - 1e-15)
+            return -(w * (y * jnp.log(pc) +
+                          (1 - y) * jnp.log1p(-pc))).sum() / n
+        if dist in ("poisson", "gamma", "tweedie"):
+            return (w * (mu - y * jnp.clip(F, -30, 30))).sum() / n
+        return (w * (mu - y) ** 2).sum() / n
+    if metric in ("MSE", "RMSE"):
+        err = ((prob - y) ** 2 if prob is not None else (mu - y) ** 2)
+        mse = (w * err).sum() / n
+        return jnp.sqrt(mse) if metric == "RMSE" else mse
+    if metric == "misclassification":
+        pred = (prob > 0.5).astype(jnp.float32)
+        return (w * (pred != y)).sum() / n
+    if metric == "AUC":
+        # weighted Mann-Whitney on the score order (row ties ignored — the
+        # stopping test needs a consistent monotone score); negated so the
+        # stopping comparison stays less-is-better
+        order = jnp.argsort(prob)
+        ys, ws = y[order], w[order]
+        negw = ws * (1.0 - ys)
+        cumneg = jnp.cumsum(negw)
+        posw = ws * ys
+        tot = jnp.maximum(posw.sum() * negw.sum(), 1e-30)
+        return -(posw * cumneg).sum() / tot
+    raise ValueError(f"unsupported stopping_metric {metric!r}")
+
+
+def _traverse_heap_device(binned_v, heap, n_bins: int, has_mask: bool):
+    """Leaf values of ONE freshly grown tree for held-out rows, straight from
+    the device heap channels (feat, thresh_bin, thresh_val, na_left,
+    is_split, leaf, gain, cover[, left_mask]) — lets the fused scan carry
+    validation margins without leaving the device."""
+    feat, tbin, na_l, is_sp, leaf = heap[0], heap[1], heap[3], heap[4], heap[5]
+    mask = heap[8] if has_mask else None
+    rows = binned_v.shape[0]
+    depth = int(np.log2(feat.shape[0] + 1)) - 1
+    idx = jnp.zeros(rows, jnp.int32)
+    for _ in range(depth):
+        f = jnp.maximum(feat[idx], 0)
+        b = jnp.take_along_axis(binned_v, f[:, None], axis=1)[:, 0]
+        if mask is None:
+            left = jnp.where(b >= n_bins, na_l[idx], b < tbin[idx])
+        else:
+            left = jnp.where(b >= n_bins, na_l[idx],
+                             mask[idx, jnp.minimum(b, n_bins - 1)])
+        nxt = idx * 2 + jnp.where(left, 1, 2)
+        idx = jnp.where(is_sp[idx], nxt, idx)
+    return leaf[idx]
+
+
 @jax.jit
 def _grad_hess_multinomial(F, y, w):
     """Softmax gradients for all K classes at once (reference: GBM.java
@@ -136,7 +221,8 @@ def _grad_hess_multinomial(F, y, w):
                                    "reg_lambda", "reg_alpha", "gamma",
                                    "min_split_improvement", "lr", "bootstrap",
                                    "drf", "nclass", "quantile_alpha",
-                                   "huber_alpha", "tweedie_power"))
+                                   "huber_alpha", "tweedie_power", "track",
+                                   "ntrees_prior"))
 def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 dist: str, depth: int, n_bins: int, col_rate: float,
                 sample_rate: float, col_tree_rate: float, min_rows: float,
@@ -145,7 +231,8 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 bootstrap: bool, drf: bool, nclass: int,
                 quantile_alpha: float = 0.5, huber_alpha: float = 0.9,
                 tweedie_power: float = 1.5, mono=None, reach=None,
-                cat_feats=None):
+                cat_feats=None, track: str | None = None, val=None,
+                ntrees_prior: int = 0):
     """The WHOLE boosting/bagging run in one compiled program.
 
     Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
@@ -187,8 +274,46 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
             min_rows, reg_lambda, reg_alpha, gamma, min_split_improvement,
             col_rate, mono=mono, reach=reach, cat_feats=cat_feats)
 
+    # -- optional per-tree metric tracking (fused ScoreKeeper) ---------------
+    # `track` emits one train-metric scalar per tree from the carried
+    # margins; `val` additionally carries held-out margins, traversing each
+    # fresh tree on the validation bins inside the scan — scoring history and
+    # early stopping then cost ZERO extra dispatches. DRF carries the running
+    # SUM of tree predictions; its metric divides by the tree count.
+    track_dist = "drf_prob" if drf else dist
+    has_mask = cat_feats is not None
+    M_prior = float(ntrees_prior)
+
+    def scores(i, Ft, Fv):
+        outs = []
+        if drf:
+            denom = jnp.maximum(i + 1.0 + M_prior, 1.0)
+            Ft = Ft / denom
+            Fv = None if Fv is None else Fv / denom
+        if track is not None:
+            outs.append(_metric_device(track, track_dist, Ft, yc, w, nclass))
+        if Fv is not None:
+            vb, yv, wv, _ = val
+            outs.append(_metric_device(track or "AUTO", track_dist, Fv, yv,
+                                       wv, nclass))
+        return tuple(outs)
+
+    def update_val(Fval, heap):
+        if val is None:
+            return None
+        vb = val[0]
+        if nclass <= 1:
+            step = _traverse_heap_device(vb, heap, n_bins, has_mask)
+            return Fval + (step if drf else lr * step)
+        step = jnp.stack(
+            [_traverse_heap_device(vb, [h[k] for h in heap], n_bins, has_mask)
+             for k in range(nclass)], axis=1)
+        return Fval + (step if drf else lr * step)
+
     if nclass <= 1:
-        def body(Fcur, ks):
+        def body(carry, xs):
+            ks, i = xs
+            Fcur, Fval = carry
             wt = sample_w(ks[0])
             if drf:
                 g, h = -yc * wt, wt      # leaf = weighted in-node mean
@@ -197,11 +322,15 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                                   huber_alpha, tweedie_power)
             out = grow(g, h, wt, sample_fmask(ks[1]), ks[2])
             heap, row_leaf = out[:-1], out[-1]
-            return (Fcur if drf else Fcur + lr * row_leaf), heap
+            Fnew = Fcur + (row_leaf if drf else lr * row_leaf)
+            Fval = update_val(Fval, heap)
+            return (Fnew, Fval), (heap, *scores(i, Fnew, Fval))
     else:
         yoh = jax.nn.one_hot(yc.astype(jnp.int32), nclass)
 
-        def body(Fcur, ks):
+        def body(carry, xs):
+            ks, i = xs
+            Fcur, Fval = carry
             wt = sample_w(ks[0])
             if drf:
                 G = -(yoh * wt[:, None])
@@ -213,9 +342,16 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
             outs = jax.vmap(lambda gk, hk, k: grow(gk, hk, wt, fmask, k))(
                 G.T, H.T, kk)
             heap, row_leaf = outs[:-1], outs[-1]       # row_leaf: [K, R]
-            return (Fcur if drf else Fcur + lr * row_leaf.T), heap
+            Fnew = Fcur + (row_leaf.T if drf else lr * row_leaf.T)
+            Fval = update_val(Fval, heap)
+            return (Fnew, Fval), (heap, *scores(i, Fnew, Fval))
 
-    return lax.scan(body, Fcur0, keys)
+    Fval0 = val[3] if val is not None else None
+    idx = jnp.arange(keys.shape[0], dtype=jnp.float32)
+    (Fend, Fvend), ys = lax.scan(body, (Fcur0, Fval0), (keys, idx))
+    heap = ys[0]
+    extras = ys[1:]      # (tscore[, vscore]) per-tree metric arrays
+    return Fend, heap, extras, Fvend
 
 
 def _trees_from_stacked(heap, m: int, k: int | None = None) -> Tree:
@@ -233,9 +369,10 @@ def _trees_from_stacked(heap, m: int, k: int | None = None) -> Tree:
 
 
 def _heap_to_host(heap):
-    """One transfer for the whole stacked ensemble (the heap arrays are tiny:
-    ntrees x 2^(depth+1) nodes)."""
-    return jax.tree.map(lambda a: np.asarray(jax.device_get(a)), heap)
+    """ONE batched transfer for the whole stacked ensemble (the heap arrays
+    are tiny: ntrees x 2^(depth+1) nodes; per-leaf device_get would pay one
+    ~40ms tunnel round-trip PER CHANNEL)."""
+    return jax.tree.map(np.asarray, jax.device_get(heap))
 
 
 class SharedTreeModel(Model):
@@ -386,6 +523,31 @@ class SharedTreeBuilder(ModelBuilder):
     # Dense-heap trees cap depth at 16 (2^17 nodes); the reference's default 20
     # assumes sparse node storage.
     MAX_TREE_DEPTH = 16
+
+    #: scoring-history column name per stopping metric (AUC is tracked
+    #: negated for less-is-better stopping; the table shows the true value)
+    _HIST_NAMES = {"AUTO": "deviance", "deviance": "deviance",
+                   "logloss": "logloss", "MSE": "mse", "RMSE": "rmse",
+                   "AUC": "auc", "misclassification": "classification_error"}
+
+    def _scoring_history(self, model):
+        """Per-tree metric rows from the fused scan's tracked series
+        (reference: ``SharedTree.doScoringAndSaveModel`` →
+        ``createScoringHistoryTable``)."""
+        series = getattr(self, "_score_series", None)
+        if not series:
+            return None
+        metric, tser, vser = series
+        name = self._HIST_NAMES.get(metric, "deviance")
+        sign = -1.0 if metric == "AUC" else 1.0   # tracked negated
+        cols = [("number_of_trees", "long", "%d"),
+                (f"training_{name}", "double", "%.5f")]
+        if vser is not None:
+            cols.append((f"validation_{name}", "double", "%.5f"))
+        values = [[i + 1, sign * float(tv)] +
+                  ([sign * float(vser[i])] if vser is not None else [])
+                  for i, tv in enumerate(tser)]
+        return self._history_table(model, cols, values)
 
     def _prepare(self, frame: Frame, x: list[str], y: str):
         depth = int(self.params["max_depth"])
@@ -751,7 +913,10 @@ class GBM(SharedTreeBuilder):
         kwargs.update(mono=mono, reach=reach, cat_feats=self._cat_feats)
         fmask_base = jnp.ones(binned.shape[1], bool)
         valid = None
-        if int(p.get("stopping_rounds") or 0) > 0:
+        if getattr(self, "_validation_frame", None) is not None or \
+                int(p.get("stopping_rounds") or 0) > 0:
+            # also tracked without early stopping: the validation series
+            # feeds scoring_history (reference scores valid per event)
             valid = self._valid_stop_data(
                 edges, 0, f0, lr, domains,
                 yvec.domain if yvec.is_categorical else None,
@@ -879,12 +1044,14 @@ class GBM(SharedTreeBuilder):
     def _grow_with_stopping(self, job, binned, edges, yc, w, fmask_base,
                             Fcur, keys, dist: str, nclass: int, kwargs: dict,
                             p, valid=None) -> list:
-        """Run the fused scan; with ``stopping_rounds`` > 0, grow per-tree
-        chunks scoring ``stopping_metric`` between them — on the validation
-        frame when one was given, else on train (reference:
-        ScoreKeeper.stopEarly — stop after k scoring events without a
-        relative ``stopping_tolerance`` improvement). The per-tree dispatch
-        round-trips only occur when early stopping is requested."""
+        """Run the fused scan in watchdog-sized chunks, with per-tree metric
+        series computed INSIDE the scan (train always; validation when a
+        frame was given) — scoring history and ``stopping_rounds`` early
+        stopping cost zero extra dispatches (reference: ``ScoreKeeper``
+        between driver iterations; ``SharedTree.doScoringAndSaveModel``).
+        On a stop the surplus chunk tail is discarded and the margins are
+        replayed to the kept prefix, so the result is tree-for-tree
+        identical to per-tree scoring."""
         M = keys.shape[0]
         sr = int(p.get("stopping_rounds") or 0)
         metric = str(p.get("stopping_metric") or "AUTO")
@@ -894,65 +1061,101 @@ class GBM(SharedTreeBuilder):
         if metric not in self.STOPPING_METRICS:
             raise ValueError(f"unsupported stopping_metric {metric!r}; have "
                              f"{self.STOPPING_METRICS}")
+        # validate metric/distribution compatibility up front (the device
+        # tracker assumes a classification margin for AUC/logloss/misclass)
+        sdist = "multinomial" if nclass > 1 else dist
+        if metric in ("logloss", "misclassification", "AUC") and sdist not in (
+                "bernoulli", "multinomial"):
+            raise ValueError(f"stopping_metric={metric!r} requires a "
+                             "classification distribution")
+        if metric == "AUC" and sdist == "multinomial":
+            raise ValueError("stopping_metric='AUC' requires a binomial "
+                             "response")
         out_trees: list = []
+        tser: list[float] = []
+        vser: list[float] = []
 
-        def collect(heap, count):
-            heap = _heap_to_host(heap)
+        def collect(heap_h, count):
             if nclass > 1:
-                return [[_trees_from_stacked(heap, m, k) for k in range(nclass)]
-                        for m in range(count)]
-            return [_trees_from_stacked(heap, m) for m in range(count)]
+                return [[_trees_from_stacked(heap_h, m, k)
+                         for k in range(nclass)] for m in range(count)]
+            return [_trees_from_stacked(heap_h, m) for m in range(count)]
 
-        if sr <= 0:
-            # cap rows*trees per dispatch: a single fused program running
-            # >~90s trips the device/tunnel watchdog (observed at HIGGS-11M
-            # x 20 trees); ~1.5e8 rows*trees ≈ 60s on v5e at 64 bins, and
-            # histogram cost scales with bins. The inter-chunk host hop
-            # costs ~40ms — noise against a multi-second chunk.
-            cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
-            per = max(1, int(1.5e8 // cost))
-            out_trees = []
-            for s0 in range(0, M, per):
-                kchunk = keys[s0:s0 + per]
-                Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base,
-                                         Fcur, kchunk, **kwargs)
-                jax.block_until_ready(heap)
-                out_trees.extend(collect(heap, kchunk.shape[0]))
-                job.update(0.1 + 0.8 * min(s0 + per, M) / M,
-                           f"{len(out_trees)}/{M} trees grown")
-            return out_trees, Fcur
-
+        # cap rows*trees per dispatch: a single fused program running
+        # >~90s trips the device/tunnel watchdog (observed at HIGGS-11M
+        # x 20 trees); ~1.5e8 rows*trees ≈ 60s on v5e at 64 bins, and
+        # histogram cost scales with bins. The inter-chunk host hop
+        # costs ~40ms — noise against a multi-second chunk.
+        cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
+        per = max(1, int(1.5e8 // cost))
+        if sr > 0:
+            # bound the discarded overshoot past the stopping point; ≥16
+            # trees per chunk keeps the dispatch count low (each chunk pays
+            # a host round-trip for the stopping decision)
+            per = min(per, max(4 * sr, 16))
         tol = float(p.get("stopping_tolerance") or 1e-3)
         lr = float(kwargs["lr"])
         nbins = int(kwargs["n_bins"])
         best, since = np.inf, 0
-        for i in range(M):
-            Fcur, heap = _boost_scan(binned, edges, yc, w, fmask_base, Fcur,
-                                     keys[i:i + 1], **kwargs)
-            new = collect(heap, 1)
-            out_trees.extend(new)
+        for s0 in range(0, M, per):
+            kchunk = keys[s0:s0 + per]
+            take = kchunk.shape[0]
+            if take < per and per <= M:
+                # pad the final partial chunk to the compiled chunk shape:
+                # the surplus trees are grown then discarded (keep cap below)
+                # — one margin replay is far cheaper than a second ~30-40s
+                # XLA compile of an odd-shaped program
+                reps = np.concatenate([np.arange(take),
+                                       np.full(per - take, take - 1)])
+                kchunk = kchunk[reps]
+            F_prev = Fcur
+            Fcur, heap, extras, Fvend = _boost_scan(
+                binned, edges, yc, w, fmask_base, Fcur, kchunk,
+                track=metric, val=valid, **kwargs)
+            # ONE batched host transfer per chunk (tunnel round-trips are
+            # ~40ms each; per-leaf gets would pay a dozen of them)
+            heap_h, extras_h = jax.device_get((heap, extras))
+            heap_h = jax.tree.map(np.asarray, heap_h)
+            new_trees = collect(heap_h, take)
+            ts = np.asarray(extras_h[0], np.float64)[:take]
+            vs = (np.asarray(extras_h[1], np.float64)[:take]
+                  if len(extras_h) > 1 else None)
             if valid is not None:
-                binned_v, yv, wv, Fval = valid
+                valid = (valid[0], valid[1], valid[2], Fvend)
+            series = vs if vs is not None else ts
+            stop_at = None
+            if sr > 0:
+                for j, dev in enumerate(series):
+                    # sign-safe relative improvement: deviances can be < 0
+                    if dev < best - tol * abs(best) or not np.isfinite(best):
+                        best, since = dev, 0
+                    else:
+                        since += 1
+                        if since >= sr:
+                            stop_at = j
+                            break
+            keep = take if stop_at is None else stop_at + 1
+            out_trees.extend(new_trees[:keep])
+            tser.extend(ts[:keep])
+            if vs is not None:
+                vser.extend(vs[:keep])
+            shown = -series[keep - 1] if metric == "AUC" else series[keep - 1]
+            job.update(0.1 + 0.8 * min(s0 + keep, M) / M,
+                       f"{len(out_trees)}/{M} trees, {metric} {shown:.5f}")
+            if keep < kchunk.shape[0] and not kwargs.get("drf"):
+                # the scan's margins include discarded trees (mid-chunk stop
+                # or chunk padding) — replay to the kept prefix; one cheap
+                # dispatch
+                kept = new_trees[:keep]
                 if nclass > 1:
-                    Fval = Fval + lr * jnp.stack(
-                        [predict_binned(binned_v, [new[0][k]], nbins)
+                    Fcur = F_prev + lr * jnp.stack(
+                        [predict_binned(binned, [t[k] for t in kept], nbins)
                          for k in range(nclass)], axis=1)
                 else:
-                    Fval = Fval + lr * predict_binned(binned_v, new, nbins)
-                valid = (binned_v, yv, wv, Fval)
-                dev = self._stop_score(metric, dist, Fval, yv, wv, nclass)
-            else:
-                dev = self._stop_score(metric, dist, Fcur, yc, w, nclass)
-            shown = -dev if metric == "AUC" else dev   # AUC is negated for
-            job.update(0.1 + 0.8 * (i + 1) / M,        # less-is-better compare
-                       f"tree {i + 1}: {metric} {shown:.5f}")
-            # sign-safe relative improvement: partial deviances can be < 0
-            if dev < best - tol * abs(best) or not np.isfinite(best):
-                best, since = dev, 0
-            else:
-                since += 1
-                if since >= sr:
-                    break
+                    Fcur = F_prev + lr * predict_binned(binned, kept, nbins)
+            if stop_at is not None:
+                break
+        self._score_series = (metric, tser, vser if vser else None)
         return out_trees, Fcur
 
     def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,
@@ -1002,7 +1205,8 @@ class GBM(SharedTreeBuilder):
         _, reach = self._constraint_arrays(x, frame)
         kwargs.update(mono=None, reach=reach, cat_feats=self._cat_feats)
         valid = None
-        if int(p.get("stopping_rounds") or 0) > 0:
+        if getattr(self, "_validation_frame", None) is not None or \
+                int(p.get("stopping_rounds") or 0) > 0:
             valid = self._valid_stop_data(
                 edges, K, f0, lr, domains, yvec.domain,
                 prior_trees=trees_multi if done else None)
@@ -1094,7 +1298,7 @@ class DRF(SharedTreeBuilder):
                 trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
                 done = len(trees_multi[0])
             keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
-            _, heap = _boost_scan(
+            _, heap, _, _ = _boost_scan(
                 binned, edges, yc, w, fmask,
                 jnp.zeros((binned.shape[0], nclass), jnp.float32), keys,
                 dist="multinomial", depth=int(p["max_depth"]),
@@ -1124,7 +1328,7 @@ class DRF(SharedTreeBuilder):
             trees = list(cp.output["trees"])
         done = len(trees)
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
-        _, heap = _boost_scan(
+        _, heap, _, _ = _boost_scan(
             binned, edges, yc, w, fmask,
             jnp.zeros(binned.shape[0], jnp.float32), keys,
             dist="gaussian", depth=int(p["max_depth"]), n_bins=int(p["nbins"]),

@@ -358,6 +358,24 @@ class GLM(ModelBuilder):
                              validation_frame=validation_frame,
                              weights=weights)
 
+    def _scoring_history(self, model):
+        """Per-IRLS-iteration rows (reference: ``GLM.java``
+        ``ScoringHistory`` — iterations / negative_log_likelihood /
+        objective; h2o-py's ``model.negative_log_likelihood()`` reads these
+        column names)."""
+        devs = getattr(self, "_iter_devs", None)
+        if not devs:
+            return None
+        nobs = float(model.training_metrics.nobs) if getattr(
+            model.training_metrics, "nobs", 0) else 1.0
+        return self._history_table(
+            model,
+            [("iterations", "long", "%d"),
+             ("negative_log_likelihood", "double", "%.5f"),
+             ("objective", "double", "%.5f")],
+            [[i + 1, d / 2.0, d / (2.0 * nobs)]
+             for i, d in enumerate(devs)])
+
     @classmethod
     def defaults(cls) -> dict:
         return dict(
@@ -551,6 +569,8 @@ class GLM(ModelBuilder):
             dev = float(jax.device_get(dev))
             delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
             beta = beta_new
+            if hasattr(self, "_iter_devs"):
+                self._iter_devs.append(dev)
             job.update((it + 1) / int(params["max_iterations"]),
                        f"iter {it} deviance {dev:.4f}")
             if family == "gaussian" and not nn and it >= 1:
@@ -611,6 +631,7 @@ class GLM(ModelBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
+        self._iter_devs = []    # per-IRLS-iteration deviances → scoring_history
         if int(params["max_iterations"]) == -1:
             # reference: -1 means solver-chosen default (GLM.java auto)
             params["max_iterations"] = 50

@@ -151,9 +151,11 @@ class KMeans(ModelBuilder):
     def _run_lloyd(self, job: Job, X, w, C) -> tuple[jax.Array, float, int]:
         """Lloyd to convergence; returns (centers, tot_withinss, iters)."""
         wss_v, wss_prev, iters = np.inf, np.inf, 0
+        self._wss_series = []
         for it in range(max(int(self.params["max_iterations"]), 1)):
             C, wss, _ = _lloyd_step(X, w, C)
             wss_v = float(jax.device_get(wss))
+            self._wss_series.append(wss_v)
             iters = it + 1
             job.update(iters / max(int(self.params["max_iterations"]), 1),
                        f"k={C.shape[0]} iter {iters} within-SS {wss_v:.4f}")
@@ -161,6 +163,18 @@ class KMeans(ModelBuilder):
                 break
             wss_prev = wss_v
         return C, wss_v, iters
+
+    def _scoring_history(self, model):
+        """Per-Lloyd-iteration rows (reference: ``KMeans.java``
+        scoring-history table — iteration / within_cluster_sum_of_squares)."""
+        ser = getattr(self, "_wss_series", None)
+        if not ser:
+            return None
+        return self._history_table(
+            model,
+            [("iterations", "long", "%d"),
+             ("within_cluster_sum_of_squares", "double", "%.5f")],
+            [[i + 1, v] for i, v in enumerate(ser)])
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> KMeansModel:
         p = self.params

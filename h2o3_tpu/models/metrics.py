@@ -129,6 +129,76 @@ class ModelMetricsBinomial(MetricsBase):
     def __post_init__(self):
         self.gini = 2.0 * self.auc - 1.0
 
+    #: criteria maximized over thresholds (reference: ``hex/AUC2.java:24-36``
+    #: ThresholdCriterion enum; the last four report counts AT max-F1)
+    MAX_CRITERIA = ("f1", "f2", "f0point5", "accuracy", "precision",
+                    "recall", "specificity", "absolute_mcc",
+                    "min_per_class_accuracy", "mean_per_class_accuracy")
+
+    def threshold_table(self):
+        """Per-threshold criterion values over the 400-bin score histogram
+        (reference: ``hex/AUC2.java`` — the ``thresholds_and_metric_scores``
+        table h2o-py's ``perf.F1()``/``perf.mcc()`` read). Returns
+        (columns, rows) with thresholds descending."""
+        if self._tp_h is None:
+            return [], []
+        tp_h = np.asarray(self._tp_h, np.float64)[::-1]   # descending score
+        fp_h = np.asarray(self._fp_h, np.float64)[::-1]
+        P, N = tp_h.sum(), fp_h.sum()
+        tps = np.cumsum(tp_h)          # predicted-positive counts at ≥ thr
+        fps = np.cumsum(fp_h)
+        fns, tns = P - tps, N - fps
+        nb = len(tp_h)
+        thr = (nb - 1 - np.arange(nb)) / nb
+        eps = 1e-30
+        precision = tps / np.maximum(tps + fps, eps)
+        recall = tps / max(P, eps)                      # = tpr
+        specificity = tns / max(N, eps)                 # = tnr
+        accuracy = (tps + tns) / max(P + N, eps)
+        f1 = 2 * precision * recall / np.maximum(precision + recall, eps)
+        f2 = 5 * precision * recall / np.maximum(4 * precision + recall, eps)
+        f05 = 1.25 * precision * recall / np.maximum(
+            0.25 * precision + recall, eps)
+        mcc_den = np.sqrt(np.maximum(
+            (tps + fps) * (tps + fns) * (tns + fps) * (tns + fns), eps))
+        mcc = np.abs((tps * tns - fps * fns) / mcc_den)
+        minpca = np.minimum(recall, specificity)
+        meanpca = 0.5 * (recall + specificity)
+        cols = ["threshold", "f1", "f2", "f0point5", "accuracy", "precision",
+                "recall", "specificity", "absolute_mcc",
+                "min_per_class_accuracy", "mean_per_class_accuracy",
+                "tns", "fns", "fps", "tps", "tnr", "fnr", "fpr", "tpr", "idx"]
+        rows = [[float(thr[i]), float(f1[i]), float(f2[i]), float(f05[i]),
+                 float(accuracy[i]), float(precision[i]), float(recall[i]),
+                 float(specificity[i]), float(mcc[i]), float(minpca[i]),
+                 float(meanpca[i]), float(tns[i]), float(fns[i]),
+                 float(fps[i]), float(tps[i]), float(specificity[i]),
+                 float(fns[i] / max(P, eps)), float(fps[i] / max(N, eps)),
+                 float(recall[i]), i]
+                for i in range(nb)]
+        return cols, rows
+
+    def max_criteria_and_metric_scores(self, table=None):
+        """The AUC2 max-criteria table (reference: ``hex/AUC2.java:24-36``;
+        h2o-py ``find_threshold_by_max_metric``). Rows:
+        (metric, threshold, value, idx). Pass an already-computed
+        ``threshold_table()`` result to avoid rebuilding the 400-row sweep."""
+        cols, rows = table if table is not None else self.threshold_table()
+        if not rows:
+            return [], []
+        arr = np.asarray([r[:11] for r in rows], np.float64)
+        out = []
+        for j, name in enumerate(self.MAX_CRITERIA, start=1):
+            i = int(np.argmax(arr[:, j]))
+            out.append([f"max {name}", float(arr[i, 0]), float(arr[i, j]), i])
+        # count criteria report the count at ITS OWN max (reference: tns..tps
+        # maximize the count itself)
+        for name, col in (("tns", 11), ("fns", 12), ("fps", 13), ("tps", 14)):
+            vals = np.asarray([r[col] for r in rows], np.float64)
+            i = int(np.argmax(vals))
+            out.append([f"max {name}", float(rows[i][0]), float(vals[i]), i])
+        return ["metric", "threshold", "value", "idx"], out
+
     def __repr__(self):
         return (f"ModelMetricsBinomial(auc={self.auc:.5f}, pr_auc={self.pr_auc:.5f}, "
                 f"logloss={self.logloss:.5f}, rmse={self.rmse:.5f}, "

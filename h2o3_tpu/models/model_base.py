@@ -80,6 +80,10 @@ class Model:
         self.cv_holdout_predictions = None   # [plen] or [plen, K] OOF preds
         self.cv_holdout_mask = None
         self.run_time_ms: int = 0
+        # per-scoring-event table (reference: Model.Output._scoring_history
+        # TwoDimTable, surfaced as h2o-py model.scoring_history()):
+        # (columns, rows) where columns = [(name, type, format), ...]
+        self.scoring_history: tuple[list, list] | None = None
         # transformers applied to every scoring frame (reference: AutoML
         # bundles the TargetEncoder into the model's scoring pipeline)
         self.preprocessors: list = []
@@ -292,6 +296,8 @@ class ModelBuilder:
         self.job = Job(f"{self.algo} on {frame.key or 'frame'}")
         t0 = time.time()
 
+        self._score_series = None   # per-train metric series (tree builders)
+
         def driver(job: Job) -> Model:
             model = self._fit(job, frame, x, y, base_w)
             model.run_time_ms = int((time.time() - t0) * 1000)
@@ -304,6 +310,9 @@ class ModelBuilder:
                     self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
+            # snapshot BEFORE the CV refits below clobber the per-iteration
+            # series on this (shared) builder instance
+            model.scoring_history = self._scoring_history(model)
             nfolds = int(self.params.get("nfolds") or 0)
             if nfolds >= 2 and y is not None:
                 model.cross_validation_metrics = self._cross_validate(
@@ -326,6 +335,28 @@ class ModelBuilder:
                               segment_models_id=segment_models_id)
 
     # -- helpers -------------------------------------------------------------
+
+    def _scoring_history(self, model: Model):
+        """Per-scoring-event table hook (reference: ``SharedTree.java:798``
+        ``doScoringAndSaveModel`` fills a TwoDimTable per iteration).
+        Iterative builders override; returns (columns, rows) or None."""
+        return None
+
+    def _history_table(self, model: Model, value_cols, values):
+        """Shared timestamp/duration scaffold for scoring-history rows:
+        ``value_cols`` = [(name, type, format), ...], ``values`` = one value
+        list per scoring event (duration is interpolated from the total
+        train wall-clock — the events happened inside one fused program)."""
+        if not values:
+            return None
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        total_s = model.run_time_ms / 1000.0
+        n = len(values)
+        cols = [("timestamp", "string", "%s"),
+                ("duration", "string", "%s")] + list(value_cols)
+        rows = [[stamp, f"{total_s * (i + 1) / n:.3f} sec", *vals]
+                for i, vals in enumerate(values)]
+        return cols, rows
 
     def _validate(self, frame: Frame, x: list[str], y: str | None) -> None:
         if y is not None:

@@ -47,7 +47,7 @@ def default_metric(model: Model) -> str:
 
 
 def metric_higher_is_better(metric: str) -> bool:
-    return metric in ("auc", "pr_auc", "accuracy", "r2", "gini")
+    return metric in ("auc", "pr_auc", "aucpr", "accuracy", "r2", "gini")
 
 
 class Grid:

@@ -92,11 +92,14 @@ class Leaderboard:
         else:
             metrics = ["rmse", "mse", "mae", "rmsle", "mean_residual_deviance"]
         sort_metric = self.sort_metric or default_metric(model)
-        if sort_metric in metrics and metrics[0] != sort_metric:
-            metrics.remove(sort_metric)
-            metrics.insert(0, sort_metric)
-        elif sort_metric not in metrics:
-            metrics.insert(0, sort_metric)
+        # the table shows wire names (aucpr), rows store attr names (pr_auc)
+        wire_sort = {"pr_auc": "aucpr"}.get(sort_metric, sort_metric)
+        if wire_sort in metrics and metrics[0] != wire_sort:
+            metrics.remove(wire_sort)
+            metrics.insert(0, wire_sort)
+        elif wire_sort not in metrics:
+            metrics.insert(0, wire_sort)
+        sort_metric = wire_sort
         ext = [e.lower() for e in (extensions or [])]
         known_ext = ("training_time_ms", "predict_time_per_row_ms", "algo")
         ext_cols = (list(known_ext) if "all" in ext

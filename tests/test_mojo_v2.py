@@ -1,0 +1,138 @@
+"""MOJO v2 (pickle-free) round-trips across the algo families.
+
+VERDICT r2 item 5: the artifact must be loadable with zero unpickling
+(reference: ``hex/genmodel/ModelMojoReader.java`` — ini + named binary
+blobs, never Java serialization).
+"""
+
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.genmodel import MojoModel
+
+
+def _roundtrip(model, frame, tmp_path, name):
+    p = model.download_mojo(str(tmp_path / f"{name}.mojo"))
+    # pickle-free guarantee: only ini/json/npz members, and the npz loads
+    # with allow_pickle=False (done inside MojoModel.load)
+    with zipfile.ZipFile(p) as z:
+        names = set(z.namelist())
+        assert names == {"model.ini", "structure.json", "arrays.npz"}, names
+        json.loads(z.read("structure.json"))      # pure JSON
+    mojo = MojoModel.load(p)
+    got = np.asarray(mojo._score_raw(frame))
+    want = np.asarray(model._score_raw(frame))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    return mojo
+
+
+@pytest.fixture
+def bin_frame(rng):
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] - X[:, 1] > 0)
+    cat = rng.integers(0, 4, size=n)
+    return Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "g": np.array(list("wxyz"), dtype=object)[cat],
+        "y": np.array(["no", "yes"], dtype=object)[y.astype(int)]})
+
+
+def test_mojo_v2_gbm(bin_frame, tmp_path, rng):
+    from h2o3_tpu.models.gbm import GBM
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y",
+                                                 training_frame=bin_frame)
+    mojo = _roundtrip(m, bin_frame, tmp_path, "gbm")
+    assert mojo.algo == "gbm" and mojo.nclasses == 2
+
+
+def test_mojo_v2_drf_multinomial(tmp_path, rng):
+    from h2o3_tpu.models.gbm import DRF
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = np.argmax(np.stack([X[:, 0], -X[:, 1], X[:, 0] * 0], 1), axis=1)
+    fr = Frame.from_arrays({"a": X[:, 0], "b": X[:, 1],
+                            "y": np.array(["u", "v", "w"], dtype=object)[y]})
+    m = DRF(ntrees=6, max_depth=4, seed=1).train(y="y", training_frame=fr)
+    _roundtrip(m, fr, tmp_path, "drf")
+
+
+def test_mojo_v2_xgboost(bin_frame, tmp_path):
+    from h2o3_tpu.models.xgboost import XGBoost
+    m = XGBoost(ntrees=5, max_depth=3, seed=1).train(
+        y="y", training_frame=bin_frame)
+    _roundtrip(m, bin_frame, tmp_path, "xgb")
+
+
+def test_mojo_v2_glm(bin_frame, tmp_path):
+    from h2o3_tpu.models.glm import GLM
+    m = GLM(family="binomial", lambda_=1e-3).train(y="y",
+                                                   training_frame=bin_frame)
+    _roundtrip(m, bin_frame, tmp_path, "glm")
+
+
+def test_mojo_v2_deeplearning(bin_frame, tmp_path):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    m = DeepLearning(hidden=[8], epochs=2, seed=1).train(
+        y="y", training_frame=bin_frame)
+    _roundtrip(m, bin_frame, tmp_path, "dl")
+
+
+def test_mojo_v2_kmeans(bin_frame, tmp_path):
+    from h2o3_tpu.models.kmeans import KMeans
+    m = KMeans(k=3, seed=1).train(x=["a", "b", "c"],
+                                  training_frame=bin_frame)
+    _roundtrip(m, bin_frame, tmp_path, "km")
+
+
+def test_mojo_v2_isotonic(tmp_path, rng):
+    from h2o3_tpu.models.isotonic import IsotonicRegression
+    n = 300
+    x = rng.normal(size=n).astype(np.float32)
+    y = (x + 0.2 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays({"x": x, "y": y})
+    m = IsotonicRegression().train(x=["x"], y="y", training_frame=fr)
+    _roundtrip(m, fr, tmp_path, "iso")
+
+
+def test_mojo_v2_stackedensemble(bin_frame, tmp_path):
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.orchestration import StackedEnsemble
+    common = dict(nfolds=3, keep_cross_validation_predictions=True, seed=1)
+    m1 = GBM(ntrees=5, max_depth=3, **common).train(y="y",
+                                                    training_frame=bin_frame)
+    m2 = GLM(family="binomial", **common).train(y="y",
+                                                training_frame=bin_frame)
+    se = StackedEnsemble(base_models=[m1, m2]).train(y="y",
+                                                     training_frame=bin_frame)
+    _roundtrip(se, bin_frame, tmp_path, "se")
+
+
+def test_mojo_v1_pickle_refused(bin_frame, tmp_path):
+    """A legacy pickle-payload artifact must be refused by default."""
+    import configparser
+    import io
+    import pickle
+
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.persist.model_io import host_copy
+    m = GBM(ntrees=2, max_depth=2, seed=1).train(y="y",
+                                                 training_frame=bin_frame)
+    ini = configparser.ConfigParser()
+    ini["info"] = {"format": "h2o3_tpu_mojo", "version": "1.0",
+                   "algorithm": "gbm", "n_classes": "2"}
+    buf = io.StringIO()
+    ini.write(buf)
+    p = tmp_path / "legacy.mojo"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("model.ini", buf.getvalue())
+        z.writestr("payload.bin", pickle.dumps(host_copy(m)))
+    with pytest.raises(ValueError, match="pickle-payload"):
+        MojoModel.load(str(p))
+    mojo = MojoModel.load(str(p), allow_legacy=True)
+    assert mojo.algo == "gbm"
