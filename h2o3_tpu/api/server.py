@@ -165,11 +165,12 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         tok = self._session_token()
         sessions = getattr(self.server, "_login_sessions", {})
-        if tok in sessions:
+        exp = sessions.get(tok) if tok else None
+        if exp is not None:
             import time as _t
-            if _t.time() < sessions[tok]:
+            if _t.time() < exp:
                 return True
-            del sessions[tok]          # expired
+            sessions.pop(tok, None)    # expired (tolerant: handler threads race)
         hdr = self.headers.get("Authorization") or ""
         if hdr.startswith("Basic "):
             import base64
@@ -210,8 +211,9 @@ class _Handler(BaseHTTPRequestHandler):
         import time as _t
         sessions = self.server._login_sessions
         now = _t.time()
-        for k in [k for k, exp in sessions.items() if exp < now]:
-            del sessions[k]            # sweep expired tokens
+        # snapshot before sweeping: handler threads mutate concurrently
+        for k in [k for k, exp in list(sessions.items()) if exp < now]:
+            sessions.pop(k, None)
         if len(sessions) >= 10_000:    # cap: a login-per-request client
             sessions.clear()           # must fall back to re-auth, not OOM us
         tok = uuid.uuid4().hex
@@ -1367,8 +1369,12 @@ class H2OServer:
             import ssl
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(ssl_certfile, ssl_keyfile)
+            # handshake on first read in the per-connection worker thread —
+            # with do_handshake_on_connect=True a single idle client would
+            # stall the accept loop mid-handshake and freeze the server
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
-                                                server_side=True)
+                                                server_side=True,
+                                                do_handshake_on_connect=False)
             self.scheme = "https"
         self.host, self.port = host, self.httpd.server_address[1]
         self._thread: threading.Thread | None = None

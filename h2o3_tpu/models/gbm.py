@@ -104,24 +104,6 @@ def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
     return w * (F - y), w  # gaussian
 
 
-@partial(jax.jit, static_argnames=("dist",))
-def _train_deviance(dist: str, F, y, w):
-    """Mean training deviance at margins F (the reference's AUTO stopping
-    metric: logloss for classification, deviance/MSE for regression)."""
-    n = jnp.maximum(w.sum(), 1e-30)
-    if dist == "bernoulli":
-        p = jnp.clip(jax.nn.sigmoid(F), 1e-15, 1 - 1e-15)
-        return -(w * (y * jnp.log(p) + (1 - y) * jnp.log1p(-p))).sum() / n
-    if dist == "multinomial":
-        logp = jax.nn.log_softmax(F, axis=1)
-        picked = jnp.take_along_axis(logp, y.astype(jnp.int32)[:, None], 1)[:, 0]
-        return -(w * picked).sum() / n
-    if dist in ("poisson", "gamma", "tweedie"):
-        mu = jnp.exp(jnp.clip(F, -30, 30))
-        return (w * (mu - y * jnp.clip(F, -30, 30))).sum() / n
-    return (w * (F - y) ** 2).sum() / n    # gaussian & robust families
-
-
 def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
     """Stopping/score metric as traced device code (less-is-better; AUC is
     negated), so the fused scan can emit one scalar per tree with zero host
@@ -157,7 +139,7 @@ def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
         mu = F
     if metric in ("AUTO", "deviance", "logloss"):
         if prob is not None:         # bernoulli margins or DRF probabilities
-            pc = jnp.clip(prob, 1e-15, 1 - 1e-15)
+            pc = jnp.clip(prob, 1e-7, 1 - 1e-7)
             return -(w * (y * jnp.log(pc) +
                           (1 - y) * jnp.log1p(-pc))).sum() / n
         if dist in ("poisson", "gamma", "tweedie"):
@@ -952,58 +934,22 @@ class GBM(SharedTreeBuilder):
                         "misclassification")
 
     def _stop_score(self, metric: str, dist: str, F, y, w, nclass: int) -> float:
-        """Less-is-better score for ``stopping_metric`` (reference:
-        ``ScoreKeeper.stopEarly`` — more-is-better metrics are negated)."""
+        """Less-is-better score for ``stopping_metric`` in host loops (the
+        DART driver); same math as the fused scan's :func:`_metric_device`
+        — one implementation keeps the two paths from drifting."""
         sdist = "multinomial" if nclass > 1 else dist
         if metric in ("logloss", "misclassification", "AUC") and sdist not in (
                 "bernoulli", "multinomial"):
             raise ValueError(f"stopping_metric={metric!r} requires a "
                              "classification distribution")
-        if metric in ("AUTO", "deviance", "logloss"):
-            return float(jax.device_get(_train_deviance(sdist, F, y, w)))
-        if sdist == "bernoulli":
-            prob = jax.nn.sigmoid(F)
-        elif sdist == "multinomial":
-            prob = jax.nn.softmax(F, axis=1)
-        else:
-            prob = None
-        if metric in ("MSE", "RMSE"):
-            if sdist == "bernoulli":
-                err = (prob - y) ** 2
-            elif sdist == "multinomial":
-                ptrue = jnp.take_along_axis(
-                    prob, y.astype(jnp.int32)[:, None], 1)[:, 0]
-                err = (1.0 - ptrue) ** 2
-            else:
-                mu = (jnp.exp(jnp.clip(F, -30, 30))
-                      if sdist in ("poisson", "gamma", "tweedie") else F)
-                err = (mu - y) ** 2
-            mse = float(jax.device_get(
-                (w * err).sum() / jnp.maximum(w.sum(), 1e-30)))
-            return float(np.sqrt(mse)) if metric == "RMSE" else mse
-        if metric == "misclassification":
-            if sdist == "bernoulli":
-                pred = (prob > 0.5).astype(jnp.float32)
-            else:
-                pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
-            return float(jax.device_get(
-                (w * (pred != y)).sum() / jnp.maximum(w.sum(), 1e-30)))
-        if metric == "AUC":
-            if sdist != "bernoulli":
-                raise ValueError("stopping_metric='AUC' requires a binomial "
-                                 "response")
-            # weighted Mann-Whitney AUC (ties across rows ignored — the
-            # stopping test only needs a consistent monotone score)
-            order = jnp.argsort(prob)
-            ys, ws = y[order], w[order]
-            negw = ws * (1.0 - ys)
-            cumneg = jnp.cumsum(negw)
-            posw = ws * ys
-            tot = jnp.maximum(posw.sum() * negw.sum(), 1e-30)
-            auc = float(jax.device_get((posw * cumneg).sum() / tot))
-            return -auc
-        raise ValueError(f"unsupported stopping_metric {metric!r}; have "
-                         f"{self.STOPPING_METRICS}")
+        if metric == "AUC" and sdist != "bernoulli":
+            raise ValueError("stopping_metric='AUC' requires a binomial "
+                             "response")
+        if metric not in self.STOPPING_METRICS:
+            raise ValueError(f"unsupported stopping_metric {metric!r}; have "
+                             f"{self.STOPPING_METRICS}")
+        return float(jax.device_get(
+            _metric_device(metric, sdist, F, y, w, nclass)))
 
     def _valid_stop_data(self, edges, nclass: int, f0, lr: float,
                          domains, y_domain, prior_trees=None):

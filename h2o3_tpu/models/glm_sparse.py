@@ -61,7 +61,7 @@ def _sparse_irls_step(family: str, data, row, col, nrows: int, ncols: int,
     beta_new, _ = jax.scipy.sparse.linalg.cg(A, b, x0=beta, M=M,
                                              maxiter=cg_iters, tol=1e-8)
     if family == "binomial":
-        p = jnp.clip(mu, 1e-15, 1 - 1e-15)
+        p = jnp.clip(mu, 1e-7, 1 - 1e-7)
         dev = -2.0 * (w * (y * jnp.log(p) + (1 - y) * jnp.log1p(-p))).sum()
     elif family == "poisson":
         dev = 2.0 * (w * (mu - y + jnp.where(y > 0, y * (jnp.log(

@@ -199,6 +199,7 @@ class KMeans(ModelBuilder):
             cutoff = min(0.02 + 10.0 / nrows + 2.5 / max(X.shape[1], 1) ** 2, 0.8)
             C = ((w[:, None] * X).sum(axis=0) / jnp.maximum(w.sum(), 1e-12))[None, :]
             C, wss_best, iters = self._run_lloyd(job, X, w, C)
+            accepted_series = list(self._wss_series)
             for k_try in range(2, k + 1):
                 d2 = _sq_dists(X, C).min(axis=1)
                 nxt = jnp.argmax(jnp.where(w > 0, d2, -jnp.inf))
@@ -208,6 +209,10 @@ class KMeans(ModelBuilder):
                 if rel < cutoff:
                     break
                 C, wss_best, iters = Cand, wss_now, it2
+                accepted_series = list(self._wss_series)
+            # scoring history must describe the ACCEPTED run, not the
+            # rejected final candidate that broke the loop
+            self._wss_series = accepted_series
             k = C.shape[0]
         else:
             mode = str(p["init"]).lower()

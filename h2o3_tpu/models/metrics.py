@@ -130,7 +130,8 @@ class ModelMetricsBinomial(MetricsBase):
         self.gini = 2.0 * self.auc - 1.0
 
     #: criteria maximized over thresholds (reference: ``hex/AUC2.java:24-36``
-    #: ThresholdCriterion enum; the last four report counts AT max-F1)
+    #: ThresholdCriterion enum; the tns/fns/fps/tps count rows each maximize
+    #: the count itself, appended in max_criteria_and_metric_scores)
     MAX_CRITERIA = ("f1", "f2", "f0point5", "accuracy", "precision",
                     "recall", "specificity", "absolute_mcc",
                     "min_per_class_accuracy", "mean_per_class_accuracy")
@@ -261,7 +262,7 @@ def _binomial_pass(p, y, mask, nbins=NBINS):
     """One fused pass: 400-bin score histogram (AUC2 semantics) + logloss + MSE."""
     w = mask.astype(jnp.float32)
     n = w.sum()
-    pc = jnp.clip(p, 1e-15, 1 - 1e-15)
+    pc = jnp.clip(p, 1e-7, 1 - 1e-7)
     logloss = -(w * (y * jnp.log(pc) + (1 - y) * jnp.log1p(-pc))).sum() / n
     err = jnp.where(mask, p - y, 0.0)
     mse = (err * err).sum() / n
