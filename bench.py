@@ -1,22 +1,28 @@
 """Headline benchmarks at BASELINE.json spec scale.
 
-Configs measured (BASELINE.json names five; four run here, the GLM config is
-covered by the AutoML stack):
+All five BASELINE.json configs run:
 
 1. **GBM on HIGGS-shaped 11M rows** (primary metric) — histogram-tree
-   training rows*trees/sec/chip. vs_baseline anchor: 1.0M rows/sec/device,
-   the order of magnitude of XGBoost `gpu_hist` on HIGGS-class data on a
-   modern accelerator (BASELINE.json: "XGBoost-TPU matching gpu_hist A100").
+   training rows*trees/sec/chip. vs_baseline anchor: 1.0M rows·trees/sec/
+   device. Context (see ROOFLINE.md for the full accounting): published
+   A100 `gpu_hist` rides hardware atomic adds at ~the HBM floor
+   (~50-150M rows·trees/s on HIGGS); a v5e has no scatter hardware, and
+   the MXU one-hot formulation measured in ROOFLINE.md is its ceiling —
+   the anchor marks the competitive-on-this-silicon line, not A100 parity.
 2. **XGBoost config** — same data, 256 bins / depth 6 (the reference's
    `tree_method=hist` defaults; h2o-extensions/xgboost).
-3. **DeepLearning MLP** — MNIST-shaped 784-50-50-10 Rectifier, samples/sec/
+3. **GLM logistic regression, airlines-scale** — 1M×12 IRLS to
+   convergence, rows·iters/sec/chip (BASELINE config 1).
+4. **DeepLearning MLP** — MNIST-shaped 784-50-50-10 Rectifier, samples/sec/
    chip (reference: 294 samples/s on 1× i7-5820k, dlperf.Rmd:375).
-4. **AutoML leaderboard** — wall-clock for a 5-model leaderboard on 100k
+5. **AutoML leaderboard** — wall-clock for a 5-model leaderboard on 100k
    rows (reference config: "AutoML leaderboard on Lending Club").
 
 Prints ONE JSON line: the primary GBM metric with the other configs under
 "extra". Data is synthetic (zero-egress image): throughput is shape-bound,
-not distribution-bound, so synthetic proxies are faithful for rows/sec.
+not distribution-bound, so rows/sec is faithful — but the reported AUCs are
+on the SYNTHETIC task and are NOT comparable to published HIGGS numbers
+(they are echoed as ``auc_synthetic`` to make that explicit).
 """
 
 from __future__ import annotations
@@ -64,7 +70,7 @@ def bench_gbm(fr, ndev: int) -> dict:
     dt = time.perf_counter() - t0
     rps = fr.nrows * NTREES / dt / ndev
     return dict(rows_per_sec_chip=round(rps, 1), seconds=round(dt, 2),
-                auc=round(float(model.training_metrics.auc), 4))
+                auc_synthetic=round(float(model.training_metrics.auc), 4))
 
 
 def bench_xgboost(fr, ndev: int) -> dict:
@@ -86,7 +92,39 @@ def bench_xgboost(fr, ndev: int) -> dict:
     dt = time.perf_counter() - t0
     rps = fr.nrows * nt / dt / ndev
     return dict(rows_per_sec_chip=round(rps, 1), seconds=round(dt, 2),
-                auc=round(float(model.training_metrics.auc), 4))
+                auc_synthetic=round(float(model.training_metrics.auc), 4))
+
+
+def bench_glm(ndev: int) -> dict:
+    """Airlines-scale logistic GLM (BASELINE config 1): 1M×12 binomial
+    IRLS to convergence; metric = rows·iterations/sec/chip."""
+    import jax
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.glm import GLM
+
+    n = 1_000_000
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    logit = X[:, :5] @ np.array([0.8, -0.5, 0.3, -0.2, 0.4], np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit)))
+    cols = {f"x{i}": X[:, i] for i in range(12)}
+    cols["dep_delayed"] = np.where(y, "YES", "NO")
+    fr = Frame.from_arrays(cols)
+
+    def train():
+        b = GLM(family="binomial", lambda_=1e-4, max_iterations=30)
+        m = b.train(y="dep_delayed", training_frame=fr)
+        return m, len(b._iter_devs)
+
+    train()   # warm-up compiles
+    jax.effects_barrier()
+    t0 = time.perf_counter()
+    model, iters = train()
+    jax.effects_barrier()
+    dt = time.perf_counter() - t0
+    return dict(rows_iters_per_sec_chip=round(n * iters / dt / ndev, 1),
+                iterations=iters, seconds=round(dt, 2),
+                auc_synthetic=round(float(model.training_metrics.auc), 4))
 
 
 def bench_dl(ndev: int) -> dict:
@@ -134,7 +172,22 @@ def bench_automl(ndev: int) -> dict:
 
 
 def main() -> None:
+    import os
+
     import jax
+
+    # persistent XLA compilation cache (the standard TPU production setup):
+    # AutoML's many model configs are compile-bound on a cold process; the
+    # cache cuts repeat runs to pure compute. Timed regions below still
+    # include a warm-up call, so cold-vs-warm compile state never leaks
+    # into the reported rows/sec.
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass      # older jax: feature absent, bench still valid
     ndev = max(1, len(jax.devices()))
 
     extra: dict = {}
@@ -142,6 +195,7 @@ def main() -> None:
     gbm = bench_gbm(fr, ndev)
 
     for name, fn, args in (("xgboost_hist_11m", bench_xgboost, (fr, ndev)),
+                           ("glm_airlines_1m", bench_glm, (ndev,)),
                            ("dl_mlp_mnist", bench_dl, (ndev,)),
                            ("automl_leaderboard_100k", bench_automl, (ndev,))):
         try:

@@ -281,6 +281,144 @@ h2o.deeplearning <- function(x = NULL, y, training_frame, ...)
 h2o.kmeans <- function(training_frame, x = NULL, ...)
   .train("kmeans", x, NULL, training_frame, ...)
 
+h2o.xgboost <- function(x = NULL, y, training_frame, ...)
+  .train("xgboost", x, y, training_frame, ...)
+
+h2o.naiveBayes <- function(x = NULL, y, training_frame, ...)
+  .train("naivebayes", x, y, training_frame, ...)
+
+h2o.isolationForest <- function(training_frame, x = NULL, ...)
+  .train("isolationforest", x, NULL, training_frame, ...)
+
+h2o.prcomp <- function(training_frame, x = NULL, k = 2, ...)
+  .train("pca", x, NULL, training_frame, k = k, ...)
+
+h2o.stackedEnsemble <- function(x = NULL, y, training_frame, base_models,
+                                ...) {
+  ids <- vapply(base_models, function(m)
+    if (inherits(m, "H2OModel")) m$model_id else as.character(m), "")
+  .train("stackedensemble", x, y, training_frame,
+         base_models = paste0("[", paste(ids, collapse = ","), "]"), ...)
+}
+
+h2o.getModel <- function(model_id) {
+  mj <- .http("GET", paste0("/3/Models/", model_id))$models[[1]]
+  structure(list(model_id = model_id, algo = mj$algo, json = mj),
+            class = "H2OModel")
+}
+
+# -- TwoDimTable (reference: water/api/schemas3/TwoDimTableV3) ---------------
+
+.table_to_df <- function(tbl) {
+  cols <- tbl$columns
+  data <- tbl$data
+  keep <- which(vapply(cols, function(c) !identical(c$name, ""), TRUE))
+  out <- lapply(keep, function(i) {
+    col <- data[[i]]
+    col[vapply(col, is.null, TRUE)] <- NA
+    v <- unlist(col, use.names = FALSE)
+    if (identical(cols[[i]]$type, "double") ||
+        identical(cols[[i]]$type, "long")) suppressWarnings(as.numeric(v))
+    else v
+  })
+  names(out) <- vapply(keep, function(i) cols[[i]]$name, "")
+  as.data.frame(out, stringsAsFactors = FALSE, check.names = FALSE)
+}
+
+h2o.scoreHistory <- function(model) {
+  sh <- model$json$output$scoring_history
+  if (is.null(sh)) return(NULL)
+  .table_to_df(sh)
+}
+
+# -- AutoML (reference: h2o-r h2o.automl / water/automl/api) -----------------
+
+h2o.automl <- function(x = NULL, y, training_frame, max_models = 0,
+                       max_runtime_secs = 0, nfolds = -1, seed = -1,
+                       project_name = NULL, ...) {
+  body <- list(training_frame = training_frame$frame_id,
+               response_column = y, max_models = max_models,
+               max_runtime_secs = max_runtime_secs, nfolds = nfolds,
+               seed = seed)
+  if (!is.null(project_name)) body$project_name <- project_name
+  extra <- list(...)
+  for (k in names(extra)) body[[k]] <- extra[[k]]
+  out <- .http("POST", "/99/AutoMLBuilder", body)
+  .poll_job(out$job$key$name)
+  project <- out$build_control$project_name
+  state <- .http("GET", paste0("/99/AutoML/", project))
+  leader_id <- if (length(state$leaderboard$models))
+    state$leaderboard$models[[1]]$name else NULL
+  structure(list(project_name = project,
+                 leader = if (!is.null(leader_id)) h2o.getModel(leader_id),
+                 leaderboard = .table_to_df(state$leaderboard_table),
+                 event_log = .table_to_df(state$event_log_table)),
+            class = "H2OAutoML")
+}
+
+h2o.get_leaderboard <- function(object, extra_columns = NULL) {
+  path <- paste0("/99/Leaderboards/", object$project_name)
+  if (!is.null(extra_columns))
+    path <- paste0(path, "?extensions=",
+                   paste(extra_columns, collapse = ","))
+  .table_to_df(.http("GET", path)$table)
+}
+
+# -- Grid search (reference: h2o-r h2o.grid) ---------------------------------
+
+h2o.grid <- function(algorithm, x = NULL, y = NULL, training_frame,
+                     hyper_params, search_criteria = NULL, ...) {
+  .json_val <- function(v) {
+    if (is.character(v)) paste0("\"", .json_escape(v), "\"")
+    else if (is.logical(v)) tolower(as.character(v))
+    else as.character(v)
+  }
+  .json_obj <- function(lst) {
+    paste0("{", paste(vapply(names(lst), function(k) {
+      v <- lst[[k]]
+      val <- if (length(v) > 1 || is.list(v))
+        paste0("[", paste(vapply(unlist(v), .json_val, ""),
+                          collapse = ","), "]")
+      else .json_val(v)
+      paste0("\"", k, "\":", val)
+    }, "")), collapse = ","), "}")
+  }
+  body <- list(training_frame = training_frame$frame_id,
+               hyper_parameters = .json_obj(hyper_params))
+  if (!is.null(y)) body$response_column <- y
+  if (!is.null(search_criteria))
+    body$search_criteria <- .json_obj(search_criteria)
+  extra <- list(...)
+  for (k in names(extra)) body[[k]] <- extra[[k]]
+  out <- .http("POST", paste0("/99/Grid/", algorithm), body)
+  job <- .poll_job(out$job$key$name)
+  grid_id <- job$dest$name
+  g <- .http("GET", paste0("/99/Grids/", grid_id))
+  structure(list(grid_id = grid_id,
+                 model_ids = vapply(g$model_ids, function(m) m$name, "")),
+            class = "H2OGrid")
+}
+
+h2o.getGrid <- function(grid_id) {
+  g <- .http("GET", paste0("/99/Grids/", grid_id))
+  structure(list(grid_id = grid_id,
+                 model_ids = vapply(g$model_ids, function(m) m$name, "")),
+            class = "H2OGrid")
+}
+
+# -- model persistence (reference: h2o-r h2o.saveModel/h2o.loadModel) --------
+
+h2o.saveModel <- function(object, path) {
+  out <- .http("GET", paste0("/99/Models.bin/", object$model_id,
+                             "?dir=", utils::URLencode(path, reserved = TRUE)))
+  out$dir
+}
+
+h2o.loadModel <- function(path) {
+  out <- .http("POST", "/99/Models.bin/", list(dir = path))
+  h2o.getModel(out$models[[1]]$model_id$name)
+}
+
 h2o.predict <- function(object, newdata) {
   out <- .http("POST", paste0("/3/Predictions/models/", object$model_id,
                               "/frames/", newdata$frame_id))

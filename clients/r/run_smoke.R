@@ -31,6 +31,36 @@ glm <- h2o.glm(y = "y", training_frame = tr, family = "binomial")
 cat("GLM logloss:",
     h2o.logloss(h2o.performance(glm, newdata = te)), "\n")
 
+# round-3 verbs: xgboost, scoring history, grid, automl, save/load, ensemble
+xgb <- h2o.xgboost(y = "y", training_frame = tr, ntrees = 4, max_depth = 3)
+sh <- h2o.scoreHistory(xgb)
+stopifnot(nrow(sh) == 4)
+
+grid <- h2o.grid("gbm", y = "y", training_frame = tr, ntrees = 3,
+                 hyper_params = list(max_depth = c(2, 3)))
+stopifnot(length(grid$model_ids) == 2)
+
+aml <- h2o.automl(y = "y", training_frame = tr, max_models = 2, nfolds = 0,
+                  seed = 1, include_algos = '["GLM","GBM"]',
+                  project_name = "r_smoke_aml")
+stopifnot(nrow(aml$leaderboard) >= 2)
+lb <- h2o.get_leaderboard(aml, extra_columns = "ALL")
+stopifnot("algo" %in% names(lb))
+
+saved <- h2o.saveModel(xgb, tempdir())
+back <- h2o.loadModel(saved)
+stopifnot(back$model_id == xgb$model_id)
+
+b1 <- h2o.gbm(y = "y", training_frame = tr, ntrees = 3, max_depth = 2,
+              nfolds = 3, seed = 1,
+              keep_cross_validation_predictions = TRUE)
+b2 <- h2o.gbm(y = "y", training_frame = tr, ntrees = 5, max_depth = 3,
+              nfolds = 3, seed = 2,
+              keep_cross_validation_predictions = TRUE)
+se <- h2o.stackedEnsemble(y = "y", training_frame = tr,
+                          base_models = list(b1, b2))
+stopifnot(h2o.auc(h2o.performance(se, newdata = te)) > 0.7)
+
 stopifnot(length(h2o.ls()) >= 3)
 h2o.rm(pred)
 h2o.removeAll()

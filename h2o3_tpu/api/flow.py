@@ -33,7 +33,13 @@ FLOW_HTML = """<!DOCTYPE html>
  .row{display:flex;gap:8px;margin:4px 0;flex-wrap:wrap;align-items:center}
  label{font-size:12px;color:#5a6b7b}
 </style></head><body>
-<header><h1>h2o3-tpu Flow</h1><span id="cloud">connecting…</span></header>
+<header><h1>h2o3-tpu Flow</h1><span id="cloud">connecting…</span>
+ <span style="float:right">
+  <input type="text" id="nbname" placeholder="notebook name" style="width:12em">
+  <button class="small" onclick="saveFlow()">Save</button>
+  <select id="nblist" onchange="loadFlow(this.value)"><option value="">Load…</option></select>
+ </span>
+</header>
 <main>
 <section class="wide"><h2>Import / Parse</h2>
  <div class="row">
@@ -241,7 +247,33 @@ async function runRapids(){
   }catch(e){ out.textContent = "error: " + e.message; }
 }
 
-function refreshAll(){ refreshCloud(); refreshFrames(); refreshModels(); }
+// notebook persistence (reference: Flow save/load via NodePersistentStorage)
+const FLOW_FIELDS = ["path","dest","algo","params","ast"];
+async function saveFlow(){
+  const name = document.getElementById("nbname").value || "flow";
+  const doc = {version: 1, fields: {}};
+  for (const f of FLOW_FIELDS) doc.fields[f] = document.getElementById(f).value;
+  doc.rapids_log = document.getElementById("rapidsout").textContent;
+  await fetch(`/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`,
+              {method: "POST", body: JSON.stringify(doc)});
+  refreshNotebooks();
+}
+async function loadFlow(name){
+  if (!name) return;
+  const r = await fetch(`/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`);
+  const doc = JSON.parse(await r.text());
+  for (const f of FLOW_FIELDS)
+    if (doc.fields && f in doc.fields) document.getElementById(f).value = doc.fields[f];
+  if (doc.rapids_log) document.getElementById("rapidsout").textContent = doc.rapids_log;
+  document.getElementById("nbname").value = name;
+}
+async function refreshNotebooks(){
+  const r = await J("GET", "/3/NodePersistentStorage/notebook");
+  const sel = document.getElementById("nblist");
+  sel.innerHTML = '<option value="">Load…</option>' +
+    r.entries.map(e => `<option value="${esc(e.name)}">${esc(e.name)}</option>`).join("");
+}
+function refreshAll(){ refreshCloud(); refreshFrames(); refreshModels(); refreshNotebooks(); }
 refreshAll();
 setInterval(refreshCloud, 10000);
 </script></body></html>

@@ -621,6 +621,77 @@ class _Handler(BaseHTTPRequestHandler):
     #    ProfilerHandler, WaterMeter* behind /3/Timeline,/3/JStack,
     #    /3/Profiler,/3/WaterMeterCpuTicks,/3/WaterMeterIo) -----------------
 
+    # -- NodePersistentStorage (reference: water/api/NodePersistentStorage
+    #    Handler — Flow saves notebooks under category "notebook") ----------
+
+    @staticmethod
+    def _nps_name(x: str) -> str:
+        """Path-component sanitizer: besides the charset filter, all-dot
+        names ('.', '..') must not survive — they'd traverse out of the
+        storage root."""
+        safe = re.sub(r"[^\w.-]", "_", x).strip(".")
+        return safe or "_"
+
+    @classmethod
+    def _nps_dir(cls, category: str) -> str:
+        import os
+        base = os.environ.get(
+            "H2O3TPU_NPS_DIR",
+            os.path.join(os.path.expanduser("~"), ".h2o3tpu", "nps"))
+        d = os.path.join(base, cls._nps_name(category))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def r_nps_list(self, category):
+        import os
+        d = self._nps_dir(category)
+        entries = []
+        for name in sorted(os.listdir(d)):
+            st = os.stat(os.path.join(d, name))
+            entries.append({"name": name, "size": st.st_size,
+                            "timestamp_millis": int(st.st_mtime * 1000)})
+        self._reply({"__meta": {"schema_type": "NodePersistentStorageV3"},
+                     "category": category, "entries": entries})
+
+    def r_nps_get(self, category, name):
+        import os
+        path = os.path.join(self._nps_dir(category), self._nps_name(name))
+        if not os.path.exists(path):
+            raise KeyError(f"no {category}/{name} in persistent storage")
+        with open(path, "rb") as f:
+            body = f.read()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def r_nps_put(self, category, name):
+        import os
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > 16 << 20:
+            self._error(413, "notebook exceeds the 16MiB cap")
+            return
+        data = self.rfile.read(length)
+        # h2o-py/Flow POST the value as a multipart or urlencoded field
+        ctype = self.headers.get("Content-Type", "")
+        if "urlencoded" in ctype:
+            vals = urllib.parse.parse_qs(data.decode("utf-8", "replace"))
+            data = (vals.get("value") or [""])[0].encode()
+        path = os.path.join(self._nps_dir(category), self._nps_name(name))
+        with open(path, "wb") as f:
+            f.write(data)
+        self._reply({"__meta": {"schema_type": "NodePersistentStorageV3"},
+                     "category": category, "name": name,
+                     "total_bytes": len(data)})
+
+    def r_nps_delete(self, category, name):
+        import os
+        path = os.path.join(self._nps_dir(category), self._nps_name(name))
+        if os.path.exists(path):
+            os.unlink(path)
+        self._reply({"__meta": {"schema_type": "NodePersistentStorageV3"}})
+
     def r_timeline(self):
         from h2o3_tpu.utils.timeline import TIMELINE
         self._reply({"__meta": {"schema_type": "TimelineV3"},
@@ -1326,6 +1397,11 @@ _ROUTES = [
     (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
     (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
     (r"/3/NetworkTest", "GET", _Handler.r_network_test),
+    (r"/3/NodePersistentStorage/([^/]+)", "GET", _Handler.r_nps_list),
+    (r"/3/NodePersistentStorage/([^/]+)/([^/]+)", "GET", _Handler.r_nps_get),
+    (r"/3/NodePersistentStorage/([^/]+)/([^/]+)", "POST", _Handler.r_nps_put),
+    (r"/3/NodePersistentStorage/([^/]+)/([^/]+)", "DELETE",
+     _Handler.r_nps_delete),
     (r"/login", "GET", _Handler.r_login_page),
     (r"/login", "POST", _Handler.r_login),
     (r"/logout", "POST", _Handler.r_logout),

@@ -44,9 +44,16 @@ def main(args=None) -> int:
                     help="with --fork: virtual CPU devices per process")
     ap.add_argument("--port", type=int, default=7337,
                     help="with --fork: coordinator port")
-    ap.add_argument("script")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve the REST API after clouding (instead of, or "
+                         "in addition to, running a script) — the k8s pod-0 "
+                         "/ driver-node mode")
+    ap.add_argument("--rest-port", type=int, default=54321)
+    ap.add_argument("script", nargs="?", default=None)
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(args)
+    if not ns.serve and ns.script is None:
+        ap.error("a script is required unless --serve is given")
 
     if ns.fork:
         procs = []
@@ -60,8 +67,11 @@ def main(args=None) -> int:
                                 f"{ns.devices_per_process}").strip()
             cmd = [sys.executable, "-m", "h2o3_tpu.launch",
                    "--coordinator", f"localhost:{ns.port}",
-                   "--num-processes", str(ns.fork), "--process-id", str(pid),
-                   ns.script] + ns.script_args
+                   "--num-processes", str(ns.fork), "--process-id", str(pid)]
+            if ns.serve:
+                cmd += ["--serve", "--rest-port", str(ns.rest_port)]
+            if ns.script is not None:
+                cmd += [ns.script] + ns.script_args
             procs.append(subprocess.Popen(cmd, env=env))
         # reap in any order; one failure tears down the rest (a dead
         # coordinator would leave workers blocked in initialize forever)
@@ -87,7 +97,22 @@ def main(args=None) -> int:
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             jax.config.update("jax_platforms", "cpu")
         init_distributed(ns.coordinator, ns.num_processes, ns.process_id)
-    _run_script(ns.script, ns.script_args)
+    if ns.serve:
+        import jax
+        from h2o3_tpu.api import H2OServer
+        # only the controller process serves (reference: the driver node's
+        # REST API); workers just participate in the SPMD cloud
+        if getattr(jax, "process_index", lambda: 0)() == 0:
+            server = H2OServer(port=ns.rest_port, host="0.0.0.0").start()
+            print(f"h2o3_tpu REST serving on {server.url}", flush=True)
+        if ns.script is None:
+            # workers block as cloud members; REST-driven TRAINING is
+            # single-controller (multi-host training uses script mode,
+            # where every process runs the same SPMD program)
+            import threading
+            threading.Event().wait()     # serve forever
+    if ns.script is not None:
+        _run_script(ns.script, ns.script_args)
     return 0
 
 

@@ -1031,14 +1031,24 @@ class GBM(SharedTreeBuilder):
         # >~90s trips the device/tunnel watchdog (observed at HIGGS-11M
         # x 20 trees); ~1.5e8 rows*trees ≈ 60s on v5e at 64 bins, and
         # histogram cost scales with bins. The inter-chunk host hop
-        # costs ~40ms — noise against a multi-second chunk.
+        # costs ~40ms — noise against a multi-second chunk. The 25-tree
+        # ceiling decouples the program shape from large ntrees: the common
+        # AutoML values (50, 100, 200 trees) all balance to 25-tree chunks
+        # and share one compile per (depth, bins) config; other ntrees get
+        # waste-free balanced chunks (per = ceil(M/k)) at the cost of their
+        # own shape.
         cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
-        per = max(1, int(1.5e8 // cost))
+        per = max(1, min(int(1.5e8 // cost), 25))
         if sr > 0:
             # bound the discarded overshoot past the stopping point; ≥16
             # trees per chunk keeps the dispatch count low (each chunk pays
             # a host round-trip for the stopping decision)
             per = min(per, max(4 * sr, 16))
+        # balanced chunks: ceil(M/k) for k = chunk count. Padding then wastes
+        # at most k-1 trees per train instead of up to per-1 (a 20-tree run
+        # with per=13 must grow 2x10, not 13 + a padded 7->13)
+        k_chunks = max(1, -(-M // per))
+        per = -(-M // k_chunks)
         tol = float(p.get("stopping_tolerance") or 1e-3)
         lr = float(kwargs["lr"])
         nbins = int(kwargs["n_bins"])

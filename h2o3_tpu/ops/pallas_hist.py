@@ -10,10 +10,18 @@ XLA's ``segment_sum`` lowering of this inside the fused tree program runs at
 ~110 ms/level on 500k×28 (scatter-add serialization); this kernel instead
 rides the MXU: per (row-tile, feature) grid step it builds the transposed
 bin one-hot [S, T] on the VPU and contracts it against a per-tile
-node×stat spread matrix ns[T, N*3] (computed once per tile into VMEM
-scratch), accumulating all features' histograms in one resident VMEM output
-block. ~30 ms/level → ~4× end-to-end tree-growth speedup, measured on
-TPU v5e.
+node×stat spread matrix ns[T, Nb*3] (computed once per tile into VMEM
+scratch), accumulating histograms in a resident VMEM output block.
+
+Tiling (round-3 lift of the depth-6/narrow-F cliff): the grid is
+(node-blocks, feature-blocks, row-tiles, features-in-block). The output
+block holds one (feature-block × node-block) slab and stays VMEM-resident
+across the row sweep; node blocks beyond the first re-read the inputs, so
+HBM traffic scales with ``ceil(N / NODE_BLOCK)`` — the dispatch layer caps
+how many blocks are worth it (measured crossover vs the scatter path; see
+``_MAX_NODE_BLOCKS`` and ROOFLINE.md). FLOP cost is R·F·2·S·3·N MACs and
+doubles per level — the MXU wins while the arithmetic stays under the
+scatter path's serialization, not asymptotically.
 
 Layout notes (Mosaic constraints): the bin one-hot is built TRANSPOSED
 ([S, T], bins on sublanes) because dynamic lane indexing is unsupported;
@@ -30,48 +38,65 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# VMEM budget: out block F*S*(N*3)*4 + ns scratch T*(N*3)*4 + narrow input
-# blocks padded to 128 lanes. T=1024 fits comfortably for N ≤ 64, F ≤ ~100.
 _TILE = 1024
-_MAX_NODES = 64      # beyond this the resident out block would blow VMEM
+_NODE_BLOCK = 64     # nodes per resident output slab
+#: node-block count cap: levels needing more blocks fall back to the XLA
+#: scatter path. Kernel time grows ~linearly with blocks (input re-reads +
+#: MXU FLOPs ∝ N); the scatter path is roughly flat until XLA switches
+#: lowering around N≈4096 and speeds up. Measured crossover on v5e at
+#: 1M×28×64bins: 3.8× win at N=2048 (32 blocks), loss at N=4096 — so 32
+#: blocks ≡ tree depth ≤ 11 stays on the kernel (ROOFLINE.md has the table).
+_MAX_NODE_BLOCKS = 32
+#: validated up to 10.7MB resident (257 bins × 64 nodes × 28 features in one
+#: slab) on v5e's 16MB VMEM — keep 256-bin × F≈28 configs single-block
+_VMEM_BUDGET = 11 * 1024 * 1024
+
+
+def _plan(n_nodes: int, n_feat: int, n_bins_tot: int):
+    """(node_block, feat_block) tile sizes, or None if out of envelope."""
+    S = ((n_bins_tot + 7) // 8) * 8
+    Nb = min(n_nodes, _NODE_BLOCK)
+    if (n_nodes + Nb - 1) // Nb > _MAX_NODE_BLOCKS:
+        return None
+    # resident out slab Fb*S*Nb*3*4 within budget after fixed costs
+    fixed = (_TILE * Nb * 3 * 4          # ns scratch
+             + S * _TILE * 4             # bin one-hot
+             + 3 * _TILE * 128 * 4 * 2)  # padded input double-buffers
+    per_feat = S * Nb * 3 * 4
+    Fb = max(1, min(n_feat, (_VMEM_BUDGET - fixed) // per_feat))
+    if Fb < 1 or fixed + per_feat > _VMEM_BUDGET:
+        return None
+    return Nb, Fb
 
 
 def pallas_available(n_nodes: int, n_feat: int, n_bins_tot: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
-    if n_nodes > _MAX_NODES:
-        return False
-    # resident out block + ns scratch + bin one-hot + double-buffered narrow
-    # inputs (padded to 128 lanes); 11MB leaves headroom in 16MB VMEM —
-    # validated up to 257 bins × 64 nodes × 28 features
-    S = ((n_bins_tot + 7) // 8) * 8
-    vmem = (n_feat * S * n_nodes * 3 * 4        # out block
-            + _TILE * n_nodes * 3 * 4           # ns scratch
-            + S * _TILE * 4                     # bin one-hot
-            + 3 * _TILE * 128 * 4 * 2)          # padded input double-buffers
-    return vmem < 11 * 1024 * 1024
+    return _plan(n_nodes, n_feat, n_bins_tot) is not None
 
 
-def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, N, S, T):
+def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, Nb, S, T, Fb):
     import jax.experimental.pallas as pl
 
-    i = pl.program_id(0)
-    f = pl.program_id(1)
+    gb = pl.program_id(0)      # node block
+    i = pl.program_id(2)       # row tile
+    fi = pl.program_id(3)      # feature within block
 
-    @pl.when(jnp.logical_and(i == 0, f == 0))
+    @pl.when(jnp.logical_and(i == 0, fi == 0))
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # ns[k, t] = (node[t] == k//3) * ghw[k%3, t]; built once per row tile.
-    # Inputs arrive ROW-MAJOR-TRANSPOSED ([3, R], [1, R]): a narrow [R, 3]
-    # array in HBM pads its 3-wide minor dim to 128 lanes (42x memory blowup
-    # at 11M rows — an OOM, not a slowdown); [3, R] pads 3 sublanes to 8.
-    @pl.when(f == 0)
+    # ns[k, t] = (node[t] == gb*Nb + k//3) * ghw[k%3, t]; built once per
+    # (node-block, row-tile). Inputs arrive ROW-MAJOR-TRANSPOSED ([3, R],
+    # [1, R]): a narrow [R, 3] array in HBM pads its 3-wide minor dim to 128
+    # lanes (42x memory blowup at 11M rows); [3, R] pads 3 sublanes to 8.
+    @pl.when(fi == 0)
     def _():
         nd = n_ref[0, :]
-        iota_k = jax.lax.broadcasted_iota(jnp.int32, (N * 3, 1), 0)
-        ghw_rep = jnp.concatenate([s_ref[:]] * N, axis=0)          # [N*3, T]
-        ns_ref[:] = jnp.where(nd[None, :] == iota_k // 3, ghw_rep, 0.0)
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (Nb * 3, 1), 0)
+        ghw_rep = jnp.concatenate([s_ref[:]] * Nb, axis=0)         # [Nb*3, T]
+        ns_ref[:] = jnp.where(nd[None, :] == gb * Nb + iota_k // 3,
+                              ghw_rep, 0.0)
 
     binf = b_ref[0, 0, :].astype(jnp.int32)   # i16 in HBM; upcast per tile
     iota_r = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
@@ -80,8 +105,8 @@ def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, N, S, T):
     # gradient sums — enough to flip near-tie split decisions
     acc = jax.lax.dot_general(bin_oh_T, ns_ref[:], (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32,
-                              precision=jax.lax.Precision.HIGHEST)  # [S, N*3]
-    out_ref[pl.ds(f * S, S), :] += acc
+                              precision=jax.lax.Precision.HIGHEST)  # [S, Nb*3]
+    out_ref[0, 0, pl.ds(fi * S, S), :] += acc
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins_tot"))
@@ -93,6 +118,14 @@ def hist_pallas(binned_T, node, g, h, w, n_nodes: int, n_bins_tot: int):
     N, Bt, T = n_nodes, n_bins_tot, _TILE
     F, R = binned_T.shape
     S = ((Bt + 7) // 8) * 8
+    Nb, Fb = _plan(N, F, Bt)
+    n_gb = (N + Nb - 1) // Nb
+    n_fb = (F + Fb - 1) // Fb
+    padf = n_fb * Fb - F
+    if padf:
+        # feature padding: rows read a duplicate of the last feature; the
+        # surplus output slabs are sliced off below
+        binned_T = jnp.pad(binned_T, ((0, padf), (0, 0)), mode="edge")
     pad = (-R) % T
     if pad:
         # padded bin value Bt+1 never matches a one-hot row; padded node -1
@@ -105,21 +138,27 @@ def hist_pallas(binned_T, node, g, h, w, n_nodes: int, n_bins_tot: int):
     act = node >= 0
     # stats-major [3, R] / [1, R]: see layout note in the kernel
     ghw_T = jnp.stack([g, h, w], 0) * act[None, :].astype(jnp.float32)
-    nodec = jnp.where(act, node, 0)[None, :]
+    nodec = jnp.where(act, node, -1)[None, :]
     out = pl.pallas_call(
-        partial(_hist_kernel, N=N, S=S, T=T),
-        out_shape=jax.ShapeDtypeStruct((F * S, N * 3), jnp.float32),
-        grid=(Rp // T, F),
+        partial(_hist_kernel, Nb=Nb, S=S, T=T, Fb=Fb),
+        out_shape=jax.ShapeDtypeStruct((n_gb, n_fb, Fb * S, Nb * 3),
+                                       jnp.float32),
+        grid=(n_gb, n_fb, Rp // T, Fb),
         in_specs=[
-            pl.BlockSpec((1, 1, T), lambda i, f: (f, 0, i),
+            pl.BlockSpec((1, 1, T), lambda gb, fb, i, fi: (fb * Fb + fi, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T), lambda i, f: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, T), lambda i, f: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda gb, fb, i, fi: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, T), lambda gb, fb, i, fi: (0, i),
+                         memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((F * S, N * 3), lambda i, f: (0, 0),
+        out_specs=pl.BlockSpec((1, 1, Fb * S, Nb * 3),
+                               lambda gb, fb, i, fi: (gb, fb, 0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((N * 3, T), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((Nb * 3, T), jnp.float32)],
     )(binned_T[:, None, :], nodec, ghw_T)
-    # [F, S, N, 3] → clip bin padding → [F, N, Bt, 3] → [F, N*Bt, 3]
-    out = out.reshape(F, S, N, 3)[:, :Bt].transpose(0, 2, 1, 3)
+    # [n_gb, n_fb, Fb*S, Nb*3] → [F, N, S, 3] → clip padding → [F, N*Bt, 3]
+    out = out.reshape(n_gb, n_fb, Fb, S, Nb, 3)
+    out = out.transpose(1, 2, 0, 4, 3, 5).reshape(n_fb * Fb, n_gb * Nb, S, 3)
+    out = out[:F, :N, :Bt]
     return out.reshape(F, N * Bt, 3)

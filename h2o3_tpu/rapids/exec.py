@@ -167,6 +167,10 @@ class Session:
             DKV.remove(name)
 
     def end(self):
+        """Session teardown drops every temp's DKV copy too (reference:
+        ``Session.end`` → ``Scope`` temp-key cleanup)."""
+        for name in list(self._tmp):
+            self.remove(name)
         self._tmp.clear()
 
 

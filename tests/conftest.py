@@ -47,3 +47,26 @@ def _clear_jax_caches():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# -- test tiers (VERDICT r2 weak #8: full-suite wall-clock keeps growing) ----
+# smoke tier: `pytest -m "not full" tests/` (< ~3 min); full tier adds the
+# heavy end-to-end modules (real-client flows, closures, device parity).
+FULL_TIER = {
+    "test_h2o_py_compat", "test_multiprocess", "test_rapids_closure",
+    "test_orchestration", "test_device_parity", "test_glm_completions",
+    "test_golden_parity", "test_deeplearning", "test_binfmt_cleaner",
+    "test_algos3", "test_psvm", "test_glrm_losses", "test_tls_auth",
+    "test_mojo_v2", "test_r_client",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "full: heavy end-to-end tier")
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
+def pytest_collection_modifyitems(config, items):
+    for it in items:
+        if it.module.__name__ in FULL_TIER:
+            it.add_marker(pytest.mark.full)

@@ -111,3 +111,69 @@ def test_rest_observability_endpoints(server):
     assert "cpu" in cpu["cpu_ticks"]
     io = _get(server, "/3/WaterMeterIo")
     assert isinstance(io["persist_stats"], dict)
+
+
+def test_scope_temp_key_cleanup():
+    """Scope (reference water/Scope.java): keys created inside are removed
+    at exit unless kept; nesting hands kept keys to the outer scope."""
+    import numpy as np
+    from h2o3_tpu import Frame
+    from h2o3_tpu.utils import scope
+    from h2o3_tpu.utils.registry import DKV
+
+    def put(name):
+        DKV.put(name, Frame.from_arrays({"a": np.arange(3, dtype=np.float32)}))
+
+    with scope.scope("kept"):
+        put("kept")
+        put("tmp1")
+        with scope.scope():
+            put("tmp2")
+        assert "tmp2" not in DKV          # inner scope cleaned up
+        assert "tmp1" in DKV
+    assert "tmp1" not in DKV
+    assert "kept" in DKV                  # explicitly kept survives
+    DKV.remove("kept")
+
+
+def test_nps_notebook_roundtrip(tmp_path):
+    """NodePersistentStorage (reference water/api/NodePersistentStorage):
+    Flow notebooks save/list/load/delete across server instances."""
+    import json
+    import os
+    import urllib.request
+
+    from h2o3_tpu.api import H2OServer
+
+    os.environ["H2O3TPU_NPS_DIR"] = str(tmp_path)
+    try:
+        s = H2OServer(port=0).start()
+        try:
+            doc = json.dumps({"version": 1, "fields": {"path": "/d.csv"}})
+            urllib.request.urlopen(urllib.request.Request(
+                f"{s.url}/3/NodePersistentStorage/notebook/myflow",
+                data=doc.encode(), method="POST",
+                headers={"Content-Type": "application/json"}))
+            with urllib.request.urlopen(
+                    f"{s.url}/3/NodePersistentStorage/notebook") as r:
+                lst = json.loads(r.read())
+            assert [e["name"] for e in lst["entries"]] == ["myflow"]
+        finally:
+            s.stop()
+        # persistence survives a server restart (disk-backed)
+        s2 = H2OServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"{s2.url}/3/NodePersistentStorage/notebook/myflow") as r:
+                back = json.loads(r.read())
+            assert back["fields"]["path"] == "/d.csv"
+            urllib.request.urlopen(urllib.request.Request(
+                f"{s2.url}/3/NodePersistentStorage/notebook/myflow",
+                method="DELETE"))
+            with urllib.request.urlopen(
+                    f"{s2.url}/3/NodePersistentStorage/notebook") as r:
+                assert json.loads(r.read())["entries"] == []
+        finally:
+            s2.stop()
+    finally:
+        del os.environ["H2O3TPU_NPS_DIR"]
