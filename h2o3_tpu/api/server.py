@@ -74,6 +74,10 @@ def _parse_list(v: str) -> list:
                 if s.strip()]
 
 
+class PayloadTooLarge(ValueError):
+    """Raised for oversized request bodies; routed to HTTP 413."""
+
+
 def _done_job(description: str, dest_key: str | None = None) -> dict:
     """A completed, DKV-registered job serialized as JobV3 — synchronous
     routes still hand h2o-py's H2OJob wrapper a pollable job payload."""
@@ -115,10 +119,31 @@ class _Handler(BaseHTTPRequestHandler):
                      "exception_type": "java.lang.RuntimeException",
                      "values": {}, "stacktrace": []}, code)
 
+    #: non-upload request bodies are parameter payloads; cap them (the
+    #: reference relies on Jetty's request limits). File content goes
+    #: through /3/PostFile, which has its own 1GiB cap.
+    MAX_PARAM_BODY = 64 << 20
+
+    def _drain_body(self, length: int) -> None:
+        """Read and discard an oversized body: replying mid-upload breaks
+        the pipe on the client side instead of delivering the error."""
+        left = length
+        while left > 0:
+            chunk = self.rfile.read(min(left, 1 << 20))
+            if not chunk:
+                break
+            left -= len(chunk)
+
     def _params(self) -> dict:
         q = urllib.parse.urlparse(self.path).query
         out = {k: v[0] for k, v in urllib.parse.parse_qs(q).items()}
         length = int(self.headers.get("Content-Length") or 0)
+        if length > self.MAX_PARAM_BODY:
+            self._drain_body(length)
+            raise PayloadTooLarge(
+                f"request body of {length} bytes exceeds the "
+                f"{self.MAX_PARAM_BODY >> 20}MiB parameter cap "
+                "(use /3/PostFile for data uploads)")
         if length:
             body = self.rfile.read(length).decode()
             ctype = self.headers.get("Content-Type", "")
@@ -246,6 +271,8 @@ class _Handler(BaseHTTPRequestHandler):
                     fn(self, *match.groups())
                     return
             self._error(404, f"no route for {method} {path}")
+        except PayloadTooLarge as e:
+            self._error(413, str(e))
         except KeyError as e:
             self._error(404, str(e))
         except Exception as e:   # one bad request must not kill the server
@@ -292,6 +319,7 @@ class _Handler(BaseHTTPRequestHandler):
         dest = (q.get("destination_frame") or [None])[0]
         length = int(self.headers.get("Content-Length") or 0)
         if length > 1 << 30:
+            self._drain_body(length)
             self._error(413, f"upload of {length} bytes exceeds the 1GiB cap")
             return
         body = self.rfile.read(length)
@@ -670,6 +698,7 @@ class _Handler(BaseHTTPRequestHandler):
         import os
         length = int(self.headers.get("Content-Length") or 0)
         if length > 16 << 20:
+            self._drain_body(length)
             self._error(413, "notebook exceeds the 16MiB cap")
             return
         data = self.rfile.read(length)

@@ -33,12 +33,17 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
     # URI routing (reference: water/persist/PersistManager scheme dispatch)
     if "://" in path:
         scheme = path.split("://", 1)[0].lower()
-        if scheme in ("s3", "s3a", "s3n", "gs", "gcs", "hdfs", "drive"):
-            raise ValueError(
-                f"{scheme}:// persist backend is not enabled in this build "
-                "(reference ships h2o-persist-s3/gcs/hdfs as optional "
-                "modules); download the object locally or serve it over "
-                "http(s) and re-import")
+        if scheme in ("s3", "s3a", "s3n", "gs", "gcs", "hdfs"):
+            # cloud persist backends (stdlib-HTTP S3 SigV4 / GCS JSON /
+            # WebHDFS — persist/cloud.py); fetch then parse as local
+            from h2o3_tpu.persist.cloud import MANAGER
+            tmp = MANAGER.fetch_to_temp(path)
+            try:
+                return import_file(tmp, key=key or _key_from_path(path),
+                                   header=header, col_types=col_types,
+                                   na_strings=na_strings, sep=sep)
+            finally:
+                os.unlink(tmp)
         if scheme not in ("http", "https", "file"):
             raise ValueError(f"unknown URI scheme {scheme!r}")
         if scheme == "file":

@@ -105,14 +105,16 @@ def main(args=None) -> int:
         if getattr(jax, "process_index", lambda: 0)() == 0:
             server = H2OServer(port=ns.rest_port, host="0.0.0.0").start()
             print(f"h2o3_tpu REST serving on {server.url}", flush=True)
-        if ns.script is None:
-            # workers block as cloud members; REST-driven TRAINING is
-            # single-controller (multi-host training uses script mode,
-            # where every process runs the same SPMD program)
-            import threading
-            threading.Event().wait()     # serve forever
     if ns.script is not None:
         _run_script(ns.script, ns.script_args)
+    if ns.serve:
+        # keep serving after the (optional) setup script: the REST server
+        # runs on a daemon thread, so returning would tear it down. Workers
+        # block as cloud members; REST-driven TRAINING is single-controller
+        # (multi-host training uses script mode, where every process runs
+        # the same SPMD program).
+        import threading
+        threading.Event().wait()     # serve forever
     return 0
 
 

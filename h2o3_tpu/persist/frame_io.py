@@ -78,7 +78,14 @@ def load_frame(path: str, key: str | None = None) -> Frame:
 
 
 def export_file(frame: Frame, path: str, header: bool = True, sep: str = ",") -> str:
-    """CSV export (reference: ``h2o.export_file`` → ``Frame.export``)."""
+    """CSV export (reference: ``h2o.export_file`` → ``Frame.export``);
+    cloud URIs upload through the persist backends (PersistManager)."""
     df = frame.to_pandas()
+    scheme = path.split("://", 1)[0].lower() if "://" in path else ""
+    if scheme in ("s3", "s3a", "s3n", "gs", "gcs", "hdfs"):
+        from h2o3_tpu.persist.cloud import MANAGER
+        MANAGER.put(path, df.to_csv(index=False, header=header,
+                                    sep=sep).encode())
+        return path
     df.to_csv(path, index=False, header=header, sep=sep)
     return path

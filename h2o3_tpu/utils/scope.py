@@ -12,41 +12,53 @@ obvious way; ``track`` force-registers keys created through side channels.
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 
 from h2o3_tpu.utils.registry import DKV
 
-_stack: list[dict] = []
+# per-thread like the reference (Scope.java keys its stack by thread):
+# concurrent REST handlers must not pop each other's frames
+_local = threading.local()
+
+
+def _stack_of() -> list[dict]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
 
 
 def enter() -> None:
-    _stack.append({"pre": set(DKV.keys()), "tracked": set()})
+    _stack_of().append({"pre": set(DKV.keys()), "tracked": set()})
 
 
 def track(key: str) -> str:
     """Explicitly mark a key for cleanup at scope exit."""
-    if _stack:
-        _stack[-1]["tracked"].add(key)
+    stack = _stack_of()
+    if stack:
+        stack[-1]["tracked"].add(key)
     return key
 
 
 def untrack(key: str) -> str:
-    if _stack:
-        _stack[-1]["tracked"].discard(key)
+    stack = _stack_of()
+    if stack:
+        stack[-1]["tracked"].discard(key)
     return key
 
 
 def exit(*keep: str) -> None:
     """Remove keys created since the matching :func:`enter`, except ``keep``
     (and anything a still-open outer scope already owned)."""
-    frame = _stack.pop()
+    stack = _stack_of()
+    frame = stack.pop()
     keep_set = set(keep)
     new = (set(DKV.keys()) - frame["pre"]) | frame["tracked"]
     for k in new - keep_set:
         if k in DKV:
             DKV.remove(k)
-    if _stack:   # surviving keys become the outer scope's responsibility
-        _stack[-1]["tracked"] |= keep_set & set(DKV.keys())
+    if stack:    # surviving keys become the outer scope's responsibility
+        stack[-1]["tracked"] |= keep_set & set(DKV.keys())
 
 
 @contextmanager

@@ -1,0 +1,120 @@
+"""REST error-surface contract (VERDICT r2 weak #7).
+
+Reference: the H2OError/H2OModelBuilderError schema contract — malformed
+requests must come back as structured JSON errors with sane status codes,
+never connection drops or server death.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+    DKV.clear()
+
+
+def _post(server, path, body):
+    data = urllib.parse.urlencode(body).encode()
+    return urllib.request.urlopen(
+        urllib.request.Request(f"{server.url}{path}", data=data))
+
+
+def _err(server, path, body=None, method="POST"):
+    data = urllib.parse.urlencode(body).encode() if body is not None else b""
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{server.url}{path}", data=data if method == "POST" else None,
+            method=method))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+    raise AssertionError("expected an HTTP error")
+
+
+def test_malformed_rapids_is_structured_error(server):
+    for ast in ["(unknown_op 1 2)", "(((", "(cols_py missing_frame 0)", ""]:
+        code, body = _err(server, "/99/Rapids", {"ast": ast})
+        assert code in (400, 404, 500), (ast, code)
+        assert body["__meta"]["schema_type"] == "H2OErrorV3"
+        assert body["msg"]
+    # the server is still alive and serving
+    with urllib.request.urlopen(f"{server.url}/3/Cloud") as r:
+        assert r.status == 200
+
+
+def test_unknown_keys_are_404(server):
+    for path, method in [("/3/Frames/nope", "GET"),
+                         ("/3/Models/nope", "GET"),
+                         ("/3/Jobs/nope", "GET"),
+                         ("/99/AutoML/nope", "GET"),
+                         ("/99/Leaderboards/nope", "GET")]:
+        code, body = _err(server, path, method=method)
+        assert code == 404, (path, code)
+        assert body["__meta"]["schema_type"] == "H2OErrorV3"
+
+
+def test_oversized_param_body_rejected(server):
+    big = b"x" * ((64 << 20) + 1024)
+    req = urllib.request.Request(f"{server.url}/99/Rapids", data=big)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 413
+    body = json.loads(ei.value.read())
+    assert "cap" in body["msg"]
+    with urllib.request.urlopen(f"{server.url}/3/Cloud") as r:
+        assert r.status == 200
+
+
+def test_concurrent_job_cancellation(server, rng):
+    n = 4000
+    X = rng.normal(size=(n, 3))
+    y = X[:, 0] > 0
+    fr = Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.array(["n", "p"], dtype=object)[y.astype(int)]},
+        key="cancel_fr")
+    DKV.put("cancel_fr", fr)
+    with _post(server, "/3/ModelBuilders/gbm",
+               {"training_frame": "cancel_fr", "response_column": "y",
+                "ntrees": 200, "max_depth": 5}) as r:
+        job_key = json.loads(r.read())["job"]["key"]["name"]
+    # cancel from several clients at once while the build runs
+    errs = []
+
+    def cancel():
+        try:
+            _post(server, f"/3/Jobs/{job_key}/cancel", {}).read()
+        except Exception as e:   # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=cancel) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for _ in range(200):
+        with urllib.request.urlopen(f"{server.url}/3/Jobs/{job_key}") as r:
+            st = json.loads(r.read())["jobs"][0]["status"]
+        if st in ("CANCELLED", "DONE", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert st in ("CANCELLED", "DONE")   # DONE if it outran the cancel
+    # a second cancel of a finished job is a no-op, not a crash
+    _post(server, f"/3/Jobs/{job_key}/cancel", {}).read()
+    with urllib.request.urlopen(f"{server.url}/3/Cloud") as r:
+        assert r.status == 200
