@@ -270,6 +270,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if match and m == method:
                     fn(self, *match.groups())
                     return
+            # extension-contributed routes (reference RestApiExtension SPI)
+            from h2o3_tpu.utils import extensions as _ext
+            for pat, m, fn in _ext.rest_routes():
+                match = re.fullmatch(pat, path)
+                if match and m == method:
+                    fn(self, *match.groups())
+                    return
             self._error(404, f"no route for {method} {path}")
         except PayloadTooLarge as e:
             self._error(413, str(e))
@@ -310,18 +317,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "ImportFilesV3"},
                      "destination_frames": keys, "fails": fails})
 
-    def r_postfile(self):
-        """Reference PostFileHandler (``water/api/PostFileHandler.java``,
-        used by ``h2o.upload_file``): store the multipart body's file part as
-        a raw key for ParseSetup/Parse. Uploads are size-capped (the
-        reference relies on Jetty limits)."""
-        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
-        dest = (q.get("destination_frame") or [None])[0]
+    def _read_upload(self) -> "tuple[bytes, str] | None":
+        """Read a (possibly multipart) uploaded body; None = too large
+        (the 413 is already sent). Returns (file bytes, filename)."""
+        import os
         length = int(self.headers.get("Content-Length") or 0)
         if length > 1 << 30:
             self._drain_body(length)
             self._error(413, f"upload of {length} bytes exceeds the 1GiB cap")
-            return
+            return None
         body = self.rfile.read(length)
         ctype = self.headers.get("Content-Type", "")
         data, fname = body, "upload.csv"
@@ -339,6 +343,39 @@ class _Handler(BaseHTTPRequestHandler):
                                                                 "replace"))
                 data = content[:-2] if content.endswith(b"\r\n") else content
                 break
+        return data, fname
+
+    def r_putkey(self):
+        """Reference PutKeyHandler: store raw uploaded bytes under a DKV key
+        (h2o-py ``_put_key`` — the transport for custom metric/distribution
+        UDF zips, ``h2o.py:2073``)."""
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        dest = (q.get("destination_key") or [None])[0]
+        overwrite = (q.get("overwrite") or ["True"])[0].lower() != "false"
+        up = self._read_upload()
+        if up is None:
+            return
+        data, fname = up
+        key = dest or f"{fname.replace('.', '_')}_{uuid.uuid4().hex[:8]}"
+        if not overwrite and DKV.get(key) is not None:
+            self._error(400, f"key {key!r} already exists and overwrite=False")
+            return
+        from h2o3_tpu.frame.parse import RawFile
+        DKV.put(key, RawFile(data, name=fname))
+        self._reply({"__meta": {"schema_type": "PutKeyV3"},
+                     "destination_key": key})
+
+    def r_postfile(self):
+        """Reference PostFileHandler (``water/api/PostFileHandler.java``,
+        used by ``h2o.upload_file``): store the multipart body's file part as
+        a raw key for ParseSetup/Parse. Uploads are size-capped (the
+        reference relies on Jetty limits)."""
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        dest = (q.get("destination_frame") or [None])[0]
+        up = self._read_upload()
+        if up is None:
+            return
+        data, fname = up
         from h2o3_tpu.frame.parse import RawFile
         key = dest or f"{fname.replace('.', '_')}_{uuid.uuid4().hex[:8]}"
         DKV.put(key, RawFile(data, name=fname))
@@ -1234,10 +1271,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "GridsV99"}, "grids": grids})
 
     def r_capabilities(self):
+        from h2o3_tpu.utils import extensions as _ext
         self._reply({"__meta": {"schema_type": "CapabilitiesV3"},
                      "capabilities": [
                          {"name": a, "module": "core"}
-                         for a in sorted(_algo_registry())]})
+                         for a in sorted(_algo_registry())] + [
+                         {"name": e.name, "module": "extension"}
+                         for e in _ext.extensions()]})
 
     def r_init_id(self):
         self._reply({"__meta": {"schema_type": "InitIDV3"},
@@ -1356,6 +1396,7 @@ _ROUTES = [
     (r"/99/Models/([^/]+)", "GET", _Handler.r_model),
     (r"/3/PostFile", "POST", _Handler.r_postfile),
     (r"/3/PostFile\.bin", "POST", _Handler.r_postfile),
+    (r"/3/PutKey", "POST", _Handler.r_putkey),
     (r"/3/Shutdown", "POST", _Handler.r_shutdown),
     (r"/3/GarbageCollect", "POST", _Handler.r_gc),
     (r"/3/Timeline", "GET", _Handler.r_timeline),
@@ -1489,9 +1530,15 @@ class H2OServer:
         return f"{self.scheme}://{self.host}:{self.port}"
 
     def start(self) -> "H2OServer":
+        # extension lifecycle (reference: ExtensionManager hooks run during
+        # H2O.main before the REST API is declared up)
+        from h2o3_tpu.utils import extensions as _ext
+        _ext.load_env_extensions()
+        _ext.init_all()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        _ext.report("cloud_up", url=self.url)
         return self
 
     def stop(self) -> None:

@@ -60,13 +60,21 @@ def _weighted_quantile_host(y, w, prob: float) -> float:
     return float(ys[min(idx, len(ys) - 1)])
 
 
-@partial(jax.jit, static_argnames=("dist", "quantile_alpha", "huber_alpha",
-                                   "tweedie_power"))
+@partial(jax.jit, static_argnames=("dist", "custom_id"))
 def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
-               huber_alpha: float = 0.9, tweedie_power: float = 1.5):
+               huber_alpha: float = 0.9, tweedie_power: float = 1.5,
+               custom_id: int = -1):
     """Per-distribution (g, h) pairs (reference: hex/Distribution.java loss
     families; non-smooth losses use the standard GBM pseudo-residual with
     unit hessian, leaf value = weighted mean pseudo-residual)."""
+    if dist == "custom":
+        # user-uploaded CDistributionFunc (water/udf/CDistributionFunc.java):
+        # host callback once per boosting iteration on full columns; the
+        # scan stays one compiled program around it
+        from h2o3_tpu.utils import udf
+        shp = jax.ShapeDtypeStruct(F.shape, jnp.float32)
+        return jax.pure_callback(udf.grad_hess_host(custom_id), (shp, shp),
+                                 F, y, w)
     if dist == "bernoulli":
         p = jax.nn.sigmoid(F)
         return w * (p - y), w * jnp.maximum(p * (1 - p), 1e-10)
@@ -102,6 +110,18 @@ def _grad_hess(dist: str, F, y, w, quantile_alpha: float = 0.5,
         delta = ar[order][idx]
         return w * jnp.clip(r, -delta, delta), w
     return w * (F - y), w  # gaussian
+
+
+def _linkinv_device(link: str, f):
+    """Inverse link on device for custom distributions (reference
+    ``LinkFunction*.java`` families; names per CDistributionFunc.link())."""
+    if link == "log":
+        return jnp.exp(jnp.clip(f, -30, 30))
+    if link == "logit":
+        return jax.nn.sigmoid(f)
+    if link == "inverse":
+        return 1.0 / jnp.where(jnp.abs(f) < 1e-30, 1e-30, f)
+    return f
 
 
 def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
@@ -198,13 +218,6 @@ def _grad_hess_multinomial(F, y, w):
     return w[:, None] * (p - yoh), w[:, None] * jnp.maximum(p * (1 - p), 1e-10)
 
 
-@partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "col_rate",
-                                   "sample_rate", "col_tree_rate", "min_rows",
-                                   "reg_lambda", "reg_alpha", "gamma",
-                                   "min_split_improvement", "lr", "bootstrap",
-                                   "drf", "nclass", "quantile_alpha",
-                                   "huber_alpha", "tweedie_power", "track",
-                                   "ntrees_prior"))
 def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 dist: str, depth: int, n_bins: int, col_rate: float,
                 sample_rate: float, col_tree_rate: float, min_rows: float,
@@ -214,7 +227,7 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 quantile_alpha: float = 0.5, huber_alpha: float = 0.9,
                 tweedie_power: float = 1.5, mono=None, reach=None,
                 cat_feats=None, track: str | None = None, val=None,
-                ntrees_prior: int = 0):
+                ntrees_prior: int = 0, custom_id: int = -1):
     """The WHOLE boosting/bagging run in one compiled program.
 
     Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
@@ -224,23 +237,61 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
     TPU every host-visible op between trees costs a ~30-40ms round-trip,
     which at 20 trees would double the total train time.
 
+    Hyperparameter floats (lr, rates, regularization) are packed into ONE
+    traced f32 vector, NOT static jit args: AutoML's random grids vary them
+    per model, and as compile-time constants every config would pay a fresh
+    XLA compile (the round-2 564s leaderboard was mostly compiles). One
+    packed vector costs one ~40ms host→device upload per *model* (amortized
+    over the whole train); sharing the compiled program saves tens of
+    seconds per config. Only shape/control-flow params (dist, depth, bins,
+    sampling on/off) remain static.
+
     ``keys``: [M, 3, 2] per-remaining-tree PRNG keys (precomputed from the
     base seed so checkpoint resume replays the same per-tree randomness).
     ``nclass`` > 1 grows one tree per class per round (multinomial), vmapped.
     Returns stacked heap arrays [M(, K), heap] + final margins Fcur.
     """
+    hp = jnp.asarray([col_rate, sample_rate, col_tree_rate, min_rows,
+                      reg_lambda, reg_alpha, gamma, min_split_improvement,
+                      lr, quantile_alpha, huber_alpha, tweedie_power],
+                     jnp.float32)
+    return _boost_scan_jit(
+        binned, edges, yc, w, fmask_base, Fcur0, keys, hp,
+        dist=dist, depth=depth, n_bins=n_bins, bootstrap=bootstrap, drf=drf,
+        nclass=nclass,
+        do_row_sample=bool(sample_rate < 1.0),
+        do_tree_col_sample=bool(col_tree_rate < 1.0),
+        do_col_sample=bool(col_rate < 1.0),
+        mono=mono, reach=reach, cat_feats=cat_feats, track=track, val=val,
+        ntrees_prior=ntrees_prior, custom_id=custom_id)
+
+
+@partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "bootstrap",
+                                   "drf", "nclass", "do_row_sample",
+                                   "do_tree_col_sample", "do_col_sample",
+                                   "track", "ntrees_prior", "custom_id"))
+def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
+                    dist: str, depth: int, n_bins: int, bootstrap: bool,
+                    drf: bool, nclass: int, do_row_sample: bool,
+                    do_tree_col_sample: bool, do_col_sample: bool,
+                    mono=None, reach=None, cat_feats=None,
+                    track: str | None = None, val=None,
+                    ntrees_prior: int = 0, custom_id: int = -1):
+    (col_rate, sample_rate, col_tree_rate, min_rows, reg_lambda, reg_alpha,
+     gamma, min_split_improvement, lr, quantile_alpha, huber_alpha,
+     tweedie_power) = hp
     F = binned.shape[1]
     binned_T = binned.T   # hoisted once by XLA; the Pallas kernel wants [F, R]
 
     def sample_w(k1):
         if bootstrap:
             return w * jax.random.poisson(k1, sample_rate, w.shape).astype(jnp.float32)
-        if sample_rate < 1.0:
+        if do_row_sample:
             return w * (jax.random.uniform(k1, w.shape) < sample_rate)
         return w
 
     def sample_fmask(k2):
-        if col_tree_rate >= 1.0:
+        if not do_tree_col_sample:
             return fmask_base
         ku, kf = jax.random.split(k2)
         # force a guaranteed feature BEFORE intersecting with the base mask
@@ -254,7 +305,8 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
         return _grow_tree_device(
             binned, binned_T, edges, g, h, wt, fmask, k3, depth, n_bins,
             min_rows, reg_lambda, reg_alpha, gamma, min_split_improvement,
-            col_rate, mono=mono, reach=reach, cat_feats=cat_feats)
+            col_rate, do_col_sample=do_col_sample,
+            mono=mono, reach=reach, cat_feats=cat_feats)
 
     # -- optional per-tree metric tracking (fused ScoreKeeper) ---------------
     # `track` emits one train-metric scalar per tree from the carried
@@ -301,7 +353,7 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 g, h = -yc * wt, wt      # leaf = weighted in-node mean
             else:
                 g, h = _grad_hess(dist, Fcur, yc, wt, quantile_alpha,
-                                  huber_alpha, tweedie_power)
+                                  huber_alpha, tweedie_power, custom_id)
             out = grow(g, h, wt, sample_fmask(ks[1]), ks[2])
             heap, row_leaf = out[:-1], out[-1]
             Fnew = Fcur + (row_leaf if drf else lr * row_leaf)
@@ -472,6 +524,8 @@ class GBMModel(SharedTreeModel):
             return jnp.stack([1 - p, p], axis=1)
         if self.output["distribution"] in ("poisson", "gamma", "tweedie"):
             return jnp.exp(jnp.clip(f, -30, 30))   # log link
+        if self.output["distribution"] == "custom":
+            return _linkinv_device(self.output["custom_link"], f)
         return f
 
 
@@ -799,6 +853,7 @@ class GBM(SharedTreeBuilder):
             quantile_alpha=0.5,    # quantile distribution target
             huber_alpha=0.9,       # huber delta = this quantile of |residual|
             tweedie_power=1.5,
+            custom_distribution_func=None,  # "python:key=module.Class" UDF
         )
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GBMModel:
@@ -830,10 +885,20 @@ class GBM(SharedTreeBuilder):
             if dist == "bernoulli":
                 raise ValueError("bernoulli distribution requires a categorical (2-level) response")
             if dist not in ("gaussian", "poisson", "gamma", "tweedie",
-                            "laplace", "quantile", "huber"):
+                            "laplace", "quantile", "huber", "custom"):
                 raise ValueError(f"unsupported distribution {dist!r}; "
                                  "have gaussian, bernoulli, poisson, gamma, "
-                                 "tweedie, laplace, quantile, huber, AUTO")
+                                 "tweedie, laplace, quantile, huber, custom, "
+                                 "AUTO")
+        custom_id, custom_dist = -1, None
+        if dist == "custom":
+            ref = p.get("custom_distribution_func")
+            if not ref:
+                raise ValueError("distribution='custom' requires "
+                                 "custom_distribution_func "
+                                 "(h2o.upload_custom_distribution reference)")
+            from h2o3_tpu.utils import udf as _udf
+            custom_id, custom_dist = _udf.resolve_distribution(ref)
         w = weights * valid
         yc = jnp.where(w > 0, yy, 0.0)
 
@@ -858,6 +923,13 @@ class GBM(SharedTreeBuilder):
                 f0 = _weighted_quantile_host(yy, w, 0.5)
             elif dist == "quantile":
                 f0 = _weighted_quantile_host(yy, w, float(p["quantile_alpha"]))
+            elif dist == "custom":
+                import numpy as _np
+                oc_ = p.get("offset_column")
+                off = (_np.nan_to_num(np.asarray(frame.vec(oc_).as_float()))
+                       if oc_ else None)
+                f0 = custom_dist.f0(np.asarray(jax.device_get(yy)),
+                                    np.asarray(jax.device_get(w)), off)
             else:
                 f0 = ybar
 
@@ -890,7 +962,7 @@ class GBM(SharedTreeBuilder):
             bootstrap=False, drf=False, nclass=0,
             quantile_alpha=float(p["quantile_alpha"]),
             huber_alpha=float(p["huber_alpha"]),
-            tweedie_power=float(p["tweedie_power"]))
+            tweedie_power=float(p["tweedie_power"]), custom_id=custom_id)
         mono, reach = self._constraint_arrays(x, frame)
         kwargs.update(mono=mono, reach=reach, cat_feats=self._cat_feats)
         fmask_base = jnp.ones(binned.shape[1], bool)
@@ -915,6 +987,8 @@ class GBM(SharedTreeBuilder):
             self._last_train_raw = jnp.stack([1 - pe, pe], axis=1)
         elif dist in ("poisson", "gamma", "tweedie"):
             self._last_train_raw = jnp.exp(jnp.clip(Fend, -30, 30))
+        elif dist == "custom":
+            self._last_train_raw = _linkinv_device(custom_dist.link_name, Fend)
         else:
             self._last_train_raw = Fend
 
@@ -924,7 +998,10 @@ class GBM(SharedTreeBuilder):
             response_domain=yvec.domain if yvec.is_categorical else None,
             output=dict(trees=trees, edges=edges, f0=f0, learn_rate=lr,
                         distribution=dist, x_cols=list(x), feat_domains=domains,
-                        ntrees=len(trees), **self._cat_output()),
+                        ntrees=len(trees),
+                        **({"custom_link": custom_dist.link_name}
+                           if custom_dist is not None else {}),
+                        **self._cat_output()),
         )
         self._maybe_calibrate(model)
         return model

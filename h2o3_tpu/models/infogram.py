@@ -177,3 +177,107 @@ class Infogram(ModelBuilder):
                 build_core=build_core,
                 relevance_model=rel_model,
             ))
+
+
+def fairness_metrics(model, frame: Frame, protected_cols: list[str],
+                     reference: list[str] | None = None,
+                     favorable_class: str | None = None) -> Frame:
+    """Per-protected-group fairness table (reference:
+    ``water/rapids/ast/prims/models/AstFairnessMetrics.java`` — tp/fp/tn/fn,
+    accuracy/precision/f1/tpr/tnr/fpr/fnr, AUC, logloss, selectedRatio, plus
+    the adverse-impact ratio (AIR) and Fisher p-value vs the reference group).
+    The reference returns a map of frames (overview + per-group ROC tables);
+    here the overview frame carries the full metric set — the per-group ROC
+    curves are recoverable via ``model.model_performance`` on a sliced frame.
+    """
+    import numpy as np
+
+    from h2o3_tpu.frame.types import VecType
+    from h2o3_tpu.frame.vec import Vec
+
+    if not model.is_classifier or len(model.response_domain or ()) != 2:
+        raise ValueError("fairnessMetrics requires a binomial model")
+    dom = list(model.response_domain)
+    fav = favorable_class or dom[1]
+    if fav not in dom:
+        raise ValueError(f"favorable class {fav!r} not in domain {dom}")
+    fi = dom.index(fav)
+
+    preds = model.predict(frame)
+    p = np.asarray(preds.vec(f"p{fav}").to_numpy(), np.float64)[: frame.nrows]
+    yl = frame.vec(model.response_column).labels()
+    act = np.array([lbl == fav for lbl in yl], bool)
+    thr = getattr(model, "_default_threshold", None)
+    thr = 0.5 if thr is None else float(thr)   # 0.0 is a valid threshold
+    sel = p >= thr
+
+    glabels = [frame.vec(c).labels() for c in protected_cols]
+    keys = list(zip(*glabels))
+    groups: dict[tuple, np.ndarray] = {}
+    for i, k in enumerate(keys):
+        groups.setdefault(k, []).append(i)
+    groups = {k: np.asarray(v) for k, v in groups.items()}
+
+    if reference:
+        ref_key = tuple(reference)
+        if ref_key not in groups:
+            raise ValueError(f"reference group {ref_key} not present")
+    else:   # reference default: the largest group (reference ditto)
+        ref_key = max(groups, key=lambda k: len(groups[k]))
+
+    def rank_auc(pi, ai):
+        pos, neg = pi[ai], pi[~ai]
+        if not len(pos) or not len(neg):
+            return float("nan")
+        order = np.argsort(np.concatenate([pos, neg]), kind="mergesort")
+        ranks = np.empty(len(order)); ranks[order] = np.arange(1, len(order) + 1)
+        return float((ranks[: len(pos)].sum() - len(pos) * (len(pos) + 1) / 2)
+                     / (len(pos) * len(neg)))
+
+    def fisher_p(a, b, c, d):
+        try:
+            from scipy.stats import fisher_exact
+            return float(fisher_exact([[a, b], [c, d]])[1])
+        except Exception:          # noqa: BLE001 — scipy-free fallback
+            return float("nan")
+
+    ref_idx = groups[ref_key]
+    ref_sel_ratio = float(sel[ref_idx].mean()) if len(ref_idx) else float("nan")
+
+    rows = []
+    # NA protected-attribute values form their own group; None sorts first
+    order = sorted(groups, key=lambda k: tuple("" if x is None else str(x)
+                                               for x in k))
+    for k in order:
+        idx = groups[k]
+        s, a = sel[idx], act[idx]
+        tp = float((s & a).sum()); fp = float((s & ~a).sum())
+        fn = float((~s & a).sum()); tn = float((~s & ~a).sum())
+        tot = tp + fp + tn + fn
+        pc = np.clip(p[idx], 1e-15, 1 - 1e-15)
+        ll = float(-(a * np.log(pc) + ~a * np.log1p(-pc)).mean()) if tot else float("nan")
+        sel_ratio = (tp + fp) / tot if tot else float("nan")
+        rows.append(list(k) + [
+            tot, tot / frame.nrows,
+            (tp + tn) / tot if tot else np.nan,
+            tp / (tp + fp) if tp + fp else np.nan,
+            2 * tp / (2 * tp + fp + fn) if 2 * tp + fp + fn else np.nan,
+            tp / (tp + fn) if tp + fn else np.nan,
+            tn / (tn + fp) if tn + fp else np.nan,
+            fp / (fp + tn) if fp + tn else np.nan,
+            fn / (fn + tp) if fn + tp else np.nan,
+            rank_auc(p[idx], a), ll, sel_ratio,
+            sel_ratio / ref_sel_ratio if ref_sel_ratio else np.nan,
+            fisher_p(tp + fp, tn + fn,
+                     float(sel[ref_idx].sum()),
+                     float((~sel[ref_idx]).sum())),
+        ])
+    names = list(protected_cols) + [
+        "total", "relativeSize", "accuracy", "precision", "f1", "tpr", "tnr",
+        "fpr", "fnr", "auc", "logloss", "selectedRatio", "air", "p_value"]
+    ncat = len(protected_cols)
+    vecs = [Vec.from_numpy(np.array([r[j] for r in rows], dtype=object),
+                           type=VecType.STR) for j in range(ncat)]
+    vecs += [Vec.from_numpy(np.float32([r[j] for r in rows]))
+             for j in range(ncat, len(names))]
+    return Frame(names, vecs)

@@ -119,7 +119,13 @@ class Model:
         n = frame.nrows
         if not self.is_classifier:
             return Frame(["predict"], [Vec.from_device(raw, n, VecType.NUM)])
-        labels = jnp.argmax(raw, axis=1).astype(jnp.int32)
+        thr = getattr(self, "_default_threshold", None)
+        if thr is not None and self.nclasses == 2:
+            # reset-able binomial decision threshold (reference:
+            # AstModelResetThreshold / defaultThreshold); argmax == 0.5
+            labels = (raw[:, 1] >= float(thr)).astype(jnp.int32)
+        else:
+            labels = jnp.argmax(raw, axis=1).astype(jnp.int32)
         names = ["predict"] + [f"p{d}" for d in self.response_domain]
         vecs = [Vec.from_device(labels, n, VecType.CAT, domain=self.response_domain)]
         for k in range(self.nclasses):
@@ -299,14 +305,24 @@ class ModelBuilder:
         self._score_series = None   # per-train metric series (tree builders)
 
         def driver(job: Job) -> Model:
+            from h2o3_tpu.utils import extensions as _ext
+            _ext.report("model_build_start", algo=self.algo, job=job.key,
+                        frame=frame.key)
             model = self._fit(job, frame, x, y, base_w)
             model.run_time_ms = int((time.time() - t0) * 1000)
             if y is not None:
                 model.training_metrics = self._holdout_metrics(model, frame, y, base_w)
                 cmf = self.params.get("custom_metric_func")
                 if cmf is not None and model.training_metrics is not None:
-                    # user UDF metric (reference: water/udf CFuncRef custom
-                    # metrics; here a python callable (preds, y, w) -> value)
+                    # user UDF metric: either an in-process python callable
+                    # (preds, y, w) -> value, or the reference's wire form
+                    # "python:key=module.Class" naming a /3/PutKey upload
+                    # (water/udf CFuncRef; h2o.upload_custom_metric)
+                    if isinstance(cmf, str):
+                        from h2o3_tpu.utils import udf as _udf
+                        key_name = cmf.split(":", 1)[1].split("=", 1)[0]
+                        cmf = _udf.metric_callable(_udf.load_cfunc(cmf),
+                                                   key_name)
                     self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
@@ -318,6 +334,8 @@ class ModelBuilder:
                 model.cross_validation_metrics = self._cross_validate(
                     job, frame, x, y, base_w, nfolds, model)
             DKV.put(model.key, model)
+            _ext.report("model_build_end", algo=self.algo, model=model.key,
+                        job=job.key)
             return model
 
         self.model = self.job.run(driver)

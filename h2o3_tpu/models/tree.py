@@ -222,6 +222,7 @@ def _leaf_value(G, H, W, reg_lambda, reg_alpha):
 def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
                       depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                       gamma, min_split_improvement, col_rate: float,
+                      do_col_sample: bool | None = None,
                       mono=None, reach=None, cat_feats=None):
     """Grow one whole tree on device; the level loop unrolls at trace time.
 
@@ -247,10 +248,12 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
     def clamp(v, bnd):
         return jnp.clip(v, bnd[:, 0], bnd[:, 1]) if bnd is not None else v
 
+    if do_col_sample is None:     # static callers pass a concrete col_rate
+        do_col_sample = col_rate < 1.0
     for d in range(depth):
         N = 2 ** d
         lmask = feat_mask
-        if col_rate < 1.0:
+        if do_col_sample:
             key, kd, kf = jax.random.split(key, 3)
             sub = jax.random.uniform(kd, (F,)) < col_rate
             sub = sub.at[jax.random.randint(kf, (), 0, F)].set(True)

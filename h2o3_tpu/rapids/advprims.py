@@ -732,3 +732,72 @@ def grouped_permute(frame: Frame, perm_col, group_by, permute_by, keep_col
     arr = np.asarray(rows, np.float32)
     return Frame(names, [Vec.from_numpy(arr[:, j])
                          for j in range(arr.shape[1])])
+
+
+def rectangle_assign(dst: Frame, src, cols, rows) -> Frame:
+    """AstRectangleAssign ``(:= dst src col_expr row_expr)`` — assign a
+    scalar/string/NA or a Frame into a row×column slice of ``dst``
+    (reference ``ast/prims/assign/AstRectangleAssign.java``; h2o-py emits it
+    for ``fr[rows, cols] = value``). Returns a fresh Frame (the reference is
+    copy-on-write; device arrays here are immutable anyway)."""
+    n = dst.nrows
+    # -- column selection ([] = all; numbers or names) -----------------------
+    if cols is None or (isinstance(cols, (list, tuple)) and not cols):
+        cidx = list(range(dst.ncols))
+    else:
+        sel = cols if isinstance(cols, (list, tuple, np.ndarray)) else [cols]
+        cidx = [dst.names.index(c) if isinstance(c, str) else int(c)
+                for c in sel]
+    # -- row selection ([] = all; boolean-mask Frame/Vec; index list) --------
+    if rows is None or (isinstance(rows, (list, tuple)) and not rows):
+        ridx = np.arange(n)
+    elif isinstance(rows, Frame) or isinstance(rows, Vec):
+        mv = rows.vecs[0] if isinstance(rows, Frame) else rows
+        m = np.asarray(fetch(mv.as_float()))[:n]
+        ridx = np.nonzero((m > 0) & ~np.isnan(m))[0]
+    else:
+        ridx = np.atleast_1d(np.asarray(rows)).astype(np.int64)
+    if np.any((ridx < 0) | (ridx >= n)):
+        raise ValueError("row index out of range in rectangle assign")
+
+    def src_col(j_pos: int):
+        """Source values aligned to ridx for the j-th selected column."""
+        v = src.vecs[j_pos]
+        if v.type == VecType.CAT:
+            vals = v.labels()
+        elif v.type in (VecType.STR, VecType.UUID):
+            vals = v.host_values
+        else:
+            vals = np.asarray(fetch(v.as_float()))[: src.nrows]
+        if src.nrows == n:              # full-height source: pick slice rows
+            return vals[ridx]
+        if src.nrows == len(ridx):      # slice-height source: direct
+            return vals
+        raise ValueError(
+            f"source frame has {src.nrows} rows; need {n} or {len(ridx)}")
+
+    new_vecs = list(dst.vecs)
+    for j_pos, j in enumerate(cidx):
+        v = dst.vecs[j]
+        if isinstance(src, Frame):
+            vals = src_col(j_pos)
+        else:
+            vals = src                   # scalar / string / None broadcast
+        if v.type == VecType.CAT:
+            cur = v.labels()             # object array of labels (None = NA)
+            cur[ridx] = vals
+            new_vecs[j] = Vec.from_numpy(cur, type=VecType.CAT)
+        elif v.type in (VecType.STR, VecType.UUID):
+            cur = np.array(v.host_values, dtype=object)
+            cur[ridx] = vals
+            new_vecs[j] = Vec.from_numpy(cur, type=v.type)
+        else:
+            cur = np.asarray(fetch(v.as_float()))[:n].astype(np.float64)
+            fv = (np.nan if vals is None else
+                  np.asarray(vals, np.float64) if not np.isscalar(vals)
+                  else float(vals))
+            cur[ridx] = fv
+            new_vecs[j] = Vec.from_numpy(cur.astype(np.float32),
+                                         type=v.type if v.type == VecType.TIME
+                                         else VecType.NUM)
+    return Frame(list(dst.names), new_vecs)

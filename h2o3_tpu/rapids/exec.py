@@ -254,6 +254,9 @@ def _eval(node, s: Session):
     if op == "cols":
         fr, sel = args
         return fr[_sel_names(fr, sel)]
+    if op == ":=":                  # AstRectangleAssign (dst src cols rows)
+        from h2o3_tpu.rapids import advprims
+        return advprims.rectangle_assign(args[0], args[1], args[2], args[3])
     if op == "rows":
         fr, sel = args
         if isinstance(sel, Frame):
@@ -579,6 +582,103 @@ def _eval(node, s: Session):
             out_names.append(titles.get(k, k.replace("run_", "Run ")))
             vecs.append(Vec.from_numpy(np.float32([r[k] for r in rows])))
         return Frame(out_names, vecs)
+    if op == "makeLeaderboard":
+        # AstMakeLeaderboard (models leaderboardFrame sortMetric extensions
+        # scoringData) → ranked frame (h2o.make_leaderboard)
+        from h2o3_tpu.models.model_base import Model
+        from h2o3_tpu.orchestration.leaderboard import Leaderboard
+        mods = args[0] if isinstance(args[0], list) else [args[0]]
+        lbfr = None
+        if len(args) > 1 and args[1] not in (None, ""):
+            lbfr = args[1] if isinstance(args[1], Frame) else DKV.get(str(args[1]))
+        metric = str(args[2]) if len(args) > 2 and args[2] else None
+        lb = Leaderboard(sort_metric=None if metric in (None, "AUTO") else
+                         metric.lower(), leaderboard_frame=lbfr)
+        for mk in mods:
+            lb.add(mk if isinstance(mk, Model) else DKV[str(mk)])
+        return lb.as_frame()
+    if op == "model.reset.threshold":
+        # AstModelResetThreshold: set the binomial decision threshold used by
+        # predict(); returns the previous one (0.5 = argmax default)
+        model = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        prev = getattr(model, "_default_threshold", None)
+        old = 0.5 if prev is None else float(prev)   # 0.0 is a valid threshold
+        model._default_threshold = float(args[1])
+        DKV.put(model.key, model)
+        return old
+    if op == "segment_models_as_frame":            # AstSegmentModelsAsFrame
+        sm = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        return sm.as_frame()
+    if op == "result":                             # AstResultFrame
+        # reference: ModelSelection/ANOVAGLM expose their summary as a frame
+        model = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        res = getattr(model, "result", None)
+        if res is None:
+            raise ValueError(f"model {getattr(model, 'key', args[0])!r} has "
+                             "no result frame")
+        rows = res() if callable(res) else res
+        if isinstance(rows, Frame):
+            return rows
+        from h2o3_tpu.frame.types import VecType
+        names = list(rows[0].keys())
+        vecs = []
+        for nm in names:
+            col = [r.get(nm) for r in rows]
+            if any(isinstance(c, (str, list, tuple)) for c in col):
+                col = [", ".join(map(str, c)) if isinstance(c, (list, tuple))
+                       else c for c in col]
+                vecs.append(Vec.from_numpy(np.array(col, dtype=object),
+                                           type=VecType.STR))
+            else:
+                vecs.append(Vec.from_numpy(np.float32(
+                    [np.nan if c is None else c for c in col])))
+        return Frame(names, vecs)
+    if op == "transform":
+        # AstTransformFrame (model frame) — transformer models (TargetEncoder,
+        # Word2Vec) applied via Rapids
+        model = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        fr = args[1] if isinstance(args[1], Frame) else DKV[str(args[1])]
+        return model.transform(fr)
+    if op == "fairnessMetrics":
+        # AstFairnessMetrics (model frame protected_cols reference
+        # favourable_class) → per-protected-group metrics frame
+        from h2o3_tpu.models.infogram import fairness_metrics
+        model = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        fr = args[1] if isinstance(args[1], Frame) else DKV[str(args[1])]
+        prot = args[2] if isinstance(args[2], list) else [args[2]]
+        return fairness_metrics(model, fr, [str(c) for c in prot],
+                                reference=[str(r) for r in args[3]]
+                                if len(args) > 3 and isinstance(args[3], list)
+                                else None,
+                                favorable_class=str(args[4])
+                                if len(args) > 4 else None)
+    if op == "model.testJavaScoring":
+        # AstTestJavaScoring analog: the reference cross-checks in-cluster
+        # scoring against the generated POJO; here against the exported
+        # dependency-free numpy scorer module (genmodel/codegen.py)
+        from h2o3_tpu.genmodel.codegen import generate_pojo
+        model = args[0] if not isinstance(args[0], str) else DKV[args[0]]
+        fr = args[1] if isinstance(args[1], Frame) else DKV[str(args[1])]
+        eps = float(args[3]) if len(args) > 3 else 1e-6
+        ns: dict = {}
+        exec(compile(generate_pojo(model), "<pojo>", "exec"), ns)
+        cols = []
+        for c in model.output["x_cols"]:
+            v = fr.vec(c)
+            x = np.asarray(v.to_numpy(), np.float64)
+            if v.is_categorical:
+                x = np.where(x < 0, np.nan, x)
+            cols.append(x)
+        got = np.asarray(ns["score_batch"](np.stack(cols, axis=1)))
+        ours = model.predict(fr)
+        if model.is_classifier:
+            a = np.stack([np.asarray(ours.vec(nm).to_numpy())
+                          for nm in ours.names[1:]], axis=1)
+            b = got[:, 1:] if got.shape[1] == a.shape[1] + 1 else got
+        else:
+            a = np.asarray(ours.vec("predict").to_numpy())
+            b = got[:, 0] if got.ndim == 2 else got
+        return float(np.allclose(a, b, atol=eps, rtol=eps))
     if op == "ls":                                 # AstLs → key listing
         from h2o3_tpu.frame.types import VecType
         keys = DKV.keys()
@@ -673,9 +773,13 @@ _CHAIN_OPS = (
     "maxNA", "minNA", "sumNA", "prod.na", "naCnt", "any.na", "sumaxis",
     "topn", "seq", "seq_len", "rep_len", "match", "which", "which.max",
     "which.min", "countmatches", "strDistance", "tokenize", "difflag1",
-    "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%",
+    "isax", "perfectAUC", "mod", "%%", "intDiv", "%/%", ":=",
     "replaceall", "replacefirst", "num_valid_substrings", "append",
-    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls", "PermutationVarImp", "grouped_permute",
+    "cols_py", "moment", "getTimeZone", "listTimeZones", "setTimeZone", "ls",
+    "PermutationVarImp", "grouped_permute",
+    # models family closure (ast/prims/models/)
+    "makeLeaderboard", "model.reset.threshold", "segment_models_as_frame",
+    "result", "transform", "fairnessMetrics", "model.testJavaScoring",
 )
 
 

@@ -117,6 +117,55 @@ assert se.metalearner() is not None
 assert 0.7 < se.model_performance(te).auc() <= 1.0
 assert se.predict(te).col_names == ["predict", "pno", "pyes"]
 
+# custom UDF metric/distribution: h2o.upload_custom_metric zips generated
+# source, uploads via POST /3/PutKey, and names it "python:key=module.Class"
+# (reference water/udf; server execs the module against the shim interfaces)
+reg = tr[["x1", "x2"]]
+reg["t"] = tr["x1"] * 2 + tr["x2"]
+mae_ref = h2o.upload_custom_metric(
+    """class CustomMaeFunc:
+    def map(self, pred, act, w, o, model):
+        return [w * abs(act[0] - pred[0]), w]
+
+    def reduce(self, l, r):
+        return [l[0] + r[0], l[1] + r[1]]
+
+    def metric(self, l):
+        return l[0] / l[1]
+""", class_name="CustomMaeFunc", func_name="mae")
+cm_gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1,
+                                      custom_metric_func=mae_ref)
+cm_gbm.train(x=["x1", "x2"], y="t", training_frame=reg)
+tm = cm_gbm._model_json["output"]["training_metrics"]
+assert tm["custom_metric_name"] == "mae", tm.get("custom_metric_name")
+assert tm["custom_metric_value"] > 0.0
+
+dist_ref = h2o.upload_custom_distribution(
+    """class CustomGaussianFunc:
+    def link(self):
+        return "identity"
+
+    def init(self, w, o, y):
+        return [w * (y - o), w]
+
+    def gradient(self, y, f):
+        return y - f
+
+    def gamma(self, w, y, z, f):
+        return [w * z, w]
+""", class_name="CustomGaussianFunc", func_name="gauss")
+cd_gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1,
+                                      distribution="custom",
+                                      custom_distribution_func=dist_ref)
+cd_gbm.train(x=["x1", "x2"], y="t", training_frame=reg)
+# the UDF above IS gaussian, so the custom path must reproduce the builtin
+ref_gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1,
+                                       distribution="gaussian")
+ref_gbm.train(x=["x1", "x2"], y="t", training_frame=reg)
+cd_rmse = cd_gbm.model_performance(reg).rmse()
+ref_rmse = ref_gbm.model_performance(reg).rmse()
+assert abs(cd_rmse - ref_rmse) < 0.02 * ref_rmse, (cd_rmse, ref_rmse)
+
 # frame round-trips the client relies on
 df = te.as_data_frame()
 assert list(df.columns) == ["x1", "x2", "y"] and len(df) == te.nrow

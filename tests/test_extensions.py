@@ -1,0 +1,117 @@
+"""Extensions SPI + listener service (reference: water/ExtensionManager.java,
+AbstractH2OExtension.java, ListenerService.java, RestApiExtension)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.models.gbm import GBM
+from h2o3_tpu.utils import extensions as ext
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    ext.reset()
+    yield
+    ext.reset()
+
+
+def _frame(rng, key="ext_fr"):
+    fr = Frame.from_arrays(
+        {"a": rng.normal(size=120).astype(np.float32),
+         "y": rng.normal(size=120).astype(np.float32)}, key=key)
+    DKV.put(fr.key, fr)
+    return fr
+
+
+def test_listener_receives_model_events(rng):
+    events = []
+    ext.add_listener(lambda e, **kw: events.append((e, kw)))
+    fr = _frame(rng)
+    GBM(ntrees=2, max_depth=2).train(y="y", training_frame=fr)
+    names = [e for e, _ in events]
+    assert "model_build_start" in names and "model_build_end" in names
+    end = [kw for e, kw in events if e == "model_build_end"][0]
+    assert end["algo"] == "gbm" and end["model"] in DKV
+
+
+def test_broken_listener_does_not_break_training(rng):
+    def bad(e, **kw):
+        raise RuntimeError("boom")
+    ext.add_listener(bad)
+    fr = _frame(rng, "ext_fr2")
+    m = GBM(ntrees=2, max_depth=2).train(y="y", training_frame=fr)
+    assert m.training_metrics is not None
+
+
+class _ProbeExt(ext.H2OExtension):
+    name = "probe"
+
+    def __init__(self):
+        self.inited = 0
+        self.events = []
+
+    def init(self):
+        self.inited += 1
+
+    def routes(self):
+        def handler(h):
+            h._reply({"__meta": {"schema_type": "ProbeV3"}, "probe": "ok"})
+        return [(r"/3/Probe", "GET", handler)]
+
+    def on_event(self, event, **info):
+        self.events.append(event)
+
+
+def test_extension_rest_route_and_capabilities():
+    probe = ext.register(_ProbeExt())
+    s = H2OServer(port=0).start()
+    try:
+        assert probe.inited == 1
+        assert "cloud_up" in probe.events
+        with urllib.request.urlopen(s.url + "/3/Probe") as r:
+            assert json.loads(r.read())["probe"] == "ok"
+        with urllib.request.urlopen(s.url + "/3/Capabilities") as r:
+            caps = json.loads(r.read())["capabilities"]
+        assert {"name": "probe", "module": "extension"} in caps
+    finally:
+        s.stop()
+
+
+def test_broken_extension_init_is_disabled():
+    class Bad(ext.H2OExtension):
+        name = "bad"
+
+        def init(self):
+            raise RuntimeError("no")
+
+    ext.register(Bad())
+    ext.init_all()
+    assert all(e.name != "bad" for e in ext.extensions())
+
+
+def test_env_discovery(tmp_path):
+    """$H2O3TPU_EXTENSIONS modules are imported and self-register (the
+    ServiceLoader analog)."""
+    mod = tmp_path / "my_h2o_ext.py"
+    mod.write_text(
+        "from h2o3_tpu.utils import extensions as ext\n"
+        "class E(ext.H2OExtension):\n"
+        "    name = 'from-env'\n"
+        "ext.register(E())\n")
+    sys.path.insert(0, str(tmp_path))
+    os.environ["H2O3TPU_EXTENSIONS"] = "my_h2o_ext"
+    try:
+        ext.load_env_extensions()
+        assert any(e.name == "from-env" for e in ext.extensions())
+    finally:
+        sys.path.remove(str(tmp_path))
+        del os.environ["H2O3TPU_EXTENSIONS"]
+        sys.modules.pop("my_h2o_ext", None)

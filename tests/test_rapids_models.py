@@ -1,0 +1,112 @@
+"""Models-family rapids prims + RectangleAssign (reference:
+``water/rapids/ast/prims/models/``, ``assign/AstRectangleAssign.java``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import GBM
+from h2o3_tpu.models.glm import GLM
+from h2o3_tpu.rapids.exec import rapids
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture
+def binfr(rng):
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    sex = rng.choice(["m", "f"], size=n, p=[0.6, 0.4])
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.5 * (sex == "m")
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "yes", "no")
+    fr = Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1], "sex": sex,
+                            "y": y}, key="rmfr")
+    DKV.put(fr.key, fr)
+    return fr
+
+
+@pytest.fixture
+def models(binfr):
+    g = GBM(ntrees=5, max_depth=3, seed=1, model_id="rm_gbm").train(
+        y="y", training_frame=binfr)
+    l = GLM(family="binomial", lambda_=1e-3, model_id="rm_glm").train(
+        y="y", training_frame=binfr)
+    return g, l
+
+
+def test_make_leaderboard(binfr, models):
+    lb = rapids("(makeLeaderboard ['rm_gbm' 'rm_glm'] rmfr 'auc' [] 'AUTO')")
+    assert lb.nrows == 2
+    assert "model_id" in lb.names and "auc" in lb.names
+    aucs = lb.vec("auc").to_numpy()
+    assert aucs[0] >= aucs[1]          # sorted best-first
+
+
+def test_reset_threshold_changes_predictions(binfr, models):
+    g, _ = models
+    old = rapids("(model.reset.threshold rm_gbm 0.95)")
+    assert old == pytest.approx(0.5)
+    m = DKV["rm_gbm"]
+    preds = m.predict(binfr)
+    p = np.asarray(preds.vec("pyes").to_numpy())
+    lab = preds.vec("predict").labels()
+    assert all((lbl == "yes") == (pi >= 0.95) for lbl, pi in zip(lab, p))
+    rapids("(model.reset.threshold rm_gbm 0.5)")
+
+
+def test_result_frame_model_selection(binfr, rng):
+    n = binfr.nrows
+    t = (binfr.vec("x0").to_numpy() * 2 + rng.normal(size=n) * 0.1)
+    fr = Frame.from_arrays({"x0": binfr.vec("x0").to_numpy(),
+                            "x1": binfr.vec("x1").to_numpy(),
+                            "t": t.astype(np.float32)}, key="msfr")
+    DKV.put(fr.key, fr)
+    from h2o3_tpu.models.model_selection import ModelSelection
+    ModelSelection(mode="maxr", max_predictor_number=2,
+                   model_id="rm_ms").train(y="t", training_frame=fr)
+    res = rapids("(result rm_ms)")
+    assert isinstance(res, Frame) and res.nrows >= 1
+
+
+def test_transform_prim_target_encoder(binfr):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+    TargetEncoder(model_id="rm_te").train(
+        x=["sex"], y="y", training_frame=binfr)
+    out = rapids("(transform rm_te rmfr)")
+    assert isinstance(out, Frame)
+    assert any("sex" in nm and nm != "sex" for nm in out.names)
+
+
+def test_fairness_metrics(binfr, models):
+    out = rapids("(fairnessMetrics rm_gbm rmfr ['sex'] ['m'] 'yes')")
+    assert out.nrows == 2
+    assert "air" in out.names and "auc" in out.names
+    sexes = list(out.vec("sex").host_values)
+    air = out.vec("air").to_numpy()
+    assert air[sexes.index("m")] == pytest.approx(1.0)   # reference group
+    assert np.isfinite(out.vec("p_value").to_numpy()).all()
+
+
+def test_java_scoring_parity_prim(binfr, models):
+    ok = rapids("(model.testJavaScoring rm_gbm rmfr '' 1e-4)")
+    assert ok == 1.0
+
+
+def test_rectangle_assign_scalar_and_mask(binfr):
+    out = rapids("(:= rmfr 99 [0] [0 1 2])")
+    assert np.allclose(out.vec("x0").to_numpy()[:3], 99)
+    assert out.vec("x0").to_numpy()[3] != 99
+    # boolean-mask rows via a predicate expression, all columns of col-set
+    out2 = rapids("(:= rmfr 7 [1] (> (cols rmfr [0]) 98))")
+    x1 = out2.vec("x1").to_numpy()
+    x0 = out2.vec("x0").to_numpy()
+    assert np.allclose(x1[x0 > 98], 7)
+
+
+def test_rectangle_assign_categorical_and_frame_src(binfr):
+    out = rapids("(:= rmfr 'f' [2] [0 1])")
+    assert list(out.vec("sex").labels()[:2]) == ["f", "f"]
+    # frame source, slice height
+    src = Frame.from_arrays({"v": np.float32([5.0, 6.0])}, key="rmsrc")
+    DKV.put(src.key, src)
+    out2 = rapids("(:= rmfr rmsrc [0] [4 5])")
+    assert np.allclose(out2.vec("x0").to_numpy()[4:6], [5.0, 6.0])

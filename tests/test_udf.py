@@ -1,0 +1,163 @@
+"""Custom metric / distribution UDFs over the wire (reference: water/udf/,
+h2o-py ``h2o.upload_custom_metric`` / ``upload_custom_distribution``,
+``h2o-py/h2o/h2o.py:2128,2230``).
+
+The zips built here are byte-for-byte what h2o-py generates (same code
+template, same ``import water.udf.CMetricFunc as MetricFunc`` wrapper line),
+so passing these proves the real client's upload protocol works unmodified.
+"""
+
+import io
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.frame.parse import RawFile
+from h2o3_tpu.models.gbm import GBM
+from h2o3_tpu.utils import udf
+from h2o3_tpu.utils.registry import DKV
+
+# exactly h2o-py's _CFUNC_CODE_TEMPLATE output for a str-form metric
+MAE_METRIC_SRC = """# Generated code
+import water.udf.CMetricFunc as MetricFunc
+
+class CustomMaeFunc:
+    def map(self, pred, act, w, o, model):
+        return [w * abs(act[0] - pred[0]), w]
+
+    def reduce(self, l, r):
+        return [l[0] + r[0], l[1] + r[1]]
+
+    def metric(self, l):
+        return l[0] / l[1]
+
+class CustomMaeFuncWrapper(CustomMaeFunc, MetricFunc, object):
+    pass
+"""
+
+# a gaussian-equivalent custom distribution: identical math to the builtin,
+# so the custom pure_callback path must reproduce builtin results exactly
+GAUSS_DIST_SRC = """# Generated code
+import water.udf.CDistributionFunc as DistributionFunc
+
+class CustomGaussianFunc:
+    def link(self):
+        return "identity"
+
+    def init(self, w, o, y):
+        return [w * (y - o), w]
+
+    def gradient(self, y, f):
+        return y - f
+
+    def gamma(self, w, y, z, f):
+        return [w * z, w]
+
+class CustomGaussianFuncWrapper(CustomGaussianFunc, DistributionFunc, object):
+    pass
+"""
+
+
+def _zip_bytes(fname: str, src: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr(fname, src)
+    return buf.getvalue()
+
+
+@pytest.fixture
+def reg_frame(rng):
+    n = 300
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    fr = Frame.from_arrays(
+        {"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y}, key="udf_train")
+    DKV.put(fr.key, fr)
+    return fr
+
+
+def test_metric_udf_loads_and_matches_numpy(reg_frame):
+    DKV.put("mae", RawFile(_zip_bytes("metrics.py", MAE_METRIC_SRC),
+                           name="func.jar"))
+    m = GBM(ntrees=5, max_depth=3, seed=1,
+            custom_metric_func="python:mae=metrics.CustomMaeFuncWrapper"
+            ).train(y="y", training_frame=reg_frame)
+    mm = m.training_metrics
+    assert mm.custom_metric_name == "mae"
+    preds = np.asarray(m.predict(reg_frame).vec("predict").data)[:reg_frame.nrows]
+    yv = np.asarray(reg_frame.vec("y").data)[:reg_frame.nrows]
+    assert mm.custom_metric_value == pytest.approx(
+        float(np.abs(yv - preds).mean()), rel=1e-5)
+
+
+def test_custom_distribution_reproduces_gaussian(reg_frame):
+    DKV.put("gauss_dist", RawFile(_zip_bytes("distributions.py",
+                                             GAUSS_DIST_SRC), name="func.jar"))
+    ref = GBM(ntrees=8, max_depth=3, seed=7).train(y="y",
+                                                   training_frame=reg_frame)
+    cus = GBM(ntrees=8, max_depth=3, seed=7, distribution="custom",
+              custom_distribution_func=(
+                  "python:gauss_dist=distributions.CustomGaussianFuncWrapper")
+              ).train(y="y", training_frame=reg_frame)
+    pr = np.asarray(ref.predict(reg_frame).vec("predict").data)
+    pc = np.asarray(cus.predict(reg_frame).vec("predict").data)
+    np.testing.assert_allclose(pc, pr, rtol=2e-4, atol=2e-4)
+    assert cus.output["custom_link"] == "identity"
+
+
+def test_custom_distribution_requires_func(reg_frame):
+    with pytest.raises(ValueError, match="custom_distribution_func"):
+        GBM(ntrees=2, distribution="custom").train(y="y",
+                                                   training_frame=reg_frame)
+
+
+def test_bad_udf_references(reg_frame):
+    with pytest.raises(ValueError, match="malformed"):
+        udf.load_cfunc("not-a-ref")
+    with pytest.raises(KeyError, match="PutKey"):
+        udf.load_cfunc("python:absent=m.C")
+    DKV.put("notzip", RawFile(b"plain bytes", name="x"))
+    with pytest.raises(Exception):
+        udf.load_cfunc("python:notzip=m.C")
+
+
+def _multipart(data: bytes, filename: str) -> tuple[bytes, str]:
+    boundary = "babecafe"
+    body = (f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="{filename}"\r\n'
+            "Content-Type: application/octet-stream\r\n\r\n"
+            ).encode() + data + f"\r\n--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def test_putkey_route_and_rest_custom_metric(reg_frame):
+    """The full wire loop: upload the UDF zip via POST /3/PutKey (h2o-py
+    ``_put_key``), then train over REST with the reference string; the model
+    JSON must carry the custom metric (ADVICE r2: schemas must not clobber
+    it)."""
+    s = H2OServer(port=0).start()
+    try:
+        body, ctype = _multipart(_zip_bytes("metrics.py", MAE_METRIC_SRC),
+                                 "func.jar")
+        req = urllib.request.Request(
+            s.url + "/3/PutKey?destination_key=rest_mae", data=body,
+            headers={"Content-Type": ctype}, method="POST")
+        import json
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["destination_key"] == "rest_mae"
+        assert isinstance(DKV["rest_mae"], RawFile)
+
+        from h2o3_tpu.api import H2OClient
+        c = H2OClient(s.url)
+        model = c.train(
+            "gbm", reg_frame.key, y="y", ntrees=3, max_depth=3, seed=1,
+            custom_metric_func="python:rest_mae=metrics.CustomMaeFuncWrapper")
+        mm = model["output"]["training_metrics"]
+        assert mm["custom_metric_name"] == "rest_mae"
+        assert mm["custom_metric_value"] > 0.0
+    finally:
+        s.stop()
