@@ -1329,6 +1329,35 @@ class _Handler(BaseHTTPRequestHandler):
                      "routes": [{"http_method": m, "url_pattern": pat}
                                 for pat, m, _ in _ROUTES]})
 
+    def r_metadata_endpoint(self, path):
+        """Reference MetadataHandler.fetchRoute: one route's metadata, with
+        its handler docstring as the help text."""
+        import urllib.parse as _up
+        want = _up.unquote(path)
+        for pat, m, fn in _ROUTES:
+            if pat.replace("\\", "") == want or pat == want:
+                self._reply({"__meta": {"schema_type": "MetadataV3"},
+                             "routes": [{"http_method": m, "url_pattern": pat,
+                                         "summary": (fn.__doc__ or "").strip()
+                                         .split("\n")[0]}]})
+                return
+        raise KeyError(f"no route matching {want!r}")
+
+    def r_kill3(self):
+        """Reference KillMinus3Handler (kill -3 = SIGQUIT thread dump): log
+        every thread's stack, but keep serving (the JVM analog dumps and
+        continues too)."""
+        import logging
+        import sys
+        import traceback
+        dump = []
+        for tid, frame in sys._current_frames().items():
+            dump.append(f"Thread {tid}:\n"
+                        + "".join(traceback.format_stack(frame)))
+        logging.getLogger("h2o3_tpu").info("KillMinus3 thread dump:\n%s",
+                                           "\n".join(dump))
+        self._reply({"__meta": {"schema_type": "KillMinus3V3"}})
+
     # field inventories h2o-py's schema bootstrap fetches at connect time
     # (reference: water/api/schemas3/H2OErrorV3.java et al.)
     _SCHEMA_FIELDS = {
@@ -1465,6 +1494,9 @@ _ROUTES = [
     (r"/3/LogAndEcho", "POST", _Handler.r_log_and_echo),
     (r"/99/Rapids/help", "GET", _Handler.r_rapids_help),
     (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
+    (r"/3/Metadata/endpoints/(.+)", "GET", _Handler.r_metadata_endpoint),
+    (r"/3/Metadata/schemaclasses/([^/]+)", "GET", _Handler.r_metadata_schema),
+    (r"/3/KillMinus3", "POST", _Handler.r_kill3),
     (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
     (r"/3/NetworkTest", "GET", _Handler.r_network_test),
     (r"/3/NodePersistentStorage/([^/]+)", "GET", _Handler.r_nps_list),

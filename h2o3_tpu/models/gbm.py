@@ -124,7 +124,8 @@ def _linkinv_device(link: str, f):
     return f
 
 
-def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
+def _metric_device(metric: str, dist: str, F, y, w, nclass: int,
+                   custom_link: str | None = None):
     """Stopping/score metric as traced device code (less-is-better; AUC is
     negated), so the fused scan can emit one scalar per tree with zero host
     round-trips (reference: ``ScoreKeeper`` scores between driver
@@ -154,6 +155,10 @@ def _metric_device(metric: str, dist: str, F, y, w, nclass: int):
     elif dist in ("poisson", "gamma", "tweedie"):
         prob = None
         mu = jnp.exp(jnp.clip(F, -30, 30))
+    elif dist == "custom":
+        # score in RESPONSE space, not link space (review r3 finding)
+        prob = None
+        mu = _linkinv_device(custom_link or "identity", F)
     else:
         prob = None
         mu = F
@@ -227,7 +232,8 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 quantile_alpha: float = 0.5, huber_alpha: float = 0.9,
                 tweedie_power: float = 1.5, mono=None, reach=None,
                 cat_feats=None, track: str | None = None, val=None,
-                ntrees_prior: int = 0, custom_id: int = -1):
+                ntrees_prior: int = 0, custom_id: int = -1,
+                custom_link: str | None = None):
     """The WHOLE boosting/bagging run in one compiled program.
 
     Reference: ``SharedTree.scoreAndBuildTrees`` loops trees on the driver
@@ -263,20 +269,23 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
         do_tree_col_sample=bool(col_tree_rate < 1.0),
         do_col_sample=bool(col_rate < 1.0),
         mono=mono, reach=reach, cat_feats=cat_feats, track=track, val=val,
-        ntrees_prior=ntrees_prior, custom_id=custom_id)
+        ntrees_prior=ntrees_prior, custom_id=custom_id,
+        custom_link=custom_link)
 
 
 @partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "bootstrap",
                                    "drf", "nclass", "do_row_sample",
                                    "do_tree_col_sample", "do_col_sample",
-                                   "track", "ntrees_prior", "custom_id"))
+                                   "track", "ntrees_prior", "custom_id",
+                                   "custom_link"))
 def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
                     dist: str, depth: int, n_bins: int, bootstrap: bool,
                     drf: bool, nclass: int, do_row_sample: bool,
                     do_tree_col_sample: bool, do_col_sample: bool,
                     mono=None, reach=None, cat_feats=None,
                     track: str | None = None, val=None,
-                    ntrees_prior: int = 0, custom_id: int = -1):
+                    ntrees_prior: int = 0, custom_id: int = -1,
+                    custom_link: str | None = None):
     (col_rate, sample_rate, col_tree_rate, min_rows, reg_lambda, reg_alpha,
      gamma, min_split_improvement, lr, quantile_alpha, huber_alpha,
      tweedie_power) = hp
@@ -325,11 +334,12 @@ def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
             Ft = Ft / denom
             Fv = None if Fv is None else Fv / denom
         if track is not None:
-            outs.append(_metric_device(track, track_dist, Ft, yc, w, nclass))
+            outs.append(_metric_device(track, track_dist, Ft, yc, w, nclass,
+                                       custom_link))
         if Fv is not None:
             vb, yv, wv, _ = val
             outs.append(_metric_device(track or "AUTO", track_dist, Fv, yv,
-                                       wv, nclass))
+                                       wv, nclass, custom_link))
         return tuple(outs)
 
     def update_val(Fval, heap):
@@ -962,7 +972,8 @@ class GBM(SharedTreeBuilder):
             bootstrap=False, drf=False, nclass=0,
             quantile_alpha=float(p["quantile_alpha"]),
             huber_alpha=float(p["huber_alpha"]),
-            tweedie_power=float(p["tweedie_power"]), custom_id=custom_id)
+            tweedie_power=float(p["tweedie_power"]), custom_id=custom_id,
+            custom_link=custom_dist.link_name if custom_dist else None)
         mono, reach = self._constraint_arrays(x, frame)
         kwargs.update(mono=mono, reach=reach, cat_feats=self._cat_feats)
         fmask_base = jnp.ones(binned.shape[1], bool)
@@ -1010,7 +1021,8 @@ class GBM(SharedTreeBuilder):
     STOPPING_METRICS = ("AUTO", "deviance", "logloss", "MSE", "RMSE", "AUC",
                         "misclassification")
 
-    def _stop_score(self, metric: str, dist: str, F, y, w, nclass: int) -> float:
+    def _stop_score(self, metric: str, dist: str, F, y, w, nclass: int,
+                    custom_link: str | None = None) -> float:
         """Less-is-better score for ``stopping_metric`` in host loops (the
         DART driver); same math as the fused scan's :func:`_metric_device`
         — one implementation keeps the two paths from drifting."""
@@ -1026,7 +1038,7 @@ class GBM(SharedTreeBuilder):
             raise ValueError(f"unsupported stopping_metric {metric!r}; have "
                              f"{self.STOPPING_METRICS}")
         return float(jax.device_get(
-            _metric_device(metric, sdist, F, y, w, nclass)))
+            _metric_device(metric, sdist, F, y, w, nclass, custom_link)))
 
     def _valid_stop_data(self, edges, nclass: int, f0, lr: float,
                          domains, y_domain, prior_trees=None):

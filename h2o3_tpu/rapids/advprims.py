@@ -741,15 +741,22 @@ def rectangle_assign(dst: Frame, src, cols, rows) -> Frame:
     for ``fr[rows, cols] = value``). Returns a fresh Frame (the reference is
     copy-on-write; device arrays here are immutable anyway)."""
     n = dst.nrows
+
+    def _empty_sel(s):
+        # the Rapids parser yields "[]" as an empty ndarray, clients may also
+        # send [] — both mean "all" (reference AstRectangleAssign special case)
+        return s is None or (isinstance(s, (list, tuple, np.ndarray))
+                             and len(s) == 0)
+
     # -- column selection ([] = all; numbers or names) -----------------------
-    if cols is None or (isinstance(cols, (list, tuple)) and not cols):
+    if _empty_sel(cols):
         cidx = list(range(dst.ncols))
     else:
         sel = cols if isinstance(cols, (list, tuple, np.ndarray)) else [cols]
         cidx = [dst.names.index(c) if isinstance(c, str) else int(c)
                 for c in sel]
     # -- row selection ([] = all; boolean-mask Frame/Vec; index list) --------
-    if rows is None or (isinstance(rows, (list, tuple)) and not rows):
+    if _empty_sel(rows):
         ridx = np.arange(n)
     elif isinstance(rows, Frame) or isinstance(rows, Vec):
         mv = rows.vecs[0] if isinstance(rows, Frame) else rows

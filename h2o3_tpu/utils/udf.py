@@ -33,7 +33,7 @@ import numpy as np
 
 __all__ = ["load_cfunc", "metric_callable", "CustomDistribution",
            "register_custom_dist", "get_custom_dist", "grad_hess_host",
-           "LINKS", "LINK_INVS"]
+           "LINKS"]
 
 
 # -- water.udf shim ----------------------------------------------------------
@@ -137,19 +137,14 @@ def metric_callable(obj, name: str):
 
 # -- custom distribution -----------------------------------------------------
 
+# forward links only (f0 init); the INVERSE link lives in ONE place —
+# ``gbm._linkinv_device`` (device code) — so scoring and init can't drift
 LINKS = {
     "identity": lambda x: x,
     "log": lambda x: np.log(np.maximum(x, 1e-30)),
     "logit": lambda x: np.log(np.clip(x, 1e-12, 1 - 1e-12)
                               / (1 - np.clip(x, 1e-12, 1 - 1e-12))),
     "inverse": lambda x: 1.0 / np.where(np.abs(x) < 1e-30, 1e-30, x),
-}
-
-LINK_INVS = {
-    "identity": lambda f: f,
-    "log": lambda f: np.exp(np.clip(f, -30, 30)),
-    "logit": lambda f: 1.0 / (1.0 + np.exp(-np.clip(f, -30, 30))),
-    "inverse": lambda f: 1.0 / np.where(np.abs(f) < 1e-30, 1e-30, f),
 }
 
 
@@ -167,9 +162,9 @@ class CustomDistribution:
         self.obj = obj
         self.ref = ref
         self.link_name = str(obj.link())
-        if self.link_name not in LINK_INVS:
+        if self.link_name not in LINKS:
             raise ValueError(f"unsupported custom link {self.link_name!r}; "
-                             f"have {sorted(LINK_INVS)}")
+                             f"have {sorted(LINKS)}")
 
     def f0(self, y, w, offset=None) -> float:
         """Initial margin: link(sum num / sum den) over init contributions
@@ -202,8 +197,6 @@ class CustomDistribution:
             h[i] = max(nd[1], 1e-10)
         return g.astype(np.float32), h.astype(np.float32)
 
-    def linkinv(self, F):
-        return LINK_INVS[self.link_name](np.asarray(F))
 
 
 # process-local registry: jit static args carry the integer id, the callback
