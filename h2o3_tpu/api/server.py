@@ -1334,6 +1334,13 @@ class _Handler(BaseHTTPRequestHandler):
         its handler docstring as the help text."""
         import urllib.parse as _up
         want = _up.unquote(path)
+        if want.isdigit() and int(want) < len(_ROUTES):   # fetch by index
+            pat, m, fn = _ROUTES[int(want)]
+            self._reply({"__meta": {"schema_type": "MetadataV3"},
+                         "routes": [{"http_method": m, "url_pattern": pat,
+                                     "summary": (fn.__doc__ or "").strip()
+                                     .split("\n")[0]}]})
+            return
         for pat, m, fn in _ROUTES:
             if pat.replace("\\", "") == want or pat == want:
                 self._reply({"__meta": {"schema_type": "MetadataV3"},
@@ -1496,7 +1503,7 @@ _ROUTES = [
     (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
     (r"/3/Metadata/endpoints/(.+)", "GET", _Handler.r_metadata_endpoint),
     (r"/3/Metadata/schemaclasses/([^/]+)", "GET", _Handler.r_metadata_schema),
-    (r"/3/KillMinus3", "POST", _Handler.r_kill3),
+    (r"/3/KillMinus3", "GET", _Handler.r_kill3),
     (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
     (r"/3/NetworkTest", "GET", _Handler.r_network_test),
     (r"/3/NodePersistentStorage/([^/]+)", "GET", _Handler.r_nps_list),

@@ -178,16 +178,22 @@ def _metric_device(metric: str, dist: str, F, y, w, nclass: int,
         pred = (prob > 0.5).astype(jnp.float32)
         return (w * (pred != y)).sum() / n
     if metric == "AUC":
-        # weighted Mann-Whitney on the score order (row ties ignored — the
-        # stopping test needs a consistent monotone score); negated so the
-        # stopping comparison stays less-is-better
+        # weighted Mann-Whitney with EXACT tie handling (reference
+        # ScoreKeeper scores tied predictions at half credit): positives in
+        # a tie group earn cumneg-before-group + half the group's negative
+        # weight. Negated so the stopping comparison stays less-is-better.
         order = jnp.argsort(prob)
+        s = prob[order]
         ys, ws = y[order], w[order]
         negw = ws * (1.0 - ys)
         cumneg = jnp.cumsum(negw)
+        lo = jnp.searchsorted(s, s, side="left")
+        hi = jnp.searchsorted(s, s, side="right") - 1
+        before = jnp.where(lo > 0, cumneg[jnp.maximum(lo - 1, 0)], 0.0)
+        credit = before + 0.5 * (cumneg[hi] - before)
         posw = ws * ys
         tot = jnp.maximum(posw.sum() * negw.sum(), 1e-30)
-        return -(posw * cumneg).sum() / tot
+        return -(posw * credit).sum() / tot
     raise ValueError(f"unsupported stopping_metric {metric!r}")
 
 
@@ -556,6 +562,9 @@ class SharedTreeBuilder(ModelBuilder):
             stopping_rounds=0,
             stopping_metric="AUTO",      # deviance (logloss/MSE) like reference
             stopping_tolerance=1e-3,
+            score_tree_interval=0,   # history row cadence; the fused tracker
+            score_each_iteration=False,  # scores EVERY tree at no cost, so
+                                         # these only thin the reported table
             monotone_constraints=None,       # {col: ±1} (Constraints.java)
             interaction_constraints=None,    # [[cols...], ...] (BranchInteractionConstraints)
             calibrate_model=False,           # CalibrationHelper.java:18
@@ -590,9 +599,16 @@ class SharedTreeBuilder(ModelBuilder):
                 (f"training_{name}", "double", "%.5f")]
         if vser is not None:
             cols.append((f"validation_{name}", "double", "%.5f"))
+        # score_tree_interval thins the REPORTED table (reference scores on
+        # that cadence; the fused tracker gets every tree anyway) — the last
+        # tree always reports, matching doScoringAndSaveModel(finalScoring)
+        sti = int(self.params.get("score_tree_interval") or 0)
+        if self.params.get("score_each_iteration"):
+            sti = 1
         values = [[i + 1, sign * float(tv)] +
                   ([sign * float(vser[i])] if vser is not None else [])
-                  for i, tv in enumerate(tser)]
+                  for i, tv in enumerate(tser)
+                  if sti <= 1 or (i + 1) % sti == 0 or i == len(tser) - 1]
         return self._history_table(model, cols, values)
 
     def _prepare(self, frame: Frame, x: list[str], y: str):

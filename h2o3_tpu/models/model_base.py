@@ -320,9 +320,9 @@ class ModelBuilder:
                     # (water/udf CFuncRef; h2o.upload_custom_metric)
                     if isinstance(cmf, str):
                         from h2o3_tpu.utils import udf as _udf
-                        obj = _udf.load_cfunc(cmf)   # validates the ref form
-                        key_name = _udf._REF_RE.match(cmf).group(2)
-                        cmf = _udf.metric_callable(obj, key_name)
+                        _, key_name, _qual = _udf.parse_ref(cmf)
+                        cmf = _udf.metric_callable(_udf.load_cfunc(cmf),
+                                                   key_name)
                     self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)

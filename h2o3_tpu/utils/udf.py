@@ -31,7 +31,7 @@ import zipfile
 
 import numpy as np
 
-__all__ = ["load_cfunc", "metric_callable", "CustomDistribution",
+__all__ = ["load_cfunc", "parse_ref", "metric_callable", "CustomDistribution",
            "register_custom_dist", "get_custom_dist", "grad_hess_host",
            "LINKS"]
 
@@ -72,17 +72,23 @@ def _install_shim() -> None:
 _REF_RE = re.compile(r"^(\w+):([^=]+)=(.+)$")
 
 
+def parse_ref(ref: str) -> tuple[str, str, str]:
+    """Split ``"lang:key=module.Class"`` into (lang, key, qualified class);
+    the ONE place the ref grammar lives."""
+    m = _REF_RE.match(ref)
+    if not m:
+        raise ValueError(
+            f"malformed UDF reference {ref!r}; expected 'python:key=module.Class'")
+    return m.group(1), m.group(2), m.group(3)
+
+
 def load_cfunc(ref: str):
     """Resolve a ``"python:KEY=module.Class"`` reference to a live instance.
 
     The KEY names a DKV value holding the zip h2o-py uploaded (a ``func.jar``
     containing ``module.py``); ``module.Class`` names the wrapper class the
     generated source defines."""
-    m = _REF_RE.match(ref)
-    if not m:
-        raise ValueError(
-            f"malformed UDF reference {ref!r}; expected 'python:key=module.Class'")
-    lang, key, qual = m.groups()
+    lang, key, qual = parse_ref(ref)
     if lang != "python":
         raise ValueError(f"unsupported UDF language {lang!r} (only 'python')")
     from h2o3_tpu.utils.registry import DKV

@@ -1,0 +1,574 @@
+// Standalone MOJO v2 scorer — the cross-runtime proof for the artifact
+// format (reference: h2o-genmodel's Java MojoModel runtime,
+// hex/genmodel/ModelMojoReader.java — any runtime can score a MOJO without
+// the training system). This binary reads an h2o3_tpu MOJO (zip of
+// model.ini + structure.json + arrays.npz, see h2o3_tpu/genmodel/mojo.py)
+// and scores a CSV with NO Python/JAX — only libc + zlib.
+//
+//   g++ -O2 -std=c++17 mojo_scorer.cpp -lz -o mojo_score
+//   ./mojo_score model.mojo data.csv        # one prediction line per row
+//
+// Supported model families: GBM and DRF (regression, bernoulli,
+// multinomial), including categorical group splits (left_mask bins,
+// reference DHistogram enum subsets) and NA routing. Raw string
+// categoricals in the CSV are mapped through the artifact's feat_domains.
+// Mirrors h2o3_tpu/models/tree.py:_predict_raw_impl/_predict_raw_masked and
+// cat_bins_for_codes exactly; parity pinned by tests/test_mojo_native.py.
+
+#include <zlib.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------- zip
+
+static std::vector<uint8_t> read_file(const std::string &path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) throw std::runtime_error("cannot open " + path);
+    return std::vector<uint8_t>(std::istreambuf_iterator<char>(f), {});
+}
+
+static uint32_t rd32(const uint8_t *p) {
+    return p[0] | (p[1] << 8) | (p[2] << 16) | ((uint32_t)p[3] << 24);
+}
+static uint16_t rd16(const uint8_t *p) { return p[0] | (p[1] << 8); }
+
+static std::vector<uint8_t> inflate_raw(const uint8_t *src, size_t n,
+                                        size_t out_n) {
+    std::vector<uint8_t> out(out_n);
+    z_stream zs{};
+    if (inflateInit2(&zs, -MAX_WBITS) != Z_OK)
+        throw std::runtime_error("inflateInit2 failed");
+    zs.next_in = const_cast<Bytef *>(src);
+    zs.avail_in = (uInt)n;
+    zs.next_out = out.data();
+    zs.avail_out = (uInt)out_n;
+    int rc = inflate(&zs, Z_FINISH);
+    inflateEnd(&zs);
+    if (rc != Z_STREAM_END) throw std::runtime_error("inflate failed");
+    return out;
+}
+
+// name -> uncompressed bytes, for every entry in the zip
+static std::map<std::string, std::vector<uint8_t>> read_zip(
+        const std::vector<uint8_t> &buf) {
+    // end-of-central-directory: scan back for PK\x05\x06
+    size_t eocd = std::string::npos;
+    for (size_t i = buf.size() >= 22 ? buf.size() - 22 : 0;; --i) {
+        if (buf[i] == 'P' && buf[i + 1] == 'K' && buf[i + 2] == 5 &&
+            buf[i + 3] == 6) { eocd = i; break; }
+        if (i == 0) break;
+    }
+    if (eocd == std::string::npos) throw std::runtime_error("not a zip");
+    uint16_t count = rd16(&buf[eocd + 10]);
+    uint32_t cd_off = rd32(&buf[eocd + 16]);
+    std::map<std::string, std::vector<uint8_t>> out;
+    size_t p = cd_off;
+    for (int e = 0; e < count; ++e) {
+        if (rd32(&buf[p]) != 0x02014b50)
+            throw std::runtime_error("bad central directory");
+        uint16_t method = rd16(&buf[p + 10]);
+        uint32_t csize = rd32(&buf[p + 20]), usize = rd32(&buf[p + 24]);
+        uint16_t nlen = rd16(&buf[p + 28]), xlen = rd16(&buf[p + 30]),
+                 clen = rd16(&buf[p + 32]);
+        uint32_t lho = rd32(&buf[p + 42]);
+        std::string name((const char *)&buf[p + 46], nlen);
+        // local header: its name/extra lengths differ from the CD's
+        uint16_t lnlen = rd16(&buf[lho + 26]), lxlen = rd16(&buf[lho + 28]);
+        const uint8_t *data = &buf[lho + 30 + lnlen + lxlen];
+        if (method == 0)
+            out[name] = std::vector<uint8_t>(data, data + usize);
+        else if (method == 8)
+            out[name] = inflate_raw(data, csize, usize);
+        else
+            throw std::runtime_error("unsupported zip method");
+        p += 46 + nlen + xlen + clen;
+    }
+    return out;
+}
+
+// ---------------------------------------------------------------------- npy
+
+struct Arr {
+    std::vector<double> data;     // everything promoted to double
+    std::vector<int64_t> shape;
+    int64_t size() const {
+        int64_t s = 1;
+        for (auto d : shape) s *= d;
+        return s;
+    }
+};
+
+static Arr parse_npy(const std::vector<uint8_t> &b) {
+    if (b.size() < 10 || memcmp(b.data(), "\x93NUMPY", 6) != 0)
+        throw std::runtime_error("bad npy magic");
+    int major = b[6];
+    size_t hlen, hoff;
+    if (major == 1) { hlen = rd16(&b[8]); hoff = 10; }
+    else { hlen = rd32(&b[8]); hoff = 12; }
+    std::string hdr((const char *)&b[hoff], hlen);
+    auto get = [&](const std::string &key) {
+        size_t k = hdr.find("'" + key + "'");
+        if (k == std::string::npos) throw std::runtime_error("npy header");
+        return k + key.size() + 2;
+    };
+    // descr
+    size_t dp = hdr.find('\'', get("descr"));
+    std::string descr = hdr.substr(dp + 1, hdr.find('\'', dp + 1) - dp - 1);
+    size_t fv = hdr.find_first_not_of(": ", get("fortran_order"));
+    bool fortran = hdr.compare(fv, 4, "True") == 0;
+    if (fortran) throw std::runtime_error("fortran order unsupported");
+    // shape tuple
+    size_t sp = hdr.find('(', get("shape"));
+    size_t se = hdr.find(')', sp);
+    Arr a;
+    {
+        std::string s = hdr.substr(sp + 1, se - sp - 1);
+        const char *c = s.c_str();
+        while (*c) {
+            char *end;
+            long v = strtol(c, &end, 10);
+            if (end == c) break;
+            a.shape.push_back(v);
+            c = end;
+            while (*c == ',' || *c == ' ') ++c;
+        }
+        if (a.shape.empty()) a.shape.push_back(1);   // 0-d scalar
+    }
+    const uint8_t *d = &b[hoff + hlen];
+    int64_t n = a.size();
+    a.data.resize(n);
+    auto load = [&](auto conv, size_t w) {
+        for (int64_t i = 0; i < n; ++i) a.data[i] = conv(d + i * w);
+    };
+    if (descr == "<f4")
+        load([](const uint8_t *p) { float v; memcpy(&v, p, 4); return (double)v; }, 4);
+    else if (descr == "<f8")
+        load([](const uint8_t *p) { double v; memcpy(&v, p, 8); return v; }, 8);
+    else if (descr == "<i4")
+        load([](const uint8_t *p) { int32_t v; memcpy(&v, p, 4); return (double)v; }, 4);
+    else if (descr == "<i8")
+        load([](const uint8_t *p) { int64_t v; memcpy(&v, p, 8); return (double)v; }, 8);
+    else if (descr == "<i2")
+        load([](const uint8_t *p) { int16_t v; memcpy(&v, p, 2); return (double)v; }, 2);
+    else if (descr == "|b1" || descr == "|u1")
+        load([](const uint8_t *p) { return (double)*p; }, 1);
+    else
+        throw std::runtime_error("unsupported npy dtype " + descr);
+    return a;
+}
+
+// --------------------------------------------------------------------- json
+
+struct JNode {
+    enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+    bool b = false;
+    double num = 0;
+    std::string str;
+    std::vector<JNode> arr;
+    std::map<std::string, JNode> obj;
+    const JNode *get(const std::string &k) const {
+        auto it = obj.find(k);
+        return it == obj.end() ? nullptr : &it->second;
+    }
+};
+
+struct JParser {
+    const char *p, *end;
+    explicit JParser(const std::string &s) : p(s.data()), end(s.data() + s.size()) {}
+    void ws() { while (p < end && (*p == ' ' || *p == '\n' || *p == '\t' || *p == '\r')) ++p; }
+    JNode parse() { ws(); return value(); }
+    JNode value() {
+        ws();
+        if (*p == '{') return object();
+        if (*p == '[') return array();
+        if (*p == '"') { JNode n; n.kind = JNode::STR; n.str = string(); return n; }
+        if (!strncmp(p, "null", 4)) { p += 4; return JNode{}; }
+        if (!strncmp(p, "true", 4)) { p += 4; JNode n; n.kind = JNode::BOOL; n.b = true; return n; }
+        if (!strncmp(p, "false", 5)) { p += 5; JNode n; n.kind = JNode::BOOL; return n; }
+        if (!strncmp(p, "NaN", 3)) { p += 3; JNode n; n.kind = JNode::NUM; n.num = NAN; return n; }
+        if (!strncmp(p, "Infinity", 8)) { p += 8; JNode n; n.kind = JNode::NUM; n.num = INFINITY; return n; }
+        if (!strncmp(p, "-Infinity", 9)) { p += 9; JNode n; n.kind = JNode::NUM; n.num = -INFINITY; return n; }
+        char *e;
+        JNode n; n.kind = JNode::NUM; n.num = strtod(p, &e);
+        if (e == p) throw std::runtime_error("json parse error");
+        p = e;
+        return n;
+    }
+    std::string string() {
+        std::string out;
+        ++p;                       // opening quote
+        while (p < end && *p != '"') {
+            if (*p == '\\') {
+                ++p;
+                switch (*p) {
+                    case 'n': out += '\n'; break;
+                    case 't': out += '\t'; break;
+                    case 'r': out += '\r'; break;
+                    case 'b': out += '\b'; break;
+                    case 'f': out += '\f'; break;
+                    case 'u': {      // BMP only — enough for column names
+                        unsigned cp = strtoul(std::string(p + 1, p + 5).c_str(), nullptr, 16);
+                        if (cp < 0x80) out += (char)cp;
+                        else if (cp < 0x800) {
+                            out += (char)(0xC0 | (cp >> 6));
+                            out += (char)(0x80 | (cp & 0x3F));
+                        } else {
+                            out += (char)(0xE0 | (cp >> 12));
+                            out += (char)(0x80 | ((cp >> 6) & 0x3F));
+                            out += (char)(0x80 | (cp & 0x3F));
+                        }
+                        p += 4;
+                        break;
+                    }
+                    default: out += *p;
+                }
+                ++p;
+            } else out += *p++;
+        }
+        ++p;                       // closing quote
+        return out;
+    }
+    JNode array() {
+        JNode n; n.kind = JNode::ARR;
+        ++p; ws();
+        if (*p == ']') { ++p; return n; }
+        while (true) {
+            n.arr.push_back(value());
+            ws();
+            if (*p == ',') { ++p; continue; }
+            if (*p == ']') { ++p; break; }
+            throw std::runtime_error("json array");
+        }
+        return n;
+    }
+    JNode object() {
+        JNode n; n.kind = JNode::OBJ;
+        ++p; ws();
+        if (*p == '}') { ++p; return n; }
+        while (true) {
+            ws();
+            std::string k = string();
+            ws();
+            if (*p != ':') throw std::runtime_error("json object");
+            ++p;
+            n.obj[k] = value();
+            ws();
+            if (*p == ',') { ++p; continue; }
+            if (*p == '}') { ++p; break; }
+            throw std::runtime_error("json object");
+        }
+        return n;
+    }
+};
+
+// -------------------------------------------------------------------- model
+
+struct Tree {
+    Arr feat, tv, na_left, is_split, leaf;
+    Arr left_mask;                 // optional [heap, B]; empty when absent
+    bool has_mask = false;
+};
+
+struct Mojo {
+    std::string algo, distribution, custom_link;
+    double f0 = 0, learn_rate = 1;
+    std::vector<double> f0_multi;
+    std::vector<Tree> trees;                        // single-output
+    std::vector<std::vector<Tree>> trees_multi;     // [K][ntrees]
+    std::vector<std::string> x_cols, response_domain;
+    std::map<std::string, std::vector<std::string>> feat_domains;
+    std::vector<double> cat_card;                   // per feature, 0 = numeric
+    int cat_bins = 0, ntrees = 0, nclasses = 1;
+    bool drf = false, binomial = false;
+};
+
+static const Arr &resolve(const JNode *n,
+                          const std::map<std::string, Arr> &arrays) {
+    const JNode *a = n->get("$a");
+    auto it = arrays.find(a->str);
+    if (it == arrays.end()) throw std::runtime_error("missing array " + a->str);
+    return it->second;
+}
+
+static Tree decode_tree(const JNode &t, const std::map<std::string, Arr> &arrays) {
+    const JNode *spec = t.get("$tree");
+    Tree out;
+    out.feat = resolve(spec->get("feat"), arrays);
+    out.tv = resolve(spec->get("thresh_val"), arrays);
+    out.na_left = resolve(spec->get("na_left"), arrays);
+    out.is_split = resolve(spec->get("is_split"), arrays);
+    out.leaf = resolve(spec->get("leaf"), arrays);
+    const JNode *lm = spec->get("left_mask");
+    if (lm && lm->kind == JNode::OBJ && lm->get("$a")) {
+        out.left_mask = resolve(lm, arrays);
+        out.has_mask = true;
+    }
+    return out;
+}
+
+static std::vector<std::string> decode_strlist(const JNode *n) {
+    const JNode *items = n;
+    if (n->kind == JNode::OBJ && n->get("$t")) items = n->get("$t");
+    std::vector<std::string> out;
+    for (auto &v : items->arr) out.push_back(v.str);
+    return out;
+}
+
+static Mojo load_mojo(const std::string &path) {
+    auto zip = read_zip(read_file(path));
+    // arrays.npz is itself a zip of .npy members
+    auto npz = read_zip(zip.at("arrays.npz"));
+    std::map<std::string, Arr> arrays;
+    for (auto &kv : npz) {
+        std::string name = kv.first;
+        if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+            name = name.substr(0, name.size() - 4);
+        arrays[name] = parse_npy(kv.second);
+    }
+    std::string sj((const char *)zip.at("structure.json").data(),
+                   zip.at("structure.json").size());
+    JNode root = JParser(sj).parse();
+
+    Mojo m;
+    m.algo = root.get("algo")->str;
+    m.drf = m.algo == "drf";
+    const JNode *out = root.get("output")->get("$d");
+    auto num = [&](const char *k, double dflt) {
+        const JNode *n = out->get(k);
+        if (!n) return dflt;
+        if (n->kind == JNode::NUM) return n->num;
+        if (n->kind == JNode::OBJ && n->get("$f"))
+            return strtod(n->get("$f")->str.c_str(), nullptr);
+        return dflt;
+    };
+    m.learn_rate = num("learn_rate", 1.0);
+    m.f0 = num("f0", 0.0);
+    const JNode *dist = out->get("distribution");
+    m.distribution = dist ? dist->str : "gaussian";
+    const JNode *cl = out->get("custom_link");
+    if (cl && cl->kind == JNode::STR) m.custom_link = cl->str;
+    m.ntrees = (int)num("ntrees", 0);
+    const JNode *bin = out->get("binomial");
+    m.binomial = bin && bin->kind == JNode::BOOL && bin->b;
+    m.x_cols = decode_strlist(out->get("x_cols"));
+    const JNode *fd = out->get("feat_domains");
+    if (fd && fd->get("$d"))
+        for (auto &kv : fd->get("$d")->obj)
+            m.feat_domains[kv.first] = decode_strlist(&kv.second);
+    const JNode *cc = out->get("cat_card");
+    if (cc && cc->kind == JNode::OBJ && cc->get("$a")) {
+        m.cat_card = resolve(cc, arrays).data;
+        m.cat_bins = (int)num("cat_bins", 0);
+    }
+    const JNode *tm = out->get("trees_multi");
+    if (tm && tm->kind == JNode::ARR) {
+        for (auto &cls : tm->arr) {
+            std::vector<Tree> ts;
+            for (auto &t : cls.arr) ts.push_back(decode_tree(t, arrays));
+            m.trees_multi.push_back(std::move(ts));
+            if (!m.ntrees) m.ntrees = (int)m.trees_multi.back().size();
+        }
+        const JNode *f0m = out->get("f0_multi");
+        if (f0m && f0m->get("$a")) m.f0_multi = resolve(f0m, arrays).data;
+        else m.f0_multi.assign(m.trees_multi.size(), 0.0);
+        m.nclasses = (int)m.trees_multi.size();
+    } else {
+        for (auto &t : out->get("trees")->arr)
+            m.trees.push_back(decode_tree(t, arrays));
+        if (!m.ntrees) m.ntrees = (int)m.trees.size();
+    }
+    const JNode *rd = root.get("response_domain");
+    if (rd && (rd->kind == JNode::ARR ||
+               (rd->kind == JNode::OBJ && rd->get("$t"))))
+        m.response_domain = decode_strlist(rd);
+    if (m.response_domain.size() == 2 && m.nclasses == 1) m.nclasses = 2;
+    return m;
+}
+
+// ---------------------------------------------------------------- traversal
+
+// mirrors tree.py cat_bins_for_codes: identity when cardinality fits,
+// contiguous range grouping otherwise
+static int cat_bin_for_code(double x, double card, int n_bins) {
+    int code = std::isnan(x) ? 0 : (int)x;
+    if (card > n_bins) {
+        int grouped = (int)((int64_t)code * n_bins / (int64_t)(card < 1 ? 1 : card));
+        return grouped < 0 ? 0 : grouped >= n_bins ? n_bins - 1 : grouped;
+    }
+    return code < 0 ? 0 : code >= n_bins ? n_bins - 1 : code;
+}
+
+static double score_tree(const Tree &t, const std::vector<double> &row,
+                         const std::vector<double> &cat_card, int cat_bins) {
+    int depth = 0;                                 // heap 2^(depth+1)-1
+    for (int64_t h = t.feat.size() + 1; h > 2; h /= 2) ++depth;
+    int64_t idx = 0;
+    for (int d = 0; d < depth; ++d) {
+        if (t.is_split.data[idx] == 0) break;
+        int f = (int)t.feat.data[idx];
+        if (f < 0) f = 0;
+        double x = row[f];
+        bool left;
+        if (std::isnan(x)) {
+            left = t.na_left.data[idx] != 0;
+        } else if (t.has_mask && !cat_card.empty() && cat_card[f] > 0) {
+            int B = (int)(t.left_mask.shape[1]);
+            int b = cat_bin_for_code(x, cat_card[f], cat_bins ? cat_bins : B);
+            if (b >= B) b = B - 1;
+            left = t.left_mask.data[idx * B + b] != 0;
+        } else {
+            left = x < t.tv.data[idx];
+        }
+        idx = idx * 2 + (left ? 1 : 2);
+    }
+    return t.leaf.data[idx];
+}
+
+// ---------------------------------------------------------------------- csv
+
+static std::vector<std::string> split_csv_line(const std::string &line) {
+    std::vector<std::string> out;
+    std::string cur;
+    bool q = false;
+    for (size_t i = 0; i < line.size(); ++i) {
+        char c = line[i];
+        if (q) {
+            if (c == '"' && i + 1 < line.size() && line[i + 1] == '"') { cur += '"'; ++i; }
+            else if (c == '"') q = false;
+            else cur += c;
+        } else if (c == '"') q = true;
+        else if (c == ',') { out.push_back(cur); cur.clear(); }
+        else cur += c;
+    }
+    out.push_back(cur);
+    return out;
+}
+
+// ---------------------------------------------------------------------- main
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s model.mojo data.csv\n", argv[0]);
+        return 2;
+    }
+    try {
+        Mojo m = load_mojo(argv[1]);
+        std::ifstream f(argv[2]);
+        if (!f) throw std::runtime_error("cannot open csv");
+        std::string line;
+        std::getline(f, line);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        auto header = split_csv_line(line);
+        // column index per model feature
+        std::vector<int> colidx(m.x_cols.size(), -1);
+        for (size_t j = 0; j < m.x_cols.size(); ++j)
+            for (size_t c = 0; c < header.size(); ++c)
+                if (header[c] == m.x_cols[j]) { colidx[j] = (int)c; break; }
+        for (size_t j = 0; j < m.x_cols.size(); ++j)
+            if (colidx[j] < 0)
+                throw std::runtime_error("csv lacks column " + m.x_cols[j]);
+
+        while (std::getline(f, line)) {
+            if (!line.empty() && line.back() == '\r') line.pop_back();
+            if (line.empty()) continue;
+            auto cells = split_csv_line(line);
+            std::vector<double> row(m.x_cols.size(), NAN);
+            for (size_t j = 0; j < m.x_cols.size(); ++j) {
+                if ((size_t)colidx[j] >= cells.size()) continue;  // ragged: NA
+                const std::string &cell = cells[colidx[j]];
+                auto dom = m.feat_domains.find(m.x_cols[j]);
+                if (dom != m.feat_domains.end()) {
+                    row[j] = NAN;                  // unseen/missing level
+                    for (size_t k = 0; k < dom->second.size(); ++k)
+                        if (dom->second[k] == cell) { row[j] = (double)k; break; }
+                } else if (cell.empty() || cell == "NA" || cell == "nan") {
+                    row[j] = NAN;
+                } else {
+                    char *e;
+                    row[j] = strtod(cell.c_str(), &e);
+                    if (e == cell.c_str()) row[j] = NAN;
+                }
+            }
+            if (!m.trees_multi.empty()) {          // multinomial
+                std::vector<double> margin(m.nclasses);
+                for (int k = 0; k < m.nclasses; ++k) {
+                    double s = 0;
+                    for (auto &t : m.trees_multi[k])
+                        s += score_tree(t, row, m.cat_card, m.cat_bins);
+                    margin[k] = m.drf ? s / (m.ntrees ? m.ntrees : 1)
+                                      : m.f0_multi[k] + m.learn_rate * s;
+                }
+                std::vector<double> p(m.nclasses);
+                double tot = 0;
+                if (m.drf) {
+                    for (int k = 0; k < m.nclasses; ++k) {
+                        p[k] = margin[k] < 0 ? 0 : margin[k] > 1 ? 1 : margin[k];
+                        tot += p[k];
+                    }
+                    for (auto &v : p) v /= tot > 1e-30 ? tot : 1e-30;
+                } else {
+                    double mx = margin[0];
+                    for (double v : margin) mx = std::max(mx, v);
+                    for (int k = 0; k < m.nclasses; ++k) {
+                        p[k] = std::exp(margin[k] - mx);
+                        tot += p[k];
+                    }
+                    for (auto &v : p) v /= tot;
+                }
+                int best = 0;
+                for (int k = 1; k < m.nclasses; ++k)
+                    if (p[k] > p[best]) best = k;
+                printf("%s", m.response_domain[best].c_str());
+                for (double v : p) printf(",%.9g", v);
+                printf("\n");
+                continue;
+            }
+            double s = 0;
+            for (auto &t : m.trees)
+                s += score_tree(t, row, m.cat_card, m.cat_bins);
+            if (m.drf) {
+                double mean = s / (m.ntrees ? m.ntrees : 1);
+                if (m.binomial) {
+                    double p1 = mean < 0 ? 0 : mean > 1 ? 1 : mean;
+                    printf("%s,%.9g,%.9g\n",
+                           m.response_domain[p1 >= 0.5 ? 1 : 0].c_str(),
+                           1 - p1, p1);
+                } else {
+                    printf("%.9g\n", mean);
+                }
+                continue;
+            }
+            double fm = m.f0 + m.learn_rate * s;
+            if (m.distribution == "bernoulli") {
+                double p1 = 1.0 / (1.0 + std::exp(-fm));
+                printf("%s,%.9g,%.9g\n",
+                       m.response_domain[p1 >= 0.5 ? 1 : 0].c_str(),
+                       1 - p1, p1);
+            } else if (m.distribution == "poisson" ||
+                       m.distribution == "gamma" ||
+                       m.distribution == "tweedie" ||
+                       (m.distribution == "custom" && m.custom_link == "log")) {
+                printf("%.9g\n", std::exp(fm > 30 ? 30 : fm < -30 ? -30 : fm));
+            } else if (m.distribution == "custom" && m.custom_link == "logit") {
+                printf("%.9g\n", 1.0 / (1.0 + std::exp(-fm)));
+            } else if (m.distribution == "custom" && m.custom_link == "inverse") {
+                printf("%.9g\n", 1.0 / (std::fabs(fm) < 1e-30 ? 1e-30 : fm));
+            } else {
+                printf("%.9g\n", fm);
+            }
+        }
+        return 0;
+    } catch (const std::exception &e) {
+        fprintf(stderr, "mojo_score: %s\n", e.what());
+        return 1;
+    }
+}

@@ -208,3 +208,19 @@ def test_import_sql_route(client, tmp_path):
     out = client.request("POST", "/99/ImportSQLTable",
                          {"connection_url": f"sqlite:{db}", "table": "t"})
     assert DKV[out["dest"]["name"]].nrows == 9
+
+
+def test_killminus3_and_metadata_endpoint_detail(server, client):
+    """GET /3/KillMinus3 (reference RegisterV3Api:439 — thread dump, server
+    keeps serving) + /3/Metadata/endpoints/{path|index} fetchRoute."""
+    import json
+    import urllib.request
+    u = urllib.request.urlopen
+    r = json.loads(u(server.url + "/3/KillMinus3").read())
+    assert r["__meta"]["schema_type"] == "KillMinus3V3"
+    assert json.loads(u(server.url + "/3/Cloud").read())["cloud_healthy"]
+    byp = json.loads(u(server.url +
+                       "/3/Metadata/endpoints/%2F3%2FCloud").read())
+    assert byp["routes"][0]["url_pattern"] == "/3/Cloud"
+    byi = json.loads(u(server.url + "/3/Metadata/endpoints/0").read())
+    assert byi["routes"][0]["url_pattern"]
