@@ -1334,7 +1334,8 @@ class _Handler(BaseHTTPRequestHandler):
         its handler docstring as the help text."""
         import urllib.parse as _up
         want = _up.unquote(path)
-        if want.isdigit() and int(want) < len(_ROUTES):   # fetch by index
+        if want.isascii() and want.isdecimal() \
+                and int(want) < len(_ROUTES):   # fetch by index
             pat, m, fn = _ROUTES[int(want)]
             self._reply({"__meta": {"schema_type": "MetadataV3"},
                          "routes": [{"http_method": m, "url_pattern": pat,
@@ -1382,6 +1383,15 @@ class _Handler(BaseHTTPRequestHandler):
                     "build_too_old", "node_idx", "cloud_internal_timezone",
                     "datafile_parser_timezone"],
     }
+
+    def r_metadata_schemas(self):
+        """Reference MetadataHandler.listSchemas."""
+        self._reply({"__meta": {"schema_type": "MetadataV3"},
+                     "schemas": [{"name": n,
+                                  "fields": [{"name": f, "is_schema": False,
+                                              "help": f}
+                                             for f in self._SCHEMA_FIELDS[n]]}
+                                 for n in sorted(self._SCHEMA_FIELDS)]})
 
     def r_metadata_schema(self, name):
         fields = self._SCHEMA_FIELDS.get(name, [])
@@ -1503,6 +1513,7 @@ _ROUTES = [
     (r"/3/Metadata/endpoints", "GET", _Handler.r_metadata_endpoints),
     (r"/3/Metadata/endpoints/(.+)", "GET", _Handler.r_metadata_endpoint),
     (r"/3/Metadata/schemaclasses/([^/]+)", "GET", _Handler.r_metadata_schema),
+    (r"/3/Metadata/schemas", "GET", _Handler.r_metadata_schemas),
     (r"/3/KillMinus3", "GET", _Handler.r_kill3),
     (r"/3/Metadata/schemas/([^/]+)", "GET", _Handler.r_metadata_schema),
     (r"/3/NetworkTest", "GET", _Handler.r_network_test),

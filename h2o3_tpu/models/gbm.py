@@ -229,6 +229,18 @@ def _grad_hess_multinomial(F, y, w):
     return w[:, None] * (p - yoh), w[:, None] * jnp.maximum(p * (1 - p), 1e-10)
 
 
+def _pack_hp(col_rate, sample_rate, col_tree_rate, min_rows, reg_lambda,
+             reg_alpha, gamma, min_split_improvement, lr,
+             quantile_alpha=0.5, huber_alpha=0.9, tweedie_power=1.5):
+    """The ``_boost_scan_jit`` hp-vector layout — the ONE place the slot
+    order lives (the dryrun audit in ``__graft_entry__`` packs with this
+    too, so it can never silently audit a differently-wired program)."""
+    return jnp.asarray([col_rate, sample_rate, col_tree_rate, min_rows,
+                        reg_lambda, reg_alpha, gamma, min_split_improvement,
+                        lr, quantile_alpha, huber_alpha, tweedie_power],
+                       jnp.float32)
+
+
 def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
                 dist: str, depth: int, n_bins: int, col_rate: float,
                 sample_rate: float, col_tree_rate: float, min_rows: float,
@@ -263,10 +275,9 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
     ``nclass`` > 1 grows one tree per class per round (multinomial), vmapped.
     Returns stacked heap arrays [M(, K), heap] + final margins Fcur.
     """
-    hp = jnp.asarray([col_rate, sample_rate, col_tree_rate, min_rows,
-                      reg_lambda, reg_alpha, gamma, min_split_improvement,
-                      lr, quantile_alpha, huber_alpha, tweedie_power],
-                     jnp.float32)
+    hp = _pack_hp(col_rate, sample_rate, col_tree_rate, min_rows,
+                  reg_lambda, reg_alpha, gamma, min_split_improvement,
+                  lr, quantile_alpha, huber_alpha, tweedie_power)
     return _boost_scan_jit(
         binned, edges, yc, w, fmask_base, Fcur0, keys, hp,
         dist=dist, depth=depth, n_bins=n_bins, bootstrap=bootstrap, drf=drf,

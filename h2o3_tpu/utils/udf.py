@@ -228,8 +228,8 @@ def resolve_distribution(ref: str) -> tuple[int, "CustomDistribution"]:
     import hashlib
 
     from h2o3_tpu.utils.registry import DKV
-    m = _REF_RE.match(ref)
-    data = getattr(DKV.get(m.group(2)), "data", b"") if m else b""
+    _lang, ref_key, _qual = parse_ref(ref)
+    data = getattr(DKV.get(ref_key), "data", b"")
     key = (ref, hashlib.sha1(bytes(data)).hexdigest() if
            isinstance(data, (bytes, bytearray)) else "")
     if key in _BY_SOURCE:

@@ -61,8 +61,9 @@ static std::vector<uint8_t> inflate_raw(const uint8_t *src, size_t n,
 static std::map<std::string, std::vector<uint8_t>> read_zip(
         const std::vector<uint8_t> &buf) {
     // end-of-central-directory: scan back for PK\x05\x06
+    if (buf.size() < 22) throw std::runtime_error("not a zip");
     size_t eocd = std::string::npos;
-    for (size_t i = buf.size() >= 22 ? buf.size() - 22 : 0;; --i) {
+    for (size_t i = buf.size() - 22;; --i) {
         if (buf[i] == 'P' && buf[i + 1] == 'K' && buf[i + 2] == 5 &&
             buf[i + 3] == 6) { eocd = i; break; }
         if (i == 0) break;

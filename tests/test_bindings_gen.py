@@ -1,0 +1,42 @@
+"""Bindings codegen from live server metadata (reference: h2o-bindings/
+bin/gen_python.py generating the h2o-py estimator classes)."""
+
+import importlib.util
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.utils.registry import DKV
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/clients")
+
+
+def test_generated_estimators_train(tmp_path, rng):
+    from bindings_gen import generate
+    s = H2OServer(port=0).start()
+    try:
+        src = generate(s.url)
+        mod_path = tmp_path / "estimators_gen.py"
+        mod_path.write_text(src)
+        spec = importlib.util.spec_from_file_location("estimators_gen",
+                                                      mod_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert hasattr(mod, "GbmEstimator") and hasattr(mod, "GlmEstimator")
+
+        n = 200
+        fr = Frame.from_arrays(
+            {"a": rng.normal(size=n).astype(np.float32),
+             "t": rng.normal(size=n).astype(np.float32)}, key="bind_fr")
+        DKV.put(fr.key, fr)
+        est = mod.GbmEstimator(url=s.url, ntrees=3, max_depth=2)
+        est.train("bind_fr", y="t")
+        assert est.model_json["algo"] == "gbm"
+        with pytest.raises(ValueError, match="unknown parameters"):
+            mod.GbmEstimator(url=s.url, bogus_param=1)
+    finally:
+        s.stop()
