@@ -2,8 +2,6 @@
 bin/gen_python.py generating the h2o-py estimator classes)."""
 
 import importlib.util
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -12,20 +10,24 @@ from h2o3_tpu import Frame
 from h2o3_tpu.api import H2OServer
 from h2o3_tpu.utils.registry import DKV
 
-sys.path.insert(0, __file__.rsplit("/tests/", 1)[0] + "/clients")
+GEN = __file__.rsplit("/tests/", 1)[0] + "/clients/bindings_gen.py"
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_generated_estimators_train(tmp_path, rng):
-    from bindings_gen import generate
+    generate = _load("bindings_gen", GEN).generate
     s = H2OServer(port=0).start()
     try:
         src = generate(s.url)
         mod_path = tmp_path / "estimators_gen.py"
         mod_path.write_text(src)
-        spec = importlib.util.spec_from_file_location("estimators_gen",
-                                                      mod_path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        mod = _load("estimators_gen", mod_path)
         assert hasattr(mod, "GbmEstimator") and hasattr(mod, "GlmEstimator")
 
         n = 200
