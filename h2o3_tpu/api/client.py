@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import time
 import urllib.parse
+import uuid
 import urllib.request
 
 
@@ -54,6 +55,28 @@ class H2OClient:
             d["destination_frame"] = destination_frame
         out = self.request("POST", "/3/ImportFiles", d)
         return out["destination_frames"][0]
+
+    def upload_file(self, path: str, destination_frame: str | None = None) -> str:
+        """Ship a CLIENT-LOCAL file to the server and parse it (h2o-py
+        ``h2o.upload_file``: multipart POST /3/PostFile + POST /3/Parse)."""
+        import os
+        with open(path, "rb") as f:
+            data = f.read()
+        boundary = uuid.uuid4().hex
+        fname = os.path.basename(path)
+        body = (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="file"; filename="{fname}"\r\n\r\n').encode() + data \
+            + f"\r\n--{boundary}--\r\n".encode()
+        req = urllib.request.Request(
+            self.url + "/3/PostFile", data=body, method="POST",
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        with urllib.request.urlopen(req) as resp:
+            raw_key = json.loads(resp.read())["destination_frame"]
+        dest = destination_frame or raw_key
+        self.request("POST", "/3/Parse",
+                     {"source_frames": [raw_key], "destination_frame": dest})
+        return dest
 
     def frame(self, key: str) -> dict:
         return self.request("GET", f"/3/Frames/{key}")["frames"][0]

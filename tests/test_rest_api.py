@@ -129,3 +129,13 @@ def test_init_connect_cluster_shutdown():
     import pytest as _pytest
     with _pytest.raises(RuntimeError):
         hc.cluster()
+
+
+def test_client_upload_file(client, tmp_path):
+    """H2OClient.upload_file ships a client-local csv via POST /3/PostFile
+    + Parse (the h2o.upload_file flow; remote-server safe)."""
+    p = tmp_path / "up.csv"
+    p.write_text("x,y\n1,2\n3,4\n5,6\n")
+    key = client.upload_file(str(p), destination_frame="uploaded_fr")
+    fr = client.frame(key)
+    assert key == "uploaded_fr" and fr["rows"] == 3
