@@ -242,10 +242,11 @@ class ModelBuilder:
         raise NotImplementedError
 
     def _apply_custom_metric(self, model: Model, frame: Frame, y: str,
-                             weights, fn) -> None:
-        """Evaluate a user metric callable on the training predictions and
-        attach it to the metrics object (reference: custom_metric_func via
-        water/udf — here a plain python function, no jar upload)."""
+                             weights, fn, mm=None) -> None:
+        """Evaluate a user metric callable on predictions over ``frame`` and
+        attach it to ``mm`` (default: training metrics). Reference:
+        custom_metric_func via water/udf — computed for every scored frame
+        (CMetricScoringTask), so validation metrics carry it too."""
         import numpy as np
 
         from h2o3_tpu.models.data_info import response_adapted
@@ -255,9 +256,9 @@ class ModelBuilder:
             frame.vec(y),
             model.response_domain if model.is_classifier else None)
         ok = fetch(frame.row_mask() & valid)[: frame.nrows]
-        w = fetch(weights)[: frame.nrows] * ok
+        w = fetch(weights)[: frame.nrows] * ok if weights is not None else ok
         value = fn(np.asarray(raw), fetch(yv)[: frame.nrows], np.asarray(w))
-        mm = model.training_metrics
+        mm = model.training_metrics if mm is None else mm
         try:
             mm.custom_metric_name = getattr(fn, "__name__", "custom")
             mm.custom_metric_value = float(value)
@@ -326,6 +327,11 @@ class ModelBuilder:
                     self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
+                if cmf is not None and not isinstance(cmf, str) \
+                        and model.validation_metrics is not None:
+                    self._apply_custom_metric(model, validation_frame, y,
+                                              None, cmf,
+                                              mm=model.validation_metrics)
             # snapshot BEFORE the CV refits below clobber the per-iteration
             # series on this (shared) builder instance
             model.scoring_history = self._scoring_history(model)

@@ -218,3 +218,26 @@ def test_tie_aware_auc_stopping_metric(rng):
                                 jnp.asarray(y), jnp.asarray(w), 0))
     want = roc_auc_score(y, p, sample_weight=w)
     assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_metric_udf_on_validation_frame(reg_frame, rng):
+    """The reference computes custom metrics for every scored frame
+    (CMetricScoringTask) — validation metrics must carry it too."""
+    DKV.put("mae2", RawFile(_zip_bytes("metrics.py", MAE_METRIC_SRC),
+                            name="func.jar"))
+    n = 100
+    vf = Frame.from_arrays(
+        {"a": rng.normal(size=n).astype(np.float32),
+         "b": rng.normal(size=n).astype(np.float32),
+         "c": rng.normal(size=n).astype(np.float32),
+         "y": rng.normal(size=n).astype(np.float32)}, key="udf_valid")
+    DKV.put(vf.key, vf)
+    m = GBM(ntrees=4, max_depth=3, seed=1,
+            custom_metric_func="python:mae2=metrics.CustomMaeFuncWrapper"
+            ).train(y="y", training_frame=reg_frame, validation_frame=vf)
+    vm = m.validation_metrics
+    assert vm.custom_metric_name == "mae2"
+    preds = np.asarray(m.predict(vf).vec("predict").data)[:n]
+    yv = np.asarray(vf.vec("y").data)[:n]
+    assert vm.custom_metric_value == pytest.approx(
+        float(np.abs(yv - preds).mean()), rel=1e-5)
