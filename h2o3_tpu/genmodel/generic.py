@@ -30,11 +30,19 @@ class Generic(ModelBuilder):
 
     def train(self, x=None, y=None, training_frame=None, **kw):
         from h2o3_tpu.genmodel.mojo import MojoModel
+        from h2o3_tpu.genmodel.mojo_ref import is_reference_mojo, load_ref_mojo
         path = self.params.get("path")
         if not path:
             raise ValueError("path to a mojo artifact is required")
-        mojo = MojoModel.load(path)
-        inner = mojo._inner
+        if is_reference_mojo(path):
+            # a real H2O-3 MOJO zip (the migration path: users arrive with
+            # artifacts from model.download_mojo()) — reference
+            # hex/generic/GenericModel.java wraps them the same way
+            inner = load_ref_mojo(path)
+            mojo = inner
+        else:
+            mojo = MojoModel.load(path)
+            inner = mojo._inner
         model = GenericModel(
             key=make_model_key(self.algo, self.model_id),
             params=self.params, data_info=None,
@@ -42,6 +50,11 @@ class Generic(ModelBuilder):
             response_domain=inner.response_domain,
             output=dict(mojo=mojo, source_algo=mojo.algo),
         )
+        # the artifact's decision threshold (max-F1 at training time) must
+        # drive predict() labels, not argmax — EasyPredict parity
+        thr = getattr(inner, "_default_threshold", None)
+        if thr is not None:
+            model._default_threshold = float(thr)
         if training_frame is not None and inner.response_column is not None \
                 and inner.response_column in training_frame:
             model.training_metrics = model.model_performance(training_frame)

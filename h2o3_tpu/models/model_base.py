@@ -311,26 +311,34 @@ class ModelBuilder:
                         frame=frame.key)
             model = self._fit(job, frame, x, y, base_w)
             model.run_time_ms = int((time.time() - t0) * 1000)
+            # user UDF metric: either an in-process python callable
+            # (preds, y, w) -> value, or the reference's wire form
+            # "python:key=module.Class" naming a /3/PutKey upload
+            # (water/udf CFuncRef; h2o.upload_custom_metric). Resolved
+            # up-front so validation scoring sees the callable even when
+            # training metrics are absent (CMetricScoringTask computes the
+            # custom metric on EVERY scored frame).
+            cmf = self.params.get("custom_metric_func")
+            if isinstance(cmf, str) and y is not None:
+                from h2o3_tpu.utils import udf as _udf
+                _, key_name, _qual = _udf.parse_ref(cmf)
+                cmf = _udf.metric_callable(_udf.load_cfunc(cmf), key_name,
+                                           model=model)
             if y is not None:
                 model.training_metrics = self._holdout_metrics(model, frame, y, base_w)
-                cmf = self.params.get("custom_metric_func")
                 if cmf is not None and model.training_metrics is not None:
-                    # user UDF metric: either an in-process python callable
-                    # (preds, y, w) -> value, or the reference's wire form
-                    # "python:key=module.Class" naming a /3/PutKey upload
-                    # (water/udf CFuncRef; h2o.upload_custom_metric)
-                    if isinstance(cmf, str):
-                        from h2o3_tpu.utils import udf as _udf
-                        _, key_name, _qual = _udf.parse_ref(cmf)
-                        cmf = _udf.metric_callable(_udf.load_cfunc(cmf),
-                                                   key_name)
                     self._apply_custom_metric(model, frame, y, base_w, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
-                if cmf is not None and not isinstance(cmf, str) \
-                        and model.validation_metrics is not None:
+                if cmf is not None and model.validation_metrics is not None:
+                    # weights apply on every scored frame, validation included
+                    vw = None
+                    wc = self.params.get("weights_column")
+                    if wc and wc in validation_frame.names:
+                        vw = (validation_frame.row_mask().astype(jnp.float32)
+                              * validation_frame.vec(wc).data)
                     self._apply_custom_metric(model, validation_frame, y,
-                                              None, cmf,
+                                              vw, cmf,
                                               mm=model.validation_metrics)
             # snapshot BEFORE the CV refits below clobber the per-iteration
             # series on this (shared) builder instance

@@ -774,6 +774,10 @@ def rectangle_assign(dst: Frame, src, cols, rows) -> Frame:
             vals = v.labels()
         elif v.type in (VecType.STR, VecType.UUID):
             vals = v.host_values
+        elif v.type == VecType.TIME:
+            # exact ABSOLUTE epoch ms — device data is shifted by the
+            # source's own time_offset and would land decades off
+            vals = np.asarray(v.to_numpy(), np.float64)
         else:
             vals = np.asarray(fetch(v.as_float()))[: src.nrows]
         if src.nrows == n:              # full-height source: pick slice rows
@@ -798,13 +802,37 @@ def rectangle_assign(dst: Frame, src, cols, rows) -> Frame:
             cur = np.array(v.host_values, dtype=object)
             cur[ridx] = vals
             new_vecs[j] = Vec.from_numpy(cur, type=v.type)
+        elif v.type == VecType.TIME:
+            # TIME device data is *shifted* f32 ms; the exact absolute epoch
+            # ms live host-side in f64 (vec.py:94-97). Mutate the f64 host
+            # values (rapids time scalars are absolute epoch ms, vec.py:240)
+            # and rebuild through the datetime64 path so the ms-offset device
+            # encoding and exact host values are preserved — storing absolute
+            # epoch ms (~1.7e12) as raw f32 would corrupt every row by up to
+            # ~131 s (f32 resolution at that magnitude).
+            cur = (np.array(v.host_values, dtype=np.float64)[:n]
+                   if v.host_values is not None else
+                   np.asarray(fetch(v.as_float()))[:n].astype(np.float64)
+                   + v.time_offset)
+            fv = (np.nan if vals is None else
+                  np.asarray(vals, np.float64) if not np.isscalar(vals)
+                  else float(vals))
+            cur[ridx] = fv
+            ns = np.full(n, np.datetime64("NaT"), dtype="datetime64[ns]")
+            fin = np.isfinite(cur)
+            # integer-exact ms->ns: cur*1e6 in f64 is inexact above 2^53
+            # (~0.24 us drift on ~25% of epoch-ms values); split whole ms
+            # (exact int64) from sub-ms remainder
+            whole = np.floor(cur[fin])
+            ns_i = (whole.astype(np.int64) * 1_000_000
+                    + np.round((cur[fin] - whole) * 1e6).astype(np.int64))
+            ns[fin] = ns_i.astype("datetime64[ns]")
+            new_vecs[j] = Vec.from_numpy(ns, type=VecType.TIME)
         else:
             cur = np.asarray(fetch(v.as_float()))[:n].astype(np.float64)
             fv = (np.nan if vals is None else
                   np.asarray(vals, np.float64) if not np.isscalar(vals)
                   else float(vals))
             cur[ridx] = fv
-            new_vecs[j] = Vec.from_numpy(cur.astype(np.float32),
-                                         type=v.type if v.type == VecType.TIME
-                                         else VecType.NUM)
+            new_vecs[j] = Vec.from_numpy(cur.astype(np.float32), type=VecType.NUM)
     return Frame(list(dst.names), new_vecs)

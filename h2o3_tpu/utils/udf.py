@@ -114,23 +114,37 @@ def load_cfunc(ref: str):
 
 # -- custom metric adapter ---------------------------------------------------
 
-def metric_callable(obj, name: str):
+def metric_callable(obj, name: str, model=None):
     """Adapt a map/reduce/metric UDF object to the builder's vectorized
     ``(preds, y, w) -> float`` custom-metric contract.
 
     Row layout matches the reference ``CFuncTask`` (h2o-py docs at
     ``h2o.py:2133``): classifiers get ``[label, p0, p1, ...]``, regression
     gets ``[prediction]``; ``act`` is ``[y]``; offset is 0 (offset-aware
-    custom metrics would read it from the model, which we pass as None)."""
+    custom metrics would read it from the model, which we pass as None).
+
+    ``model`` (an object or zero-arg callable yielding one) supplies the
+    binomial decision threshold so the label in ``pred[0]`` matches what
+    ``predict()`` emits — the reference passes the model's threshold-based
+    label, and ``_default_threshold`` is resettable via
+    ``model.reset.threshold`` here; falls back to argmax when absent
+    (multinomial)."""
     def fn(preds, y, w):
         preds = np.asarray(preds)
         y = np.asarray(y, np.float64)
         w = np.asarray(w, np.float64)
+        thr = None
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            m = model() if callable(model) else model
+            t = getattr(m, "_default_threshold", None)
+            thr = float(t) if t is not None else None
         acc = None
         for i in np.nonzero(w > 0)[0]:
             if preds.ndim == 2:
                 probs = [float(v) for v in preds[i]]
-                row = [float(np.argmax(preds[i]))] + probs
+                label = (float(probs[1] >= thr) if thr is not None
+                         else float(np.argmax(preds[i])))
+                row = [label] + probs
             else:
                 row = [float(preds[i])]
             state = obj.map(row, [float(y[i])], float(w[i]), 0.0, None)
@@ -207,13 +221,23 @@ class CustomDistribution:
 
 # process-local registry: jit static args carry the integer id, the callback
 # looks the adapter back up (ids are never reused within a process, so cached
-# compiled programs always resolve to the distribution they were traced for)
+# compiled programs always resolve to the distribution they were traced for).
+# Allocation is lock-guarded: two concurrent custom-distribution trains
+# through the threaded REST server must not collide on a cid — the jitted
+# program carries cid as a static arg, so a collision would silently train
+# one model with the other upload's gradients.
+import itertools as _itertools
+import threading as _threading
+
 _CUSTOM_DISTS: dict[int, CustomDistribution] = {}
+_DIST_LOCK = _threading.Lock()
+_NEXT_CID = _itertools.count(1)
 
 
 def register_custom_dist(cd: CustomDistribution) -> int:
-    cid = len(_CUSTOM_DISTS) + 1
-    _CUSTOM_DISTS[cid] = cd
+    with _DIST_LOCK:
+        cid = next(_NEXT_CID)
+        _CUSTOM_DISTS[cid] = cd
     return cid
 
 
@@ -232,12 +256,18 @@ def resolve_distribution(ref: str) -> tuple[int, "CustomDistribution"]:
     data = getattr(DKV.get(ref_key), "data", b"")
     key = (ref, hashlib.sha1(bytes(data)).hexdigest() if
            isinstance(data, (bytes, bytearray)) else "")
-    if key in _BY_SOURCE:
-        cid = _BY_SOURCE[key]
-        return cid, _CUSTOM_DISTS[cid]
+    with _DIST_LOCK:
+        if key in _BY_SOURCE:
+            cid = _BY_SOURCE[key]
+            return cid, _CUSTOM_DISTS[cid]
     cd = CustomDistribution(load_cfunc(ref), ref)
-    cid = register_custom_dist(cd)
-    _BY_SOURCE[key] = cid
+    with _DIST_LOCK:
+        if key in _BY_SOURCE:          # lost the load race: reuse winner's id
+            cid = _BY_SOURCE[key]
+            return cid, _CUSTOM_DISTS[cid]
+        cid = next(_NEXT_CID)
+        _CUSTOM_DISTS[cid] = cd
+        _BY_SOURCE[key] = cid
     return cid, cd
 
 
