@@ -352,6 +352,7 @@ class _RefModelBase:
         from h2o3_tpu.frame.vec import Vec
         raw = np.asarray(self._score_raw(frame))
         n = frame.nrows
+        raw = raw[:n]                       # drop the device padding rows
         if not self.is_classifier:
             return Frame(["predict"], [Vec.from_numpy(raw)])
         if self.nclasses == 2:

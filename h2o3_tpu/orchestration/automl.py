@@ -62,7 +62,8 @@ class AutoML:
                  exclude_algos: Sequence[str] = (), include_algos: Sequence[str] | None = None,
                  project_name: str | None = None,
                  preprocessing: Sequence[str] | None = None,
-                 exploitation_ratio: float = 0.1):
+                 exploitation_ratio: float = 0.1,
+                 parallelism: int = 2):
         if not max_models and not max_runtime_secs:
             max_runtime_secs = 3600.0   # reference default budget
         self.max_models = int(max_models)
@@ -76,6 +77,9 @@ class AutoML:
         self.project_name = project_name or f"automl_{int(time.time())}"
         self.preprocessing = list(preprocessing or [])
         self.exploitation_ratio = float(exploitation_ratio)
+        # overlapped base/grid builds (reference runs steps on the F/J pools;
+        # see orchestration/parallel_build.py). 1 = strictly sequential.
+        self.parallelism = max(1, int(parallelism))
         self.leaderboard: Leaderboard | None = None
         self.event_log = EventLog()
         self._t0 = 0.0
@@ -205,25 +209,46 @@ class AutoML:
                                      f"{type(e).__name__}: {e}")
 
         tree_algos = {"GBM", "XGBOOST", "DRF"}
-        for algo, cls, params in self._steps():
-            if not self._budget_left():
-                break
-            if not self._algo_enabled(algo):
+
+        from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+
+        def enabled_steps():
+            for algo, cls, params in self._steps():
+                if self._algo_enabled(algo):
+                    yield algo, cls, params
+
+        def can_submit(n_submitted: int) -> bool:
+            cap = self._cap if self._cap else 0
+            if cap and self._n_built + n_submitted >= cap:
+                return False
+            return not (self.max_runtime_secs
+                        and time.time() - self._t0 > self.max_runtime_secs)
+
+        def build_step(step):
+            algo, cls, params = step
+            t = time.time()
+            fr_s, x_s = ((tree_frame, tree_x) if algo in tree_algos
+                         else (training_frame, x))
+            m = cls(**{**params, **common}).train(x=x_s, y=y,
+                                                  training_frame=fr_s)
+            return m, algo, time.time() - t
+
+        results, _ = windowed_parallel(enabled_steps(), self.parallelism,
+                                       can_submit, build_step)
+        # leaderboard membership follows PLAN order regardless of completion
+        # interleaving — identical to the sequential leaderboard
+        for step, res, exc in results:
+            if exc is not None:
+                log.log("error", f"{step[0]} failed: "
+                                 f"{type(exc).__name__}: {exc}")
                 continue
-            try:
-                t = time.time()
-                fr_s, x_s = ((tree_frame, tree_x) if algo in tree_algos
-                             else (training_frame, x))
-                m = cls(**{**params, **common}).train(x=x_s, y=y,
-                                                      training_frame=fr_s)
-                if te_model is not None and algo in tree_algos:
-                    m.preprocessors.append(te_model)
-                self._n_built += 1
-                base_models.append(m)
-                self.leaderboard.add(m)
-                log.log("model", f"{m.key} ({algo}) in {time.time() - t:.1f}s")
-            except Exception as e:
-                log.log("error", f"{algo} failed: {type(e).__name__}: {e}")
+            m, algo, dt = res
+            if te_model is not None and algo in tree_algos:
+                m.preprocessors.append(te_model)
+            self._n_built += 1
+            base_models.append(m)
+            self.leaderboard.add(m)
+            log.log("model", f"{m.key} ({algo}) in {dt:.1f}s")
 
         # random grid phase under the remaining budget
         for algo, cls, fixed, hyper, gseed in self._grids():
@@ -240,6 +265,7 @@ class AutoML:
                                                  max_models=max(remaining_models, 0),
                                                  max_runtime_secs=max(remaining_secs, 0.0),
                                                  seed=gseed),
+                            parallelism=self.parallelism,
                             **{**fixed, **common})
             # grids are tree families: same TE frame as the base tree steps
             grid = gs.train(x=tree_x, y=y, training_frame=tree_frame)

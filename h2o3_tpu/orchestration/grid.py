@@ -96,7 +96,8 @@ class GridSearch:
                  hyper_params: dict[str, Sequence[Any]],
                  grid_id: str | None = None,
                  search_criteria: dict | None = None,
-                 recovery_dir: str | None = None, **fixed_params):
+                 recovery_dir: str | None = None,
+                 parallelism: int = 1, **fixed_params):
         if isinstance(builder_cls, ModelBuilder):
             fixed_params = {**builder_cls.params, **fixed_params}
             builder_cls = type(builder_cls)
@@ -106,6 +107,9 @@ class GridSearch:
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.grid_id = grid_id or f"{builder_cls.algo}_grid_{int(time.time())}"
         self.recovery_dir = recovery_dir
+        # reference: GridSearch.startGridSearch(..., parallelism) — builds
+        # overlap on host threads (see orchestration/parallel_build.py)
+        self.parallelism = max(1, int(parallelism))
         self.grid: Grid | None = None
 
     def _combos(self):
@@ -156,32 +160,60 @@ class GridSearch:
                             "hyper_params": self.hyper_params,
                             "search_criteria": self.search_criteria})
 
-        exhausted = True
-        for combo in self._combos():
-            if max_models and len(models) >= max_models:
-                exhausted = False   # budget stop: keep the recovery resumable
-                break
-            if max_secs and time.time() - t0 > max_secs:
-                exhausted = False
-                break
-            if recovery is not None and recovery.is_done(combo):
-                continue
+        from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+        from h2o3_tpu.persist.recovery import combo_key
+
+        def fresh_combos():
+            for combo in self._combos():
+                if recovery is not None and recovery.is_done(combo):
+                    continue
+                yield combo
+
+        def can_submit(n_submitted: int) -> bool:
+            if max_models and len(models) + n_submitted >= max_models:
+                return False
+            return not (max_secs and time.time() - t0 > max_secs)
+
+        def build_one(combo: dict) -> Model:
             params = {**self.fixed_params, **combo}
             # id derived from the combo, stable across recovery resumes (a
             # positional counter would collide with recovered models)
-            from h2o3_tpu.persist.recovery import combo_key
             tag = hashlib.md5(combo_key(combo).encode()).hexdigest()[:8]
             params["model_id"] = f"{self.grid_id}_model_{tag}"
-            try:
-                b = self.builder_cls(**params)
-                m = b.train(x=x, y=y, training_frame=training_frame,
-                            validation_frame=validation_frame, **kw)
-                m.output["hyper_values"] = combo
+            b = self.builder_cls(**params)
+            m = b.train(x=x, y=y, training_frame=training_frame,
+                        validation_frame=validation_frame, **kw)
+            m.output["hyper_values"] = combo
+            return m
+
+        if self.parallelism <= 1:
+            # sequential: a FAILED build does not consume model budget
+            # (reference GridSearch keeps walking the space)
+            exhausted = True
+            for combo in fresh_combos():
+                if max_models and len(models) >= max_models:
+                    exhausted = False   # budget stop: recovery stays resumable
+                    break
+                if max_secs and time.time() - t0 > max_secs:
+                    exhausted = False
+                    break
+                try:
+                    m = build_one(combo)
+                    models.append(m)
+                    if recovery is not None:
+                        recovery.model_built(combo, m)
+                except Exception as e:
+                    failures.append((combo, f"{type(e).__name__}: {e}"))
+        else:
+            results, exhausted = windowed_parallel(
+                fresh_combos(), self.parallelism, can_submit, build_one)
+            for combo, m, exc in results:
+                if exc is not None:
+                    failures.append((combo, f"{type(exc).__name__}: {exc}"))
+                    continue
                 models.append(m)
                 if recovery is not None:
                     recovery.model_built(combo, m)
-            except Exception as e:  # reference: failed params recorded on the grid
-                failures.append((combo, f"{type(e).__name__}: {e}"))
         if recovery is not None and exhausted:
             recovery.done()
         self.grid = Grid(self.grid_id, models, failures,

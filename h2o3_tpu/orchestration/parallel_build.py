@@ -1,0 +1,84 @@
+"""Overlapped model builds (SURVEY §7 hard part (e); VERDICT r3 weak #6).
+
+The reference overlaps independent model builds on its fork/join pools
+(``hex/grid/GridSearch.java`` parallel builds,
+``water/ParallelizationTask.java``).  The TPU-native equivalent is
+host-thread parallelism over the single device stream: while one build's
+jitted step executes on the device, another build's trace/compile (host CPU,
+GIL released inside XLA) and host-side orchestration proceed — on small
+AutoML-scale frames, wall-clock is dominated by exactly that host work, so
+two in-flight builds hide most of it.  JAX dispatch, tracing, and
+compilation are thread-safe; DKV and the leaderboard are lock-guarded.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Any, Callable, Iterable
+
+
+def windowed_parallel(
+    items: Iterable[Any],
+    par: int,
+    can_submit: Callable[[int], bool],
+    run_one: Callable[[Any], Any],
+) -> tuple[list[tuple[Any, Any, Exception | None]], bool]:
+    """Run ``run_one(item)`` over a LAZY item stream with at most ``par`` in
+    flight.  ``can_submit(n_submitted)`` gates each submission (budget /
+    deadline); the stream is never advanced past the gate, so huge spaces
+    stay unenumerated (RandomDiscrete walker contract).
+
+    ``can_submit`` receives the count of SUCCESSFUL-or-in-flight builds, so
+    a failed build releases its budget and the walker keeps going — the
+    reference GridSearch semantics (failed params don't consume max_models).
+
+    Returns ``(results, stream_exhausted)`` where results are
+    ``(item, result, exc)`` in SUBMISSION order — callers get deterministic
+    model ordering regardless of completion interleaving — and
+    ``stream_exhausted`` is False when a budget/deadline stop (not stream
+    end) ended the run.
+    """
+    it = iter(items)
+    if par <= 1:
+        out: list = []
+        n_ok = 0
+        for item in it:
+            if not can_submit(n_ok):
+                return out, False
+            try:
+                out.append((item, run_one(item), None))
+                n_ok += 1
+            except Exception as e:          # noqa: BLE001 — per-item failures recorded
+                out.append((item, None, e))
+        return out, True
+
+    results: dict[int, tuple] = {}
+    futs: dict = {}
+    n_sub = 0
+    n_failed = 0
+    stream_ended = False
+    with ThreadPoolExecutor(max_workers=par,
+                            thread_name_prefix="model-build") as ex:
+        while True:
+            # gate sees successes + in-flight: completed failures released
+            # their budget, so a closed gate can reopen after a failure
+            while (not stream_ended and len(futs) < par
+                   and can_submit(n_sub - n_failed)):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    stream_ended = True
+                    break
+                futs[ex.submit(run_one, item)] = (n_sub, item)
+                n_sub += 1
+            if not futs:
+                break
+            done, _ = wait(list(futs), return_when=FIRST_COMPLETED)
+            for f in done:
+                i, item = futs.pop(f)
+                try:
+                    results[i] = (item, f.result(), None)
+                except Exception as e:      # noqa: BLE001
+                    results[i] = (item, None, e)
+                    n_failed += 1
+    return [results[i] for i in sorted(results)], stream_ended

@@ -1,0 +1,87 @@
+"""Overlapped model builds (orchestration/parallel_build.py; reference
+``hex/grid/GridSearch.java`` parallelism, ``water/ParallelizationTask.java``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+
+
+def test_results_in_submission_order():
+    def run(i):
+        time.sleep(0.02 * (5 - i))       # later items finish FIRST
+        return i * 10
+
+    out, exhausted = windowed_parallel(range(5), 3, lambda n: True, run)
+    assert exhausted
+    assert [item for item, _, _ in out] == [0, 1, 2, 3, 4]
+    assert [res for _, res, _ in out] == [0, 10, 20, 30, 40]
+
+
+def test_window_respects_parallelism():
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def run(i):
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.02)
+        with lock:
+            active[0] -= 1
+        return i
+
+    windowed_parallel(range(8), 2, lambda n: True, run)
+    assert peak[0] <= 2
+
+
+def test_budget_gate_stops_submission():
+    ran = []
+
+    def run(i):
+        ran.append(i)
+        return i
+
+    out, exhausted = windowed_parallel(range(100), 2,
+                                       lambda n: n < 5, run)
+    assert not exhausted                 # budget stop, not stream end
+    assert len(out) == 5
+    assert len(ran) == 5                 # stream never advanced past the gate
+
+
+def test_failures_recorded_not_raised():
+    def run(i):
+        if i == 2:
+            raise ValueError("boom")
+        return i
+
+    out, _ = windowed_parallel(range(4), 2, lambda n: True, run)
+    assert [e is not None for _, _, e in out] == [False, False, True, False]
+    assert isinstance(out[2][2], ValueError)
+
+
+def test_grid_parallel_same_models_as_sequential(rng):
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.orchestration.grid import GridSearch
+
+    n = 400
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    fr = Frame.from_arrays({
+        "a": x[:, 0], "b": x[:, 1], "c": x[:, 2],
+        "y": np.where(x[:, 0] + x[:, 1] > 0, "t", "f")})
+    hyper = {"max_depth": [2, 3], "learn_rate": [0.1, 0.3]}
+
+    g1 = GridSearch(GBM, hyper, grid_id="gseq", parallelism=1,
+                    ntrees=3, seed=5).train(y="y", training_frame=fr)
+    g2 = GridSearch(GBM, hyper, grid_id="gpar", parallelism=3,
+                    ntrees=3, seed=5).train(y="y", training_frame=fr)
+    assert len(g1.models) == len(g2.models) == 4
+    # same combos in the same submission order, same fitted trees
+    for m1, m2 in zip(g1.models, g2.models):
+        assert m1.output["hyper_values"] == m2.output["hyper_values"]
+        assert float(m1.training_metrics.auc) == \
+            pytest.approx(float(m2.training_metrics.auc), abs=1e-7)
