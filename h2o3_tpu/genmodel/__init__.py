@@ -4,8 +4,9 @@ MOJO writers/readers, standalone scorers, TreeSHAP)."""
 from h2o3_tpu.genmodel.codegen import download_pojo, generate_pojo
 from h2o3_tpu.genmodel.generic import Generic, GenericModel, import_mojo
 from h2o3_tpu.genmodel.mojo import MojoModel, write_mojo
+from h2o3_tpu.genmodel.pipeline import MojoPipeline, Transform
 from h2o3_tpu.genmodel.treeshap import ensemble_contributions, tree_shap
 
-__all__ = ["Generic", "GenericModel", "MojoModel", "download_pojo",
-           "ensemble_contributions", "generate_pojo", "import_mojo",
-           "tree_shap", "write_mojo"]
+__all__ = ["Generic", "GenericModel", "MojoModel", "MojoPipeline",
+           "Transform", "download_pojo", "ensemble_contributions",
+           "generate_pojo", "import_mojo", "tree_shap", "write_mojo"]

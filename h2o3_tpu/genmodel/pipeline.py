@@ -1,0 +1,291 @@
+"""MOJO pipeline transform runtime — feature engineering that ships WITH a
+scoring artifact and runs before the model scores.
+
+Reference: ``h2o-genmodel-extensions/mojo-pipeline/.../transformers/*.java``
+(MathUnaryTransform, MathBinaryTransform, StringUnaryTransform,
+StringGrepTransform, StringSplitTransform, StringPropertiesUnary/Binary,
+TimeUnaryTransform, ToNumericConversion, ToStringConversion) and the
+``MojoPipelineBuilder`` assembly (``hex/genmodel/MojoPipelineBuilder.java``).
+The reference executes each transform as a per-row Java loop over MojoFrame
+columns; here numeric transforms are vectorized device ops (XLA fuses the
+whole transform chain into the scoring program's input processing) and
+string transforms run on the host payloads (string columns are host-resident
+by design, ``frame/vec.py:93``).
+
+A ``MojoPipeline`` is an ordered list of ``Transform`` steps plus a final
+model; ``save``/``load`` round-trips through a json spec inside the MOJO v2
+zip so pipelines are portable artifacts like the reference's.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import zipfile
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.frame.vec import Vec
+
+__all__ = ["Transform", "MojoPipeline", "MATH_UNARY", "MATH_BINARY",
+           "STRING_UNARY"]
+
+# -- op tables (names match the reference factories) -------------------------
+
+MATH_UNARY = {
+    "abs": jnp.abs, "acos": jnp.arccos, "acosh": jnp.arccosh,
+    "asin": jnp.arcsin, "asinh": jnp.arcsinh, "atan": jnp.arctan,
+    "atanh": jnp.arctanh, "ceiling": jnp.ceil, "cos": jnp.cos,
+    "cosh": jnp.cosh, "cospi": lambda x: jnp.cos(jnp.pi * x),
+    "digamma": lambda x: _scipy_host(x, "digamma"),
+    "exp": jnp.exp, "expm1": jnp.expm1, "floor": jnp.floor,
+    "gamma": lambda x: _scipy_host(x, "gamma"),
+    "lgamma": lambda x: _scipy_host(x, "gammaln"),
+    "log": jnp.log, "log10": jnp.log10, "log1p": jnp.log1p,
+    "log2": jnp.log2, "round": jnp.round, "sign": jnp.sign,
+    "signif": jnp.round,                      # signif(x, digits) via params
+    "sin": jnp.sin, "sinh": jnp.sinh, "sinpi": lambda x: jnp.sin(jnp.pi * x),
+    "sqrt": jnp.sqrt, "tan": jnp.tan, "tanh": jnp.tanh,
+    "tanpi": lambda x: jnp.tan(jnp.pi * x),
+    "trigamma": lambda x: _scipy_host(x, "polygamma1"),
+    "trunc": jnp.trunc, "none": lambda x: x,
+}
+
+MATH_BINARY = {
+    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
+    "%": jnp.mod, "^": jnp.power, "intDiv": lambda a, b: jnp.floor_divide(a, b),
+    "==": lambda a, b: (a == b).astype(jnp.float32),
+    "!=": lambda a, b: (a != b).astype(jnp.float32),
+    "<": lambda a, b: (a < b).astype(jnp.float32),
+    "<=": lambda a, b: (a <= b).astype(jnp.float32),
+    ">": lambda a, b: (a > b).astype(jnp.float32),
+    ">=": lambda a, b: (a >= b).astype(jnp.float32),
+    "min": jnp.minimum, "max": jnp.maximum,
+}
+
+STRING_UNARY = {
+    "toupper": lambda s: s.upper(), "tolower": lambda s: s.lower(),
+    "trim": lambda s: s.strip(), "lstrip": lambda s: s.lstrip(),
+    "rstrip": lambda s: s.rstrip(),
+}
+
+STRING_PROPS = {
+    "length": lambda s: float(len(s)),
+    "num_words": lambda s: float(len(s.split())),
+    "entropy": lambda s: _entropy(s),
+}
+
+
+def _entropy(s: str) -> float:
+    if not s:
+        return 0.0
+    from collections import Counter
+    n = len(s)
+    return float(-sum((c / n) * np.log2(c / n)
+                      for c in Counter(s).values()))
+
+
+def _scipy_host(x, fn: str):
+    """Special functions absent from jnp: host round-trip via scipy (these
+    are rare pipeline ops; the common ops stay fused on device)."""
+    import scipy.special as sp
+    import jax
+    a = np.asarray(jax.device_get(x), np.float64)
+    f = (lambda v: sp.polygamma(1, v)) if fn == "polygamma1" \
+        else getattr(sp, fn)
+    return jnp.asarray(f(a).astype(np.float32))
+
+
+TIME_UNARY = ("year", "month", "day", "hour", "minute", "second",
+              "dayOfWeek", "week")
+
+
+class Transform:
+    """One pipeline step: op over input column(s) into an output column.
+
+    kinds: math_unary / math_binary / string_unary / string_prop /
+    string_grep / string_split / time_unary / to_numeric / to_string.
+    """
+
+    def __init__(self, kind: str, op: str, inputs: list[str], output: str,
+                 params: dict | None = None):
+        self.kind = kind
+        self.op = op
+        self.inputs = list(inputs)
+        self.output = output
+        self.params = dict(params or {})
+        self._check()
+
+    def _check(self) -> None:
+        tables = {"math_unary": MATH_UNARY, "math_binary": MATH_BINARY,
+                  "string_unary": STRING_UNARY, "string_prop": STRING_PROPS}
+        if self.kind in tables and self.op not in tables[self.kind]:
+            raise ValueError(f"unsupported {self.kind} op {self.op!r}; "
+                             f"have {sorted(tables[self.kind])}")
+        if self.kind == "time_unary" and self.op not in TIME_UNARY:
+            raise ValueError(f"unsupported time op {self.op!r}")
+
+    # -- application ---------------------------------------------------------
+
+    def apply(self, frame: Frame) -> Frame:
+        out = Frame(list(frame.names), list(frame.vecs))
+        if self.kind == "math_unary":
+            v = frame.vec(self.inputs[0])
+            y = MATH_UNARY[self.op](v.as_float())
+            if self.op == "signif":
+                digits = int(self.params.get("digits", 6))
+                x = v.as_float()
+                # guard 0: log10(0) -> -inf -> mag inf -> 0*inf = NaN
+                ax = jnp.where(x == 0, 1.0, jnp.abs(x))
+                mag = jnp.power(10.0, digits - 1 - jnp.floor(jnp.log10(ax)))
+                y = jnp.where(x == 0, 0.0, jnp.round(x * mag) / mag)
+            vec = Vec.from_device(y.astype(jnp.float32), frame.nrows,
+                                  VecType.NUM)
+        elif self.kind == "math_binary":
+            a = frame.vec(self.inputs[0]).as_float()
+            b = (frame.vec(self.inputs[1]).as_float()
+                 if len(self.inputs) > 1 else
+                 jnp.float32(self.params["constant"]))
+            if self.params.get("reverse"):      # constant OP column
+                a, b = b, a
+            y = MATH_BINARY[self.op](a, b)
+            vec = Vec.from_device(y.astype(jnp.float32), frame.nrows,
+                                  VecType.NUM)
+        elif self.kind in ("string_unary", "string_prop", "string_grep",
+                           "to_numeric", "to_string", "string_split"):
+            vec = self._apply_string(frame)
+        elif self.kind == "time_unary":
+            from h2o3_tpu.rapids import timeops
+            fn = {"dayOfWeek": "day_of_week"}.get(self.op, self.op)
+            vec = getattr(timeops, fn)(frame.vec(self.inputs[0]))
+        else:
+            raise ValueError(f"unknown transform kind {self.kind!r}")
+        if self.kind == "string_split":
+            # split emits N columns: output, output.1, ...
+            for i, v in enumerate(vec):
+                out.add(self.output if i == 0 else f"{self.output}.{i}", v)
+        else:
+            out.add(self.output, vec)
+        return out
+
+    def _apply_string(self, frame: Frame):
+        v = frame.vec(self.inputs[0])
+        vals = (v.labels() if v.is_categorical else
+                v.host_values[: frame.nrows] if v.type is VecType.STR else
+                [None if np.isnan(x) else _numstr(x) for x in v.to_numpy()])
+        if self.kind == "string_unary":
+            f = STRING_UNARY[self.op]
+            return Vec.from_numpy(np.array(
+                [None if s is None else f(str(s)) for s in vals],
+                dtype=object), type=VecType.STR)
+        if self.kind == "string_prop":
+            f = STRING_PROPS[self.op]
+            return Vec.from_numpy(np.float32(
+                [np.nan if s is None else f(str(s)) for s in vals]))
+        if self.kind == "string_grep":
+            pat = re.compile(self.params["regex"])
+            inv = bool(self.params.get("invert"))
+            return Vec.from_numpy(np.float32(
+                [np.nan if s is None else
+                 float(bool(pat.search(str(s))) != inv) for s in vals]))
+        if self.kind == "string_split":
+            pat = self.params.get("pattern", r"\s+")
+            parts = [([] if s is None else re.split(pat, str(s)))
+                     for s in vals]
+            width = max((len(p) for p in parts), default=1)
+            cols = []
+            for i in range(width):
+                cols.append(Vec.from_numpy(np.array(
+                    [p[i] if i < len(p) else None for p in parts],
+                    dtype=object), type=VecType.STR))
+            return cols
+        if self.kind == "to_numeric":
+            def conv(s):
+                try:
+                    return float(s)
+                except (TypeError, ValueError):
+                    return np.nan
+            return Vec.from_numpy(np.float32([conv(s) for s in vals]))
+        # to_string
+        return Vec.from_numpy(np.array(
+            [None if s is None else str(s) for s in vals], dtype=object),
+            type=VecType.STR)
+
+    def spec(self) -> dict:
+        return dict(kind=self.kind, op=self.op, inputs=self.inputs,
+                    output=self.output, params=self.params)
+
+    @staticmethod
+    def from_spec(d: dict) -> "Transform":
+        return Transform(d["kind"], d["op"], d["inputs"], d["output"],
+                         d.get("params"))
+
+
+def _numstr(x: float) -> str:
+    return str(int(x)) if float(x).is_integer() else repr(float(x))
+
+
+class MojoPipeline:
+    """Transforms + final model as ONE portable scoring artifact
+    (reference: ``MojoPipelineBuilder`` assembling main + generated-column
+    sub-mojos)."""
+
+    def __init__(self, transforms: list[Transform], model=None):
+        self.transforms = list(transforms)
+        self.model = model
+
+    def transform(self, frame: Frame) -> Frame:
+        for t in self.transforms:
+            frame = t.apply(frame)
+        return frame
+
+    def predict(self, frame: Frame) -> Frame:
+        fr = self.transform(frame)
+        if self.model is None:
+            return fr
+        return self.model.predict(fr)
+
+    # -- artifact round-trip -------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Zip with pipeline.json (+ the model's own MOJO v2 when present)."""
+        import io
+        import os
+        spec = dict(format="h2o3_tpu/mojo-pipeline", version=1,
+                    transforms=[t.spec() for t in self.transforms])
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("pipeline.json", json.dumps(spec, indent=1))
+            if self.model is not None:
+                from h2o3_tpu.genmodel.mojo import write_mojo
+                tmp = path + ".model.tmp"
+                write_mojo(self.model, tmp)
+                z.write(tmp, "model.mojo")
+                os.unlink(tmp)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "MojoPipeline":
+        import io
+        with zipfile.ZipFile(path) as z:
+            spec = json.loads(z.read("pipeline.json"))
+            if spec.get("format") != "h2o3_tpu/mojo-pipeline":
+                raise ValueError(f"{path} is not a mojo-pipeline artifact")
+            model = None
+            if "model.mojo" in z.namelist():
+                import os
+                import tempfile
+                from h2o3_tpu.genmodel.mojo import MojoModel
+                with tempfile.NamedTemporaryFile(suffix=".zip",
+                                                 delete=False) as f:
+                    f.write(z.read("model.mojo"))
+                    tmp = f.name
+                try:
+                    model = MojoModel.load(tmp)
+                finally:
+                    os.unlink(tmp)
+        return MojoPipeline([Transform.from_spec(t)
+                             for t in spec["transforms"]], model)

@@ -6,14 +6,16 @@ vocab build ``WordCountTask``), plus the h2o-py surface
 (``H2OWord2vecEstimator``: train on a string column, ``find_synonyms``,
 ``transform(aggregate_method="AVERAGE")``).
 
-TPU-native redesign: hierarchical softmax is a per-word variable-length tree
-walk — hostile to fixed-shape compilation — so training uses **skip-gram with
-negative sampling**: every step is a [batch] gather of center/context/negative
+TPU-native redesign: the default objective is **skip-gram with negative
+sampling** — every step is a [batch] gather of center/context/negative
 embedding rows, a batched dot product, and a scatter-add update, fused by XLA
-into MXU-friendly programs (same estimator family; Mikolov et al. report SGNS
-quality ≥ HS at lower cost). The window-pair generation is a one-time host
-pass over the (host-resident) string column; the SGD epochs run entirely on
-device via ``lax.scan`` over shuffled minibatches.
+into MXU-friendly programs (Mikolov et al. report SGNS quality ≥ HS at lower
+cost). The reference's **hierarchical softmax** is also available
+(``objective="hsm"``): the per-word variable-length Huffman walk is made
+fixed-shape by padding every path to the tree depth with a mask, so the HSM
+update compiles to the same single fused ``lax.scan``. Window-pair
+generation is a one-time host pass over the (host-resident) string column;
+the SGD epochs run entirely on device over shuffled minibatches.
 """
 
 from __future__ import annotations
@@ -62,6 +64,77 @@ def _sgns_epoch(Wc, Wx, centers, contexts, noise_cdf, key, lr, n_neg: int):
 
     (Wc, Wx, _), _ = jax.lax.scan(step, (Wc, Wx, key), (centers, contexts))
     return Wc, Wx
+
+
+def _huffman_paths(freqs: np.ndarray):
+    """Huffman tree over the vocab (reference ``buildHuffmanBinaryTree``):
+    per word, the inner-node index path and the binary code, padded to the
+    tree's max depth so the HSM update has a fixed shape.
+
+    Returns (nodes [V, L] int32, codes [V, L] f32, mask [V, L] f32)."""
+    import heapq
+
+    V = len(freqs)
+    heap = [(float(f), i) for i, f in enumerate(freqs)]
+    heapq.heapify(heap)
+    parent = np.full(2 * V - 1, -1, np.int64)
+    bit = np.zeros(2 * V - 1, np.int8)
+    nxt = V
+    while len(heap) > 1:
+        f1, a = heapq.heappop(heap)
+        f2, b = heapq.heappop(heap)
+        parent[a], parent[b] = nxt, nxt
+        bit[b] = 1
+        heapq.heappush(heap, (f1 + f2, nxt))
+        nxt += 1
+    paths, codes = [], []
+    for w in range(V):
+        p, c, n = [], [], w
+        while parent[n] >= 0:
+            p.append(parent[n] - V)       # inner-node id in [0, V-1)
+            c.append(float(bit[n]))
+            n = parent[n]
+        paths.append(p[::-1])
+        codes.append(c[::-1])
+    L = max(len(p) for p in paths)
+    nodes = np.zeros((V, L), np.int32)
+    code = np.zeros((V, L), np.float32)
+    mask = np.zeros((V, L), np.float32)
+    for w in range(V):
+        k = len(paths[w])
+        nodes[w, :k] = paths[w]
+        code[w, :k] = codes[w]
+        mask[w, :k] = 1.0
+    return nodes, code, mask
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _hsm_epoch(Wc, Wn, centers, contexts, nodes, codes, mask, lr):
+    """One epoch of skip-gram + hierarchical softmax SGD — the reference's
+    objective (``WordVectorTrainer.java:114-168``), reshaped for XLA: each
+    (center, context) pair updates the context word's Huffman path (padded
+    to fixed length L, masked), so the whole epoch is one fused lax.scan.
+
+    Wc: [V, D] word embeddings; Wn: [V-1, D] inner-node vectors."""
+
+    def step(carry, batch):
+        Wc, Wn = carry
+        c, x = batch
+        pn = nodes[x]                               # [B, L]
+        pc = codes[x]                               # [B, L]
+        pm = mask[x]                                # [B, L]
+        vc = Wc[c]                                  # [B, D]
+        un = Wn[pn]                                 # [B, L, D]
+        s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", vc, un))
+        g = (s - pc) * pm                           # d/dθ of -log p(code)
+        d_vc = jnp.einsum("bl,bld->bd", g, un)
+        d_un = g[..., None] * vc[:, None, :]
+        Wc = Wc.at[c].add(-lr * d_vc)
+        Wn = Wn.at[pn.reshape(-1)].add(-lr * d_un.reshape(-1, Wc.shape[1]))
+        return (Wc, Wn), None
+
+    (Wc, Wn), _ = jax.lax.scan(step, (Wc, Wn), (centers, contexts))
+    return Wc, Wn
 
 
 class Word2VecModel(Model):
@@ -151,9 +224,26 @@ class Word2Vec(ModelBuilder):
             epochs=5,
             negative_samples=5,
             mini_batch_size=1024,
+            word_model="SkipGram",
+            # objective: "sgns" (default; see module docstring) or "hsm"
+            # (the reference's hierarchical softmax, Huffman paths padded to
+            # fixed length so the update still compiles to one fused scan)
+            objective="sgns",
+            # frame (or DKV key) holding an external word->vector table:
+            # col 0 = STR words, cols 1..D = numeric components (reference
+            # Word2Vec.fromPretrainedModel, Word2Vec.java:123-145)
+            pre_trained=None,
         )
 
     def train(self, x=None, y=None, training_frame=None, **kw):
+        pre = self.params.get("pre_trained")
+        if pre is not None:
+            self.job = Job("word2vec-import")
+            self.model = self.job.run(
+                lambda job: self._from_pretrained(pre))
+            if self.job.status == Job.FAILED:
+                raise self.job.exception
+            return self.job.result
         frame = training_frame
         str_cols = [c for c in frame.names if frame.vec(c).type is VecType.STR]
         if not str_cols:
@@ -165,6 +255,51 @@ class Word2Vec(ModelBuilder):
         if self.job.status == Job.FAILED:
             raise self.job.exception
         return self.job.result
+
+    def _from_pretrained(self, pre) -> Word2VecModel:
+        """Wrap an external embedding table as a full Word2VecModel
+        (reference ``convertToModel``/``fromPretrainedModel``,
+        ``Word2Vec.java:112-145``): col 0 STR words, cols 1.. numeric."""
+        from h2o3_tpu.utils.registry import DKV
+        fr = pre if isinstance(pre, Frame) else DKV.get(str(pre))
+        if fr is None or fr.ncols < 2:
+            raise ValueError("pre_trained frame needs >= 2 columns "
+                             "(words + vector components)")
+        wv = fr.vecs[0]
+        if wv.type is not VecType.STR and not wv.is_categorical:
+            # reference demands T_STR; a parsed word table may legitimately
+            # arrive categorical — accept its labels as the words
+            raise ValueError("pre_trained column 0 must be the STR words "
+                             f"column, got {wv.type}")
+        bad = [n for n, v in zip(fr.names[1:], fr.vecs[1:])
+               if not v.is_numeric]
+        if bad:
+            raise ValueError(f"non-numeric vector components: {bad}")
+        # reference sets vec_size from the frame (fromPretrainedModel); an
+        # explicit mismatching vec_size is the driver's IllegalState. The
+        # builder default (100) is indistinguishable from a user-passed 100,
+        # so 100 is accepted and vec_size is overwritten from the frame.
+        want = int(self.params.get("vec_size") or 0)
+        if want not in (0, 100, fr.ncols - 1):
+            raise ValueError(
+                f"pre-trained frame has {fr.ncols - 1} components, "
+                f"vec_size={want} specified")
+        self.params["vec_size"] = fr.ncols - 1
+        vocab = [str(w) for w in
+                 (wv.labels() if wv.is_categorical else
+                  wv.host_values[: fr.nrows])]
+        W = np.stack([np.asarray(v.to_numpy(), np.float32)[: fr.nrows]
+                      for v in fr.vecs[1:]], 1)
+        model = Word2VecModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=self.params, data_info=None, response_column=None,
+            response_domain=None,
+            output=dict(vectors=jnp.asarray(W), vocab=vocab,
+                        word_index={w: i for i, w in enumerate(vocab)},
+                        vec_size=W.shape[1], epochs_run=0, n_pairs=0,
+                        pre_trained=True))
+        DKV.put(model.key, model)
+        return model
 
     def _fit(self, job, frame, x, y, weights):
         return self._fit_words(job, frame)
@@ -219,9 +354,18 @@ class Word2Vec(ModelBuilder):
         key = jax.random.PRNGKey(int(rng.integers(0, 2**31)))
         Wc = (jax.random.uniform(key, (V, D), jnp.float32) - 0.5) / D
         Wx = jnp.zeros((V, D), jnp.float32)
-        # unigram^0.75 noise distribution for negative sampling
-        freq = np.array([counts[w] for w in vocab], np.float64) ** 0.75
-        noise_cdf = jnp.asarray(np.cumsum(freq / freq.sum()), jnp.float32)
+        objective = str(p.get("objective", "sgns")).lower()
+        if objective == "hsm":
+            # reference objective: Huffman-coded hierarchical softmax
+            word_freq = np.array([counts[w] for w in vocab], np.float64)
+            hn, hc, hm = _huffman_paths(word_freq)
+            hn_d, hc_d, hm_d = (jnp.asarray(hn), jnp.asarray(hc),
+                                jnp.asarray(hm))
+            Wn = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        else:
+            # unigram^0.75 noise distribution for negative sampling
+            freq = np.array([counts[w] for w in vocab], np.float64) ** 0.75
+            noise_cdf = jnp.asarray(np.cumsum(freq / freq.sum()), jnp.float32)
         lr = float(p["init_learning_rate"])
         n_epochs = max(int(p["epochs"]), 1)
         for ep in range(n_epochs):
@@ -232,8 +376,13 @@ class Word2Vec(ModelBuilder):
             key, ek = jax.random.split(key)
             # linear LR decay per epoch (reference: alpha annealing)
             lr_e = lr * max(1.0 - ep / n_epochs, 1e-4 / lr if lr > 0 else 0.0)
-            Wc, Wx = _sgns_epoch(Wc, Wx, cb, xb, noise_cdf, ek, jnp.float32(lr_e),
-                                 int(p["negative_samples"]))
+            if objective == "hsm":
+                Wc, Wn = _hsm_epoch(Wc, Wn, cb, xb, hn_d, hc_d, hm_d,
+                                    jnp.float32(lr_e))
+            else:
+                Wc, Wx = _sgns_epoch(Wc, Wx, cb, xb, noise_cdf, ek,
+                                     jnp.float32(lr_e),
+                                     int(p["negative_samples"]))
             job.update((ep + 1) / n_epochs, f"epoch {ep + 1}/{n_epochs}")
 
         model = Word2VecModel(

@@ -1,0 +1,63 @@
+# Drive the REAL h2o-r package (the reference's 99 kLoC R client) against a
+# running h2o3_tpu server — the R-side analog of tests/scripts/h2o_py_flow.py.
+#
+# Usage: Rscript h2o_r_flow.R <server_url> <train_csv> <h2o_r_package_dir>
+#
+# Exit codes: 0 = flow green; 42 = R dependencies (RCurl/jsonlite) or the
+# package install are unavailable on this host (callers treat as SKIP);
+# anything else = real failure.
+#
+# Reference entry points exercised: h2o-r/h2o-package/R/connection.R
+# (h2o.connect), frame.R (h2o.importFile/as.data.frame), gbm.R, glm.R,
+# models.R (predict/h2o.performance/h2o.auc).
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 3) {
+  cat("need <server_url> <train_csv> <h2o_r_dir>\n"); quit(status = 2)
+}
+url <- args[[1]]; csv <- args[[2]]; pkg_dir <- args[[3]]
+
+have <- function(p) requireNamespace(p, quietly = TRUE)
+if (!have("RCurl") || !have("jsonlite")) {
+  cat("SKIP: RCurl/jsonlite not installed\n"); quit(status = 42)
+}
+
+# ALWAYS install the reference checkout into a private lib (never trust a
+# pre-installed CRAN h2o — this test proves THE reference package works)
+lib <- file.path(tempdir(), "h2o_r_lib")
+dir.create(lib, showWarnings = FALSE)
+rc <- system2("R", c("CMD", "INSTALL", "--no-docs", "--no-multiarch",
+                     paste0("--library=", lib), pkg_dir),
+              stdout = TRUE, stderr = TRUE)
+if (!is.null(attr(rc, "status")) && attr(rc, "status") != 0) {
+  cat("SKIP: R CMD INSTALL of h2o-r failed on this host\n")
+  cat(tail(rc, 20), sep = "\n"); quit(status = 42)
+}
+.libPaths(c(lib, .libPaths()))
+suppressMessages(library(h2o, lib.loc = lib))
+
+parts <- regmatches(url, regexec("^https?://([^:/]+):([0-9]+)", url))[[1]]
+conn <- h2o.connect(ip = parts[[2]], port = as.integer(parts[[3]]))
+
+fr <- h2o.importFile(csv, destination_frame = "r_train")
+stopifnot(nrow(fr) > 0)
+fr$y <- as.factor(fr$y)
+
+gbm <- h2o.gbm(y = "y", training_frame = fr, ntrees = 5, max_depth = 3,
+               seed = 1)
+perf <- h2o.performance(gbm, train = TRUE)
+auc <- h2o.auc(perf)
+cat(sprintf("GBM train AUC: %.4f\n", auc))
+stopifnot(is.finite(auc), auc > 0.5)
+
+pred <- h2o.predict(gbm, fr)
+stopifnot(nrow(pred) == nrow(fr))
+
+glm <- h2o.glm(y = "y", training_frame = fr, family = "binomial",
+               lambda = 1e-4)
+gperf <- h2o.performance(glm, train = TRUE)
+cat(sprintf("GLM train AUC: %.4f\n", h2o.auc(gperf)))
+stopifnot(h2o.auc(gperf) > 0.5)
+
+cat("REAL h2o-r flow: OK\n")
+quit(status = 0)

@@ -1,0 +1,99 @@
+"""MOJO pipeline transform runtime (reference:
+``h2o-genmodel-extensions/mojo-pipeline/.../transformers/*.java``)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.genmodel.pipeline import MojoPipeline, Transform
+
+
+@pytest.fixture
+def fr():
+    return Frame.from_arrays({
+        "a": np.float32([1.0, 4.0, 9.0, np.nan]),
+        "b": np.float32([2.0, 2.0, 3.0, 4.0]),
+        "s": np.array(["  Hello World ", "foo", None, "a b c"], dtype=object),
+        "n": np.array(["1.5", "x", "3", None], dtype=object),
+    }, types={"s": VecType.STR, "n": VecType.STR})
+
+
+def test_math_unary_and_binary(fr):
+    p = MojoPipeline([
+        Transform("math_unary", "sqrt", ["a"], "sq"),
+        Transform("math_binary", "*", ["sq", "b"], "prod"),
+        Transform("math_binary", "+", ["a"], "plus5",
+                  params={"constant": 5.0}),
+    ])
+    out = p.transform(fr)
+    np.testing.assert_allclose(out.vec("sq").to_numpy()[:3], [1, 2, 3])
+    np.testing.assert_allclose(out.vec("prod").to_numpy()[:3], [2, 4, 9])
+    np.testing.assert_allclose(out.vec("plus5").to_numpy()[:3], [6, 9, 14])
+    assert np.isnan(out.vec("sq").to_numpy()[3])
+
+
+def test_string_transforms(fr):
+    p = MojoPipeline([
+        Transform("string_unary", "trim", ["s"], "t"),
+        Transform("string_unary", "tolower", ["t"], "l"),
+        Transform("string_prop", "length", ["l"], "len"),
+        Transform("string_grep", "grep", ["s"], "has_o",
+                  params={"regex": "o"}),
+        Transform("to_numeric", "as.numeric", ["n"], "num"),
+    ])
+    out = p.transform(fr)
+    assert out.vec("l").host_values[0] == "hello world"
+    np.testing.assert_allclose(out.vec("len").to_numpy()[:2], [11, 3])
+    np.testing.assert_allclose(out.vec("has_o").to_numpy()[[0, 1, 3]],
+                               [1, 1, 0])
+    got = out.vec("num").to_numpy()
+    assert got[0] == pytest.approx(1.5) and got[2] == 3.0
+    assert np.isnan(got[1]) and np.isnan(got[3])
+
+
+def test_string_split(fr):
+    p = MojoPipeline([Transform("string_split", "split", ["s"], "w",
+                                params={"pattern": r"\s+"})])
+    out = p.transform(fr)
+    assert out.vec("w.1").host_values[3] == "b"
+
+
+def test_time_unary():
+    ts = np.array(["2024-02-29T13:45:30", "1999-12-31T23:59:59"],
+                  dtype="datetime64[ms]")
+    fr = Frame.from_arrays({"t": ts}, types={"t": VecType.TIME})
+    out = MojoPipeline([Transform("time_unary", "year", ["t"], "yr"),
+                        Transform("time_unary", "dayOfWeek", ["t"], "dw"),
+                        ]).transform(fr)
+    assert out.vec("yr").to_numpy().tolist() == [2024.0, 1999.0]
+
+
+def test_pipeline_artifact_roundtrip(fr, tmp_path, rng):
+    from h2o3_tpu.models.gbm import GBM
+
+    n = 300
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    tf = Frame.from_arrays({
+        "x0": x[:, 0], "x1": x[:, 1],
+        "y": np.where(x[:, 0] * x[:, 0] + x[:, 1] > 1, "t", "f")})
+    pre = [Transform("math_unary", "abs", ["x0"], "x0_abs"),
+           Transform("math_binary", "*", ["x0", "x0"], "x0_sq")]
+    train_fr = MojoPipeline(pre).transform(tf)
+    m = GBM(ntrees=4, max_depth=3, seed=2).train(
+        y="y", training_frame=train_fr)
+    pipe = MojoPipeline(pre, model=m)
+    p1 = pipe.predict(tf)
+
+    path = str(tmp_path / "pipe.zip")
+    pipe.save(path)
+    loaded = MojoPipeline.load(path)
+    assert len(loaded.transforms) == 2
+    p2 = loaded.predict(tf)
+    np.testing.assert_allclose(p2.vec("pt").to_numpy(),
+                               p1.vec("pt").to_numpy(), rtol=0, atol=1e-6)
+
+
+def test_unknown_op_rejected():
+    with pytest.raises(ValueError, match="unsupported"):
+        Transform("math_unary", "frobnicate", ["a"], "out")

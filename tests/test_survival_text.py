@@ -188,3 +188,44 @@ def test_coxph_baseline_hazard_and_survival(rng):
     assert ((s >= 0) & (s <= 1)).all()
     # higher-risk rows (larger x) must have LOWER survival
     assert s[x > 1.0].mean() < s[x < -1.0].mean()
+
+
+def test_word2vec_hsm_objective_learns_topics(rng):
+    """The reference's hierarchical-softmax objective (Word2Vec.java HSM;
+    Huffman paths padded to fixed length for the fused scan)."""
+    f = _toy_corpus(rng)
+    m = Word2Vec(vec_size=16, min_word_freq=2, epochs=25, window_size=3,
+                 objective="hsm", seed=11).train(training_frame=f)
+    syn = m.find_synonyms("car", 3)
+    assert len(syn) == 3
+    assert set(syn) <= {"bus", "road", "wheel", "fuel"}
+
+
+def test_word2vec_pre_trained_import(rng):
+    """fromPretrainedModel (Word2Vec.java:123-145): external word->vector
+    frame becomes a full model (synonyms + transform)."""
+    f = _toy_corpus(rng, n_sent=80)
+    trained = Word2Vec(vec_size=8, min_word_freq=2, epochs=5, seed=3,
+                       ).train(training_frame=f)
+    table = trained.to_frame()            # Word | V1..V8
+
+    m = Word2Vec(pre_trained=table).train()
+    assert m.output["vec_size"] == 8
+    assert m.output["vocab"] == trained.output["vocab"]
+    np.testing.assert_allclose(
+        np.asarray(m.output["vectors"]),
+        np.asarray(trained.output["vectors"]), rtol=0, atol=1e-6)
+    # transform through the imported model matches the original
+    d1 = trained.transform(f, aggregate_method="AVERAGE")
+    d2 = m.transform(f, aggregate_method="AVERAGE")
+    np.testing.assert_allclose(d2.vec("C1").to_numpy(),
+                               d1.vec("C1").to_numpy(), rtol=0, atol=1e-6)
+
+
+def test_word2vec_pre_trained_validation(rng):
+    import pytest
+
+    from h2o3_tpu.frame.frame import Frame as F
+    bad = F.from_arrays({"a": np.float32([1, 2]), "b": np.float32([3, 4])})
+    with pytest.raises(ValueError, match="STR words"):
+        Word2Vec(pre_trained=bad).train()
