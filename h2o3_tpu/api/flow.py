@@ -1,259 +1,385 @@
-"""Flow — the built-in web console served from the node.
+"""Flow — the built-in notebook web console served from the node.
 
 Reference: ``h2o-web/`` packages the Flow notebook (CoffeeScript app served
-by the node at ``/``; ``h2o-web/README.md:1-8``): assist-driven cells for
-importFiles/parse/buildModel/predict/inspect. The TPU build ships a
-dependency-free single-page console over the same V3 REST surface with the
-same workflow cells — import → frames (+per-column summaries) → build model
-(algo/params form) → job polling → model inspection (metrics) → predict →
-Rapids console — rendered client-side from ``/3/*`` JSON.
+by the node at ``/``; ``h2o-web/README.md:1-8``): an assist-driven CELL
+notebook — each cell holds a command (importFiles/getFrames/buildModel/
+predict/plot/…), runs against the V3 REST surface, and renders its output
+inline; notebooks save/load; help is a first-class pane.
+
+The TPU build ships the same product shape dependency-free: a single-page
+cell notebook over ``/3/*``/``/99/*`` JSON with
+
+- **assist**: one click inserts a template cell per workflow verb
+  (reference ``assist`` cells);
+- **commands**: ``importFiles``, ``getFrames``, ``getFrameSummary``,
+  ``buildModel``, ``getModels``, ``getModel``, ``predict``, ``getJobs``,
+  ``rapids``, ``plot varimp|scoring|roc``, ``md`` (markdown-lite notes);
+- **inline graphs**: dependency-free SVG — variable-importance bars,
+  scoring-history lines, ROC curve from the thresholds table (reference
+  Flow's vega plots);
+- **help pane**: per-command usage + the live route list from the server;
+- **notebooks**: cells persist via NodePersistentStorage (reference Flow
+  save/load), with v1 console documents still loadable.
 """
 
-FLOW_HTML = """<!DOCTYPE html>
+FLOW_HTML = r"""<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>h2o3-tpu Flow</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1c2733}
  header{background:#1c2733;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:baseline}
  header h1{font-size:16px;margin:0}
  header span{color:#9db2c4;font-size:12px}
- main{padding:16px 20px;display:grid;grid-template-columns:1fr 1fr;gap:16px}
- section{background:#fff;border:1px solid #dde4ea;border-radius:6px;padding:12px}
- h2{font-size:13px;text-transform:uppercase;letter-spacing:.06em;color:#5a6b7b;margin:0 0 8px}
+ #wrap{display:grid;grid-template-columns:minmax(0,1fr) 300px;gap:16px;padding:16px 20px}
+ #nb{min-width:0}
+ .cell{background:#fff;border:1px solid #dde4ea;border-left:4px solid #2f6fed;border-radius:6px;margin:0 0 10px;padding:8px}
+ .cell.md{border-left-color:#8a63c9}
+ .cell textarea{width:100%;border:0;resize:vertical;font:12px/1.5 ui-monospace,monospace;outline:none;background:#fbfcfd;min-height:2.2em;box-sizing:border-box}
+ .cellbar{display:flex;gap:6px;align-items:center;margin-bottom:4px}
+ .out{margin-top:6px;font-size:12px;overflow:auto}
+ aside{font-size:12px}
+ aside section{background:#fff;border:1px solid #dde4ea;border-radius:6px;padding:10px;margin-bottom:12px}
+ h2{font-size:12px;text-transform:uppercase;letter-spacing:.06em;color:#5a6b7b;margin:0 0 8px}
  table{width:100%;border-collapse:collapse;font-size:12px}
- td,th{text-align:left;padding:4px 6px;border-bottom:1px solid #eef2f5}
+ td,th{text-align:left;padding:3px 6px;border-bottom:1px solid #eef2f5}
  th{color:#5a6b7b;font-weight:600}
- .wide{grid-column:1/3}
- input[type=text],select{padding:6px;border:1px solid #cfd8e0;border-radius:4px;font-size:13px}
- input[type=text]{width:60%}
- button{padding:6px 12px;border:0;border-radius:4px;background:#2f6fed;color:#fff;cursor:pointer;font-size:13px}
+ button{padding:4px 10px;border:0;border-radius:4px;background:#2f6fed;color:#fff;cursor:pointer;font-size:12px}
  button.small{padding:2px 8px;font-size:11px;background:#5a6b7b}
- pre{background:#f4f6f8;padding:8px;border-radius:4px;overflow:auto;max-height:240px;font-size:12px}
+ button.ghost{background:#e8eef7;color:#2f6fed}
+ pre{background:#f4f6f8;padding:8px;border-radius:4px;overflow:auto;max-height:260px;font-size:11px;margin:4px 0}
  .pill{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px;background:#e7f0e7;color:#2b6a2b}
  .err{color:#b32020}
- .row{display:flex;gap:8px;margin:4px 0;flex-wrap:wrap;align-items:center}
- label{font-size:12px;color:#5a6b7b}
+ .assist button{margin:2px}
+ svg text{font:10px system-ui}
+ .help dt{font-weight:600;margin-top:6px}.help dd{margin:0 0 2px 8px;color:#3f4f5e}
+ a{color:#2f6fed;cursor:pointer}
 </style></head><body>
 <header><h1>h2o3-tpu Flow</h1><span id="cloud">connecting…</span>
- <span style="float:right">
+ <span style="margin-left:auto">
   <input type="text" id="nbname" placeholder="notebook name" style="width:12em">
   <button class="small" onclick="saveFlow()">Save</button>
   <select id="nblist" onchange="loadFlow(this.value)"><option value="">Load…</option></select>
  </span>
 </header>
-<main>
-<section class="wide"><h2>Import / Parse</h2>
- <div class="row">
-  <input type="text" id="path" placeholder="/path/to/data.csv (csv, parquet, orc, arff, svmlight, avro, xlsx)">
-  <input type="text" id="dest" placeholder="destination key (optional)" style="width:20%">
-  <button onclick="importFile()">Import</button>
-  <span id="importmsg"></span>
+<div id="wrap">
+ <div id="nb">
+  <div class="assist" id="assist"></div>
+  <div id="cells"></div>
+  <button class="ghost" onclick="addCell('')">+ cell</button>
  </div>
-</section>
-
-<section><h2>Frames</h2><div id="frames"></div><div id="framedetail"></div></section>
-
-<section><h2>Models</h2><div id="models"></div><div id="modeldetail"></div></section>
-
-<section class="wide"><h2>Build Model</h2>
- <div class="row">
-  <label>algo</label>
-  <select id="algo"><option>gbm</option><option>drf</option><option>glm</option>
-   <option>xgboost</option><option>deeplearning</option><option>kmeans</option>
-   <option>naivebayes</option><option>isolationforest</option></select>
-  <label>training frame</label><select id="trainframe"></select>
-  <label>response</label><select id="ycol"></select>
-  <label>params (k=v, comma sep)</label>
-  <input type="text" id="params" placeholder="ntrees=20, max_depth=5" style="width:30%">
-  <button onclick="buildModel()">Train</button>
-  <span id="trainmsg"></span>
- </div>
- <div id="jobs"></div>
-</section>
-
-<section class="wide"><h2>Predict</h2>
- <div class="row">
-  <label>model</label><select id="pmodel"></select>
-  <label>frame</label><select id="pframe"></select>
-  <button onclick="runPredict()">Predict</button>
-  <span id="predmsg"></span>
- </div>
-</section>
-
-<section class="wide"><h2>Rapids console</h2>
- <div class="row">
-  <input type="text" id="ast" placeholder="(mean (cols frame_key 'col'))" style="width:70%">
-  <button onclick="runRapids()">Eval</button>
- </div>
- <pre id="rapidsout"></pre>
-</section>
-</main>
+ <aside>
+  <section><h2>Frames</h2><div id="frames"></div></section>
+  <section><h2>Models</h2><div id="models"></div></section>
+  <section><h2>Help</h2><div class="help" id="help"></div></section>
+ </aside>
+</div>
 <script>
 const J = (m, p, body) => fetch(p, body ? {method: m,
   headers: {"Content-Type": "application/json"}, body: JSON.stringify(body)}
   : {method: m}).then(r => r.json());
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+  .replace(/>/g,'&gt;').replace(/"/g,'&quot;').replace(/'/g,'&#39;')}
+function qk(k){return /[\s"']/.test(k) ? '"' + String(k).replace(/"/g, '') + '"' : k}
+function cellLink(cmdline, label){
+  return `<a data-cmd="${esc(cmdline)}" onclick="addCell(this.dataset.cmd,1)">${esc(label)}</a>`;
+}
 
-function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;').replace(/"/g,'&quot;').replace(/'/g,'&#39;')}
+// ---------------------------------------------------------------- notebook
+let CELLS = [];   // {input, output(html), kind}
+function renderCells(){
+  const host = document.getElementById("cells");
+  host.innerHTML = "";
+  CELLS.forEach((c, i) => {
+    const d = document.createElement("div");
+    d.className = "cell" + (c.input.trim().startsWith("md ") ? " md" : "");
+    d.innerHTML = `<div class="cellbar">
+      <button onclick="runCell(${i})">Run</button>
+      <button class="small" onclick="moveCell(${i},-1)">↑</button>
+      <button class="small" onclick="moveCell(${i},1)">↓</button>
+      <button class="small" onclick="delCell(${i})">✕</button>
+      <span style="color:#8aa">cell ${i + 1} — shift+enter runs</span></div>`;
+    const ta = document.createElement("textarea");
+    ta.value = c.input;
+    ta.rows = Math.max(1, c.input.split("\n").length);
+    ta.oninput = () => { c.input = ta.value; };
+    ta.onkeydown = e => { if (e.key === "Enter" && e.shiftKey){
+      e.preventDefault(); c.input = ta.value; runCell(i); } };
+    d.appendChild(ta);
+    const out = document.createElement("div");
+    out.className = "out";
+    out.id = "cellout-" + i;
+    out.innerHTML = c.output || "";
+    d.appendChild(out);
+    host.appendChild(d);
+  });
+}
+function addCell(input, run){
+  CELLS.push({input: input || "", output: ""});
+  renderCells();
+  if (run) runCell(CELLS.length - 1);
+}
+function delCell(i){ CELLS.splice(i, 1); renderCells(); }
+function moveCell(i, d){
+  const j = i + d;
+  if (j < 0 || j >= CELLS.length) return;
+  [CELLS[i], CELLS[j]] = [CELLS[j], CELLS[i]];
+  renderCells();
+}
 
+// ------------------------------------------------------------ assist + help
+const ASSIST = [
+  ["importFiles", "importFiles /path/to/data.csv"],
+  ["getFrames", "getFrames"],
+  ["frame summary", "getFrameSummary FRAME_KEY"],
+  ["buildModel", "buildModel gbm {\"training_frame\": \"FRAME\", \"response_column\": \"Y\", \"ntrees\": 20}"],
+  ["getModels", "getModels"],
+  ["getModel", "getModel MODEL_KEY"],
+  ["predict", "predict MODEL_KEY FRAME_KEY"],
+  ["plot varimp", "plot varimp MODEL_KEY"],
+  ["plot scoring", "plot scoring MODEL_KEY"],
+  ["plot roc", "plot roc MODEL_KEY"],
+  ["remove", "remove KEY"],
+  ["getJobs", "getJobs"],
+  ["rapids", "rapids (mean (cols FRAME 'col'))"],
+  ["note", "md ## notes\nanything after 'md ' renders as a note"],
+];
+const HELP = {
+  importFiles: "importFiles &lt;path&gt; [dest_key] — parse csv/parquet/orc/arff/svmlight/avro/xlsx into a frame",
+  getFrames: "getFrames — list frames in the DKV",
+  getFrameSummary: "getFrameSummary &lt;key&gt; — head rows + per-column mean/sigma/NAs/domain",
+  buildModel: "buildModel &lt;algo&gt; &lt;json params&gt; — algos: gbm drf glm xgboost deeplearning kmeans naivebayes isolationforest …; polls the job to completion",
+  getModels: "getModels — list models",
+  getModel: "getModel &lt;key&gt; — metrics + params",
+  predict: "predict &lt;model&gt; &lt;frame&gt; — score a frame; result key in DKV",
+  plot: "plot varimp|scoring|roc &lt;model&gt; — inline SVG charts from the model payload",
+  remove: "remove &lt;key&gt; — delete a frame/model from the DKV",
+  getJobs: "getJobs — job list with status/progress",
+  rapids: "rapids &lt;ast&gt; — evaluate a Rapids s-expression server-side",
+  md: "md &lt;text&gt; — a note cell (lines starting ## render as headings)",
+};
+function renderAssist(){
+  document.getElementById("assist").innerHTML = "assist: " + ASSIST.map(
+    ([label, tpl]) =>
+      `<button class="ghost" onclick='addCell(${JSON.stringify(tpl)})'>${esc(label)}</button>`
+  ).join("");
+  document.getElementById("help").innerHTML =
+    "<dl>" + Object.entries(HELP).map(([k, v]) =>
+      `<dt>${esc(k)}</dt><dd>${v}</dd>`).join("") + "</dl>" +
+    `<a onclick="routeHelp()">server routes…</a><div id="routes"></div>`;
+}
+async function routeHelp(){
+  try{
+    const r = await fetch("/3/Metadata/endpoints").then(x => x.json());
+    const list = (r.routes || []).map(x =>
+      `<tr><td>${esc(x.http_method)}</td><td>${esc(x.url_pattern)}</td></tr>`).join("");
+    document.getElementById("routes").innerHTML =
+      `<pre style="max-height:200px"><table>${list}</table></pre>`;
+  }catch(e){ document.getElementById("routes").textContent = "unavailable"; }
+}
+
+// -------------------------------------------------------------- SVG charts
+function svgBar(pairs, title){
+  const W = 560, H = 20 * pairs.length + 30, max = Math.max(...pairs.map(p => p[1]), 1e-12);
+  let s = `<svg width="${W}" height="${H}"><text x="4" y="12" font-weight="600">${esc(title)}</text>`;
+  pairs.forEach(([k, v], i) => {
+    const y = 22 + i * 20, w = 360 * v / max;
+    s += `<rect x="130" y="${y}" width="${w}" height="14" fill="#2f6fed" opacity="0.85"/>
+          <text x="126" y="${y + 11}" text-anchor="end">${esc(String(k).slice(0, 18))}</text>
+          <text x="${134 + w}" y="${y + 11}">${(+v).toPrecision(4)}</text>`;
+  });
+  return s + "</svg>";
+}
+function svgLine(series, title, xlab){
+  // series: [{name, xs, ys, color}]
+  const W = 560, H = 220, L = 46, B = 26;
+  let xs = series.flatMap(s => s.xs), ys = series.flatMap(s => s.ys);
+  ys = ys.filter(v => isFinite(v)); xs = xs.filter(v => isFinite(v));
+  if (!xs.length || !ys.length) return "<i>no data</i>";
+  const x0 = Math.min(...xs), x1 = Math.max(...xs), y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const px = x => L + (W - L - 10) * (x1 > x0 ? (x - x0) / (x1 - x0) : 0.5);
+  const py = y => (H - B) - (H - B - 22) * (y1 > y0 ? (y - y0) / (y1 - y0) : 0.5);
+  let s = `<svg width="${W}" height="${H}"><text x="4" y="12" font-weight="600">${esc(title)}</text>
+    <line x1="${L}" y1="${H - B}" x2="${W - 8}" y2="${H - B}" stroke="#9db2c4"/>
+    <line x1="${L}" y1="${H - B}" x2="${L}" y2="18" stroke="#9db2c4"/>
+    <text x="${L}" y="${H - 8}">${(+x0).toPrecision(3)}</text>
+    <text x="${W - 60}" y="${H - 8}">${(+x1).toPrecision(3)} ${esc(xlab || "")}</text>
+    <text x="2" y="${py(y0) + 3}">${(+y0).toPrecision(3)}</text>
+    <text x="2" y="${py(y1) + 3}">${(+y1).toPrecision(3)}</text>`;
+  series.forEach((sr, k) => {
+    const pts = sr.xs.map((x, i) => `${px(x)},${py(sr.ys[i])}`).join(" ");
+    s += `<polyline fill="none" stroke="${sr.color}" stroke-width="1.6" points="${pts}"/>
+          <text x="${L + 6 + 120 * k}" y="24" fill="${sr.color}">${esc(sr.name)}</text>`;
+  });
+  return s + "</svg>";
+}
+function tableCols(t){  // TwoDimTableV3 (column-major data) -> {name: values}
+  const out = {};
+  (t.columns || []).forEach((c, i) => { out[c.name] = t.data[i]; });
+  return out;
+}
+
+// ---------------------------------------------------------------- commands
+async function runCell(i){
+  const c = CELLS[i];
+  const set = html => {
+    c.output = html;
+    const node = document.getElementById("cellout-" + i);
+    if (node) node.innerHTML = html; else renderCells();
+  };
+  const line = c.input.trim();
+  if (!line) return;
+  // tokens honor double quotes so keys with spaces stay addressable:
+  //   getFrameSummary "my frame"
+  const toks = (line.match(/"([^"]*)"|\S+/g) || [])
+    .map(t => t.startsWith('"') ? t.slice(1, -1) : t);
+  const [cmd, ...rest] = toks;
+  try{
+    if (cmd === "md"){
+      const txt = c.input.replace(/^md\s*/, "");
+      set(txt.split("\n").map(l => l.startsWith("##")
+        ? `<h3>${esc(l.replace(/^#+\s*/, ""))}</h3>` : `<p>${esc(l)}</p>`).join(""));
+    } else if (cmd === "importFiles"){
+      set("importing…");
+      const body = {path: rest[0]};
+      if (rest[1]) body.destination_frame = rest[1];
+      const out = await J("POST", "/3/ImportFiles", body);
+      if (out.msg) throw new Error(out.msg);
+      set(`<span class="pill">${esc(out.destination_frames[0])}</span>`);
+      refreshSide();
+    } else if (cmd === "getFrames"){
+      const out = await J("GET", "/3/Frames");
+      set("<table><tr><th>key</th><th>rows</th><th>cols</th></tr>" +
+        out.frames.map(f => `<tr><td>${cellLink("getFrameSummary " + qk(f.frame_id.name), f.frame_id.name)}</td><td>${f.rows}</td><td>${f.column_count}</td><td>${cellLink("remove " + qk(f.frame_id.name), "rm")}</td></tr>`).join("") + "</table>");
+    } else if (cmd === "getFrameSummary"){
+      const out = await J("GET", `/3/Frames/${encodeURIComponent(rest[0])}`);
+      const f = out.frames[0];
+      const head = f.columns.map(cc => `<th>${esc(cc.label)}<br><span style="font-weight:400">${esc(cc.type)}</span></th>`).join("");
+      const n = Math.min(8, Math.max(...f.columns.map(cc => (cc.data || cc.string_data || []).length)));
+      let body = "";
+      for (let r = 0; r < n; r++)
+        body += "<tr>" + f.columns.map(cc => {
+          let v = (cc.string_data || cc.data || [])[r];
+          if (v !== null && cc.domain && cc.data) v = cc.domain[cc.data[r]] ?? v;
+          return `<td>${v == null ? "·" : esc(typeof v === "number" ? +v.toFixed(4) : v)}</td>`;
+        }).join("") + "</tr>";
+      const stats = f.columns.map(cc =>
+        `<tr><td>${esc(cc.label)}</td><td>${cc.mean == null ? "·" : (+cc.mean).toFixed(4)}</td>
+         <td>${cc.sigma == null ? "·" : (+cc.sigma).toFixed(4)}</td><td>${cc.missing_count}</td>
+         <td>${cc.domain ? cc.domain.length + " levels" : "·"}</td></tr>`).join("");
+      set(`<b>${esc(rest[0])}</b> — ${f.rows} rows<table><tr>${head}</tr>${body}</table>
+           <table><tr><th>col</th><th>mean</th><th>sigma</th><th>NAs</th><th>domain</th></tr>${stats}</table>`);
+    } else if (cmd === "buildModel"){
+      const algo = rest[0];
+      const body = JSON.parse(line.slice(line.indexOf("{")));
+      set("submitting…");
+      const out = await J("POST", `/3/ModelBuilders/${algo}`, body);
+      if (out.msg) throw new Error(out.msg);
+      for(;;){
+        const jr = await J("GET", `/3/Jobs/${out.job.key.name}`);
+        const j = jr.jobs[0];
+        set(`${esc(j.status)} ${(100 * j.progress).toFixed(0)}% — ${esc(j.progress_msg || "")}`);
+        if (["DONE", "FAILED", "CANCELLED"].includes(j.status)){
+          if (j.exception) throw new Error(j.exception);
+          set(`<span class="pill">${esc(j.dest.name)}</span> ` +
+              cellLink("getModel " + qk(j.dest.name), "inspect") + " " +
+              cellLink("plot varimp " + qk(j.dest.name), "varimp"));
+          break;
+        }
+        await new Promise(r => setTimeout(r, 500));
+      }
+      refreshSide();
+    } else if (cmd === "getModels"){
+      const out = await J("GET", "/3/Models");
+      set("<table><tr><th>key</th><th>algo</th></tr>" + out.models.map(m =>
+        `<tr><td>${cellLink("getModel " + qk(m.model_id.name), m.model_id.name)}</td><td>${esc(m.algo)}</td><td>${cellLink("remove " + qk(m.model_id.name), "rm")}</td></tr>`).join("") + "</table>");
+    } else if (cmd === "getModel"){
+      const out = await J("GET", `/3/Models/${encodeURIComponent(rest[0])}`);
+      const m = out.models[0];
+      const mm = m.output.training_metrics || {};
+      const metrics = Object.entries(mm).filter(([k, v]) => typeof v === "number")
+        .map(([k, v]) => `<tr><td>${esc(k)}</td><td>${(+v).toFixed(5)}</td></tr>`).join("");
+      set(`<b>${esc(rest[0])}</b> (${esc(m.algo)}, ${esc(m.output.model_category || "")}) ` +
+          cellLink("plot varimp " + qk(rest[0]), "varimp") + " " +
+          cellLink("plot scoring " + qk(rest[0]), "scoring") + " " +
+          cellLink("plot roc " + qk(rest[0]), "roc") +
+          `<table><tr><th>training metric</th><th>value</th></tr>${metrics}</table>`);
+    } else if (cmd === "predict"){
+      set("scoring…");
+      const out = await J("POST", `/3/Predictions/models/${encodeURIComponent(rest[0])}/frames/${encodeURIComponent(rest[1])}`);
+      if (out.msg) throw new Error(out.msg);
+      set(`<span class="pill">${esc(out.predictions_frame.name)}</span> ` +
+          cellLink("getFrameSummary " + qk(out.predictions_frame.name), "inspect"));
+      refreshSide();
+    } else if (cmd === "plot"){
+      const kind = rest[0], key = rest[1];
+      const out = await J("GET", `/3/Models/${encodeURIComponent(key)}`);
+      const mo = out.models[0].output;
+      if (kind === "varimp"){
+        const t = mo.variable_importances;
+        if (!t) throw new Error("model has no variable importances");
+        const cols = tableCols(t);
+        const pairs = cols.variable.map((v, i) => [v, +cols.scaled_importance[i]]);
+        pairs.sort((a, b) => b[1] - a[1]);
+        set(svgBar(pairs.slice(0, 20), `variable importance — ${key}`));
+      } else if (kind === "scoring"){
+        const t = mo.scoring_history;
+        if (!t) throw new Error("model has no scoring history");
+        const cols = tableCols(t);
+        const xkey = Object.keys(cols).find(k => /tree|iter|epoch/i.test(k)) || Object.keys(cols)[0];
+        const palette = ["#2f6fed", "#d1342f", "#2b8a5c", "#8a63c9"];
+        const series = Object.keys(cols)
+          .filter(k => k !== xkey && cols[k].every(v => typeof v === "number"))
+          .slice(0, 4).map((k, i) => ({name: k, xs: cols[xkey], ys: cols[k], color: palette[i]}));
+        set(svgLine(series, `scoring history — ${key}`, xkey));
+      } else if (kind === "roc"){
+        const mm = mo.training_metrics || {};
+        const t = mm.thresholds_and_metric_scores;
+        if (!t) throw new Error("no thresholds table (binomial models only)");
+        const cols = tableCols(t);
+        set(svgLine([{name: `ROC (AUC ${(+mm.AUC).toFixed(4)})`, xs: cols.fpr, ys: cols.tpr, color: "#2f6fed"},
+                     {name: "chance", xs: [0, 1], ys: [0, 1], color: "#9db2c4"}],
+                    `ROC — ${key}`, "fpr"));
+      } else throw new Error(`unknown plot kind ${kind}`);
+    } else if (cmd === "remove"){
+      await fetch(`/3/DKV/${encodeURIComponent(rest[0])}`, {method: "DELETE"});
+      set(`<span class="pill">removed ${esc(rest[0])}</span>`);
+      refreshSide();
+    } else if (cmd === "getJobs"){
+      const out = await J("GET", "/3/Jobs");
+      set("<table><tr><th>job</th><th>status</th><th>progress</th></tr>" +
+        out.jobs.map(j => `<tr><td>${esc(j.description || j.key.name)}</td><td>${esc(j.status)}</td><td>${(100 * j.progress).toFixed(0)}%</td></tr>`).join("") + "</table>");
+    } else if (cmd === "rapids"){
+      const r = await J("POST", "/99/Rapids", {ast: line.slice(7)});
+      set(`<pre>${esc(JSON.stringify(r, null, 1))}</pre>`);
+      refreshSide();
+    } else {
+      throw new Error(`unknown command ${cmd}; see help`);
+    }
+  }catch(e){ set(`<span class="err">${esc(e.message)}</span>`); }
+}
+
+// -------------------------------------------------------------- side panes
 async function refreshCloud(){
   try{
     const c = await J("GET", "/3/Cloud");
     document.getElementById("cloud").innerHTML =
       `cloud <b>${esc(c.cloud_name)}</b> · ${c.cloud_size} device(s) · v${esc(c.version)} <span class="pill">healthy</span>`;
-  }catch(e){document.getElementById("cloud").textContent = "unreachable";}
+  }catch(e){ document.getElementById("cloud").textContent = "unreachable"; }
 }
-
-async function refreshFrames(){
-  const out = await J("GET", "/3/Frames");
-  const rows = out.frames.map(f =>
-    `<tr><td><a href="#" onclick="frameDetail('${esc(f.frame_id.name)}');return false">${esc(f.frame_id.name)}</a></td>
-     <td>${f.rows}</td><td>${f.column_count}</td>
-     <td><button class="small" onclick="rmKey('${esc(f.frame_id.name)}')">rm</button></td></tr>`).join("");
-  document.getElementById("frames").innerHTML =
-    `<table><tr><th>key</th><th>rows</th><th>cols</th><th></th></tr>${rows}</table>`;
-  const opts = out.frames.map(f => `<option>${esc(f.frame_id.name)}</option>`).join("");
-  document.getElementById("trainframe").innerHTML = opts;
-  document.getElementById("pframe").innerHTML = opts;
-  refreshCols();
-}
-
-async function refreshCols(){
-  const key = document.getElementById("trainframe").value;
-  if(!key) return;
+async function refreshSide(){
   try{
-    const out = await J("GET", `/3/Frames/${key}/columns`);
-    document.getElementById("ycol").innerHTML =
-      out.columns.map(c => `<option>${esc(c.label)}</option>`).join("");
+    const fo = await J("GET", "/3/Frames");
+    document.getElementById("frames").innerHTML = "<table>" + fo.frames.map(f =>
+      `<tr><td>${cellLink("getFrameSummary " + qk(f.frame_id.name), f.frame_id.name)}</td><td>${f.rows}×${f.column_count}</td><td>${cellLink("remove " + qk(f.frame_id.name), "rm")}</td></tr>`).join("") + "</table>";
+    const mo = await J("GET", "/3/Models");
+    document.getElementById("models").innerHTML = "<table>" + mo.models.map(m =>
+      `<tr><td>${cellLink("getModel " + qk(m.model_id.name), m.model_id.name)}</td><td>${esc(m.algo)}</td><td>${cellLink("remove " + qk(m.model_id.name), "rm")}</td></tr>`).join("") + "</table>";
   }catch(e){}
 }
-document.getElementById("trainframe") && document.addEventListener("change",
-  e => {if(e.target.id === "trainframe") refreshCols();});
 
-async function frameDetail(key){
-  const out = await J("GET", `/3/Frames/${key}`);
-  const f = out.frames[0];
-  const head = f.columns.map(c => `<th>${esc(c.label)}<br><span style="font-weight:400">${esc(c.type)}</span></th>`).join("");
-  const n = Math.min(8, Math.max(...f.columns.map(c => (c.data||c.string_data||[]).length)));
-  let body = "";
-  for(let i = 0; i < n; i++){
-    body += "<tr>" + f.columns.map(c => {
-      let v = (c.string_data || c.data || [])[i];
-      if(v !== null && c.domain && c.data) v = c.domain[c.data[i]] ?? v;
-      return `<td>${v === null || v === undefined ? "·" : esc(typeof v === "number" ? +v.toFixed(4) : v)}</td>`;
-    }).join("") + "</tr>";
-  }
-  const stats = f.columns.map(c =>
-    `<tr><td>${esc(c.label)}</td><td>${c.mean==null?"·":(+c.mean).toFixed(4)}</td>
-     <td>${c.sigma==null?"·":(+c.sigma).toFixed(4)}</td><td>${c.missing_count}</td>
-     <td>${c.domain ? c.domain.length + " levels" : "·"}</td></tr>`).join("");
-  document.getElementById("framedetail").innerHTML =
-    `<h2 style="margin-top:10px">${esc(key)} — ${f.rows} rows</h2>
-     <table><tr>${head}</tr>${body}</table>
-     <h2 style="margin-top:10px">column summary</h2>
-     <table><tr><th>col</th><th>mean</th><th>sigma</th><th>NAs</th><th>domain</th></tr>${stats}</table>`;
-}
-
-async function refreshModels(){
-  const out = await J("GET", "/3/Models");
-  const rows = out.models.map(m =>
-    `<tr><td><a href="#" onclick="modelDetail('${esc(m.model_id.name)}');return false">${esc(m.model_id.name)}</a></td>
-     <td>${esc(m.algo)}</td>
-     <td><button class="small" onclick="rmKey('${esc(m.model_id.name)}')">rm</button></td></tr>`).join("");
-  document.getElementById("models").innerHTML =
-    `<table><tr><th>key</th><th>algo</th><th></th></tr>${rows}</table>`;
-  document.getElementById("pmodel").innerHTML =
-    out.models.map(m => `<option>${esc(m.model_id.name)}</option>`).join("");
-}
-
-async function modelDetail(key){
-  const out = await J("GET", `/3/Models/${key}`);
-  const m = out.models[0];
-  const mm = m.output.training_metrics || {};
-  const metrics = Object.entries(mm).filter(([k,v]) => typeof v === "number")
-    .map(([k,v]) => `<tr><td>${esc(k)}</td><td>${(+v).toFixed(5)}</td></tr>`).join("");
-  document.getElementById("modeldetail").innerHTML =
-    `<h2 style="margin-top:10px">${esc(key)} (${esc(m.algo)}, ${esc(m.output.model_category||"")})</h2>
-     <table><tr><th>training metric</th><th>value</th></tr>${metrics}</table>`;
-}
-
-async function rmKey(k){ await fetch(`/3/DKV/${k}`, {method: "DELETE"}); refreshAll(); }
-
-async function importFile(){
-  const path = document.getElementById("path").value.trim();
-  const dest = document.getElementById("dest").value.trim();
-  const msg = document.getElementById("importmsg");
-  if(!path){ msg.innerHTML = '<span class="err">enter a path</span>'; return; }
-  msg.textContent = "importing…";
-  try{
-    const body = {path}; if(dest) body.destination_frame = dest;
-    const out = await J("POST", "/3/ImportFiles", body);
-    if(out.msg) throw new Error(out.msg);
-    msg.innerHTML = `<span class="pill">${esc(out.destination_frames[0])}</span>`;
-    refreshAll();
-  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
-}
-
-async function pollJob(key, into){
-  for(;;){
-    const out = await J("GET", `/3/Jobs/${key}`);
-    const j = out.jobs[0];
-    into.textContent = `${j.status} ${(100*j.progress).toFixed(0)}% — ${j.progress_msg||""}`;
-    if(["DONE","FAILED","CANCELLED"].includes(j.status)) return j;
-    await new Promise(r => setTimeout(r, 500));
-  }
-}
-
-async function buildModel(){
-  const algo = document.getElementById("algo").value;
-  const frame = document.getElementById("trainframe").value;
-  const y = document.getElementById("ycol").value;
-  const msg = document.getElementById("trainmsg");
-  const body = {training_frame: frame, response_column: y};
-  for(const kv of document.getElementById("params").value.split(",")){
-    const [k, v] = kv.split("=").map(s => s && s.trim());
-    if(k && v !== undefined) body[k] = v;
-  }
-  msg.textContent = "submitting…";
-  try{
-    const out = await J("POST", `/3/ModelBuilders/${algo}`, body);
-    if(out.msg) throw new Error(out.msg);
-    const j = await pollJob(out.job.key.name, msg);
-    if(j.exception) msg.innerHTML = `<span class="err">${esc(j.exception)}</span>`;
-    else { msg.innerHTML = `<span class="pill">${esc(j.dest.name)}</span>`; modelDetail(j.dest.name); }
-    refreshModels();
-  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
-}
-
-async function runPredict(){
-  const m = document.getElementById("pmodel").value;
-  const f = document.getElementById("pframe").value;
-  const msg = document.getElementById("predmsg");
-  msg.textContent = "scoring…";
-  try{
-    const out = await J("POST", `/3/Predictions/models/${m}/frames/${f}`);
-    if(out.msg) throw new Error(out.msg);
-    const key = out.predictions_frame.name;
-    msg.innerHTML = `<span class="pill">${esc(key)}</span>`;
-    refreshFrames(); frameDetail(key);
-  }catch(e){ msg.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
-}
-
-async function runRapids(){
-  const ast = document.getElementById("ast").value;
-  const out = document.getElementById("rapidsout");
-  try{
-    const r = await J("POST", "/99/Rapids", {ast});
-    out.textContent = JSON.stringify(r, null, 1);
-    refreshFrames();
-  }catch(e){ out.textContent = "error: " + e.message; }
-}
-
-// notebook persistence (reference: Flow save/load via NodePersistentStorage)
-const FLOW_FIELDS = ["path","dest","algo","params","ast"];
+// ---------------------------------------------------------------- persist
 async function saveFlow(){
   const name = document.getElementById("nbname").value || "flow";
-  const doc = {version: 1, fields: {}};
-  for (const f of FLOW_FIELDS) doc.fields[f] = document.getElementById(f).value;
-  doc.rapids_log = document.getElementById("rapidsout").textContent;
+  const doc = {version: 2, cells: CELLS.map(c => ({input: c.input}))};
   await fetch(`/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`,
               {method: "POST", body: JSON.stringify(doc)});
   refreshNotebooks();
@@ -262,10 +388,15 @@ async function loadFlow(name){
   if (!name) return;
   const r = await fetch(`/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`);
   const doc = JSON.parse(await r.text());
-  for (const f of FLOW_FIELDS)
-    if (doc.fields && f in doc.fields) document.getElementById(f).value = doc.fields[f];
-  if (doc.rapids_log) document.getElementById("rapidsout").textContent = doc.rapids_log;
+  if (doc.version === 2 && doc.cells){
+    CELLS = doc.cells.map(c => ({input: c.input, output: ""}));
+  } else if (doc.fields){      // v1 console documents: convert to cells
+    CELLS = [];
+    if (doc.fields.path) CELLS.push({input: `importFiles ${doc.fields.path}`, output: ""});
+    if (doc.fields.ast) CELLS.push({input: `rapids ${doc.fields.ast}`, output: ""});
+  }
   document.getElementById("nbname").value = name;
+  renderCells();
 }
 async function refreshNotebooks(){
   const r = await J("GET", "/3/NodePersistentStorage/notebook");
@@ -273,8 +404,11 @@ async function refreshNotebooks(){
   sel.innerHTML = '<option value="">Load…</option>' +
     r.entries.map(e => `<option value="${esc(e.name)}">${esc(e.name)}</option>`).join("");
 }
-function refreshAll(){ refreshCloud(); refreshFrames(); refreshModels(); refreshNotebooks(); }
-refreshAll();
+
+renderAssist();
+addCell("md ## welcome to Flow\nuse the assist buttons above to insert workflow cells; shift+enter runs a cell");
+runCell(0);
+refreshCloud(); refreshSide(); refreshNotebooks();
 setInterval(refreshCloud, 10000);
 </script></body></html>
 """

@@ -183,6 +183,29 @@ def model_v3(model) -> dict:
         cols, rows = model.scoring_history
         out["output"]["scoring_history"] = twodim_table_v3(
             "Scoring History", "", cols, rows)
+    if hasattr(model, "varimp"):
+        # h2o-py model.varimp() reads output.variable_importances
+        # (reference: ModelOutputSchemaV3._variable_importances). Memoized:
+        # recomputing walks every tree with per-tree device fetches (~43 ms
+        # each over the tunnel) and Flow fetches the payload per plot.
+        try:
+            vi_rows = getattr(model, "_varimp_rows", None)
+            if vi_rows is None:
+                vi_rows = model.varimp()
+                try:
+                    model._varimp_rows = vi_rows
+                except Exception:   # noqa: BLE001 — frozen model classes
+                    pass
+        except Exception:   # noqa: BLE001 — varimp optional on some families
+            vi_rows = None
+        if vi_rows:
+            out["output"]["variable_importances"] = twodim_table_v3(
+                "Variable Importances", "",
+                [("variable", "string", "%s"),
+                 ("relative_importance", "float", "%5f"),
+                 ("scaled_importance", "float", "%5f"),
+                 ("percentage", "float", "%5f")],
+                [list(r) for r in vi_rows])
     meta_model = (model.output or {}).get("metalearner")
     if meta_model is not None:
         # h2o-py's H2OStackedEnsembleEstimator.metalearner() fetches this key

@@ -135,7 +135,6 @@ class Transform:
         out = Frame(list(frame.names), list(frame.vecs))
         if self.kind == "math_unary":
             v = frame.vec(self.inputs[0])
-            y = MATH_UNARY[self.op](v.as_float())
             if self.op == "signif":
                 digits = int(self.params.get("digits", 6))
                 x = v.as_float()
@@ -143,6 +142,8 @@ class Transform:
                 ax = jnp.where(x == 0, 1.0, jnp.abs(x))
                 mag = jnp.power(10.0, digits - 1 - jnp.floor(jnp.log10(ax)))
                 y = jnp.where(x == 0, 0.0, jnp.round(x * mag) / mag)
+            else:
+                y = MATH_UNARY[self.op](v.as_float())
             vec = Vec.from_device(y.astype(jnp.float32), frame.nrows,
                                   VecType.NUM)
         elif self.kind == "math_binary":
@@ -167,9 +168,10 @@ class Transform:
         if self.kind == "string_split":
             # split emits N columns: output, output.1, ...
             for i, v in enumerate(vec):
-                out.add(self.output if i == 0 else f"{self.output}.{i}", v)
+                _set_col(out, self.output if i == 0 else
+                         f"{self.output}.{i}", v)
         else:
-            out.add(self.output, vec)
+            _set_col(out, self.output, vec)
         return out
 
     def _apply_string(self, frame: Frame):
@@ -227,6 +229,15 @@ class Transform:
 
 def _numstr(x: float) -> str:
     return str(int(x)) if float(x).is_integer() else repr(float(x))
+
+
+def _set_col(frame: Frame, name: str, vec) -> None:
+    """Add-or-replace: in-place transforms (output == an existing column)
+    are a normal reference-pipeline shape."""
+    if name in frame.names:
+        frame.vecs[frame.names.index(name)] = vec
+    else:
+        frame.add(name, vec)
 
 
 class MojoPipeline:

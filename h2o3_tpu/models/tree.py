@@ -185,6 +185,8 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma,
     dirs = jnp.where(na_left, 0, 1)
     vl_b = vl[dirs, best_feat, nn, best_t - 1]
     vr_b = vr[dirs, best_feat, nn, best_t - 1]
+    wl_b = wl[dirs, best_feat, nn, best_t - 1]
+    wr_b = wr[dirs, best_feat, nn, best_t - 1]
     # left-membership mask over bins for the chosen split: numeric = bins
     # below the threshold; categorical = bins whose per-node rank is in the
     # sorted prefix (the group going left)
@@ -193,7 +195,8 @@ def _find_splits(hists, n_bins: int, min_rows, reg_lambda, reg_alpha, gamma,
         rank_best = rank[best_feat, nn, :]                       # [N,B]
         member = jnp.where(cat_feats[best_feat][:, None],
                            rank_best < best_t[:, None], member)
-    return best_gain, best_feat, best_t, na_left, G, H, W, vl_b, vr_b, member
+    return (best_gain, best_feat, best_t, na_left, G, H, W, vl_b, vr_b,
+            wl_b, wr_b, member)
 
 
 def _route_rows(binned, node_local, feat, member, na_left, do_split,
@@ -250,6 +253,12 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
 
     if do_col_sample is None:     # static callers pass a concrete col_rate
         do_col_sample = col_rate < 1.0
+    # sibling-subtraction state (reference ScoreBuildHistogram2 /
+    # gpu_hist "hist subtraction trick"): at level d >= 1 only the SMALLER
+    # child of each split parent is histogrammed — the sibling is the
+    # parent's histogram minus the computed child's — halving the one-hot
+    # contraction's node dimension (its FLOPs are ∝ N) at every level
+    prev_hists = prev_do = chosen_left = None
     for d in range(depth):
         N = 2 ** d
         lmask = feat_mask
@@ -260,11 +269,37 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
             lmask = feat_mask & sub
             # the forced index may miss feat_mask; never let the level go empty
             lmask = jnp.where(lmask.any(), lmask, feat_mask)
-        hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt)
-        gain, feat, t, na_left, G, H, W, vl_b, vr_b, member = _find_splits(
+        if d == 0:
+            hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt)
+        else:
+            P = N // 2
+            # chosen child id per parent; rows elsewhere mask to -1
+            chosen = (jnp.arange(P) * 2
+                      + jnp.where(chosen_left, 0, 1).astype(jnp.int32))
+            act = node_local >= 0
+            par = jnp.where(act, node_local // 2, 0)
+            at_chosen = act & (node_local == chosen[par])
+            node_slot = jnp.where(at_chosen, par, -1)
+            part = _histograms(binned, binned_T, node_slot, g, h, w, P, Bt)
+            part4 = part.reshape(F, P, Bt, 3)
+            prev4 = prev_hists.reshape(F, P, Bt, 3)
+            # sibling by subtraction — only where the parent really split
+            # (a frozen parent's children hold no rows; its stale parent
+            # histogram must not leak into phantom nodes)
+            other4 = jnp.where(prev_do[None, :, None, None],
+                               prev4 - part4, 0.0)
+            cl = chosen_left[None, :, None, None]
+            left4 = jnp.where(cl, part4, other4)
+            right4 = jnp.where(cl, other4, part4)
+            hists = jnp.stack([left4, right4], axis=2).reshape(F, N * Bt, 3)
+        (gain, feat, t, na_left, G, H, W, vl_b, vr_b, wl_b, wr_b,
+         member) = _find_splits(
             hists, B, min_rows, reg_lambda, reg_alpha, gamma, lmask,
             mono=mono, allowed=allowed, cat_feats=cat_feats)
+        prev_hists = hists
+        chosen_left = wl_b <= wr_b
         do = (gain > min_split_improvement) & jnp.isfinite(gain) & (W > 0)
+        prev_do = do
         leaf = jnp.where(do, 0.0,
                          clamp(_leaf_value(G, H, W, reg_lambda, reg_alpha),
                                bounds))

@@ -1,0 +1,72 @@
+"""Pallas histogram kernel semantics, validated OFF-TPU via interpret mode
+(the kernel itself only dispatches on real TPU — ``pallas_available`` gates
+on backend — but its math must be checkable in CI; VERDICT r3 next #3).
+
+Covers the MXU precision modes: "hilo" (2 bf16 passes, default), "hilo3"
+(3 passes, f32-exact), "highest" (6-pass reference mode) — all against the
+XLA segment-sum ground truth.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.models.tree import _level_histograms
+from h2o3_tpu.ops import pallas_hist
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(pallas_hist, "_INTERPRET", True)
+    pallas_hist.hist_pallas._clear_cache()
+    yield
+    pallas_hist.hist_pallas._clear_cache()
+
+
+def _data(rng, R, F, B, N):
+    binned = rng.integers(0, B + 1, size=(R, F)).astype(np.int16)
+    node = rng.integers(-1, N, size=R).astype(np.int32)
+    g = rng.normal(size=R).astype(np.float32)
+    h = rng.random(R).astype(np.float32) + 0.1
+    w = np.ones(R, np.float32)
+    return (jnp.asarray(binned), jnp.asarray(node), jnp.asarray(g),
+            jnp.asarray(h), jnp.asarray(w))
+
+
+@pytest.mark.parametrize("mode,rtol", [("hilo", 5e-4), ("hilo3", 1e-5),
+                                       ("highest", 1e-5)])
+def test_kernel_matches_segment_sum(monkeypatch, mode, rtol, rng):
+    monkeypatch.setattr(pallas_hist, "_MXU_MODE", mode)
+    pallas_hist.hist_pallas._clear_cache()
+    R, F, B, N = 4096, 7, 16, 8
+    binned, node, g, h, w = _data(rng, R, F, B, N)
+    want = _level_histograms(binned, node, g, h, w, N, B + 1)
+    got = pallas_hist.hist_pallas(binned.T, node, g, h, w, N, B + 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=rtol, atol=rtol * 10)
+
+
+def test_kernel_256_bins_and_multiblock(monkeypatch, rng):
+    """256-bin (XGBoost config) layout and a node count spanning multiple
+    node blocks both reduce to the same histograms."""
+    monkeypatch.setattr(pallas_hist, "_MXU_MODE", "hilo")
+    pallas_hist.hist_pallas._clear_cache()
+    R, F, B, N = 2048, 3, 256, 128
+    binned, node, g, h, w = _data(rng, R, F, B, N)
+    want = _level_histograms(binned, node, g, h, w, N, B + 1)
+    got = pallas_hist.hist_pallas(binned.T, node, g, h, w, N, B + 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-4, atol=5e-3)
+
+
+def test_hilo_split_exactness():
+    """hi+lo bf16 digits reconstruct f32 stats to 16-bit mantissa: the
+    one-hot side contributes no error, so a single-row 'histogram' must
+    reproduce each stat to ~1.5e-5 relative."""
+    vals = np.float32([1.0, 1e-3, 123.456, -0.9999, 3.14159e4])
+    for v in vals:
+        hi = np.float32(jnp.bfloat16(v))
+        lo = np.float32(jnp.bfloat16(np.float32(v) - hi))
+        assert abs((hi + lo) - v) <= abs(v) * 2 ** -15

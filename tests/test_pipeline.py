@@ -97,3 +97,10 @@ def test_pipeline_artifact_roundtrip(fr, tmp_path, rng):
 def test_unknown_op_rejected():
     with pytest.raises(ValueError, match="unsupported"):
         Transform("math_unary", "frobnicate", ["a"], "out")
+
+
+def test_in_place_transform_replaces_column(fr):
+    p = MojoPipeline([Transform("math_unary", "sqrt", ["a"], "a")])
+    out = p.transform(fr)
+    assert out.names.count("a") == 1
+    np.testing.assert_allclose(out.vec("a").to_numpy()[:3], [1, 2, 3])

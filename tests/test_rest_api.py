@@ -139,3 +139,26 @@ def test_client_upload_file(client, tmp_path):
     key = client.upload_file(str(p), destination_frame="uploaded_fr")
     fr = client.frame(key)
     assert key == "uploaded_fr" and fr["rows"] == 3
+
+
+def test_flow_notebook_assist_and_plots(server):
+    """Round-4 Flow: cell notebook with assist templates, command help,
+    and inline SVG chart code (reference h2o-web Flow product surface)."""
+    import urllib.request
+    with urllib.request.urlopen(server.url + "/") as r:
+        body = r.read().decode()
+    for marker in ("assist", "runCell", "buildModel", "plot varimp",
+                   "svgLine", "svgBar", "getFrameSummary",
+                   "NodePersistentStorage/notebook", "shift+enter"):
+        assert marker in body, marker
+
+
+def test_model_payload_variable_importances(client, bin_frame):
+    """output.variable_importances TwoDimTable (h2o-py model.varimp())."""
+    out = client.train("gbm", "train_frame", y="y", ntrees=3, max_depth=3)
+    vi = out["output"].get("variable_importances")
+    assert vi is not None
+    names = [c["name"] for c in vi["columns"]]
+    assert names == ["variable", "relative_importance", "scaled_importance",
+                     "percentage"]
+    assert vi["rowcount"] >= 1
