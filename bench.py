@@ -180,7 +180,11 @@ def bench_automl(ndev: int) -> dict:
 
     fr = _higgs_frame(3_000 if SMOKE else (20_000 if CPU_FALLBACK else 100_000))
     out: dict = {}
-    for par in (1, 2):
+    # the par=1-vs-2 comparison is a TPU measurement (overlap hides compile +
+    # dispatch latency behind device execution); in the degraded CPU-fallback
+    # path one pass suffices — threads on one core can't overlap anyway
+    pars = (2,) if CPU_FALLBACK else (1, 2)
+    for par in pars:
         t0 = time.perf_counter()
         aml = AutoML(max_models=2 if SMOKE else 5, nfolds=0, seed=1,
                      parallelism=par)
@@ -188,8 +192,9 @@ def bench_automl(ndev: int) -> dict:
         out[f"seconds_par{par}"] = round(time.perf_counter() - t0, 2)
         out["models"] = len(aml.leaderboard)
     out["seconds"] = out["seconds_par2"]
-    out["overlap_speedup"] = round(
-        out["seconds_par1"] / max(out["seconds_par2"], 1e-9), 2)
+    if "seconds_par1" in out:
+        out["overlap_speedup"] = round(
+            out["seconds_par1"] / max(out["seconds_par2"], 1e-9), 2)
     return out
 
 

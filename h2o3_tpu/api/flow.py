@@ -84,7 +84,8 @@ function cellLink(cmdline, label){
 }
 
 // ---------------------------------------------------------------- notebook
-let CELLS = [];   // {input, output(html), kind}
+let CELLS = [];   // {id, input, output(html)}
+let NEXT_CELL_ID = 1;
 function renderCells(){
   const host = document.getElementById("cells");
   host.innerHTML = "";
@@ -106,14 +107,14 @@ function renderCells(){
     d.appendChild(ta);
     const out = document.createElement("div");
     out.className = "out";
-    out.id = "cellout-" + i;
+    out.id = "cellout-" + c.id;
     out.innerHTML = c.output || "";
     d.appendChild(out);
     host.appendChild(d);
   });
 }
 function addCell(input, run){
-  CELLS.push({input: input || "", output: ""});
+  CELLS.push({id: NEXT_CELL_ID++, input: input || "", output: ""});
   renderCells();
   if (run) runCell(CELLS.length - 1);
 }
@@ -159,7 +160,7 @@ const HELP = {
 function renderAssist(){
   document.getElementById("assist").innerHTML = "assist: " + ASSIST.map(
     ([label, tpl]) =>
-      `<button class="ghost" onclick='addCell(${JSON.stringify(tpl)})'>${esc(label)}</button>`
+      `<button class="ghost" data-cmd="${esc(tpl)}" onclick="addCell(this.dataset.cmd)">${esc(label)}</button>`
   ).join("");
   document.getElementById("help").innerHTML =
     "<dl>" + Object.entries(HELP).map(([k, v]) =>
@@ -222,7 +223,7 @@ async function runCell(i){
   const c = CELLS[i];
   const set = html => {
     c.output = html;
-    const node = document.getElementById("cellout-" + i);
+    const node = document.getElementById("cellout-" + c.id);
     if (node) node.innerHTML = html; else renderCells();
   };
   const line = c.input.trim();
@@ -389,11 +390,21 @@ async function loadFlow(name){
   const r = await fetch(`/3/NodePersistentStorage/notebook/${encodeURIComponent(name)}`);
   const doc = JSON.parse(await r.text());
   if (doc.version === 2 && doc.cells){
-    CELLS = doc.cells.map(c => ({input: c.input, output: ""}));
+    CELLS = doc.cells.map(c => ({id: NEXT_CELL_ID++, input: c.input, output: ""}));
   } else if (doc.fields){      // v1 console documents: convert to cells
     CELLS = [];
-    if (doc.fields.path) CELLS.push({input: `importFiles ${doc.fields.path}`, output: ""});
-    if (doc.fields.ast) CELLS.push({input: `rapids ${doc.fields.ast}`, output: ""});
+    const push = input => CELLS.push({id: NEXT_CELL_ID++, input, output: ""});
+    const f = doc.fields;
+    if (f.path) push(`importFiles ${f.path}` + (f.dest ? ` ${qk(f.dest)}` : ""));
+    if (f.algo){
+      const body = {training_frame: f.dest || "FRAME", response_column: "Y"};
+      for (const kv of (f.params || "").split(",")){
+        const [k, v] = kv.split("=").map(x => x && x.trim());
+        if (k && v !== undefined) body[k] = v;
+      }
+      push(`buildModel ${f.algo} ${JSON.stringify(body)}`);
+    }
+    if (f.ast) push(`rapids ${f.ast}`);
   }
   document.getElementById("nbname").value = name;
   renderCells();

@@ -48,7 +48,10 @@ _TILE = 1024
 #: "highest" = XLA's 6-pass f32 decomposition (the round-3 default).
 #: 2 passes ≈ 3x the MXU throughput of HIGHEST for identical tree quality
 #: at the tolerance the split scan already works in (f32 cumsums).
-_MXU_MODE = os.environ.get("H2O3TPU_HIST_MXU", "hilo")
+_MXU_MODE = os.environ.get("H2O3TPU_HIST_MXU", "hilo").strip().lower()
+if _MXU_MODE not in ("hilo", "hilo3", "highest"):
+    raise ValueError(
+        f"H2O3TPU_HIST_MXU={_MXU_MODE!r}: expected hilo, hilo3, or highest")
 #: tests force interpret mode to validate kernel semantics off-TPU
 _INTERPRET = False
 _NODE_BLOCK = 64     # nodes per resident output slab
