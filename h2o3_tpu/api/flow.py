@@ -397,11 +397,16 @@ async function loadFlow(name){
     const f = doc.fields;
     if (f.path) push(`importFiles ${f.path}` + (f.dest ? ` ${qk(f.dest)}` : ""));
     if (f.algo){
-      const body = {training_frame: f.dest || "FRAME", response_column: "Y"};
+      // v1 docs never persisted the response column (it lived in a
+      // <select>): emit an md note + a template the user completes
+      const body = {training_frame: f.dest || "EDIT_FRAME_KEY",
+                    response_column: "EDIT_RESPONSE_COLUMN"};
       for (const kv of (f.params || "").split(",")){
         const [k, v] = kv.split("=").map(x => x && x.trim());
         if (k && v !== undefined) body[k] = v;
       }
+      push("md converted from a v1 console document — fill in the " +
+           "EDIT_* placeholders below before running");
       push(`buildModel ${f.algo} ${JSON.stringify(body)}`);
     }
     if (f.ast) push(`rapids ${f.ast}`);

@@ -413,6 +413,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     def r_frame(self, key):
         fr = DKV[key]
+        from h2o3_tpu.frame.parse import RawFile
+        if isinstance(fr, RawFile):
+            # a /3/PostFile upload fetched as a frame (h2o.upload_mojo does
+            # get_frame on the raw key before handing it to generic) — the
+            # reference exposes raw keys as 1-column ByteVec frames
+            self._reply({"__meta": {"schema_type": "FramesV3"},
+                         "frames": [{
+                             "frame_id": {"name": key},
+                             "rows": len(fr.data), "row_count": len(fr.data),
+                             "column_count": 1, "byte_size": len(fr.data),
+                             "is_text": False, "columns": [{
+                                 "__meta": {"schema_version": 3,
+                                            "schema_name": "ColV3",
+                                            "schema_type": "Vec"},
+                                 "label": "C1", "type": "uuid", "data": [],
+                                 "string_data": [], "missing_count": 0,
+                                 "domain": None, "domain_cardinality": 0,
+                                 "mean": 0, "sigma": 0, "zero_count": 0,
+                                 "positive_infinity_count": 0,
+                                 "negative_infinity_count": 0,
+                                 "histogram_bins": [], "histogram_base": 0,
+                                 "histogram_stride": 0, "percentiles": []}],
+                             "total_column_count": 1, "checksum": 0,
+                             "default_percentiles": [], "compatible_models": [],
+                             "chunk_summary": None, "distribution_summary": None,
+                         }]})
+            return
         if not isinstance(fr, Frame):
             raise KeyError(f"{key} is not a frame")
         self._reply({"__meta": {"schema_type": "FramesV3"},
@@ -438,6 +465,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def r_train(self, algo):
         p = self._params()
+        if algo.lower() == "generic":
+            # h2o.import_mojo / upload_mojo: no training_frame; the artifact
+            # arrives as a server path or an uploaded RawFile key
+            # (H2OGenericEstimator.from_file / h2o.upload_mojo)
+            return self._train_generic(p)
         cls = _algo_registry().get(algo.lower())
         if cls is None:
             raise KeyError(f"unknown algorithm {algo!r}")
@@ -492,6 +524,51 @@ class _Handler(BaseHTTPRequestHandler):
                      "job": schemas.job_v3(job.key, job),
                      "messages": [], "error_count": 0,
                      "parameters": [], "algo": algo.lower()})
+
+    def _train_generic(self, p: dict):
+        """POST /3/ModelBuilders/generic (reference hex/generic/Generic.java):
+        wrap a MOJO artifact — ``path`` on the server filesystem, or
+        ``model_key`` naming a /3/PostFile RawFile upload — as a model."""
+        import os
+        import tempfile
+
+        from h2o3_tpu.genmodel.generic import Generic
+
+        path = p.get("path")
+        model_key = _name(p.get("model_key"))
+        tmp = None
+        if not path and model_key:
+            raw = DKV[str(model_key).strip('"')]
+            data = getattr(raw, "data", raw)
+            if not isinstance(data, (bytes, bytearray)):
+                raise TypeError(f"model_key {model_key!r} does not hold an "
+                                "uploaded artifact")
+            fd, tmp = tempfile.mkstemp(suffix=".zip")
+            with os.fdopen(fd, "wb") as f:
+                f.write(bytes(data))
+            path = tmp
+        if not path:
+            raise ValueError("generic needs 'path' or 'model_key'")
+        builder = Generic(path=path,
+                          model_id=p.get("model_id")
+                          or f"generic_{uuid.uuid4().hex[:10]}")
+        job = Job("generic via REST", key=f"job_{uuid.uuid4().hex[:12]}")
+        job.dest_key = builder.model_id
+
+        def driver(j: Job):
+            try:
+                m = builder.train()
+            finally:
+                if tmp is not None:
+                    os.unlink(tmp)
+            j.dest_key = m.key
+            return m
+
+        job.run(driver, background=True)
+        self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
+                     "job": schemas.job_v3(job.key, job),
+                     "messages": [], "error_count": 0,
+                     "parameters": [], "algo": "generic"})
 
     def r_job(self, key):
         job = DKV[key]

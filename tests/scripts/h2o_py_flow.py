@@ -173,6 +173,32 @@ fr2 = h2o.get_frame(fr.frame_id)
 assert fr2.nrow == 300
 assert fr.frame_id in h2o.ls()["key"].tolist()
 
+# MOJO round-trip over the wire (round 4): export this server's artifact,
+# re-import it via h2o.import_mojo AND h2o.upload_mojo (the Generic
+# builder), and assert identical scoring — then import a REAL H2O-3
+# reference MOJO fixture the same way
+import tempfile
+mojo_dir = tempfile.mkdtemp()
+mojo_path = gbm.download_mojo(mojo_dir)
+reimported = h2o.import_mojo(mojo_path)
+p_orig = gbm.predict(te).as_data_frame()
+p_back = reimported.predict(te).as_data_frame()
+assert (abs(p_orig["pyes"] - p_back["pyes"]) < 1e-5).all()
+
+uploaded = h2o.upload_mojo(mojo_path)
+p_up = uploaded.predict(te).as_data_frame()
+assert (abs(p_orig["pyes"] - p_up["pyes"]) < 1e-5).all()
+
+ref_fixture = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data", "ref_mojo",
+    "gbm_variable_importance.zip")
+if os.path.exists(ref_fixture):
+    legacy = h2o.upload_mojo(ref_fixture)
+    pros = h2o.import_file(os.path.join(os.path.dirname(ref_fixture),
+                                        "prostate.csv"))
+    lp = legacy.predict(pros).as_data_frame()
+    assert len(lp) == pros.nrow and "p1" in lp.columns
+
 h2o.remove_all()
 print("H2O_PY_COMPAT_OK")
 # skip h2o-py's atexit session teardown (its ExprNode.__del__ chain assumes
