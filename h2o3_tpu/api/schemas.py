@@ -222,6 +222,30 @@ def models_list_v3(store) -> dict:
     return {**_meta("ModelsV3"), "models": models}
 
 
+def raw_frame_v3(key: str, nbytes: int) -> dict:
+    """FramesV3 body for a RAW upload key (reference exposes /3/PostFile
+    results as 1-column ByteVec frames; h2o.upload_mojo's get_frame step
+    reads this shape before handing the key to the generic builder)."""
+    col = {"__meta": {"schema_version": 3, "schema_name": "ColV3",
+                      "schema_type": "Vec"},
+           "label": "C1", "type": "uuid", "data": [], "string_data": [],
+           "missing_count": 0, "domain": None, "domain_cardinality": 0,
+           "mean": 0, "sigma": 0, "zero_count": 0,
+           "positive_infinity_count": 0, "negative_infinity_count": 0,
+           "histogram_bins": [], "histogram_base": 0, "histogram_stride": 0,
+           "percentiles": []}
+    return {"__meta": {"schema_type": "FramesV3"},
+            "frames": [{"frame_id": {"name": key},
+                        "rows": nbytes, "row_count": nbytes,
+                        "row_offset": 0, "column_offset": 0,
+                        "column_count": 1, "total_column_count": 1,
+                        "byte_size": nbytes, "is_text": False,
+                        "columns": [col], "checksum": 0,
+                        "default_percentiles": [], "compatible_models": [],
+                        "chunk_summary": None,
+                        "distribution_summary": None}]}
+
+
 def twodim_table_v3(name: str, description: str,
                     columns: list[tuple[str, str, str]],
                     rows: list[list], row_headers: bool = False) -> dict:

@@ -416,29 +416,8 @@ class _Handler(BaseHTTPRequestHandler):
         from h2o3_tpu.frame.parse import RawFile
         if isinstance(fr, RawFile):
             # a /3/PostFile upload fetched as a frame (h2o.upload_mojo does
-            # get_frame on the raw key before handing it to generic) — the
-            # reference exposes raw keys as 1-column ByteVec frames
-            self._reply({"__meta": {"schema_type": "FramesV3"},
-                         "frames": [{
-                             "frame_id": {"name": key},
-                             "rows": len(fr.data), "row_count": len(fr.data),
-                             "column_count": 1, "byte_size": len(fr.data),
-                             "is_text": False, "columns": [{
-                                 "__meta": {"schema_version": 3,
-                                            "schema_name": "ColV3",
-                                            "schema_type": "Vec"},
-                                 "label": "C1", "type": "uuid", "data": [],
-                                 "string_data": [], "missing_count": 0,
-                                 "domain": None, "domain_cardinality": 0,
-                                 "mean": 0, "sigma": 0, "zero_count": 0,
-                                 "positive_infinity_count": 0,
-                                 "negative_infinity_count": 0,
-                                 "histogram_bins": [], "histogram_base": 0,
-                                 "histogram_stride": 0, "percentiles": []}],
-                             "total_column_count": 1, "checksum": 0,
-                             "default_percentiles": [], "compatible_models": [],
-                             "chunk_summary": None, "distribution_summary": None,
-                         }]})
+            # get_frame on the raw key before handing it to generic)
+            self._reply(schemas.raw_frame_v3(key, len(fr.data)))
             return
         if not isinstance(fr, Frame):
             raise KeyError(f"{key} is not a frame")
@@ -505,17 +484,27 @@ class _Handler(BaseHTTPRequestHandler):
                 DKV[str(_name(b)).strip('"')]
                 for b in (kwargs.get("base_models") or [])]
         builder = cls(**kwargs)
-        # pre-assign the model key: h2o-py's H2OJob reads dest.name from the
-        # INITIAL builder response, before the background train finishes
-        builder.model_id = (p.get("model_id")
-                            or f"{algo.lower()}_{uuid.uuid4().hex[:10]}")
+        self._run_build_job(
+            algo.lower(), builder, p.get("model_id"),
+            lambda: builder.train(x=x, y=y, training_frame=frame,
+                                  validation_frame=vframe))
 
+    def _run_build_job(self, algo: str, builder, model_id, train_fn,
+                       cleanup=None) -> None:
+        """The shared train-job protocol every builder endpoint speaks:
+        pre-assigned model key (h2o-py's H2OJob reads dest.name from the
+        INITIAL response, before the background train finishes), background
+        Job, ModelBuildersV3 reply."""
+        builder.model_id = model_id or f"{algo}_{uuid.uuid4().hex[:10]}"
         job = Job(f"{algo} via REST", key=f"job_{uuid.uuid4().hex[:12]}")
         job.dest_key = builder.model_id
 
         def driver(j: Job):
-            m = builder.train(x=x, y=y, training_frame=frame,
-                              validation_frame=vframe)
+            try:
+                m = train_fn()
+            finally:
+                if cleanup is not None:
+                    cleanup()
             j.dest_key = m.key
             return m
 
@@ -523,7 +512,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
                      "job": schemas.job_v3(job.key, job),
                      "messages": [], "error_count": 0,
-                     "parameters": [], "algo": algo.lower()})
+                     "parameters": [], "algo": algo})
 
     def _train_generic(self, p: dict):
         """POST /3/ModelBuilders/generic (reference hex/generic/Generic.java):
@@ -549,26 +538,10 @@ class _Handler(BaseHTTPRequestHandler):
             path = tmp
         if not path:
             raise ValueError("generic needs 'path' or 'model_key'")
-        builder = Generic(path=path,
-                          model_id=p.get("model_id")
-                          or f"generic_{uuid.uuid4().hex[:10]}")
-        job = Job("generic via REST", key=f"job_{uuid.uuid4().hex[:12]}")
-        job.dest_key = builder.model_id
-
-        def driver(j: Job):
-            try:
-                m = builder.train()
-            finally:
-                if tmp is not None:
-                    os.unlink(tmp)
-            j.dest_key = m.key
-            return m
-
-        job.run(driver, background=True)
-        self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
-                     "job": schemas.job_v3(job.key, job),
-                     "messages": [], "error_count": 0,
-                     "parameters": [], "algo": "generic"})
+        builder = Generic(path=path)
+        self._run_build_job(
+            "generic", builder, p.get("model_id"), builder.train,
+            cleanup=(lambda: os.unlink(tmp)) if tmp is not None else None)
 
     def r_job(self, key):
         job = DKV[key]

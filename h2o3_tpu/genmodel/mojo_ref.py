@@ -2,7 +2,8 @@
 
 H2O-3 users arrive with MOJO zips produced by ``model.download_mojo()``; this
 module reads that format directly so ``h2o.import_mojo`` / ``Generic`` work on
-existing artifacts (VERDICT r3 missing #1).  Format provenance (studied, not
+existing artifacts (VERDICT r3 missing #1). Families: GBM, DRF (tree
+bytecode >= 1.20), GLM, K-means, and StackedEnsemble (nested submodels).  Format provenance (studied, not
 copied — this is a from-scratch Python reader):
 
 - ``model.ini`` grammar: ``hex/genmodel/ModelMojoReader.java:286-333``
@@ -262,6 +263,16 @@ def _score_tree(t: _DecodedTree, X: np.ndarray, domain_len: np.ndarray
 
 # -- link inverses (GbmMojoModel.linkInv / GlmMojoModel link functions) ------
 
+def _default_link(family: str | None) -> str:
+    """ModelMojoReader.defaultLinkFunction (ModelMojoReader.java:387)."""
+    if family in ("bernoulli", "fractionalbinomial", "quasibinomial",
+                  "modified_huber", "ordinal"):
+        return "logit"
+    if family in ("poisson", "gamma", "tweedie", "negativebinomial"):
+        return "log"
+    return "identity"
+
+
 def _link_inv(name: str, f: np.ndarray) -> np.ndarray:
     if name in ("identity", None):
         return f
@@ -387,7 +398,9 @@ class RefTreeModel(_RefModelBase):
         self.trees_per_group = int(tpc)
         self.trees = trees                      # [class][group] -> tree|None
         self.family = _kv(info, "distribution")
-        self.link = _kv(info, "link_function", "identity")
+        # link_function first appears in mojo 1.40; older artifacts default
+        # by family (ModelMojoReader.readLinkFunction/defaultLinkFunction)
+        self.link = _kv(info, "link_function") or _default_link(self.family)
         self.init_f = float(_kv(info, "init_f", 0.0) or 0.0)
         self.binomial_double_trees = _kv(info, "binomial_double_trees") == "true"
         self._domain_len = np.array(
@@ -486,6 +499,94 @@ class RefGlmModel(_RefModelBase):
         return mu
 
 
+class RefKMeansModel(_RefModelBase):
+    """Imported K-means MOJO (KMeansMojoReader/KMeansMojoModel +
+    GenModel.KMeans_distance: Euclidean on numerics, 0/1 mismatch on
+    categoricals, NA-dimension upscaling)."""
+
+    algo = "kmeans"
+
+    def __init__(self, info, columns, domains):
+        super().__init__(info, columns, domains)
+        k = int(_kv(info, "center_num"))
+        self.centers = np.stack([_kv_doubles(info, f"center_{i}")
+                                 for i in range(k)])
+        self.standardize = _kv(info, "standardize") == "true"
+        if self.standardize:
+            self.means = _kv_doubles(info, "standardize_means")
+            self.mults = _kv_doubles(info, "standardize_mults")
+            self.modes = _kv_doubles(info, "standardize_modes").astype(np.int64)
+        self.is_cat = np.array([domains[j] is not None
+                                for j in range(self.n_features)])
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        X = X.copy()
+        if self.standardize:                 # Kmeans_preprocessData
+            for j in range(self.n_features):
+                m = np.isnan(X[:, j])
+                if self.modes[j] == -1:      # numeric: impute + scale
+                    X[m, j] = self.means[j]
+                    X[:, j] = (X[:, j] - self.means[j]) * self.mults[j]
+                else:
+                    X[m, j] = self.modes[j]
+        n, P = X.shape
+        d2 = np.zeros((n, len(self.centers)))
+        valid = ~np.isnan(X)
+        pts = valid.sum(axis=1)
+        for c, ctr in enumerate(self.centers):
+            diff = np.where(self.is_cat[None, :], (X != ctr[None, :]) * 1.0,
+                            (X - ctr[None, :]) ** 2)
+            d2[:, c] = np.where(valid, diff, 0.0).sum(axis=1)
+        scale = np.where((pts > 0) & (pts < P), P / np.maximum(pts, 1), 1.0)
+        d2 *= scale[:, None]
+        return np.argmin(d2, axis=1).astype(np.float64)
+
+
+class RefStackedEnsembleModel(_RefModelBase):
+    """Imported StackedEnsemble MOJO (StackedEnsembleMojoReader /
+    StackedEnsembleMojoModel.score0): base-model predictions feed the
+    metalearner, with per-submodel column remapping by feature name."""
+
+    algo = "stackedensemble"
+
+    def __init__(self, info, columns, domains, base_models, metalearner,
+                 mappings):
+        super().__init__(info, columns, domains)
+        self.base_models = base_models          # list[_RefModelBase | None]
+        self.metalearner = metalearner
+        self.mappings = mappings                # per-base int[] into parent X
+        self.logit_transform = \
+            _kv(info, "metalearner_transform", "NONE") == "Logit"
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        nb = len(self.base_models)
+        if self.nclasses > 2:
+            base = np.zeros((n, nb * self.nclasses))
+            for i, (m, mp) in enumerate(zip(self.base_models, self.mappings)):
+                if m is None:
+                    continue
+                base[:, i * self.nclasses:(i + 1) * self.nclasses] = \
+                    m.score(X[:, mp])
+        elif self.nclasses == 2:
+            base = np.zeros((n, nb))
+            for i, (m, mp) in enumerate(zip(self.base_models, self.mappings)):
+                if m is not None:
+                    base[:, i] = m.score(X[:, mp])[:, 1]
+        else:
+            base = np.zeros((n, nb))
+            for i, (m, mp) in enumerate(zip(self.base_models, self.mappings)):
+                if m is not None:
+                    base[:, i] = m.score(X[:, mp])
+        if self.logit_transform:
+            # StackedEnsembleMojoModel.logit: p clipped to [1e-9, 1-1e-9],
+            # then max(-19, log odds) — the LOWER side clamps to -19, the
+            # upper does not (Java: x==0 ? -19 : max(-19, log(x)))
+            b = np.clip(base, 1e-9, 1 - 1e-9)
+            base = np.maximum(-19.0, np.log(b / (1 - b)))
+        return self.metalearner.score(base)
+
+
 # -- zip-level entry ---------------------------------------------------------
 
 def is_reference_mojo(path: str) -> bool:
@@ -503,45 +604,91 @@ def is_reference_mojo(path: str) -> bool:
 def load_ref_mojo(path_or_bytes):
     """Load a reference H2O-3 MOJO zip into a scoring model.
 
-    Supported algos: gbm, drf (tree families, MOJO >= 1.20), glm.  Raises
-    with a clear message otherwise — matching ``ModelMojoFactory``'s
+    Supported algos: gbm, drf (tree families, MOJO >= 1.20), glm, kmeans,
+    stackedensemble (nested submodels, MultiModelMojoReader layout).
+    Raises with a clear message otherwise — matching ``ModelMojoFactory``'s
     algo dispatch (``hex/genmodel/ModelMojoFactory.java``).
     """
     src = io.BytesIO(path_or_bytes) if isinstance(path_or_bytes, bytes) \
         else path_or_bytes
     with zipfile.ZipFile(src) as z:
-        info, columns, domain_files = _parse_ini(z.read("model.ini").decode())
-        escape = _kv(info, "escape_domain_values") == "true"
-        domains: list = [None] * len(columns)
-        for ci, (_card, fname) in domain_files.items():
-            lines = z.read("domains/" + fname).decode().splitlines()
-            domains[ci] = [(_unescape(s) if escape else s).strip()
-                           for s in lines]
-        algo = _kv(info, "algo")
-        mojo_version = float(_kv(info, "mojo_version", 0))
-        if algo in ("gbm", "drf"):
-            if mojo_version < 1.20:
-                raise ValueError(
-                    f"tree MOJO version {mojo_version} predates the "
-                    "ScoreTree2 bytecode; re-export with H2O-3 >= 3.22")
-            nclasses = max(1, int(_kv(info, "n_classes", 1)))
-            tpc = _kv(info, "n_trees_per_class")
-            if tpc is None:
-                bdt = _kv(info, "binomial_double_trees") == "true"
-                tpc = 1 if (nclasses == 2 and not bdt) else nclasses
-            tpc = int(tpc)
-            n_groups = int(_kv(info, "n_trees"))
-            trees = [[None] * n_groups for _ in range(tpc)]
-            names = set(z.namelist())
-            for k in range(tpc):
-                for g in range(n_groups):
-                    name = f"trees/t{k:02d}_{g:03d}.bin"
-                    if name in names:
-                        trees[k][g] = _decode_tree(z.read(name))
-            return RefTreeModel(info, columns, domains, trees, algo)
-        if algo == "glm":
-            return RefGlmModel(info, columns, domains)
-        raise ValueError(
-            f"unsupported reference MOJO algo {algo!r}; this importer "
-            "handles gbm, drf, glm (export other families from this "
-            "framework's own MOJO v2 instead)")
+        return _load_from_zip(z, "")
+
+
+def _load_from_zip(z: zipfile.ZipFile, prefix: str):
+    """Load the model rooted at ``prefix`` inside the (possibly shared)
+    zip — submodels of a StackedEnsemble live under ``models/...`` in the
+    parent archive (MultiModelMojoReader.NestedMojoReaderBackend)."""
+    info, columns, domain_files = _parse_ini(
+        z.read(prefix + "model.ini").decode())
+    escape = _kv(info, "escape_domain_values") == "true"
+    domains: list = [None] * len(columns)
+    for ci, (_card, fname) in domain_files.items():
+        lines = z.read(prefix + "domains/" + fname).decode().splitlines()
+        domains[ci] = [(_unescape(s) if escape else s).strip()
+                       for s in lines]
+    algo = _kv(info, "algo")
+    mojo_version = float(_kv(info, "mojo_version", 0))
+    if algo in ("gbm", "drf"):
+        if mojo_version < 1.20:
+            raise ValueError(
+                f"tree MOJO version {mojo_version} predates the "
+                "ScoreTree2 bytecode; re-export with H2O-3 >= 3.22")
+        nclasses = max(1, int(_kv(info, "n_classes", 1)))
+        tpc = _kv(info, "n_trees_per_class")
+        if tpc is None:
+            bdt = _kv(info, "binomial_double_trees") == "true"
+            tpc = 1 if (nclasses == 2 and not bdt) else nclasses
+        tpc = int(tpc)
+        n_groups = int(_kv(info, "n_trees"))
+        trees = [[None] * n_groups for _ in range(tpc)]
+        names = set(z.namelist())
+        for k in range(tpc):
+            for g in range(n_groups):
+                name = f"{prefix}trees/t{k:02d}_{g:03d}.bin"
+                if name in names:
+                    trees[k][g] = _decode_tree(z.read(name))
+        return RefTreeModel(info, columns, domains, trees, algo)
+    if algo == "glm":
+        return RefGlmModel(info, columns, domains)
+    if algo == "kmeans":
+        return RefKMeansModel(info, columns, domains)
+    if algo == "stackedensemble":
+        subs: dict = {}
+        n_sub = int(_kv(info, "submodel_count", 0))
+        for i in range(n_sub):
+            key = _kv(info, f"submodel_key_{i}")
+            sub_dir = _kv(info, f"submodel_dir_{i}")
+            subs[key] = _load_from_zip(z, prefix + sub_dir)
+        meta_key = _kv(info, "metalearner")
+        meta = subs.get(meta_key)
+        if meta is None:
+            raise ValueError(
+                f"stackedensemble MOJO names metalearner {meta_key!r} but "
+                f"the archive's submodels are {sorted(subs)}")
+        nb = int(_kv(info, "base_models_num", 0))
+        base_models, mappings = [], []
+        n_feat = int(_kv(info, "n_features"))
+        col_index = {c: j for j, c in enumerate(columns[:n_feat])}
+        for i in range(nb):
+            bkey = _kv(info, f"base_model{i}")
+            m = subs.get(bkey)
+            base_models.append(m)
+            if m is None:
+                mappings.append(None)
+                continue
+            # remap by feature NAME: submodels may order columns differently
+            # (StackedEnsembleMojoReader.createMapping)
+            feats = m.columns[: m.n_features]
+            try:
+                mappings.append(np.array([col_index[f] for f in feats],
+                                         np.int64))
+            except KeyError as e:
+                raise ValueError(f"base model {bkey!r} input column {e} "
+                                 "missing from the ensemble frame") from None
+        return RefStackedEnsembleModel(info, columns, domains, base_models,
+                                       meta, mappings)
+    raise ValueError(
+        f"unsupported reference MOJO algo {algo!r}; this importer "
+        "handles gbm, drf, glm, kmeans, stackedensemble (export other "
+        "families from this framework's own MOJO v2 instead)")
