@@ -293,6 +293,84 @@ h2o.isolationForest <- function(training_frame, x = NULL, ...)
 h2o.prcomp <- function(training_frame, x = NULL, k = 2, ...)
   .train("pca", x, NULL, training_frame, k = k, ...)
 
+# -- long-tail estimator verbs (reference h2o-r surface; each maps onto the
+# -- same ModelBuilders POST + job-poll machinery) ---------------------------
+
+h2o.coxph <- function(x = NULL, event_column, stop_column, training_frame,
+                      ...)
+  .train("coxph", x, event_column, training_frame,
+         stop_column = stop_column, ...)
+
+h2o.gam <- function(x = NULL, y, training_frame, gam_columns = NULL, ...) {
+  extra <- list(...)
+  if (!is.null(gam_columns))
+    extra$gam_columns <- paste0("[", paste(gam_columns, collapse = ","), "]")
+  do.call(.train, c(list("gam", x, y, training_frame), extra))
+}
+
+h2o.glrm <- function(training_frame, k = 2, ...)
+  .train("glrm", NULL, NULL, training_frame, k = k, ...)
+
+h2o.svd <- function(training_frame, nv = 2, ...)
+  .train("svd", NULL, NULL, training_frame, nv = nv, ...)
+
+h2o.rulefit <- function(x = NULL, y, training_frame, ...)
+  .train("rulefit", x, y, training_frame, ...)
+
+h2o.psvm <- function(x = NULL, y, training_frame, ...)
+  .train("psvm", x, y, training_frame, ...)
+
+h2o.isotonicregression <- function(x = NULL, y, training_frame, ...)
+  .train("isotonicregression", x, y, training_frame, ...)
+
+h2o.targetencoder <- function(x = NULL, y, training_frame, ...)
+  .train("targetencoder", x, y, training_frame, ...)
+
+h2o.extendedIsolationForest <- function(training_frame, x = NULL, ...)
+  .train("extendedisolationforest", x, NULL, training_frame, ...)
+
+h2o.upliftRandomForest <- function(x = NULL, y, training_frame,
+                                   treatment_column, ...)
+  .train("upliftdrf", x, y, training_frame,
+         treatment_column = treatment_column, ...)
+
+h2o.decision_tree <- function(x = NULL, y, training_frame, ...)
+  .train("decisiontree", x, y, training_frame, ...)
+
+h2o.aggregator <- function(training_frame, x = NULL, ...)
+  .train("aggregator", x, NULL, training_frame, ...)
+
+h2o.infogram <- function(x = NULL, y, training_frame, ...)
+  .train("infogram", x, y, training_frame, ...)
+
+h2o.anovaglm <- function(x = NULL, y, training_frame, ...)
+  .train("anovaglm", x, y, training_frame, ...)
+
+h2o.modelSelection <- function(x = NULL, y, training_frame, ...)
+  .train("modelselection", x, y, training_frame, ...)
+
+h2o.word2vec <- function(training_frame, ...)
+  .train("word2vec", NULL, NULL, training_frame, ...)
+
+# -- MOJO migration (reference h2o-r h2o.import_mojo / h2o.upload_mojo) ------
+
+h2o.import_mojo <- function(mojo_file_path, model_id = NULL) {
+  body <- list(path = mojo_file_path)
+  if (!is.null(model_id)) body$model_id <- model_id
+  out <- .http("POST", "/3/ModelBuilders/generic", body)
+  job <- .poll_job(out$job$key$name)
+  h2o.getModel(job$dest$name)
+}
+
+h2o.varimp <- function(object) {
+  vi <- object$json$output$variable_importances
+  if (is.null(vi)) return(NULL)
+  .table_to_df(vi)
+}
+
+h2o.mse <- function(perf) perf$MSE
+h2o.aucpr <- function(perf) perf$pr_auc
+
 h2o.stackedEnsemble <- function(x = NULL, y, training_frame, base_models,
                                 ...) {
   ids <- vapply(base_models, function(m)

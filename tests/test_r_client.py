@@ -255,3 +255,52 @@ def test_real_h2o_r_package_flow(server, tmp_path, rng):
         pytest.skip(f"h2o-r deps unavailable: {proc.stdout[-300:]}")
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert "REAL h2o-r flow: OK" in proc.stdout
+
+
+def test_r_wire_contract_round4(server, tmp_path, rng):
+    """Round-4 verbs: long-tail estimators, h2o.import_mojo (generic
+    builder), h2o.varimp — the exact requests the R package emits."""
+    import time
+
+    csv = _csv(tmp_path, rng)
+    st, imp = _raw_http(server, "POST", "/3/ImportFiles",
+                        {"path": csv, "destination_frame": "r4_train"})
+    assert st == 200
+
+    def _train(algo, body):
+        st, tr = _raw_http(server, "POST", f"/3/ModelBuilders/{algo}", body)
+        assert st == 200, tr
+        jkey = tr["job"]["key"]["name"]
+        for _ in range(300):
+            st, job = _raw_http(server, "GET", f"/3/Jobs/{jkey}")
+            if job["jobs"][0]["status"] in ("DONE", "FAILED"):
+                break
+            time.sleep(0.2)
+        assert job["jobs"][0]["status"] == "DONE", job
+        return job["jobs"][0]["dest"]["name"]
+
+    # a couple of long-tail estimator verbs over the same machinery
+    iso = _train("isotonicregression",
+                 {"training_frame": "r4_train", "response_column": "a",
+                  "x": '["b"]'})
+    assert iso
+    dt = _train("decisiontree",
+                {"training_frame": "r4_train", "response_column": "y",
+                 "max_depth": 3})
+
+    # h2o.varimp reads output.variable_importances off the model payload
+    gbm = _train("gbm", {"training_frame": "r4_train",
+                         "response_column": "y", "ntrees": 3})
+    st, mj = _raw_http(server, "GET", f"/3/Models/{gbm}")
+    vi = mj["models"][0]["output"].get("variable_importances")
+    assert vi and vi["rowcount"] >= 1
+
+    # h2o.import_mojo -> POST /3/ModelBuilders/generic with a path
+    gen = _train("generic",
+                 {"path": os.path.join(REPO, "tests", "data", "ref_mojo",
+                                       "gbm_variable_importance.zip")})
+    st, gj = _raw_http(server, "GET", f"/3/Models/{gen}")
+    assert gj["models"][0]["algo"] == "generic"
+
+    st, _ = _raw_http(server, "DELETE", "/3/DKV")
+    assert st == 200
