@@ -302,10 +302,11 @@ h2o.coxph <- function(x = NULL, event_column, stop_column, training_frame,
          stop_column = stop_column, ...)
 
 h2o.gam <- function(x = NULL, y, training_frame, gam_columns = NULL, ...) {
-  extra <- list(...)
-  if (!is.null(gam_columns))
-    extra$gam_columns <- paste0("[", paste(gam_columns, collapse = ","), "]")
-  do.call(.train, c(list("gam", x, y, training_frame), extra))
+  if (is.null(gam_columns))
+    .train("gam", x, y, training_frame, ...)
+  else
+    .train("gam", x, y, training_frame,
+           gam_columns = as.list(gam_columns), ...)
 }
 
 h2o.glrm <- function(training_frame, k = 2, ...)

@@ -270,14 +270,9 @@ def test_r_wire_contract_round4(server, tmp_path, rng):
     def _train(algo, body):
         st, tr = _raw_http(server, "POST", f"/3/ModelBuilders/{algo}", body)
         assert st == 200, tr
-        jkey = tr["job"]["key"]["name"]
-        for _ in range(300):
-            st, job = _raw_http(server, "GET", f"/3/Jobs/{jkey}")
-            if job["jobs"][0]["status"] in ("DONE", "FAILED"):
-                break
-            time.sleep(0.2)
-        assert job["jobs"][0]["status"] == "DONE", job
-        return job["jobs"][0]["dest"]["name"]
+        job = _poll(server, tr["job"]["key"]["name"])
+        assert job["status"] == "DONE", job
+        return job["dest"]["name"]
 
     # a couple of long-tail estimator verbs over the same machinery
     iso = _train("isotonicregression",
