@@ -17,6 +17,15 @@ class GenericModel(Model):
     def _score_raw(self, frame: Frame):
         return self.output["mojo"]._score_raw(frame)
 
+    def predict(self, frame: Frame) -> Frame:
+        # the artifact knows its own prediction-frame shape (e.g. an
+        # imported IsolationForest emits [predict, mean_length]); the
+        # generic Model.predict only understands classifier/regression
+        inner = self.output["mojo"]
+        if hasattr(inner, "predict"):
+            return inner.predict(frame)
+        return super().predict(frame)
+
 
 class Generic(ModelBuilder):
     """h2o-py surface: ``H2OGenericEstimator(path=...)`` / ``h2o.import_mojo``."""

@@ -2,8 +2,9 @@
 
 H2O-3 users arrive with MOJO zips produced by ``model.download_mojo()``; this
 module reads that format directly so ``h2o.import_mojo`` / ``Generic`` work on
-existing artifacts (VERDICT r3 missing #1). Families: GBM, DRF (tree
-bytecode >= 1.20), GLM, K-means, and StackedEnsemble (nested submodels).  Format provenance (studied, not
+existing artifacts (VERDICT r3 missing #1). Families: GBM, DRF, IsolationForest
+(tree bytecode >= 1.20), GLM, K-means, and StackedEnsemble (nested
+submodels).  Format provenance (studied, not
 copied — this is a from-scratch Python reader):
 
 - ``model.ini`` grammar: ``hex/genmodel/ModelMojoReader.java:286-333``
@@ -499,6 +500,55 @@ class RefGlmModel(_RefModelBase):
         return mu
 
 
+class RefIsoForModel(RefTreeModel):
+    """Imported IsolationForest MOJO (IsolationForestMojoReader/-MojoModel):
+    trees sum path lengths; score = (max − sum)/(max − min), plus the mean
+    path length (and the anomaly flag when the artifact outputs one)."""
+
+    def __init__(self, info, columns, domains, trees):
+        super().__init__(info, columns, domains, trees, "isolationforest")
+        self.min_path = float(_kv(info, "min_path_length", 0) or 0)
+        self.max_path = float(_kv(info, "max_path_length", 0) or 0)
+        self.anomaly_flag = _kv(info, "output_anomaly_flag") == "true"
+
+    def _score_raw(self, frame):
+        """Model contract: 1-D padded scores (model_base.py:103); the full
+        [score, mean_length(, flag)] table is predict()'s shape."""
+        import jax.numpy as jnp
+        raw = self.score(self._design(frame)).astype(np.float32)
+        score = raw[:, 1] if self.anomaly_flag else raw[:, 0]
+        pad = frame.vecs[0].plen - frame.nrows
+        if pad > 0:
+            score = np.pad(score, (0, pad))
+        return jnp.asarray(score)
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        sums = np.zeros(X.shape[0], np.float64)
+        for t in self.trees[0]:
+            if t is not None:
+                sums += _score_tree(t, X, self._domain_len)
+        mean_len = sums / max(self.n_groups, 1)
+        if self.max_path > self.min_path:
+            score = (self.max_path - sums) / (self.max_path - self.min_path)
+        else:
+            score = np.ones_like(sums)
+        if self.anomaly_flag:
+            # >= : the threshold convention everywhere else (EasyPredict,
+            # model_base.py binomial labels)
+            return np.stack([(score >= self._default_threshold) * 1.0,
+                             score, mean_len], 1)
+        return np.stack([score, mean_len], 1)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        raw = self.score(self._design(frame)).astype(np.float32)
+        names = (["predict", "score", "mean_length"] if self.anomaly_flag
+                 else ["predict", "mean_length"])
+        return Frame(names, [Vec.from_numpy(raw[:, j])
+                             for j in range(raw.shape[1])])
+
+
 class RefKMeansModel(_RefModelBase):
     """Imported K-means MOJO (KMeansMojoReader/KMeansMojoModel +
     GenModel.KMeans_distance: Euclidean on numerics, 0/1 mismatch on
@@ -604,8 +654,9 @@ def is_reference_mojo(path: str) -> bool:
 def load_ref_mojo(path_or_bytes):
     """Load a reference H2O-3 MOJO zip into a scoring model.
 
-    Supported algos: gbm, drf (tree families, MOJO >= 1.20), glm, kmeans,
-    stackedensemble (nested submodels, MultiModelMojoReader layout).
+    Supported algos: gbm, drf, isolationforest (tree families, MOJO
+    >= 1.20), glm, kmeans, stackedensemble (nested submodels,
+    MultiModelMojoReader layout).
     Raises with a clear message otherwise — matching ``ModelMojoFactory``'s
     algo dispatch (``hex/genmodel/ModelMojoFactory.java``).
     """
@@ -629,7 +680,7 @@ def _load_from_zip(z: zipfile.ZipFile, prefix: str):
                        for s in lines]
     algo = _kv(info, "algo")
     mojo_version = float(_kv(info, "mojo_version", 0))
-    if algo in ("gbm", "drf"):
+    if algo in ("gbm", "drf", "isolationforest"):
         if mojo_version < 1.20:
             raise ValueError(
                 f"tree MOJO version {mojo_version} predates the "
@@ -648,6 +699,8 @@ def _load_from_zip(z: zipfile.ZipFile, prefix: str):
                 name = f"{prefix}trees/t{k:02d}_{g:03d}.bin"
                 if name in names:
                     trees[k][g] = _decode_tree(z.read(name))
+        if algo == "isolationforest":
+            return RefIsoForModel(info, columns, domains, trees)
         return RefTreeModel(info, columns, domains, trees, algo)
     if algo == "glm":
         return RefGlmModel(info, columns, domains)
@@ -689,6 +742,6 @@ def _load_from_zip(z: zipfile.ZipFile, prefix: str):
         return RefStackedEnsembleModel(info, columns, domains, base_models,
                                        meta, mappings)
     raise ValueError(
-        f"unsupported reference MOJO algo {algo!r}; this importer "
-        "handles gbm, drf, glm, kmeans, stackedensemble (export other "
-        "families from this framework's own MOJO v2 instead)")
+        f"unsupported reference MOJO algo {algo!r}; this importer handles "
+        "gbm, drf, isolationforest, glm, kmeans, stackedensemble (export "
+        "other families from this framework's own MOJO v2 instead)")
