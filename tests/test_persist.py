@@ -100,3 +100,34 @@ def test_grid_recovery_resume(rng, tmp_path):
 
     rec = Recovery(rdir)
     assert not rec.resuming   # done() marked complete
+
+
+def test_grid_recovery_resume_parallel(rng, tmp_path):
+    """Recovery + overlapped builds (round 4): a budget-stopped parallel
+    grid resumes under parallelism — including a resumed run whose
+    max_models budget must count the RECOVERED models (the parallel gate's
+    len(models) + in-flight accounting) — and completes the space once."""
+    f = _frame(rng, n=400)
+    rdir = str(tmp_path / "recp")
+    hyper = {"max_depth": [2, 3, 4, 5]}
+
+    gs1 = GridSearch(GBM, hyper, grid_id="gp", recovery_dir=rdir,
+                     search_criteria={"max_models": 2}, parallelism=2,
+                     ntrees=3)
+    g1 = gs1.train(y="y", training_frame=f)
+    assert len(g1.models) == 2
+
+    # resume UNDER a budget: 2 recovered + at most 1 new build
+    gs2 = GridSearch(GBM, hyper, grid_id="gp", recovery_dir=rdir,
+                     search_criteria={"max_models": 3}, parallelism=3,
+                     ntrees=3)
+    g2 = gs2.train(y="y", training_frame=f)
+    assert len(g2.models) == 3
+
+    gs3 = GridSearch(GBM, hyper, grid_id="gp", recovery_dir=rdir,
+                     parallelism=3, ntrees=3)
+    g3 = gs3.train(y="y", training_frame=f)
+    assert len(g3.models) == 4
+    depths = sorted(m.output["hyper_values"]["max_depth"] for m in g3.models)
+    assert depths == [2, 3, 4, 5]
+    assert not Recovery(rdir).resuming
