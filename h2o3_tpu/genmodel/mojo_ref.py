@@ -476,20 +476,12 @@ class RefGlmModel(_RefModelBase):
             for i in range(self.nums):
                 m = np.isnan(X[:, self.cats + i])
                 X[m, self.cats + i] = self.num_means[i]
+        if self.family == "multinomial":
+            return self._score_multinomial(X)
         eta = np.zeros(X.shape[0], np.float64)
         for i in range(self.cats):
-            # Java (int)NaN == 0 (GlmMojoModel.java:40 without imputation);
-            # numpy NaN->int64 is undefined (INT64_MIN) — pin the semantics
-            ival = np.trunc(np.nan_to_num(X[:, i], nan=0.0)).astype(np.int64)
-            if not self.use_all_levels:         # skip level 0 of each factor
-                ok = ival != 0
-                ival = ival - 1
-            else:
-                ok = np.ones(ival.shape, bool)
-            ival = ival + self.cat_offsets[i]
-            ok &= ival < self.cat_offsets[i + 1]
-            eta += np.where(ok, self.beta[np.clip(ival, 0, len(self.beta) - 1)],
-                            0.0)
+            ok, idx = self._cat_beta_index(X, i, len(self.beta))
+            eta += np.where(ok, self.beta[idx], 0.0)
         noff = int(self.cat_offsets[self.cats]) - self.cats
         for i in range(self.cats, self.cats + self.nums):
             eta += self.beta[noff + i] * X[:, i]
@@ -498,6 +490,41 @@ class RefGlmModel(_RefModelBase):
         if self.family in ("binomial", "fractionalbinomial", "quasibinomial"):
             return np.stack([1.0 - mu, mu], 1)
         return mu
+
+    def _cat_beta_index(self, X: np.ndarray, i: int, clip_bound: int):
+        """(ok, idx) for categorical column i's beta entry — the ONE copy of
+        the decoding rules: Java (int)NaN == 0 (GlmMojoModel.java:40 without
+        imputation; numpy NaN->int64 is undefined), level-0 skip without
+        use_all_factor_levels, catOffsets shift, upper-bound mask."""
+        ival = np.trunc(np.nan_to_num(X[:, i], nan=0.0)).astype(np.int64)
+        if not self.use_all_levels:             # skip level 0 of each factor
+            ok = ival != 0
+            ival = ival - 1
+        else:
+            ok = np.ones(ival.shape, bool)
+        ival = ival + self.cat_offsets[i]
+        ok &= ival < self.cat_offsets[i + 1]
+        return ok, np.clip(ival, 0, clip_bound - 1)
+
+    def _score_multinomial(self, X: np.ndarray) -> np.ndarray:
+        """GlmMultinomialMojoModel.glmScore0: flat beta of nclasses blocks of
+        P (cat one-hots | nums | intercept), per-class eta, softmax."""
+        K = self.nclasses
+        P = len(self.beta) // K
+        if P * K != len(self.beta):
+            raise ValueError("incorrect multinomial beta coding")
+        B = self.beta.reshape(K, P)
+        noff = int(self.cat_offsets[self.cats]) if self.cats else 0
+        eta = np.zeros((X.shape[0], K), np.float64)
+        for i in range(self.cats):
+            ok, idx = self._cat_beta_index(X, i, P)
+            eta += np.where(ok[:, None], B[:, idx].T, 0.0)
+        for i in range(self.nums):
+            eta += np.outer(X[:, self.cats + i], B[:, noff + i])
+        eta += B[:, P - 1][None, :]             # intercepts
+        z = eta - eta.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)
 
 
 class RefIsoForModel(RefTreeModel):

@@ -331,3 +331,88 @@ def test_isolation_forest_through_generic_wrapper(tmp_path):
                                [(4 - 1) / 3, (4 - 3) / 3], atol=1e-6)
     raw = np.asarray(model._score_raw(fr))
     assert raw.ndim == 1                       # Model contract
+
+
+def test_multinomial_ensemble_ref_mojo():
+    """Multinomial SE import: GLM-multinomial metalearner (flat per-class
+    beta blocks, GlmMultinomialMojoModel.glmScore0) over per-class base
+    probabilities; wiring asserted exact against the formula."""
+    import csv
+
+    from h2o3_tpu.genmodel.mojo_ref import load_ref_mojo
+
+    m = load_ref_mojo(f"{DATA}/ensemble_multinomial.zip")
+    assert m.nclasses == 3 and m.metalearner.family == "multinomial"
+
+    with open(f"{DATA}/prostate.csv") as f:
+        rows = list(csv.DictReader(f))
+    names = m.columns[: m.n_features]
+    X = np.zeros((len(rows), m.n_features))
+    for j, c in enumerate(names):
+        dom = m.domains[j]
+        for i, r in enumerate(rows):
+            X[i, j] = (dom.index(r[c]) if dom and r[c] in dom
+                       else len(dom) if dom else float(r[c]))
+    p = m.score(X)
+    assert p.shape == (380, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-12)
+
+    # exact wiring: per-class base probs -> metalearner softmax
+    K = 3
+    base = np.zeros((380, len(m.base_models) * K))
+    for i, (b, mp) in enumerate(zip(m.base_models, m.mappings)):
+        base[:, i * K:(i + 1) * K] = b.score(X[:, mp])
+    np.testing.assert_allclose(p, m.metalearner.score(base),
+                               rtol=0, atol=1e-12)
+
+    # independent arithmetic for the metalearner on one row: eta_c =
+    # beta[c*P : (c+1)*P] over [nums | intercept] (cats=0 in this fixture)
+    g = m.metalearner
+    P = len(g.beta) // K
+    row = base[7]
+    eta = np.array([g.beta[c * P: c * P + len(row)] @ row
+                    + g.beta[(c + 1) * P - 1] for c in range(K)])
+    want = np.exp(eta - eta.max())
+    want /= want.sum()
+    np.testing.assert_allclose(p[7], want, atol=1e-12)
+
+
+def test_multinomial_glm_with_categoricals(tmp_path):
+    """The categorical branch of multinomial GLM scoring (level-0 skip,
+    catOffsets shift, per-class beta blocks) against hand arithmetic —
+    the committed fixture has cats=0, so this path needs its own artifact."""
+    from h2o3_tpu.genmodel.mojo_ref import load_ref_mojo
+
+    # 1 categorical (3 levels, use_all_factor_levels), 1 numeric, 3 classes:
+    # P = 3 (cat) + 1 (num) + 1 (intercept) = 5; beta = 3 blocks of 5
+    beta = [0.1, 0.2, 0.3, 1.0, -0.5,     # class 0
+            0.4, 0.5, 0.6, -1.0, 0.25,    # class 1
+            0.0, 0.7, 0.8, 0.5, 0.0]      # class 2
+    ini = "\n".join([
+        "[info]", "algo = glm", "mojo_version = 1.00",
+        "category = Multinomial", "supervised = true",
+        "n_features = 2", "n_classes = 3", "n_columns = 3", "n_domains = 2",
+        "family = multinomial", "link = multinomial",
+        "use_all_factor_levels = true", "cats = 1",
+        "cat_offsets = [0, 3]", "nums = 1", "mean_imputation = false",
+        f"beta = {beta}",
+        "[columns]", "c", "x", "y",
+        "[domains]", "0: 3 d000.txt", "2: 3 d001.txt", ""])
+    p = tmp_path / "glm_multi.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("model.ini", ini)
+        z.writestr("domains/d000.txt", "a\nb\nc\n")
+        z.writestr("domains/d001.txt", "r0\nr1\nr2\n")
+
+    m = load_ref_mojo(str(p))
+    X = np.array([[0.0, 2.0],     # level a
+                  [2.0, -1.0],    # level c
+                  [7.0, 1.0]])    # out-of-range level -> cat beta skipped
+    got = m.score(X)
+    B = np.array(beta).reshape(3, 5)
+    for r, (lvl, xnum) in enumerate([(0, 2.0), (2, -1.0), (None, 1.0)]):
+        eta = np.array([(B[k, lvl] if lvl is not None else 0.0)
+                        + B[k, 3] * xnum + B[k, 4] for k in range(3)])
+        want = np.exp(eta - eta.max())
+        want /= want.sum()
+        np.testing.assert_allclose(got[r], want, atol=1e-12, err_msg=str(r))
