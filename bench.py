@@ -313,6 +313,13 @@ def main() -> None:
         out["extra"]["backend_fallback"] = (
             f"TPU unavailable ({CPU_FALLBACK}); CPU at reduced scale — "
             "NOT comparable to per-chip baselines")
+    # a CPU capture must never read as a baseline ratio: the anchor is a
+    # per-TPU-chip number (VERDICT r4 weak #6). Keyed on the ACTUAL backend,
+    # not just the fallback flag, so a direct `JAX_PLATFORMS=cpu` run can't
+    # slip a ratio out either. Raw rows/sec stays in "extra" as a liveness
+    # probe; the ratio is explicitly null.
+    if CPU_FALLBACK or SMOKE or out["extra"]["backend"] == "cpu":
+        out["vs_baseline"] = None
     print(json.dumps(out))
     print(f"# detail: {json.dumps(extra)}", file=sys.stderr)
 

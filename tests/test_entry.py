@@ -85,3 +85,6 @@ def test_bench_smoke_falls_back_to_cpu_with_sick_backend():
     assert out["value"] > 0
     assert "backend_fallback" in out["extra"], out["extra"]
     assert out["extra"]["backend"] == "cpu"
+    # a fallback capture is a liveness probe, not evidence vs the per-chip
+    # baseline: the ratio must be null so it can never be read as one
+    assert out["vs_baseline"] is None
