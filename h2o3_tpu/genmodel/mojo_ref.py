@@ -683,7 +683,9 @@ def load_ref_mojo(path_or_bytes):
 
     Supported algos: gbm, drf, isolationforest (tree families, MOJO
     >= 1.20), glm, kmeans, stackedensemble (nested submodels,
-    MultiModelMojoReader layout).
+    MultiModelMojoReader layout), plus via ``mojo_ref2``: deeplearning,
+    pca, glrm, coxph, word2vec, rulefit, targetencoder,
+    isotonicregression.
     Raises with a clear message otherwise — matching ``ModelMojoFactory``'s
     algo dispatch (``hex/genmodel/ModelMojoFactory.java``).
     """
@@ -768,7 +770,16 @@ def _load_from_zip(z: zipfile.ZipFile, prefix: str):
                                  "missing from the ensemble frame") from None
         return RefStackedEnsembleModel(info, columns, domains, base_models,
                                        meta, mappings)
+    # long-tail families (DL/PCA/GLRM/CoxPH/Word2Vec/RuleFit/TargetEncoder/
+    # Isotonic) live in mojo_ref2 — same archive grammar, separate module
+    from h2o3_tpu.genmodel.mojo_ref2 import load_ext_family
+    model = load_ext_family(algo, z, prefix, info, columns, domains,
+                            lambda p: _load_from_zip(z, p))
+    if model is not None:
+        return model
     raise ValueError(
         f"unsupported reference MOJO algo {algo!r}; this importer handles "
-        "gbm, drf, isolationforest, glm, kmeans, stackedensemble (export "
-        "other families from this framework's own MOJO v2 instead)")
+        "gbm, drf, isolationforest, glm, kmeans, stackedensemble, "
+        "deeplearning, pca, glrm, coxph, word2vec, rulefit, targetencoder, "
+        "isotonicregression (export other families from this framework's "
+        "own MOJO v2 instead)")

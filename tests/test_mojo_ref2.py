@@ -1,0 +1,631 @@
+"""Long-tail reference-MOJO importer parity (VERDICT r4 next #3).
+
+The reference repo commits NO MOJO zips for these families (the only
+committed artifacts are trees/GLM/KMeans/SE/XGBoost — verified by an
+exhaustive ``find``), and this image has no JVM to mint them.  So each
+fixture here is a zip SYNTHESIZED to the writer's documented format
+(``DeepLearningMojoWriter.java``, ``PCAMojoWriter.java``,
+``GlrmMojoWriter.java``, ``CoxPHMojoWriter.java``,
+``Word2VecMojoWriter.java``, ``RuleFitMojoWriter.java``,
+``TargetEncoderMojoWriter.java``, ``IsotonicRegressionMojoWriter.java``
++ ``AbstractMojoWriter.java`` for the shared kv/blob grammar), and every
+expected value is computed by INDEPENDENT math in the test body (explicit
+per-row loops following the scoring spec, or closed-form algebra) — never
+by calling the reader's own vectorized code path on both sides.
+"""
+
+import io
+import math
+import struct
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.genmodel.mojo_ref import load_ref_mojo
+
+
+# -- fixture builder: the writer side of the MOJO grammar --------------------
+
+def _fmt(v) -> str:
+    """AbstractMojoWriter.writekv: value.toString(); java arrays print as
+    ``[a, b, c]`` (Arrays.toString), booleans as true/false."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return "[" + ", ".join(str(float(x)) if isinstance(x, (float, np.floating))
+                               else str(int(x)) for x in v) + "]"
+    return str(v)
+
+
+def _mojo_zip(algo: str, columns, domains, info: dict, blobs: dict | None = None,
+              texts: dict | None = None, supervised=True, n_classes=1,
+              extra_ini: str = "") -> bytes:
+    """Assemble a model.ini + domains/ + blobs zip in the reference layout
+    (ModelMojoReader.java:286-333 grammar)."""
+    n_features = len(columns) - (1 if supervised else 0)
+    base = {
+        "h2o_version": "3.46.0.1", "mojo_version": info.pop("mojo_version", "1.00"),
+        "algo": algo, "algorithm": algo,
+        "endianness": "LITTLE_ENDIAN", "category": "Unknown",
+        "uuid": "1234567890", "supervised": supervised,
+        "n_features": n_features, "n_classes": n_classes,
+        "n_columns": len(columns),
+        "n_domains": sum(d is not None for d in domains),
+        "balance_classes": False, "default_threshold": 0.5,
+    }
+    base.update(info)
+    lines = ["[info]"] + [f"{k} = {_fmt(v)}" for k, v in base.items()]
+    if extra_ini:                      # extra kv entries (still [info])
+        lines += [ln for ln in extra_ini.splitlines() if ln]
+    lines += ["", "[columns]"] + list(columns) + ["", "[domains]"]
+    dom_files = {}
+    di = 0
+    for ci, d in enumerate(domains):
+        if d is not None:
+            fname = f"d{di:03d}.txt"
+            lines.append(f"{ci}: {len(d)} {fname}")
+            dom_files[f"domains/{fname}"] = "\n".join(d) + "\n"
+            di += 1
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("model.ini", "\n".join(lines) + "\n")
+        for name, text in dom_files.items():
+            z.writestr(name, text)
+        for name, text in (texts or {}).items():
+            z.writestr(name, text)
+        for name, blob in (blobs or {}).items():
+            z.writestr(name, blob)
+    return buf.getvalue()
+
+
+def _be_d(arr) -> bytes:
+    """ByteBuffer.putDouble stream (big-endian)."""
+    return np.asarray(arr, np.float64).astype(">f8").tobytes()
+
+
+def _load(zip_bytes: bytes):
+    return load_ref_mojo(zip_bytes)
+
+
+# -- DeepLearning ------------------------------------------------------------
+
+class TestDeepLearningMojo:
+    def _fixture(self, activation="Tanh", family="gaussian", n_classes=1,
+                 dropout=None, norm_resp=False):
+        # columns: 1 cat (3 levels), 2 nums, response
+        rng = np.random.default_rng(3)
+        units = [5, 4, n_classes if n_classes > 1 else 1]
+        # cat_offsets [0, 3]: 3 one-hot slots (use_all_factor_levels=true)
+        w0 = rng.normal(size=units[1] * units[0]).round(3)
+        b0 = rng.normal(size=units[1]).round(3)
+        w1 = rng.normal(size=units[2] * units[1]).round(3)
+        b1 = rng.normal(size=units[2]).round(3)
+        info = {
+            "mojo_version": "1.10",
+            "mini_batch_size": 1, "nums": 2, "cats": 1,
+            "cat_offsets": [0, 3], "norm_mul": [0.5, 2.0],
+            "norm_sub": [1.0, -1.0],
+            "use_all_factor_levels": True, "activation": activation,
+            "distribution": family, "mean_imputation": False,
+            "neural_network_sizes": units,
+            "hidden_dropout_ratios": dropout or [0.0, 0.0],
+            "weight_layer0": w0, "bias_layer0": b0,
+            "weight_layer1": w1, "bias_layer1": b1,
+            "_genmodel_encoding": "AUTO",
+        }
+        if norm_resp:
+            info["norm_resp_mul"] = [0.25]
+            info["norm_resp_sub"] = [10.0]
+        domains = [["a", "b", "c"], None, None,
+                   [str(i) for i in range(n_classes)] if n_classes > 1
+                   else None]
+        zb = _mojo_zip("deeplearning", ["cat", "x1", "x2", "y"], domains,
+                       info, n_classes=n_classes)
+        return _load(zb), (w0, b0, w1, b1)
+
+    @staticmethod
+    def _act(name, z):
+        if name == "Tanh":
+            return 1.0 - 2.0 / (1.0 + math.exp(2.0 * z))
+        if name == "Rectifier":
+            return 0.5 * (z + abs(z))
+        if name == "ExpRectifier":
+            return z if z >= 0 else math.exp(z) - 1
+        raise AssertionError(name)
+
+    def _expected_row(self, row, w0, b0, w1, b1, activation, units):
+        """Independent scalar fprop per GenModel.setInput +
+        NeuralNetwork.formNNInputs: one-hot cat, standardized nums."""
+        cat, x1, x2 = row
+        inp = [0.0] * units[0]
+        if math.isnan(cat):
+            inp[2] = 1.0                       # NA -> last level of block
+        else:
+            inp[int(cat)] = 1.0
+        for j, x in enumerate((x1, x2)):
+            s = 0.0 if math.isnan(x) else (x - [1.0, -1.0][j]) * [0.5, 2.0][j]
+            inp[3 + j] = s
+        w0f = np.float32(w0)                   # convertDouble2Float
+        h = []
+        base = activation.replace("WithDropout", "")
+        for r in range(units[1]):
+            z = sum(float(w0f[r * units[0] + c]) * inp[c]
+                    for c in range(units[0])) + b0[r]
+            h.append(self._act(base, z))
+        w1f = np.float32(w1)
+        out = [sum(float(w1f[r * units[1] + c]) * h[c]
+                   for c in range(units[1])) + b1[r]
+               for r in range(units[2])]
+        return out
+
+    def test_regression_forward_exact(self):
+        m, (w0, b0, w1, b1) = self._fixture()
+        X = np.array([[0, 2.0, 0.5], [2, -1.0, 3.0], [np.nan, np.nan, 1.0]])
+        got = m.score(X)
+        for r in range(3):
+            (exp,) = self._expected_row(X[r], w0, b0, w1, b1, "Tanh", [5, 4, 1])
+            assert got[r] == pytest.approx(exp, rel=1e-6)
+
+    def test_binomial_softmax_and_threshold(self):
+        m, (w0, b0, w1, b1) = self._fixture(activation="Rectifier",
+                                            family="bernoulli", n_classes=2)
+        X = np.array([[1, 0.3, -0.7], [0, -2.0, 0.1]])
+        got = m.score(X)
+        assert got.shape == (2, 2)
+        for r in range(2):
+            z = self._expected_row(X[r], w0, b0, w1, b1, "Rectifier", [5, 4, 2])
+            e = np.exp(np.array(z) - max(z))
+            p = e / e.sum()
+            assert got[r] == pytest.approx(p, rel=1e-6)
+        assert np.allclose(got.sum(axis=1), 1.0)
+
+    def test_dropout_scaling_and_poisson_link(self):
+        m, (w0, b0, w1, b1) = self._fixture(activation="TanhWithDropout",
+                                            family="poisson",
+                                            dropout=[0.5, 0.0])
+        X = np.array([[1, 1.0, 1.0]])
+        z = self._expected_row(X[0], w0, b0, w1, b1, "TanhWithDropout",
+                               [5, 4, 1])
+        # hidden outputs scale by (1 - ratio) BEFORE the next layer; redo
+        # the final layer on scaled hiddens
+        h = []
+        for r in range(4):
+            s = sum(float(np.float32(w0)[r * 5 + c]) *
+                    [0.0, 1.0, 0.0, 0.0, 4.0][c] for c in range(5)) + b0[r]
+            h.append(self._act("Tanh", s) * 0.5)
+        out = sum(float(np.float32(w1)[c]) * h[c] for c in range(4)) + b1[0]
+        assert m.score(X)[0] == pytest.approx(min(1e19, math.exp(out)),
+                                              rel=1e-6)
+        del z
+
+    def test_response_unscaling(self):
+        m, (w0, b0, w1, b1) = self._fixture(norm_resp=True)
+        X = np.array([[0, 0.0, 0.0]])
+        (raw,) = self._expected_row(X[0], w0, b0, w1, b1, "Tanh", [5, 4, 1])
+        assert m.score(X)[0] == pytest.approx(raw / 0.25 + 10.0, rel=1e-6)
+
+
+# -- PCA ---------------------------------------------------------------------
+
+class TestPCAMojo:
+    def _fixture(self, use_all=True):
+        # 1 cat (2 levels) + 2 nums, k=2; eigenvector rows: cat levels
+        # then nums (permutation maps model col order)
+        eig = np.array([[1.0, 0.5], [-1.0, 2.0],   # cat level rows
+                        [2.0, 0.0], [0.0, 3.0]])   # num rows
+        if not use_all:
+            eig = eig[1:]                           # level 0 dropped
+        info = {
+            "k": 2, "use_all_factor_levels": use_all,
+            "permutation": [0, 1, 2], "ncats": 1, "nnums": 2,
+            "normSub": [1.0, 2.0], "normMul": [2.0, 0.5],
+            "catOffsets": [0, 2] if use_all else [0, 1],
+            "eigenvector_size": len(eig),
+        }
+        zb = _mojo_zip("pca", ["cat", "x1", "x2"],
+                       [["u", "v"], None, None], info,
+                       blobs={"eigenvectors_raw": _be_d(eig.ravel())},
+                       supervised=False)
+        return _load(zb), eig
+
+    def test_projection(self):
+        m, eig = self._fixture()
+        X = np.array([[0, 3.0, 4.0], [1, 1.0, 2.0]])
+        got = m.score(X)
+        for r, (cat, x1, x2) in enumerate(X):
+            exp = eig[int(cat)] + (x1 - 1.0) * 2.0 * eig[2] \
+                + (x2 - 2.0) * 0.5 * eig[3]
+            assert got[r] == pytest.approx(exp, rel=1e-12)
+
+    def test_na_and_unseen_level_skip(self):
+        m, eig = self._fixture()
+        got = m.score(np.array([[np.nan, 1.0, 2.0], [7, 1.0, 2.0]]))
+        # standardized nums are exactly 0 -> only the (skipped) cat remains
+        assert got[0] == pytest.approx([0.0, 0.0])
+        assert got[1] == pytest.approx([0.0, 0.0])
+
+    def test_level_drop_without_all_factor_levels(self):
+        m, eig = self._fixture(use_all=False)
+        got = m.score(np.array([[0, 1.0, 2.0], [1, 1.0, 2.0]]))
+        assert got[0] == pytest.approx([0.0, 0.0])      # level 0 dropped
+        assert got[1] == pytest.approx(eig[0])           # level 1 -> row 0
+
+
+# -- GLRM --------------------------------------------------------------------
+
+class TestGlrmMojo:
+    def _fixture(self, regularization="None", gammax=0.0, seed=42):
+        # rank 2, 3 numeric columns, quadratic loss; Y rows orthogonal so
+        # the optimum has closed form x* = a Y^T (Y Y^T)^-1
+        Y = np.array([[1.0, 0.0, 1.0], [0.0, 1.0, -1.0]])
+        info = {
+            "mojo_version": "1.10",
+            "initialization": "SVD", "regularizationX": regularization,
+            "regularizationY": "None", "gammaX": gammax, "gammaY": 0.0,
+            "ncolX": 2, "seed": seed, "reverse_transform": False,
+            "cols_permutation": [0, 1, 2], "num_categories": 0,
+            "num_numeric": 3, "norm_sub": [0.0, 0.0, 0.0],
+            "norm_mul": [1.0, 1.0, 1.0], "transposed": False,
+            "ncolA": 3, "ncolY": 3, "nrowY": 2,
+            "num_levels_per_category": [], "catOffsets": [0],
+        }
+        zb = _mojo_zip("glrm", ["x1", "x2", "x3"], [None, None, None], info,
+                       blobs={"archetypes": _be_d(Y.ravel())},
+                       texts={"losses": "Quadratic\nQuadratic\nQuadratic\n"},
+                       supervised=False)
+        return _load(zb), Y
+
+    def test_x_solve_reconstructs(self):
+        m, Y = self._fixture()
+        A = np.array([[2.0, -1.0, 3.0], [0.5, 0.5, 0.0]])
+        X = m.score(A)
+        # closed-form least-squares target
+        exp = A @ Y.T @ np.linalg.inv(Y @ Y.T)
+        assert X == pytest.approx(exp, abs=5e-4)
+        assert np.abs(X @ Y - A).max() < 1e-3
+
+    def test_deterministic_per_seed(self):
+        m, _ = self._fixture()
+        A = np.array([[1.0, 2.0, 3.0]])
+        assert np.array_equal(m.score(A), m.score(A))
+
+    def test_nonneg_regularizer_projects(self):
+        m, Y = self._fixture(regularization="NonNegative", gammax=0.1)
+        A = np.array([[-5.0, -5.0, 0.0]])   # optimum wants negative x
+        X = m.score(A)
+        assert (X >= 0).all()
+
+    def test_missing_cells_skipped(self):
+        m, Y = self._fixture()
+        A = np.array([[2.0, np.nan, np.nan]])
+        X = m.score(A)
+        # only column 0 constrains: x0*1 + x1*0 = 2 -> x0 ~ 2 (x1 free-ish)
+        assert X[0, 0] == pytest.approx(2.0, abs=1e-2)
+
+
+# -- CoxPH -------------------------------------------------------------------
+
+class TestCoxPHMojo:
+    def _fixture(self, strata=False):
+        # 1 cat (3 levels, level 0 dropped), 2 nums
+        coef = np.array([0.5, -0.25, 1.5, 2.0])  # [catL1, catL2, num1, num2]
+        x_mean_cat = np.array([[0.3, 0.2]])
+        x_mean_num = np.array([[1.0, -1.0]])
+        info = {
+            "coef": coef, "cats": 1, "cat_offsets": [0, 2],
+            "use_all_factor_levels": False,
+            "num_numerical_columns": 2, "num_offsets": [2, 3],
+            "strata_count": 0,
+            "x_mean_cat_size1": 1, "x_mean_cat_size2": 2,
+            "x_mean_num_size1": 1, "x_mean_num_size2": 2,
+        }
+        columns = ["cat", "n1", "n2", "y"]
+        domains = [["a", "b", "c"], None, None, None]
+        if strata:
+            info.update(strata_count=2, strata_0=[0.0], strata_1=[1.0],
+                        x_mean_cat_size1=2, x_mean_num_size1=2)
+            x_mean_cat = np.array([[0.3, 0.2], [0.1, 0.6]])
+            x_mean_num = np.array([[1.0, -1.0], [0.0, 2.0]])
+            columns = ["s", "cat", "n1", "n2", "y"]
+            domains = [["p", "q"], ["a", "b", "c"], None, None, None]
+        zb = _mojo_zip("coxph", columns, domains, info,
+                       blobs={"x_mean_cat": _be_d(x_mean_cat.ravel()),
+                              "x_mean_num": _be_d(x_mean_num.ravel())})
+        return _load(zb), coef, x_mean_cat, x_mean_num
+
+    def test_linear_predictor(self):
+        m, coef, xc, xn = self._fixture()
+        lp_base = xc[0] @ coef[:2] + xn[0] @ coef[2:]
+        X = np.array([[0, 1.0, 2.0],     # level 0 dropped -> no cat coef
+                      [1, 0.0, 0.0],     # level 1 -> coef[0]
+                      [2, -1.0, 1.0]])   # level 2 -> coef[1]
+        got = m.score(X)
+        exp = [1.0 * 1.5 + 2.0 * 2.0 - lp_base,
+               0.5 - lp_base,
+               -0.25 - 1.5 + 2.0 - lp_base]
+        assert got == pytest.approx(exp, rel=1e-12)
+
+    def test_na_cat_gives_nan(self):
+        m, *_ = self._fixture()
+        assert math.isnan(m.score(np.array([[np.nan, 1.0, 1.0]]))[0])
+
+    def test_strata_lookup(self):
+        m, coef, xc, xn = self._fixture(strata=True)
+        lp0 = xc[0] @ coef[:2] + xn[0] @ coef[2:]
+        lp1 = xc[1] @ coef[:2] + xn[1] @ coef[2:]
+        X = np.array([[0, 1, 1.0, 0.0],   # stratum 0, cat level 1
+                      [1, 1, 1.0, 0.0]])  # stratum 1, same features
+        got = m.score(X)
+        assert got[0] == pytest.approx(0.5 + 1.5 - lp0, rel=1e-12)
+        assert got[1] == pytest.approx(0.5 + 1.5 - lp1, rel=1e-12)
+        assert got[0] - got[1] == pytest.approx(lp1 - lp0, rel=1e-9)
+
+    def test_unseen_or_na_stratum_is_nan_not_crash(self):
+        m, *_ = self._fixture(strata=True)
+        X = np.array([[np.nan, 1, 1.0, 0.0],   # NA stratum
+                      [7, 1, 1.0, 0.0],        # unseen stratum
+                      [0, 1, 1.0, 0.0]])       # healthy row
+        got = m.score(X)
+        assert math.isnan(got[0]) and math.isnan(got[1])
+        assert not math.isnan(got[2])
+
+
+# -- Word2Vec ----------------------------------------------------------------
+
+class TestWord2VecMojo:
+    def _fixture(self):
+        words = ["king", "queen", "apple"]
+        vecs = np.array([[1.0, 0.0, 0.5, 0.0],
+                         [0.9, 0.1, 0.4, 0.0],
+                         [-1.0, 0.2, 0.0, 0.8]], np.float32)
+        info = {"vec_size": 4, "vocab_size": 3}
+        zb = _mojo_zip("word2vec", ["text"], [None], info,
+                       blobs={"vectors": vecs.astype(">f4").tobytes()},
+                       texts={"vocabulary": "\n".join(words) + "\n"},
+                       supervised=False)
+        return _load(zb), words, vecs
+
+    def test_lookup_and_unknown(self):
+        m, words, vecs = self._fixture()
+        assert m.transform0("queen") == pytest.approx(vecs[1])
+        assert m.transform0("banana") is None
+        out = m.transform(["apple", "nope", "king"])
+        assert out[0] == pytest.approx(vecs[2])
+        assert np.isnan(out[1]).all()
+        assert out[2] == pytest.approx(vecs[0])
+
+    def test_synonyms_ranked_by_cosine(self):
+        m, *_ = self._fixture()
+        syn = m.find_synonyms("king", 2)
+        assert list(syn)[0] == "queen"
+
+    def test_predict_refuses(self):
+        m, *_ = self._fixture()
+        with pytest.raises(ValueError, match="transform"):
+            m.predict(None)
+
+
+# -- Isotonic ----------------------------------------------------------------
+
+class TestIsotonicMojo:
+    def _fixture(self):
+        tx = np.array([0.0, 0.2, 0.6, 1.0])
+        ty = np.array([0.1, 0.1, 0.7, 0.9])
+        def blob(a):
+            return struct.pack(">i", len(a)) + _be_d(a)
+        info = {"calib_min_x": 0.0, "calib_max_x": 1.0}
+        zb = _mojo_zip("isotonicregression", ["x", "y"], [None, None], info,
+                       blobs={"calib/thresholds_x": blob(tx),
+                              "calib/thresholds_y": blob(ty)})
+        return _load(zb), tx, ty
+
+    def test_interpolation_and_clip(self):
+        m, tx, ty = self._fixture()
+        X = np.array([[0.2], [0.4], [-5.0], [5.0], [np.nan]])
+        got = m.score(X)
+        assert got[0] == pytest.approx(0.1)
+        assert got[1] == pytest.approx(0.4)      # midpoint of 0.1 and 0.7
+        assert got[2] == pytest.approx(0.1)      # clipped to min_x
+        assert got[3] == pytest.approx(0.9)      # clipped to max_x
+        assert math.isnan(got[4])
+
+
+# -- RuleFit -----------------------------------------------------------------
+
+class TestRuleFitMojo:
+    def _fixture(self, model_type=1):
+        """RULES_AND_LINEAR gaussian RuleFit: depth=1, ntrees=1, two
+        complementary rules on x1 (the two leaves of a stump), nested GLM
+        with one rule variable (categorical domain = rule names) + x1."""
+        # GLM submodel: a RULES_AND_LINEAR fit sees [M0T0 (cat), x1, y];
+        # a RULES-only fit was trained on just the rule column
+        rules_only = model_type == 2
+        if rules_only:
+            glm_info = {
+                "family": "gaussian", "link": "identity",
+                "beta": [0.7, -0.3, 1.0],    # [ruleL0, ruleL1, icpt]
+                "cats": 1, "cat_offsets": [0, 2], "nums": 0,
+                "use_all_factor_levels": True, "mean_imputation": False,
+            }
+            glm_cols = ["M0T0", "y"]
+        else:
+            glm_info = {
+                "family": "gaussian", "link": "identity",
+                "beta": [0.7, -0.3, 2.0, 1.0],  # [ruleL0, ruleL1, x1, icpt]
+                "cats": 1, "cat_offsets": [0, 2], "nums": 1,
+                "use_all_factor_levels": True, "mean_imputation": False,
+            }
+            glm_cols = ["M0T0", "x1", "y"]
+        rule_dom = ["M0T0N1", "M0T0N2"]
+        # parent rules kv
+        rules_ini = "\n".join([
+            "num_rules_M0T0 = 2",
+            # rule 0: x1 < 1.5  (var M0T0N1)
+            "num_conditions_rule_id_0_0_0 = 1",
+            "feature_index_0_0_0_0 = 0", "type_0_0_0_0 = 1",
+            "num_treshold0_0_0_0 = 1.5", "operator_0_0_0_0 = 0",
+            "feature_name_0_0_0_0 = x1", "nas_included_0_0_0_0 = true",
+            "language_condition0_0_0_0 = (x1 < 1.5 or NA)",
+            "prediction_value_rule_id_0_0_0 = 0.0",
+            "language_rule_rule_id_0_0_0 = r1",
+            "coefficient_rule_id_0_0_0 = 0.7",
+            "var_name_rule_id_0_0_0 = M0T0N1",
+            "support_rule_id_0_0_0 = 0.5",
+            # rule 1: x1 >= 1.5 (var M0T0N2); condition ids are
+            # {condId}_{ruleId} (RuleFitMojoWriter.java:119)
+            "num_conditions_rule_id_0_0_1 = 1",
+            "feature_index_0_0_0_1 = 0", "type_0_0_0_1 = 1",
+            "num_treshold0_0_0_1 = 1.5", "operator_0_0_0_1 = 1",
+            "feature_name_0_0_0_1 = x1", "nas_included_0_0_0_1 = false",
+            "language_condition0_0_0_1 = (x1 >= 1.5)",
+            "prediction_value_rule_id_0_0_1 = 1.0",
+            "language_rule_rule_id_0_0_1 = r2",
+            "coefficient_rule_id_0_0_1 = -0.3",
+            "var_name_rule_id_0_0_1 = M0T0N2",
+            "support_rule_id_0_0_1 = 0.5",
+        ]) + "\n"
+        parent_info = {
+            "linear_model": "glm-1", "model_type": model_type,
+            "depth": 1, "ntrees": 1,
+            "data_from_rules_codes_len": 0,
+            "linear_names_len": 1 if rules_only else 2,
+            "linear_names_0": "M0T0",
+            **({} if rules_only else {"linear_names_1": "x1"}),
+            "submodel_count": 1, "submodel_key_0": "glm-1",
+            "submodel_dir_0": "models/m1/",
+        }
+        parent = _mojo_zip("rulefit", ["x1", "y"], [None, None], parent_info,
+                           extra_ini=rules_ini)
+        # splice the GLM submodel files into the parent archive
+        sub = _mojo_zip("glm", glm_cols,
+                        [rule_dom] + [None] * (len(glm_cols) - 1), glm_info)
+        buf = io.BytesIO(parent)
+        with zipfile.ZipFile(buf, "a") as zp, zipfile.ZipFile(
+                io.BytesIO(sub)) as zs:
+            for name in zs.namelist():
+                zp.writestr("models/m1/" + name, zs.read(name))
+        return _load(buf.getvalue())
+
+    def test_rules_and_linear_scoring(self):
+        m = self._fixture()
+        # rule fires -> GLM cat level = domain index of the fired var;
+        # + linear x1 term; + intercept
+        X = np.array([[1.0], [2.0], [np.nan]])
+        got = m.score(X)
+        # x1=1.0: rule M0T0N1 (idx 0) -> beta 0.7; x1 kept: 2.0*1.0; +1
+        assert got[0] == pytest.approx(0.7 + 2.0 * 1.0 + 1.0)
+        # x1=2.0: rule M0T0N2 (idx 1) -> -0.3; 2*2; +1
+        assert got[1] == pytest.approx(-0.3 + 2.0 * 2.0 + 1.0)
+        # NaN: rule 0 has NAs included -> fires; x1 NaN -> GLM sees NaN num
+        # with no imputation -> Java NaN propagates; numpy matches
+        assert math.isnan(got[2])
+
+    def test_rules_only_model(self):
+        m = self._fixture(model_type=2)
+        # RULES: the linear input is just the rule column, mapped by name
+        X = np.array([[1.0], [9.0]])
+        got = m.score(X)
+        assert got[0] == pytest.approx(0.7 + 1.0)
+        assert got[1] == pytest.approx(-0.3 + 1.0)
+
+
+# -- TargetEncoder -----------------------------------------------------------
+
+class TestTargetEncoderMojo:
+    def _fixture(self, blending=False, has_na=True, nclasses=2):
+        enc_lines = ["[city]"]
+        if nclasses <= 2:
+            # categories 0..2 (2 = NA bucket): num den
+            enc_lines += ["0 = 4.0 8.0", "1 = 1.0 4.0", "2 = 3.0 3.0"]
+        else:
+            for cat in range(3):
+                for tc in (1, 2):
+                    enc_lines.append(f"{cat} = {cat + tc}.0 10.0 {tc}")
+        te = "feature_engineering/target_encoding/"
+        texts = {
+            te + "encoding_map.ini": "\n".join(enc_lines) + "\n",
+            te + "te_column_name_to_missing_values_presence.ini":
+                f"city = {1 if has_na else 0}\n",
+            te + "input_encoding_columns_map.ini":
+                "[from]\ncity\n[to]\ncity\n",
+            te + "input_output_columns_map.ini":
+                "[from]\ncity\n[to]\ncity_te\n",
+        }
+        info = {"with_blending": blending, "non_predictors": "y",
+                "keep_original_categorical_columns": True}
+        if blending:
+            info.update(inflection_point=5.0, smoothing=1.0)
+        zb = _mojo_zip("targetencoder", ["city", "y"],
+                       [["nyc", "sf", "la"], ["no", "yes"]], info,
+                       texts=texts, n_classes=nclasses)
+        return _load(zb)
+
+    def test_posterior_means(self):
+        from h2o3_tpu.frame.frame import Frame
+        m = self._fixture()
+        fr = Frame.from_arrays({"city": np.array(["nyc", "sf"], object)})
+        out = m.transform(fr)
+        te = out.vec("city_te").to_numpy()[:2]
+        assert te[0] == pytest.approx(4.0 / 8.0)
+        assert te[1] == pytest.approx(1.0 / 4.0)
+
+    def test_na_uses_na_bucket_or_prior(self):
+        from h2o3_tpu.frame.frame import Frame
+        fr = Frame.from_arrays({"city": np.array(["nyc", None], object)})
+        m = self._fixture(has_na=True)
+        te = m.transform(fr).vec("city_te").to_numpy()[:2]
+        assert te[1] == pytest.approx(3.0 / 3.0)        # NA bucket
+        m2 = self._fixture(has_na=False)
+        te2 = m2.transform(fr).vec("city_te").to_numpy()[:2]
+        prior = (4.0 + 1.0 + 3.0) / (8.0 + 4.0 + 3.0)
+        assert te2[1] == pytest.approx(prior)
+
+    def test_blending(self):
+        from h2o3_tpu.frame.frame import Frame
+        m = self._fixture(blending=True)
+        fr = Frame.from_arrays({"city": np.array(["sf"], object)})
+        te = m.transform(fr).vec("city_te").to_numpy()[0]
+        prior = 8.0 / 15.0
+        lam = 1.0 / (1.0 + math.exp((5.0 - 4) / 1.0))
+        assert te == pytest.approx(lam * 0.25 + (1 - lam) * prior)
+
+    def test_source_column_replaced_unless_kept(self):
+        from h2o3_tpu.frame.frame import Frame
+        fr = Frame.from_arrays({"city": np.array(["nyc"], object)})
+        kept = self._fixture()                  # keep_original=True fixture
+        assert "city" in kept.transform(fr).names
+        dropped = self._fixture()
+        dropped.keep_original = False
+        out = dropped.transform(fr)
+        assert "city" not in out.names and "city_te" in out.names
+
+    def test_multiclass_encodes_nminus1(self):
+        from h2o3_tpu.frame.frame import Frame
+        m = self._fixture(nclasses=3)
+        fr = Frame.from_arrays({"city": np.array(["nyc"], object)})
+        out = m.transform(fr)
+        # legacy naming comes from inout mapping: single 'city_te' name in
+        # the mapping, remaining class col synthesized
+        cols = [c for c in out.names if c.endswith("_te")]
+        assert len(cols) == 2
+        v1 = out.vec(cols[0]).to_numpy()[0]
+        assert v1 == pytest.approx((0 + 1) / 10.0)      # cat 0, class 1
+
+
+# -- Generic integration -----------------------------------------------------
+
+def test_generic_scores_dl_mojo(tmp_path):
+    m = TestDeepLearningMojo()
+    model, _ = m._fixture(activation="Rectifier", family="bernoulli",
+                          n_classes=2)
+    # round-trip through the Generic import surface
+    from h2o3_tpu.frame.frame import Frame
+    fr = Frame.from_arrays({
+        "cat": np.array(["a", "b", "c"], object),
+        "x1": np.array([0.1, -0.5, 2.0], np.float32),
+        "x2": np.array([1.0, 0.0, -1.0], np.float32)})
+    pred = model.predict(fr)
+    assert "predict" in pred.names
+    p = pred.vec("p1").to_numpy()[: fr.nrows]
+    assert ((p >= 0) & (p <= 1)).all()
