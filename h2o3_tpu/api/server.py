@@ -24,7 +24,7 @@ from h2o3_tpu.api import schemas
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model
-from h2o3_tpu.utils.registry import DKV
+from h2o3_tpu.utils.registry import DKV, LOCKS
 
 _ALGOS = None
 
@@ -425,7 +425,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "frames": [schemas.frame_v3(key, fr)]})
 
     def r_frame_delete(self, key):
-        DKV.remove(key)
+        with LOCKS.write(key):
+            DKV.remove(key)
         self._reply({"__meta": {"schema_type": "FramesV3"}})
 
     def r_models(self):
@@ -439,7 +440,8 @@ class _Handler(BaseHTTPRequestHandler):
                      "models": [schemas.model_v3(m)]})
 
     def r_model_delete(self, key):
-        DKV.remove(key)
+        with LOCKS.write(key):
+            DKV.remove(key)
         self._reply({"__meta": {"schema_type": "ModelsV3"}})
 
     def r_train(self, algo):
@@ -452,7 +454,8 @@ class _Handler(BaseHTTPRequestHandler):
         cls = _algo_registry().get(algo.lower())
         if cls is None:
             raise KeyError(f"unknown algorithm {algo!r}")
-        frame = DKV[p.pop("training_frame")]
+        train_key = p.pop("training_frame")
+        frame = DKV[train_key]
         y = p.pop("response_column", None)
         x = p.pop("x", None)
         if isinstance(x, str):
@@ -487,24 +490,30 @@ class _Handler(BaseHTTPRequestHandler):
         self._run_build_job(
             algo.lower(), builder, p.get("model_id"),
             lambda: builder.train(x=x, y=y, training_frame=frame,
-                                  validation_frame=vframe))
+                                  validation_frame=vframe),
+            frame_keys=(train_key, valid))
 
     def _run_build_job(self, algo: str, builder, model_id, train_fn,
-                       cleanup=None) -> None:
+                       cleanup=None, frame_keys=()) -> None:
         """The shared train-job protocol every builder endpoint speaks:
         pre-assigned model key (h2o-py's H2OJob reads dest.name from the
         INITIAL response, before the background train finishes), background
-        Job, ModelBuildersV3 reply."""
+        Job, ModelBuildersV3 reply.  Lockable protocol (water/Lockable.java):
+        the build write-locks its destination model key and read-locks its
+        input frames, so a concurrent DELETE waits instead of racing."""
         builder.model_id = model_id or f"{algo}_{uuid.uuid4().hex[:10]}"
         job = Job(f"{algo} via REST", key=f"job_{uuid.uuid4().hex[:12]}")
         job.dest_key = builder.model_id
 
         def driver(j: Job):
-            try:
-                m = train_fn()
-            finally:
-                if cleanup is not None:
-                    cleanup()
+            # one combined acquisition — two separate with-statements would
+            # reintroduce the ABBA deadlock the global sort order prevents
+            with LOCKS.locked(write=(builder.model_id,), read=frame_keys):
+                try:
+                    m = train_fn()
+                finally:
+                    if cleanup is not None:
+                        cleanup()
             j.dest_key = m.key
             return m
 
@@ -553,8 +562,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "JobsV3"}})
 
     def r_predict(self, model_key, frame_key):
-        m, fr = DKV[model_key], DKV[frame_key]
-        pred = m.predict(fr)
+        with LOCKS.read(model_key, frame_key):
+            m, fr = DKV[model_key], DKV[frame_key]
+            pred = m.predict(fr)
         dest = f"prediction_{uuid.uuid4().hex[:8]}"
         pred.key = dest
         DKV.put(dest, pred)
@@ -570,7 +580,8 @@ class _Handler(BaseHTTPRequestHandler):
         job.dest_key = dest
 
         def driver(j: Job):
-            pred = m.predict(fr)
+            with LOCKS.read(model_key, frame_key):
+                pred = m.predict(fr)
             pred.key = dest
             DKV.put(dest, pred)
             return pred
@@ -614,14 +625,16 @@ class _Handler(BaseHTTPRequestHandler):
         criteria = p.pop("search_criteria", None)
         if isinstance(criteria, str):
             criteria = json.loads(criteria)
-        frame = DKV[p.pop("training_frame")]
+        grid_frame_key = p.pop("training_frame")
+        frame = DKV[grid_frame_key]
         y = p.pop("response_column", None)
         gs = GridSearch(cls, hyper, grid_id=p.pop("grid_id", None),
                         search_criteria=criteria)
         job = Job(f"grid {algo} via REST")
 
         def driver(j: Job):
-            g = gs.train(y=y, training_frame=frame)
+            with LOCKS.read(grid_frame_key):
+                g = gs.train(y=y, training_frame=frame)
             j.dest_key = g.grid_id
             return g
 
@@ -690,8 +703,9 @@ class _Handler(BaseHTTPRequestHandler):
         job.dest_key = project
 
         def driver(j: Job):
-            aml.train(x=x, y=y, training_frame=frame,
-                      leaderboard_frame=DKV[lb_key] if lb_key else None)
+            with LOCKS.read(frame_key, lb_key):
+                aml.train(x=x, y=y, training_frame=frame,
+                          leaderboard_frame=DKV[lb_key] if lb_key else None)
             j.dest_key = project
             return aml
 

@@ -38,7 +38,7 @@ from h2o3_tpu.models.metrics import (
     multinomial_metrics,
     regression_metrics,
 )
-from h2o3_tpu.utils.registry import DKV
+from h2o3_tpu.utils.registry import DKV, LOCKS
 
 
 class ModelParameters(dict):
@@ -307,6 +307,17 @@ class ModelBuilder:
 
         def driver(job: Job) -> Model:
             from h2o3_tpu.utils import extensions as _ext
+            # Lockable protocol (water/Lockable.java): the build holds the
+            # write lock on its (named) destination key from first fit to
+            # final DKV.put, so a concurrent DELETE waits and a mid-build
+            # delete cannot be resurrected by the final put.  Anonymous
+            # (auto-generated) keys are unguessable, so a None model_id
+            # needs no lock.  Covers every build path: direct, REST, grid,
+            # AutoML (reentrant for the REST path, which already holds it).
+            with LOCKS.write(self.model_id):
+                return locked_driver(job, _ext)
+
+        def locked_driver(job: Job, _ext) -> Model:
             _ext.report("model_build_start", algo=self.algo, job=job.key,
                         frame=frame.key)
             model = self._fit(job, frame, x, y, base_w)

@@ -12,6 +12,7 @@ layer and the Python client to address frames/models/jobs by key.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Iterator
 
@@ -103,5 +104,94 @@ class KeyedStore:
         CLEANER._touch.clear()
 
 
-# Global registry (reference: the DKV singleton).
+class KeyLocks:
+    """Key-level read/write locks — the minimal Lockable analog.
+
+    Reference: ``water/Lockable.java:1-299`` — a training job write-locks
+    its destination model key and read-locks its input frames; deleting a
+    locked key must wait for the lock holder.  The single-controller
+    design removes most of the need (builds hold Python references, and
+    rapids ops are copy-on-write — they build fresh Frames rather than
+    mutating DKV-resident ones), but the threaded REST server
+    (api/server.py) + parallel grids mean two clients CAN race on the
+    same key: train-into-X vs delete-X, predict vs delete.  These locks
+    serialize exactly those pairs; any future genuinely-in-place frame
+    op must take ``LOCKS.write`` on its key itself.
+
+    Semantics: readers are shared and never blocked by *waiting* writers
+    (read-preference — a thread holding a read lock may take more read
+    locks without deadlocking itself); a writer needs exclusivity but is
+    reentrant within its own thread.  Unknown keys lock fine (lock state
+    is independent of the store, like the reference's key-metadata locks).
+
+    Deadlock freedom: every acquisition — including a mixed write+read
+    set — goes through ONE ``locked()`` call that acquires all its keys
+    in a single global sort order, so hold-and-wait cycles between
+    multi-key users cannot form (two separate ``with`` statements would
+    reintroduce ABBA).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        # key -> [readers, writer_thread_ident | None, writer_depth]
+        self._state: dict[str, list] = {}
+
+    def _entry(self, key: str) -> list:
+        return self._state.setdefault(key, [0, None, 0])
+
+    def _gc(self, key: str) -> None:
+        st = self._state.get(key)
+        if st is not None and st[0] == 0 and st[1] is None:
+            del self._state[key]
+
+    @contextlib.contextmanager
+    def locked(self, write=(), read=()):
+        """Acquire write locks on ``write`` and read locks on ``read`` —
+        all in one globally-sorted pass.  None keys are skipped; a key in
+        both sets locks as write."""
+        wset = {k for k in write if k}
+        rset = {k for k in read if k} - wset
+        plan = sorted([(k, True) for k in wset] + [(k, False) for k in rset])
+        me = threading.get_ident()
+        with self._cond:
+            for k, is_write in plan:
+                st = self._entry(k)
+                if is_write:
+                    while (st[1] is not None and st[1] != me) or \
+                            (st[1] is None and st[0] > 0):
+                        self._cond.wait()
+                        st = self._entry(k)
+                    st[1] = me
+                    st[2] += 1
+                else:
+                    while st[1] is not None and st[1] != me:
+                        self._cond.wait()
+                        st = self._entry(k)
+                    st[0] += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                for k, is_write in plan:
+                    st = self._entry(k)
+                    if is_write:
+                        st[2] -= 1
+                        if st[2] == 0:
+                            st[1] = None
+                    else:
+                        st[0] -= 1
+                    self._gc(k)
+                self._cond.notify_all()
+
+    def read(self, *keys: str | None):
+        return self.locked(read=keys)
+
+    def write(self, *keys: str | None):
+        return self.locked(write=keys)
+
+
+# Global registry (reference: the DKV singleton) + its key locks
+# (reference: the Lockable protocol layered on DKV keys).
 DKV = KeyedStore()
+LOCKS = KeyLocks()
