@@ -509,6 +509,11 @@ class _Handler(BaseHTTPRequestHandler):
             # one combined acquisition — two separate with-statements would
             # reintroduce the ABBA deadlock the global sort order prevents
             with LOCKS.locked(write=(builder.model_id,), read=frame_keys):
+                # re-check under the lock: a delete may have won the race
+                # between the handler's fetch and this acquisition
+                for fk in frame_keys:
+                    if fk and fk not in DKV:
+                        raise KeyError(f"{fk} not found")
                 try:
                     m = train_fn()
                 finally:
@@ -574,13 +579,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def r_predict_v4(self, model_key, frame_key):
         """V4 surface: h2o-py model.predict POSTs here and polls the job."""
-        m, fr = DKV[model_key], DKV[frame_key]
+        if model_key not in DKV or frame_key not in DKV:
+            raise KeyError(f"{model_key if model_key not in DKV else frame_key}"
+                           " not found")
         dest = f"prediction_{uuid.uuid4().hex[:8]}"
         job = Job("Predict", key=f"job_{uuid.uuid4().hex[:12]}")
         job.dest_key = dest
 
         def driver(j: Job):
+            # fetch INSIDE the lock: a delete that wins the race must 404
+            # this job, not be resurrected by a stale reference
             with LOCKS.read(model_key, frame_key):
+                m, fr = DKV[model_key], DKV[frame_key]
                 pred = m.predict(fr)
             pred.key = dest
             DKV.put(dest, pred)

@@ -49,7 +49,7 @@ class DecisionTree(SharedTreeBuilder):
         yvec = frame.vec(y)
         if yvec.is_categorical and yvec.cardinality() != 2:
             raise ValueError("DecisionTree supports binary or numeric responses")
-        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y, weights)
         w = weights * valid
         yy = jnp.where(w > 0, yy, 0.0)
 

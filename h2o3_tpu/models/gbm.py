@@ -622,7 +622,7 @@ class SharedTreeBuilder(ModelBuilder):
                   if sti <= 1 or (i + 1) % sti == 0 or i == len(tser) - 1]
         return self._history_table(model, cols, values)
 
-    def _prepare(self, frame: Frame, x: list[str], y: str):
+    def _prepare(self, frame: Frame, x: list[str], y: str, weights=None):
         depth = int(self.params["max_depth"])
         if depth > self.MAX_TREE_DEPTH:
             raise ValueError(f"max_depth={depth} exceeds the dense-heap limit "
@@ -637,7 +637,13 @@ class SharedTreeBuilder(ModelBuilder):
         sample_dev = jnp.stack([frame.vec(c).as_float()[idx] for c in x],
                                axis=1)
         sample = np.asarray(jax.device_get(sample_dev))
-        edges = jnp.asarray(compute_bin_edges(sample, int(self.params["nbins"])))
+        w_sample = None
+        if weights is not None:
+            # weighted edges keep the weights-as-replication contract
+            # (compute_bin_edges docstring); same strided sample of rows
+            w_sample = np.asarray(jax.device_get(weights[idx])).astype(np.float64)
+        edges = jnp.asarray(compute_bin_edges(sample, int(self.params["nbins"]),
+                                              w_sample))
         self._setup_cat_info(frame, x)
         binned = self._bin_frame(frame, x, edges)
         from h2o3_tpu.models.data_info import response_as_float
@@ -895,7 +901,8 @@ class GBM(SharedTreeBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GBMModel:
         p = self.params
-        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        X, edges, binned, yy, valid, yvec, domains = self._prepare(
+            frame, x, y, weights)
         cp = self._resolve_checkpoint()
         if cp is not None:
             # validate BEFORE re-binning: a feature-list mismatch must raise
@@ -1340,7 +1347,8 @@ class DRF(SharedTreeBuilder):
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DRFModel:
         p = self.params
-        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        X, edges, binned, yy, valid, yvec, domains = self._prepare(
+            frame, x, y, weights)
         cp = self._resolve_checkpoint()
         if cp is not None:
             self._check_checkpoint(cp, x, None)   # before the edges swap

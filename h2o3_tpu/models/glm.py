@@ -401,6 +401,9 @@ class GLM(ModelBuilder):
             #                           style [{"names","lower_bounds",...}]
             offset_column=None,       # per-row margin offset
             interactions=None,        # columns to cross (DataInfo interactions)
+            # MeanImputation (default) | Skip (reference GLMParameters.
+            # MissingValuesHandling; PlugValues needs a plug frame — not yet)
+            missing_values_handling="MeanImputation",
         )
 
     def _fit_ordinal(self, job: Job, frame, x, y, weights, yvec) -> "GLMModel":
@@ -632,6 +635,26 @@ class GLM(ModelBuilder):
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
         self._iter_devs = []    # per-IRLS-iteration deviances → scoring_history
+        mvh = params.get("missing_values_handling", "MeanImputation")
+        self._metrics_weights = None
+        if mvh == "Skip":
+            # rows with any NA among the used predictors drop out of the
+            # fit (weight 0) — reference MissingValuesHandling.Skip; the
+            # default path mean-imputes inside DataInfo.expand
+            from h2o3_tpu.frame.types import VecType
+            na = jnp.zeros(frame.plen, bool)
+            for c in x:
+                v = frame.vec(c)
+                na = na | ((v.data < 0) if v.type is VecType.CAT
+                           else jnp.isnan(v.data))
+            weights = weights * (~na)
+            # metrics + CV must see the same reduced row set (model_base
+            # reads this after _fit)
+            self._metrics_weights = weights
+        elif mvh not in ("MeanImputation",):
+            raise ValueError(
+                f"missing_values_handling {mvh!r} unsupported (MeanImputation"
+                " | Skip; reference PlugValues needs a plug-values frame)")
         if int(params["max_iterations"]) == -1:
             # reference: -1 means solver-chosen default (GLM.java auto)
             params["max_iterations"] = 50

@@ -282,16 +282,18 @@ def binomial_metrics(p: jax.Array, y: jax.Array, mask: jax.Array) -> ModelMetric
     # descending threshold sweep: cumulative TP/FP from the top bin down
     tps = np.cumsum(tp_h[::-1])[::-1]   # tps[b] = positives with score >= bin b
     fps = np.cumsum(fp_h[::-1])[::-1]
-    tpr = np.concatenate([tps / max(P, 1e-30), [1.0]])
-    fpr = np.concatenate([fps / max(N, 1e-30), [1.0]])
-    order = np.argsort(fpr, kind="stable")
-    auc = float(np.trapezoid(np.concatenate([[0.0], tpr[order]]),
-                             np.concatenate([[0.0], fpr[order]])))
-    # PR curve
+    # tps/fps are monotone non-increasing in b, so the descending-b sweep IS
+    # the ROC polyline (both coordinates non-decreasing) — no re-sorting.
+    # Sorting by fpr alone is wrong: stable ties put high-tpr points first,
+    # ending each vertical ROC segment at its BOTTOM (a two-valued score
+    # distribution then reads as auc=0.5 despite perfect separation).
+    tpr_pts = np.concatenate([[0.0], (tps / max(P, 1e-30))[::-1], [1.0]])
+    fpr_pts = np.concatenate([[0.0], (fps / max(N, 1e-30))[::-1], [1.0]])
+    auc = float(np.trapezoid(tpr_pts, fpr_pts))
+    # PR curve — same descending-b traversal (recall non-decreasing)
     prec = tps / np.maximum(tps + fps, 1e-30)
     rec = tps / max(P, 1e-30)
-    po = np.argsort(rec, kind="stable")
-    pr_auc = float(np.trapezoid(prec[po], rec[po]))
+    pr_auc = float(np.trapezoid(prec[::-1], rec[::-1]))
     # max-F1 threshold + confusion matrix (reference AUC2.ThresholdCriterion.f1)
     f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-30)
     b = int(np.argmax(f1))

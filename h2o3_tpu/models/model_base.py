@@ -209,7 +209,9 @@ class ModelBuilder:
         return dict(
             seed=-1,
             nfolds=0,
-            fold_assignment="Modulo",   # Modulo | Random (reference FoldAssignment)
+            # Modulo | Random | Stratified (reference hex/FoldAssignment.java)
+            fold_assignment="Modulo",
+            fold_column=None,           # explicit per-row fold ids
             weights_column=None,
             ignored_columns=None,
             max_runtime_secs=0.0,
@@ -282,6 +284,8 @@ class ModelBuilder:
             ignored.add(self.params["weights_column"])
         if self.params.get("offset_column"):
             ignored.add(self.params["offset_column"])
+        if self.params.get("fold_column"):
+            ignored.add(self.params["fold_column"])
         x = [c for c in (x if x is not None else frame.names)
              if c != y and c not in ignored and frame.vec(c).type.on_device]
         if not x:
@@ -321,6 +325,13 @@ class ModelBuilder:
             _ext.report("model_build_start", algo=self.algo, job=job.key,
                         frame=frame.key)
             model = self._fit(job, frame, x, y, base_w)
+            # a builder may shrink the effective row set during fit (GLM
+            # missing_values_handling=Skip zeroes NA-row weights); metrics
+            # and CV must see the same rows the fit saw (reference: Skip
+            # rows carry weight 0 everywhere)
+            w_metrics = getattr(self, "_metrics_weights", None)
+            if w_metrics is None:
+                w_metrics = base_w
             model.run_time_ms = int((time.time() - t0) * 1000)
             # user UDF metric: either an in-process python callable
             # (preds, y, w) -> value, or the reference's wire form
@@ -336,9 +347,10 @@ class ModelBuilder:
                 cmf = _udf.metric_callable(_udf.load_cfunc(cmf), key_name,
                                            model=model)
             if y is not None:
-                model.training_metrics = self._holdout_metrics(model, frame, y, base_w)
+                model.training_metrics = self._holdout_metrics(model, frame,
+                                                               y, w_metrics)
                 if cmf is not None and model.training_metrics is not None:
-                    self._apply_custom_metric(model, frame, y, base_w, cmf)
+                    self._apply_custom_metric(model, frame, y, w_metrics, cmf)
             if validation_frame is not None and y is not None:
                 model.validation_metrics = model.model_performance(validation_frame)
                 if cmf is not None and model.validation_metrics is not None:
@@ -355,9 +367,14 @@ class ModelBuilder:
             # series on this (shared) builder instance
             model.scoring_history = self._scoring_history(model)
             nfolds = int(self.params.get("nfolds") or 0)
+            if self.params.get("fold_column"):
+                # an explicit fold column defines the folds outright
+                # (reference: ModelBuilder.init checks _fold_column and
+                # derives N from its cardinality)
+                nfolds = self._fold_column_cardinality(frame)
             if nfolds >= 2 and y is not None:
                 model.cross_validation_metrics = self._cross_validate(
-                    job, frame, x, y, base_w, nfolds, model)
+                    job, frame, x, y, w_metrics, nfolds, model)
             DKV.put(model.key, model)
             _ext.report("model_build_end", algo=self.algo, model=model.key,
                         job=job.key)
@@ -421,13 +438,49 @@ class ModelBuilder:
         yy, valid = response_as_float(frame.vec(y))
         return compute_metrics(raw, yy, (w > 0) & valid, model.nclasses)
 
-    def _fold_ids(self, frame: Frame, nfolds: int) -> jax.Array:
-        """Fold assignment vector (reference: ``hex/FoldAssignment.java``)."""
+    def _fold_column_values(self, frame: Frame) -> np.ndarray:
+        """Per-row fold codes from the explicit fold column: distinct
+        values map to 0..K-1 in sorted order (reference:
+        ``FoldAssignment.fromUserFoldSpecification``).  NA fold values are
+        rejected like the reference does — a silent default would leak
+        those rows into every fold's training set."""
+        v = frame.vec(self.params["fold_column"])
+        vals = np.asarray(v.data)[: frame.plen].astype(np.float64)
+        body = vals[: frame.nrows]
+        na = (body < 0) if v.type is VecType.CAT else np.isnan(body)
+        if na.any():
+            raise ValueError(
+                f"fold_column {self.params['fold_column']!r} has "
+                f"{int(na.sum())} missing values; every row needs a fold")
+        uniq = np.unique(body)
+        # padding rows map to fold 0; they carry weight 0 everywhere
+        safe = np.where(np.isnan(vals) | (vals < uniq[0]), uniq[0], vals)
+        return np.searchsorted(uniq, safe).clip(0, len(uniq) - 1)             .astype(np.int32)
+
+    def _fold_column_cardinality(self, frame: Frame) -> int:
+        return int(self._fold_column_values(frame).max()) + 1
+
+    def _fold_ids(self, frame: Frame, nfolds: int, yvec=None) -> jax.Array:
+        """Fold assignment vector (reference: ``hex/FoldAssignment.java``):
+        Modulo (default), Random, Stratified (per-class round-robin so
+        every fold sees every response class), or an explicit fold
+        column."""
         plen = frame.plen
-        if self.params.get("fold_assignment", "Modulo") == "Random":
+        if self.params.get("fold_column"):
+            return jnp.asarray(self._fold_column_values(frame))
+        assignment = self.params.get("fold_assignment", "Modulo")
+        if assignment == "Random":
             seed = int(self.params.get("seed") or -1)
             key = jax.random.PRNGKey(seed if seed >= 0 else 907)
             return jax.random.randint(key, (plen,), 0, nfolds)
+        if assignment == "Stratified" and yvec is not None \
+                and yvec.is_categorical:
+            codes = np.asarray(yvec.data)[:plen]
+            ids = np.arange(plen, dtype=np.int32) % nfolds
+            for c in np.unique(codes[codes >= 0]):
+                rows = np.where(codes == c)[0]
+                ids[rows] = np.arange(len(rows)) % nfolds
+            return jnp.asarray(ids)
         return jnp.arange(plen) % nfolds
 
     def _cross_validate(self, job: Job, frame: Frame, x: list[str], y: str,
@@ -436,8 +489,8 @@ class ModelBuilder:
         (reference: ``ModelBuilder.computeCrossValidation`` builds physical
         sub-frames; see module docstring for why masking replaces that)."""
         from h2o3_tpu.models.data_info import response_as_float
-        folds = self._fold_ids(frame, nfolds)
         yvec = frame.vec(y)
+        folds = self._fold_ids(frame, nfolds, yvec)
         yy, valid = response_as_float(yvec)
         raws, masks = [], []
         for k in range(nfolds):

@@ -120,6 +120,11 @@ class TargetEncoder(ModelBuilder):
         lut, domains, train_encoded = {}, {}, {}
         leak = str(p["data_leakage_handling"])
         nfolds = int(p.get("nfolds") or 5)
+        if leak == "KFold" and p.get("fold_column"):
+            # an explicit fold column overrides nfolds: every distinct
+            # value is a fold, else rows in folds >= nfolds would keep the
+            # 0.0 initializer below (never encoded)
+            nfolds = self._fold_column_cardinality(frame)
         fold = self._fold_ids(frame, nfolds) if leak == "KFold" else None
         noise = float(p["noise"])
         key = jax.random.PRNGKey(int(p.get("seed") or 0) if int(p.get("seed") or -1) >= 0 else 7)

@@ -135,7 +135,7 @@ class UpliftDRF(SharedTreeBuilder):
         yvec = frame.vec(y)
         if not yvec.is_categorical or yvec.cardinality() != 2:
             raise ValueError("uplift response must be a 2-level categorical")
-        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y, weights)
         t = frame.vec(tc).as_float()           # codes 0 (control) / 1 (treatment)
         w = weights * valid * ~jnp.isnan(t)
         t = jnp.where(w > 0, t, 0.0)

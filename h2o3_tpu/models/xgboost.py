@@ -138,7 +138,7 @@ class XGBoost(GBM):
             raise ValueError("checkpoint resume is not supported with "
                              "booster='dart' (prior-tree weights would have "
                              "been renormalized away)")
-        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y)
+        X, edges, binned, yy, valid, yvec, domains = self._prepare(frame, x, y, weights)
         dist = str(p["distribution"])
         if dist.lower() == "auto":
             dist = "AUTO"

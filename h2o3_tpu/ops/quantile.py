@@ -24,21 +24,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def compute_bin_edges(X_host: np.ndarray, nbins: int) -> np.ndarray:
+def compute_bin_edges(X_host: np.ndarray, nbins: int,
+                      w_host: np.ndarray | None = None) -> np.ndarray:
     """Per-feature quantile edges, shape [F, nbins-1] (inf-padded).
 
-    ``X_host``: a row sample [n, F] (NaNs allowed). Bin b covers
-    [edges[b-1], edges[b]); bin(x) = #edges <= x.
+    ``X_host``: a row sample [n, F] (NaNs allowed); ``w_host``: matching
+    per-row weights.  Bin b covers [edges[b-1], edges[b]);
+    bin(x) = #edges <= x.
+
+    Quantiles are weighted inverted-CDF (the smallest value whose
+    cumulative weight reaches q·total).  That definition makes a row
+    with weight k bin IDENTICALLY to the same row repeated k times —
+    the reference's weights-as-replication contract
+    (``pyunit_weights_gbm.py``; ``hex/tree/DHistogram`` sees weighted
+    counts the same way).
     """
     n, F = X_host.shape
     qs = np.linspace(0, 1, nbins + 1)[1:-1]
     edges = np.full((F, nbins - 1), np.inf, np.float32)
+    if w_host is None:
+        w_host = np.ones(n, np.float64)
     for f in range(F):
         col = X_host[:, f]
-        col = col[~np.isnan(col)]
+        m = ~np.isnan(col) & (w_host > 0)
+        col, w = col[m], w_host[m]
         if col.size == 0:
             continue
-        e = np.unique(np.quantile(col, qs))
+        order = np.argsort(col, kind="stable")
+        c, cw = col[order], np.cumsum(w[order])
+        pos = np.searchsorted(cw, qs * cw[-1], side="left")
+        e = np.unique(c[np.clip(pos, 0, len(c) - 1)])
         edges[f, : len(e)] = e
     return edges
 

@@ -1,6 +1,6 @@
-"""Worker script for the 2-process cloud integration test.
+"""Worker script for the N-process cloud integration tests.
 
-Run via ``python -m h2o3_tpu.launch --fork 2 ...`` — each process joins the
+Run via ``python -m h2o3_tpu.launch --fork N ...`` — each process joins the
 cloud, verifies the spanning mesh, trains GBM + GLM on a frame row-sharded
 ACROSS the processes, and writes its metrics to ``<outdir>/proc<i>.json``.
 The parent test asserts both processes agree and match the single-process
@@ -17,9 +17,10 @@ import numpy as np
 
 outdir = sys.argv[1]
 
-assert jax.process_count() == 2, jax.process_count()
+nproc = jax.process_count()
+assert nproc >= 2, nproc
 assert len(jax.devices()) == 8, len(jax.devices())
-assert len(jax.local_devices()) == 4
+assert len(jax.local_devices()) == 8 // nproc
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.parallel.distributed import barrier, fetch
@@ -35,7 +36,7 @@ fr = Frame.from_arrays(cols)
 
 # the frame must really span both processes' devices
 devs = {s.device for s in fr.vec("x0").data.addressable_shards}
-assert len(devs) == 4, devs
+assert len(devs) == 8 // nproc, devs
 assert not fr.vec("x0").data.is_fully_addressable
 
 # munge paths must survive cross-process shards (filter/gather/sort)

@@ -58,3 +58,32 @@ def test_two_process_cloud(tmp_path):
         y="y", training_frame=fr)
     assert r0["gbm_logloss"] == pytest.approx(
         float(gbm.training_metrics.logloss), abs=1e-5)
+
+
+@pytest.mark.slow
+def test_four_process_cloud(tmp_path):
+    """The reference contract scales to 4 JVMs (``multiNodeUtils.sh``):
+    4 processes x 2 devices must train the identical model too."""
+    script = os.path.join(REPO, "tests", "scripts", "multiproc_train.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.launch", "--fork", "4",
+         "--devices-per-process", "2", "--port", "7457",
+         script, str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rs = []
+    for i in range(4):
+        with open(tmp_path / f"proc{i}.json") as f:
+            rs.append(json.load(f))
+    for r in rs[1:]:
+        assert rs[0]["gbm_logloss"] == pytest.approx(r["gbm_logloss"],
+                                                     abs=1e-7)
+        assert rs[0]["glm_logloss"] == pytest.approx(r["glm_logloss"],
+                                                     abs=1e-7)
+        np.testing.assert_allclose(rs[0]["glm_coef"], r["glm_coef"],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(rs[0]["pred_head"], r["pred_head"],
+                                   rtol=1e-6)
