@@ -652,11 +652,16 @@ class SharedTreeBuilder(ModelBuilder):
         return None, edges, binned, yy, valid, yvec, domains
 
     def _bin_frame(self, frame: Frame, x: list[str], edges) -> jax.Array:
-        """Per-column binning → [rows, F] int16 (the only row-major matrix
-        training keeps; int16 + one stack keeps peak HBM at [rows*F*2B] plus
-        lane padding instead of three f32/i32 copies)."""
+        """Per-column binning → [rows, F] int8/int16 (the only row-major
+        matrix training keeps). The dtype is the narrowest that holds
+        every bin id PLUS the Pallas pad sentinel (n_bins_tot + 1): int8
+        up to 125 bins halves HBM reads of the histogram kernel's dominant
+        input vs int16 (the default 64-bin config packs; the 256-bin
+        XGBoost config stays int16) — VERDICT r4 next #2."""
         from h2o3_tpu.models.tree import cat_bins_for_codes
         nbins = int(self.params["nbins"])
+        from h2o3_tpu.ops.quantile import bin_dtype
+        dtype = bin_dtype(nbins)
         cc, cat_bins = (self._cat_info if self._cat_info is not None
                         else (None, 0))
         cols = []
@@ -668,7 +673,7 @@ class SharedTreeBuilder(ModelBuilder):
             else:
                 b = jnp.searchsorted(edges[j], v, side="right")
                 b = jnp.where(jnp.isnan(v), nbins, b)
-            cols.append(b.astype(jnp.int16))
+            cols.append(b.astype(dtype))
         return jnp.stack(cols, axis=1)
 
     def _setup_cat_info(self, frame: Frame, x: list[str]) -> None:

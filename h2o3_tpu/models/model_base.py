@@ -369,9 +369,16 @@ class ModelBuilder:
             nfolds = int(self.params.get("nfolds") or 0)
             if self.params.get("fold_column"):
                 # an explicit fold column defines the folds outright
-                # (reference: ModelBuilder.init checks _fold_column and
-                # derives N from its cardinality)
+                # (reference: ModelBuilder.init rejects combining it with
+                # nfolds and requires >= 2 distinct fold values)
+                if nfolds:
+                    raise ValueError(
+                        "specify either fold_column or nfolds, not both")
                 nfolds = self._fold_column_cardinality(frame)
+                if nfolds < 2:
+                    raise ValueError(
+                        f"fold_column {self.params['fold_column']!r} must "
+                        "hold at least 2 distinct folds")
             if nfolds >= 2 and y is not None:
                 model.cross_validation_metrics = self._cross_validate(
                     job, frame, x, y, w_metrics, nfolds, model)
@@ -443,7 +450,12 @@ class ModelBuilder:
         values map to 0..K-1 in sorted order (reference:
         ``FoldAssignment.fromUserFoldSpecification``).  NA fold values are
         rejected like the reference does — a silent default would leak
-        those rows into every fold's training set."""
+        those rows into every fold's training set.  Cached per frame:
+        train() needs it for the cardinality and _cross_validate for the
+        ids — one host pass, not two."""
+        cache = getattr(self, "_fold_values_cache", None)
+        if cache is not None and cache[0] is frame:
+            return cache[1]
         v = frame.vec(self.params["fold_column"])
         vals = np.asarray(v.data)[: frame.plen].astype(np.float64)
         body = vals[: frame.nrows]
@@ -473,8 +485,13 @@ class ModelBuilder:
             seed = int(self.params.get("seed") or -1)
             key = jax.random.PRNGKey(seed if seed >= 0 else 907)
             return jax.random.randint(key, (plen,), 0, nfolds)
-        if assignment == "Stratified" and yvec is not None \
-                and yvec.is_categorical:
+        if assignment == "Stratified":
+            if yvec is None or not yvec.is_categorical:
+                # reference FoldAssignment: stratification needs a
+                # categorical response — refuse rather than silently
+                # degrade to Modulo
+                raise ValueError("fold_assignment='Stratified' requires a "
+                                 "categorical response")
             codes = np.asarray(yvec.data)[:plen]
             ids = np.arange(plen, dtype=np.int32) % nfolds
             for c in np.unique(codes[codes >= 0]):

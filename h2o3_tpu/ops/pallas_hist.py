@@ -113,7 +113,9 @@ def _hist_kernel(b_ref, n_ref, s_ref, out_ref, ns_ref, *, Nb, S, T, Fb):
         ns_ref[:] = jnp.where(nd[None, :] == gb * Nb + iota_k // 3,
                               ghw_rep, 0.0)
 
-    binf = b_ref[0, 0, :].astype(jnp.int32)   # i16 in HBM; upcast per tile
+    binf = b_ref[0, 0, :].astype(jnp.int32)   # i8/i16 in HBM (gbm._bin_frame
+    #                                           packs <=125-bin configs to
+    #                                           int8); upcast per tile
     iota_r = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0)
     if _MXU_MODE == "highest":
         bin_oh_T = (iota_r == binf[None, :]).astype(jnp.float32)   # [S, T]

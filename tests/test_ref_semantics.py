@@ -297,6 +297,34 @@ class TestFoldAssignment:
         assert m_a.cross_validation_metrics.auc == pytest.approx(
             m_b.cross_validation_metrics.auc, abs=1e-6)
 
+    def test_fold_column_misuse_rejected(self, rng):
+        """Reference ModelBuilder.init: fold_column+nfolds is an error, a
+        constant fold column is an error, stratified needs a categorical
+        response, NA fold values are rejected."""
+        n = 64
+        fr0 = _bin_frame(rng, n)
+        cols = {c: fr0.vec(c).to_numpy() for c in fr0.names if c != "y"}
+        y = fr0.vec("y").labels()
+        both = Frame.from_arrays({**cols, "y": y,
+                                  "fold": (np.arange(n) % 3).astype(np.float32)})
+        with pytest.raises(ValueError, match="not both"):
+            GBM(ntrees=2, nfolds=3, fold_column="fold").train(
+                y="y", training_frame=both)
+        const = Frame.from_arrays({**cols, "y": y,
+                                   "fold": np.zeros(n, np.float32)})
+        with pytest.raises(ValueError, match="2 distinct"):
+            GBM(ntrees=2, fold_column="fold").train(y="y",
+                                                    training_frame=const)
+        withna = Frame.from_arrays({**cols, "y": y, "fold": np.where(
+            np.arange(n) < 4, np.nan, np.arange(n) % 3).astype(np.float32)})
+        with pytest.raises(ValueError, match="missing"):
+            GBM(ntrees=2, fold_column="fold").train(y="y",
+                                                    training_frame=withna)
+        reg = _reg_frame(rng, n=64)
+        with pytest.raises(ValueError, match="categorical response"):
+            GBM(ntrees=2, nfolds=3, fold_assignment="Stratified").train(
+                y="y", training_frame=reg)
+
     def test_stratified_every_fold_sees_minority(self, rng):
         """FoldAssignment.Stratified: even a 10% minority class appears in
         every fold's holdout."""
