@@ -1210,7 +1210,10 @@ class _Handler(BaseHTTPRequestHandler):
                   for k, v in cls.defaults().items()]
         self._reply({"__meta": {"schema_type": "ModelBuildersV3"},
                      "model_builders": {algo.lower(): {
-                         "algo": algo.lower(), "parameters": params}}})
+                         "algo": algo.lower(),
+                         "supervised": not getattr(cls, "unsupervised",
+                                                   False),
+                         "parameters": params}}})
 
     def r_model_metrics_compute(self, model_key, frame_key):
         m, fr = DKV[model_key], DKV[frame_key]

@@ -467,7 +467,10 @@ class ModelBuilder:
         uniq = np.unique(body)
         # padding rows map to fold 0; they carry weight 0 everywhere
         safe = np.where(np.isnan(vals) | (vals < uniq[0]), uniq[0], vals)
-        return np.searchsorted(uniq, safe).clip(0, len(uniq) - 1)             .astype(np.int32)
+        out = np.searchsorted(uniq, safe).clip(0, len(uniq) - 1) \
+            .astype(np.int32)
+        self._fold_values_cache = (frame, out)
+        return out
 
     def _fold_column_cardinality(self, frame: Frame) -> int:
         return int(self._fold_column_values(frame).max()) + 1

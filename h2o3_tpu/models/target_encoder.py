@@ -125,7 +125,8 @@ class TargetEncoder(ModelBuilder):
             # value is a fold, else rows in folds >= nfolds would keep the
             # 0.0 initializer below (never encoded)
             nfolds = self._fold_column_cardinality(frame)
-        fold = self._fold_ids(frame, nfolds) if leak == "KFold" else None
+        fold = self._fold_ids(frame, nfolds, yvec) if leak == "KFold" \
+            else None
         noise = float(p["noise"])
         key = jax.random.PRNGKey(int(p.get("seed") or 0) if int(p.get("seed") or -1) >= 0 else 7)
 

@@ -299,3 +299,49 @@ def test_r_wire_contract_round4(server, tmp_path, rng):
 
     st, _ = _raw_http(server, "DELETE", "/3/DKV")
     assert st == 200
+
+
+def test_r_wire_contract_round5(server, tmp_path, rng):
+    """Round-5: the generated full-signature verbs (zzz_estimators_gen.R)
+    ship only changed params over the same urlencoded wire; replay their
+    exact payloads for a GBM with fold_column, a CoxPH with stop_column,
+    and a GLM with missing_values_handling."""
+    csv = _csv(tmp_path, rng)
+    st, _ = _raw_http(server, "POST", "/3/ImportFiles",
+                      {"path": csv, "destination_frame": "r5_train"})
+    assert st == 200
+    # add a fold column via rapids (what h2o-r's as.h2o + := would do)
+    st, _ = _raw_http(server, "POST", "/99/Rapids", {
+        "ast": "(assign r5_train (append r5_train "
+               "(% (seq_len 400) 3) \"fold\"))"})
+    assert st == 200
+
+    def _train(algo, body):
+        st, tr = _raw_http(server, "POST", f"/3/ModelBuilders/{algo}", body)
+        assert st == 200, tr
+        job = _poll(server, tr["job"]["key"]["name"])
+        assert job["status"] == "DONE", job
+        return job["dest"]["name"]
+
+    gbm = _train("gbm", {"training_frame": "r5_train",
+                         "response_column": "y", "ntrees": "3",
+                         "fold_column": "fold"})
+    st2, mj = _raw_http(server, "GET", f"/3/Models/{gbm}")
+    assert mj["models"][0]["output"]["cross_validation_metrics"]
+    cox_csv = tmp_path / "r5_cox.csv"
+    x0 = rng.normal(size=200)
+    t = -np.log(rng.random(200)) / np.exp(0.5 * x0)
+    cox_csv.write_text("x0,time,event\n" + "\n".join(
+        f"{a:.4f},{b:.4f},1" for a, b in zip(x0, t)) + "\n")
+    st, _ = _raw_http(server, "POST", "/3/ImportFiles",
+                      {"path": str(cox_csv), "destination_frame": "r5_cox"})
+    cox = _train("coxph", {"training_frame": "r5_cox",
+                           "response_column": "event",
+                           "stop_column": "time", "x": '["x0"]'})
+    assert cox
+    glm = _train("glm", {"training_frame": "r5_train",
+                         "response_column": "y",
+                         "missing_values_handling": "Skip",
+                         "lambda_": "0.0"})
+    assert glm
+    st, _ = _raw_http(server, "DELETE", "/3/DKV")
