@@ -11,15 +11,22 @@ cell notebook over ``/3/*``/``/99/*`` JSON with
 
 - **assist**: one click inserts a template cell per workflow verb
   (reference ``assist`` cells);
-- **commands**: ``importFiles``, ``getFrames``, ``getFrameSummary``,
-  ``buildModel``, ``getModels``, ``getModel``, ``predict``, ``getJobs``,
-  ``rapids``, ``plot varimp|scoring|roc``, ``md`` (markdown-lite notes);
+- **commands**: ``importFiles``, ``getFrames``, ``getFrameSummary``
+  (head + per-column stats + histogram sparklines from the server's ColV3
+  rollup histograms), ``buildModel``, ``buildGrid``/``getGrid``,
+  ``runAutoML``/``getLeaderboard``, ``getModels``, ``getModel``,
+  ``predict``, ``getJobs``, ``rapids``, ``plot varimp|scoring|roc``,
+  ``md`` (markdown-lite notes);
 - **inline graphs**: dependency-free SVG — variable-importance bars,
   scoring-history lines, ROC curve from the thresholds table (reference
   Flow's vega plots);
 - **help pane**: per-command usage + the live route list from the server;
 - **notebooks**: cells persist via NodePersistentStorage (reference Flow
-  save/load), with v1 console documents still loadable.
+  save/load), with v1 console documents still loadable;
+- **.flow import**: reference Flow notebooks (``{"cells": [{"type":
+  "cs"|"md", "input": ...}]}`` JSON) load via the Import .flow button —
+  known CoffeeScript commands (importFiles/buildModel/predict/getFrames/
+  getModels) convert to native cells, the rest become annotated notes.
 """
 
 FLOW_HTML = r"""<!DOCTYPE html>
@@ -57,6 +64,8 @@ FLOW_HTML = r"""<!DOCTYPE html>
  <span style="margin-left:auto">
   <input type="text" id="nbname" placeholder="notebook name" style="width:12em">
   <button class="small" onclick="saveFlow()">Save</button>
+  <label class="small" style="cursor:pointer;background:#5a6b7b;color:#fff;padding:3px 8px;border-radius:4px;font-size:11px">Import .flow
+   <input type="file" id="flowfile" accept=".flow,.json" style="display:none" onchange="importFlowFile(this.files[0])"></label>
   <select id="nblist" onchange="loadFlow(this.value)"><option value="">Load…</option></select>
  </span>
 </header>
@@ -135,6 +144,10 @@ const ASSIST = [
   ["getModels", "getModels"],
   ["getModel", "getModel MODEL_KEY"],
   ["predict", "predict MODEL_KEY FRAME_KEY"],
+  ["buildGrid", "buildGrid gbm {\"training_frame\": \"FRAME\", \"response_column\": \"Y\", \"hyper_parameters\": {\"max_depth\": [3, 5], \"ntrees\": [10, 20]}}"],
+  ["getGrid", "getGrid GRID_KEY"],
+  ["runAutoML", "runAutoML {\"training_frame\": \"FRAME\", \"response_column\": \"Y\", \"max_models\": 5, \"nfolds\": 0}"],
+  ["leaderboard", "getLeaderboard PROJECT_KEY"],
   ["plot varimp", "plot varimp MODEL_KEY"],
   ["plot scoring", "plot scoring MODEL_KEY"],
   ["plot roc", "plot roc MODEL_KEY"],
@@ -148,6 +161,10 @@ const HELP = {
   getFrames: "getFrames — list frames in the DKV",
   getFrameSummary: "getFrameSummary &lt;key&gt; — head rows + per-column mean/sigma/NAs/domain",
   buildModel: "buildModel &lt;algo&gt; &lt;json params&gt; — algos: gbm drf glm xgboost deeplearning kmeans naivebayes isolationforest …; polls the job to completion",
+  buildGrid: "buildGrid &lt;algo&gt; &lt;json&gt; — cartesian/random grid over hyper_parameters; polls the job then lists the grid",
+  getGrid: "getGrid &lt;key&gt; — models of a finished grid",
+  runAutoML: "runAutoML &lt;json&gt; — leaderboard run (max_models/max_runtime_secs budgets)",
+  getLeaderboard: "getLeaderboard &lt;project&gt; — ranked AutoML leaderboard",
   getModels: "getModels — list models",
   getModel: "getModel &lt;key&gt; — metrics + params",
   predict: "predict &lt;model&gt; &lt;frame&gt; — score a frame; result key in DKV",
@@ -212,6 +229,14 @@ function svgLine(series, title, xlab){
   });
   return s + "</svg>";
 }
+function sparkline(bins, w, h){
+  if (!bins || !bins.length) return "·";
+  const max = Math.max(...bins, 1);
+  const bw = (w - 2) / bins.length;
+  return `<svg width="${w}" height="${h}">` + bins.map((b, i) =>
+    `<rect x="${1 + i * bw}" y="${h - 1 - (h - 3) * b / max}" width="${Math.max(bw - 0.6, 0.6)}" height="${(h - 3) * b / max + 1}" fill="#2f6fed" opacity="0.8"/>`
+  ).join("") + "</svg>";
+}
 function tableCols(t){  // TwoDimTableV3 (column-major data) -> {name: values}
   const out = {};
   (t.columns || []).forEach((c, i) => { out[c.name] = t.data[i]; });
@@ -219,6 +244,18 @@ function tableCols(t){  // TwoDimTableV3 (column-major data) -> {name: values}
 }
 
 // ---------------------------------------------------------------- commands
+async function pollJob(jobKey, onTick, ms){
+  for(;;){
+    const jr = await J("GET", `/3/Jobs/${jobKey}`);
+    const j = jr.jobs[0];
+    onTick(j);
+    if (["DONE", "FAILED", "CANCELLED"].includes(j.status)){
+      if (j.exception) throw new Error(j.exception);
+      return j;
+    }
+    await new Promise(r => setTimeout(r, ms || 500));
+  }
+}
 async function runCell(i){
   const c = CELLS[i];
   const set = html => {
@@ -265,28 +302,21 @@ async function runCell(i){
       const stats = f.columns.map(cc =>
         `<tr><td>${esc(cc.label)}</td><td>${cc.mean == null ? "·" : (+cc.mean).toFixed(4)}</td>
          <td>${cc.sigma == null ? "·" : (+cc.sigma).toFixed(4)}</td><td>${cc.missing_count}</td>
-         <td>${cc.domain ? cc.domain.length + " levels" : "·"}</td></tr>`).join("");
+         <td>${cc.domain ? cc.domain.length + " levels" : "·"}</td>
+         <td>${sparkline(cc.histogram_bins, 120, 22)}</td></tr>`).join("");
       set(`<b>${esc(rest[0])}</b> — ${f.rows} rows<table><tr>${head}</tr>${body}</table>
-           <table><tr><th>col</th><th>mean</th><th>sigma</th><th>NAs</th><th>domain</th></tr>${stats}</table>`);
+           <table><tr><th>col</th><th>mean</th><th>sigma</th><th>NAs</th><th>domain</th><th>distribution</th></tr>${stats}</table>`);
     } else if (cmd === "buildModel"){
       const algo = rest[0];
       const body = JSON.parse(line.slice(line.indexOf("{")));
       set("submitting…");
       const out = await J("POST", `/3/ModelBuilders/${algo}`, body);
       if (out.msg) throw new Error(out.msg);
-      for(;;){
-        const jr = await J("GET", `/3/Jobs/${out.job.key.name}`);
-        const j = jr.jobs[0];
-        set(`${esc(j.status)} ${(100 * j.progress).toFixed(0)}% — ${esc(j.progress_msg || "")}`);
-        if (["DONE", "FAILED", "CANCELLED"].includes(j.status)){
-          if (j.exception) throw new Error(j.exception);
-          set(`<span class="pill">${esc(j.dest.name)}</span> ` +
-              cellLink("getModel " + qk(j.dest.name), "inspect") + " " +
-              cellLink("plot varimp " + qk(j.dest.name), "varimp"));
-          break;
-        }
-        await new Promise(r => setTimeout(r, 500));
-      }
+      const j = await pollJob(out.job.key.name, j =>
+        set(`${esc(j.status)} ${(100 * j.progress).toFixed(0)}% — ${esc(j.progress_msg || "")}`));
+      set(`<span class="pill">${esc(j.dest.name)}</span> ` +
+          cellLink("getModel " + qk(j.dest.name), "inspect") + " " +
+          cellLink("plot varimp " + qk(j.dest.name), "varimp"));
       refreshSide();
     } else if (cmd === "getModels"){
       const out = await J("GET", "/3/Models");
@@ -310,6 +340,52 @@ async function runCell(i){
       set(`<span class="pill">${esc(out.predictions_frame.name)}</span> ` +
           cellLink("getFrameSummary " + qk(out.predictions_frame.name), "inspect"));
       refreshSide();
+    } else if (cmd === "buildGrid"){
+      const algo = rest[0];
+      const body = JSON.parse(line.slice(line.indexOf("{")));
+      set("submitting grid…");
+      const out = await J("POST", `/99/Grid/${algo}`, body);
+      if (out.msg) throw new Error(out.msg);
+      const j = await pollJob(out.job.key.name, j =>
+        set(`${esc(j.status)} ${(100 * j.progress).toFixed(0)}%`));
+      set(`<span class="pill">${esc(j.dest.name)}</span> ` +
+          cellLink("getGrid " + qk(j.dest.name), "inspect grid"));
+      refreshSide();
+    } else if (cmd === "getGrid"){
+      const out = await J("GET", `/99/Grids/${encodeURIComponent(rest[0])}`);
+      if (out.msg) throw new Error(out.msg);
+      set(`<b>${esc(rest[0])}</b><table><tr><th>model</th></tr>` +
+        (out.model_ids || []).map(m =>
+          `<tr><td>${cellLink("getModel " + qk(m.name), m.name)}</td></tr>`).join("") +
+        "</table>" + ((out.failure_details || []).length
+          ? `<pre class="err">${esc(out.failure_details.join("\n"))}</pre>` : ""));
+    } else if (cmd === "runAutoML"){
+      const body = JSON.parse(line.slice(line.indexOf("{")));
+      set("starting AutoML…");
+      const out = await J("POST", "/99/AutoMLBuilder", body);
+      if (out.msg) throw new Error(out.msg);
+      const j = await pollJob(out.job.key.name, j =>
+        set(`${esc(j.status)} ${(100 * j.progress).toFixed(0)}% — ${esc(j.progress_msg || "training models")}`), 800);
+      set(`<span class="pill">${esc(j.dest.name)}</span> ` +
+          cellLink("getLeaderboard " + qk(j.dest.name), "leaderboard"));
+      refreshSide();
+    } else if (cmd === "getLeaderboard"){
+      const out = await J("GET", `/99/Leaderboards/${encodeURIComponent(rest[0])}`);
+      if (out.msg) throw new Error(out.msg);
+      const t = out.table;
+      const heads = t.columns.map(cc => `<th>${esc(cc.name)}</th>`).join("");
+      const nrow = (t.data[0] || []).length;
+      let rows = "";
+      for (let r = 0; r < nrow; r++){
+        rows += "<tr>" + t.columns.map((cc, ci) => {
+          const v = t.data[ci][r];
+          if (cc.name === "model_id")
+            return `<td>${cellLink("getModel " + qk(v), v)}</td>`;
+          return `<td>${typeof v === "number" ? (+v).toFixed(5) : esc(v == null ? "·" : v)}</td>`;
+        }).join("") + "</tr>";
+      }
+      set(`<b>${esc(out.project_name)}</b> — sorted by ${esc(out.sort_metric)}
+           <table><tr>${heads}</tr>${rows}</table>`);
     } else if (cmd === "plot"){
       const kind = rest[0], key = rest[1];
       const out = await J("GET", `/3/Models/${encodeURIComponent(key)}`);
@@ -413,6 +489,46 @@ async function loadFlow(name){
   }
   document.getElementById("nbname").value = name;
   renderCells();
+}
+function convertRefFlowCell(cell){
+  // reference Flow .flow cells: {type: "cs"|"md"|"raw", input: "..."}
+  // (h2o-web Flow's CoffeeScript command language). Convert the common
+  // verbs; anything else becomes an annotated note so nothing is lost.
+  const inp = (cell.input || "").trim();
+  if (cell.type === "md") return "md " + inp;
+  let m;
+  if ((m = inp.match(/^importFiles\s*\[\s*"([^"]+)"/)))
+    return "importFiles " + m[1];
+  if ((m = inp.match(/^buildModel\s+['"](\w+)['"]\s*,\s*(\{[\s\S]*\})/))){
+    try{
+      const params = JSON.parse(m[2].replace(/'/g, '"'));
+      delete params.model_id;
+      return `buildModel ${m[1]} ${JSON.stringify(params)}`;
+    }catch(e){ /* fall through to note */ }
+  }
+  if ((m = inp.match(/^predict\s+model:\s*['"]([^'"]+)['"],?\s*frame:\s*['"]([^'"]+)['"]/)))
+    return `predict ${qk(m[1])} ${qk(m[2])}`;
+  if (/^getFrames/.test(inp)) return "getFrames";
+  if (/^getModels/.test(inp)) return "getModels";
+  if ((m = inp.match(/^getFrameSummary\s+['"]([^'"]+)['"]/)))
+    return "getFrameSummary " + qk(m[1]);
+  return "md [unconverted .flow cell] " + inp;
+}
+function importFlowFile(file){
+  if (!file) return;
+  const rd = new FileReader();
+  rd.onload = () => {
+    try{
+      const doc = JSON.parse(rd.result);
+      if (!doc.cells) throw new Error("not a .flow document");
+      CELLS = doc.cells.map(c =>
+        ({id: NEXT_CELL_ID++, input: convertRefFlowCell(c), output: ""}));
+      document.getElementById("nbname").value =
+        (file.name || "imported").replace(/\.flow$/, "");
+      renderCells();
+    }catch(e){ alert("import failed: " + e.message); }
+  };
+  rd.readAsText(file);
 }
 async function refreshNotebooks(){
   const r = await J("GET", "/3/NodePersistentStorage/notebook");

@@ -60,6 +60,48 @@ def cloud_v3(version: str) -> dict:
                       for d in devs]}
 
 
+def _column_histogram(vec, r, nbins: int = 20) -> dict:
+    """ColV3 histogram fields (reference ``FrameV3.ColV3``: Flow's frame
+    inspector renders these as sparklines): fixed-stride bins over
+    [min, max] counted in one device pass."""
+    import jax
+    import jax.numpy as jnp
+    lo, hi = float(r.min), float(r.max)
+    if not (hi > lo) or r.nrows == 0:
+        return {"histogram_bins": [], "histogram_base": _clean(lo),
+                "histogram_stride": 0}
+    stride = (hi - lo) / nbins
+    ids = jnp.clip(((vec.data - lo) / stride).astype(jnp.int32), 0, nbins - 1)
+    ok = jnp.isfinite(vec.data)
+    cnt = jax.ops.segment_sum(ok.astype(jnp.float32),
+                              jnp.where(ok, ids, 0), num_segments=nbins)
+    return {"histogram_bins": [int(x) for x in jax.device_get(cnt)],
+            "histogram_base": _clean(lo), "histogram_stride": _clean(stride)}
+
+
+def _histogram_cached(vec, r) -> dict:
+    """Histograms are immutable like the rollups — compute once per vec
+    (the reference caches them in RollupStats for the same reason; frame
+    summaries are served repeatedly to Flow's side panel and h2o-py)."""
+    cache = getattr(vec, "_hist_cache", None)
+    if cache is None:
+        if vec.is_numeric:
+            cache = _column_histogram(vec, r)
+        else:
+            # categorical "histogram": per-level counts (reference ColV3
+            # serves these for Flow's frame inspector bars)
+            import jax
+            import jax.numpy as jnp
+            codes = jnp.clip(vec.data, -1, len(vec.domain) - 1)
+            cnt = jax.ops.segment_sum(
+                (vec.data >= 0).astype(jnp.float32), jnp.maximum(codes, 0),
+                num_segments=len(vec.domain))
+            cache = {"histogram_bins": [int(x) for x in jax.device_get(cnt)],
+                     "histogram_base": 0, "histogram_stride": 1}
+        vec._hist_cache = cache
+    return cache
+
+
 def frame_v3(key: str, frame, rows: int = 10) -> dict:
     """FrameV3 with the exact per-column fields h2o-py's expr cache pops
     (``h2o-py/h2o/expr.py:_fill_data``): __meta, domain_cardinality,
@@ -86,8 +128,11 @@ def frame_v3(key: str, frame, rows: int = 10) -> dict:
         if vec.is_numeric:
             col.update(mins=[_clean(r.min)], maxs=[_clean(r.max)],
                        mean=_clean(r.mean), sigma=_clean(r.sigma))
+            col.update(_histogram_cached(vec, r))
         else:
             col.update(mins=[], maxs=[], mean=None, sigma=None)
+            if vec.domain and vec.type.on_device:
+                col.update(_histogram_cached(vec, r))
         cols.append(col)
     return {**_meta("FrameV3"), "frame_id": {"name": key},
             "rows": frame.nrows, "row_count": frame.nrows,

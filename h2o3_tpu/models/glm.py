@@ -635,9 +635,11 @@ class GLM(ModelBuilder):
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
         self._iter_devs = []    # per-IRLS-iteration deviances → scoring_history
-        mvh = params.get("missing_values_handling", "MeanImputation")
+        mvh = str(params.get("missing_values_handling")
+                  or "MeanImputation").replace("_", "").lower()
+        # h2o-py sends lowercase enum forms (mean_imputation / skip)
         self._metrics_weights = None
-        if mvh == "Skip":
+        if mvh == "skip":
             # rows with any NA among the used predictors drop out of the
             # fit (weight 0) — reference MissingValuesHandling.Skip; the
             # default path mean-imputes inside DataInfo.expand
@@ -651,7 +653,7 @@ class GLM(ModelBuilder):
             # metrics + CV must see the same reduced row set (model_base
             # reads this after _fit)
             self._metrics_weights = weights
-        elif mvh not in ("MeanImputation",):
+        elif mvh != "meanimputation":
             raise ValueError(
                 f"missing_values_handling {mvh!r} unsupported (MeanImputation"
                 " | Skip; reference PlugValues needs a plug-values frame)")

@@ -1,0 +1,155 @@
+"""Flow notebook product depth (VERDICT r4 next #8).
+
+Reference: ``h2o-web/`` Flow — assist cells for grids/AutoML, a frame
+inspector with distribution sparklines, and ``.flow`` notebook documents.
+No browser ships in this image, so the DOM layer is pinned two ways:
+(1) every REST sequence a cell handler issues is replayed here verbatim
+against a live server (the contract the JS speaks), and (2) the served
+HTML is asserted to carry the cell handlers/converters these flows need.
+A real-browser drive of the same journey runs wherever a WebView exists.
+"""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.api import H2OClient, H2OServer
+from h2o3_tpu.api.flow import FLOW_HTML
+from h2o3_tpu.utils.registry import DKV
+
+
+@pytest.fixture
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def frame(rng):
+    n = 300
+    X = rng.normal(size=(n, 3))
+    f = Frame.from_arrays({
+        "a": X[:, 0].astype(np.float32), "b": X[:, 1].astype(np.float32),
+        "c": rng.choice(["u", "v", "w"], size=n).astype(object),
+        "y": np.where(X[:, 0] > 0, "yes", "no").astype(object)},
+        key="flow_train")
+    DKV.put("flow_train", f)
+    return f
+
+
+def _poll(c, job_key):
+    return c._poll(job_key)
+
+
+class TestFlowRestSequences:
+    """The exact endpoint sequences the cell handlers call."""
+
+    def test_frame_inspector_histograms(self, server, frame):
+        c = H2OClient(server.url)
+        out = c.request("GET", "/3/Frames/flow_train")
+        cols = {col["label"]: col for col in out["frames"][0]["columns"]}
+        # numeric sparkline: 20 fixed-stride bins summing to the non-NA rows
+        bins = cols["a"]["histogram_bins"]
+        assert len(bins) == 20
+        assert sum(bins) == frame.nrows
+        assert cols["a"]["histogram_stride"] > 0
+        # categorical: per-level counts over the domain
+        cbins = cols["c"]["histogram_bins"]
+        assert len(cbins) == 3 and sum(cbins) == frame.nrows
+
+    def test_build_grid_cell_sequence(self, server, frame):
+        c = H2OClient(server.url)
+        out = c.request("POST", "/99/Grid/gbm", dict(
+            training_frame="flow_train", response_column="y",
+            hyper_parameters=json.dumps({"max_depth": [2, 3],
+                                         "ntrees": [3, 5]})))
+        job = _poll(c, out["job"]["key"]["name"])
+        assert job["status"] == "DONE"
+        grid = c.request("GET", f"/99/Grids/{job['dest']['name']}")
+        assert len(grid["model_ids"]) == 4
+        # every listed model opens like the getModel cell does
+        m0 = grid["model_ids"][0]["name"]
+        mj = c.request("GET", f"/3/Models/{m0}")
+        assert mj["models"][0]["output"]["training_metrics"]["auc"] > 0.5
+
+    def test_automl_leaderboard_cell_sequence(self, server, frame):
+        c = H2OClient(server.url)
+        out = c.request("POST", "/99/AutoMLBuilder", dict(
+            training_frame="flow_train", response_column="y",
+            max_models=2, nfolds=0, project_name="flow_aml"))
+        job = _poll(c, out["job"]["key"]["name"])
+        assert job["status"] == "DONE"
+        lb = c.request("GET", "/99/Leaderboards/flow_aml")
+        assert lb["project_name"] == "flow_aml"
+        assert len(lb["models"]) >= 2
+        t = lb["table"]
+        assert t["columns"] and len(t["data"][0]) == len(lb["models"])
+
+    def test_import_train_inspect_predict_journey(self, server, tmp_path,
+                                                  rng):
+        """The full assist journey the DOM drives: importFiles →
+        buildModel → getFrameSummary → predict → summary of preds."""
+        n = 200
+        x = rng.normal(size=n)
+        p = tmp_path / "flow.csv"
+        p.write_text("x,y\n" + "\n".join(
+            f"{v:.4f},{'t' if v > 0 else 'f'}" for v in x) + "\n")
+        c = H2OClient(server.url)
+        imp = c.request("POST", "/3/ImportFiles",
+                        {"path": str(p), "destination_frame": "flow_j"})
+        assert imp["destination_frames"][0] == "flow_j"
+        out = c.request("POST", "/3/ModelBuilders/gbm", dict(
+            training_frame="flow_j", response_column="y", ntrees=3))
+        job = _poll(c, out["job"]["key"]["name"])
+        assert job["status"] == "DONE"
+        summ = c.request("GET", "/3/Frames/flow_j")
+        assert summ["frames"][0]["rows"] == n
+        pred = c.request(
+            "POST", f"/3/Predictions/models/{job['dest']['name']}"
+                    "/frames/flow_j")
+        pkey = pred["predictions_frame"]["name"]
+        ps = c.request("GET", f"/3/Frames/{pkey}")
+        names = [col["label"] for col in ps["frames"][0]["columns"]]
+        assert names[0] == "predict"
+
+
+class TestFlowDom:
+    """The served page carries the handlers the sequences above back."""
+
+    def test_served_page_has_all_cell_handlers(self, server):
+        with urllib.request.urlopen(server.url + "/flow/index.html") as r:
+            html = r.read().decode()
+        for handler in ("buildGrid", "getGrid", "runAutoML",
+                        "getLeaderboard", "sparkline", "importFlowFile",
+                        "convertRefFlowCell", "histogram_bins"):
+            assert handler in html, handler
+        assert html == FLOW_HTML
+
+    def test_ref_flow_conversion_regexes(self):
+        """The converter's regexes (as shipped in the page) match the
+        reference .flow command shapes they claim to."""
+        pats = {
+            "importFiles": r'importFiles\s*\[\s*"([^"]+)"',
+            "buildModel": r'buildModel\s+[\'"](\w+)[\'"]\s*,\s*(\{[\s\S]*\})',
+            "predict": r'predict\s+model:\s*[\'"]([^\'"]+)[\'"],?\s*'
+                       r'frame:\s*[\'"]([^\'"]+)[\'"]',
+        }
+        # shapes straight out of reference Flow notebooks
+        assert re.match(pats["importFiles"],
+                        'importFiles [ "../smalldata/airlines.csv" ]')
+        m = re.match(pats["buildModel"],
+                     "buildModel 'gbm', {\"training_frame\":\"air\","
+                     "\"response_column\":\"IsDepDelayed\"}")
+        assert m and m.group(1) == "gbm"
+        m = re.match(pats["predict"],
+                     'predict model: "gbm-1", frame: "air"')
+        assert m and m.group(2) == "air"
+        # and the page embeds each one (JS-escaped)
+        for key in ("importFiles\\s*\\[", "buildModel\\s+",
+                    "predict\\s+model:"):
+            assert key in FLOW_HTML, key
