@@ -66,13 +66,23 @@ def _column_histogram(vec, r, nbins: int = 20) -> dict:
     [min, max] counted in one device pass."""
     import jax
     import jax.numpy as jnp
+    import math as _math
+    # rows past nrows are padding; derived frames (predictions) can carry
+    # FINITE pad values there, so mask by index like _numeric_rollups does
+    in_range = jnp.arange(vec.data.shape[0]) < vec.nrows
     lo, hi = float(r.min), float(r.max)
+    if not (_math.isfinite(lo) and _math.isfinite(hi)):
+        # +/-inf rows are counted by rollups but must not set the range
+        finite = jnp.isfinite(vec.data) & in_range
+        big = jnp.float32(jnp.finfo(jnp.float32).max)
+        lo = float(jnp.min(jnp.where(finite, vec.data, big)))
+        hi = float(jnp.max(jnp.where(finite, vec.data, -big)))
     if not (hi > lo) or r.nrows == 0:
         return {"histogram_bins": [], "histogram_base": _clean(lo),
                 "histogram_stride": 0}
     stride = (hi - lo) / nbins
     ids = jnp.clip(((vec.data - lo) / stride).astype(jnp.int32), 0, nbins - 1)
-    ok = jnp.isfinite(vec.data)
+    ok = jnp.isfinite(vec.data) & in_range
     cnt = jax.ops.segment_sum(ok.astype(jnp.float32),
                               jnp.where(ok, ids, 0), num_segments=nbins)
     return {"histogram_bins": [int(x) for x in jax.device_get(cnt)],
@@ -92,10 +102,11 @@ def _histogram_cached(vec, r) -> dict:
             # serves these for Flow's frame inspector bars)
             import jax
             import jax.numpy as jnp
+            in_range = jnp.arange(vec.data.shape[0]) < vec.nrows
             codes = jnp.clip(vec.data, -1, len(vec.domain) - 1)
             cnt = jax.ops.segment_sum(
-                (vec.data >= 0).astype(jnp.float32), jnp.maximum(codes, 0),
-                num_segments=len(vec.domain))
+                ((vec.data >= 0) & in_range).astype(jnp.float32),
+                jnp.maximum(codes, 0), num_segments=len(vec.domain))
             cache = {"histogram_bins": [int(x) for x in jax.device_get(cnt)],
                      "histogram_base": 0, "histogram_stride": 1}
         vec._hist_cache = cache

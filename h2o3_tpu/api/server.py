@@ -1120,8 +1120,7 @@ class _Handler(BaseHTTPRequestHandler):
                        maxs=[schemas._clean(r.max)],
                        mean=schemas._clean(r.mean),
                        sigma=schemas._clean(r.sigma),
-                       histogram_bins=schemas._clean(
-                           getattr(r, "histogram", None)),
+                       **schemas._histogram_cached(v, r),
                        percentiles=schemas._clean(
                            fr[[col]].quantile().vec(col).to_numpy()))
         self._reply({"__meta": {"schema_type": "FramesV3"},

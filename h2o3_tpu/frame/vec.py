@@ -96,6 +96,9 @@ class Vec:
         # host-side in float64 and device compute uses the shifted column.
         self.time_offset = time_offset
         self._rollups: Rollups | None = None
+        # per-vec histogram cache (filled by api/schemas._histogram_cached;
+        # lives here so invalidate_rollups clears BOTH derived summaries)
+        self._hist_cache: dict | None = None
 
     # -- construction -------------------------------------------------------
 
@@ -167,6 +170,7 @@ class Vec:
     def invalidate_rollups(self) -> None:
         """Call after mutating ``data`` (reference: rollup epoch bump)."""
         self._rollups = None
+        self._hist_cache = None
 
     def min(self) -> float: return self.rollups().min
     def max(self) -> float: return self.rollups().max

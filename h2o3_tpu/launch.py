@@ -49,6 +49,13 @@ def main(args=None) -> int:
                          "in addition to, running a script) — the k8s pod-0 "
                          "/ driver-node mode")
     ap.add_argument("--rest-port", type=int, default=54321)
+    ap.add_argument("--ldap-login", default=None, metavar="URL",
+                    help="gate the REST API behind an LDAP simple bind "
+                         "(ldap://host:port; reference water/H2O.java "
+                         "-ldap_login)")
+    ap.add_argument("--ldap-user-template", default=None, metavar="DN",
+                    help="bind-DN template with one {} for the login name, "
+                         "e.g. 'uid={},ou=people,dc=example,dc=org'")
     ap.add_argument("script", nargs="?", default=None)
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(args)
@@ -90,12 +97,15 @@ def main(args=None) -> int:
             time.sleep(0.05)
         return rc
 
-    if ns.coordinator is not None:
-        # must run BEFORE the first jax backend touch in the script
-        from h2o3_tpu.parallel.distributed import init_distributed
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # must run BEFORE the first jax backend touch — the environment's
+        # sitecustomize force-registers the TPU plugin, and the serve-only
+        # path's jax.process_index() would otherwise initialize it even
+        # when the operator asked for CPU (and hang on a sick chip)
         import jax
-        if os.environ.get("JAX_PLATFORMS") == "cpu":
-            jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", "cpu")
+    if ns.coordinator is not None:
+        from h2o3_tpu.parallel.distributed import init_distributed
         init_distributed(ns.coordinator, ns.num_processes, ns.process_id)
     if ns.serve:
         import jax
@@ -103,7 +113,15 @@ def main(args=None) -> int:
         # only the controller process serves (reference: the driver node's
         # REST API); workers just participate in the SPMD cloud
         if getattr(jax, "process_index", lambda: 0)() == 0:
-            server = H2OServer(port=ns.rest_port, host="0.0.0.0").start()
+            authenticator = None
+            if ns.ldap_login:
+                if not ns.ldap_user_template:
+                    ap.error("--ldap-login needs --ldap-user-template")
+                from h2o3_tpu.api.ldap_auth import ldap_authenticator
+                authenticator = ldap_authenticator(ns.ldap_login,
+                                                   ns.ldap_user_template)
+            server = H2OServer(port=ns.rest_port, host="0.0.0.0",
+                               authenticator=authenticator).start()
             print(f"h2o3_tpu REST serving on {server.url}", flush=True)
     if ns.script is not None:
         _run_script(ns.script, ns.script_args)

@@ -1,0 +1,164 @@
+"""LDAP bind auth (VERDICT r4 next #10; reference ``water/H2O.java:242-266``
+-ldap_login via JAAS LdapLoginModule).
+
+A fake in-process LDAP server speaks just enough RFC 4511 — parse the BER
+BindRequest, check DN + password, answer a BindResponse — to prove the
+pure-Python client end-to-end, including the full REST stack gated behind
+the authenticator.
+"""
+
+import base64
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.api.ldap_auth import (
+    bind_request, ldap_authenticator, ldap_simple_bind, parse_bind_response,
+)
+
+
+class FakeLdapServer:
+    """Accepts binds for one (dn, password) pair; 49 otherwise."""
+
+    def __init__(self, dn: str, password: str):
+        self.dn, self.password = dn, password
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(5)
+        self.port = self.sock.getsockname()[1]
+        self.seen: list[tuple[str, str]] = []
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        from h2o3_tpu.api.ldap_auth import _read_tlv
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    data = conn.recv(8192)
+                    _, msg, _ = _read_tlv(data, 0)
+                    _, mid, pos = _read_tlv(msg, 0)         # messageID
+                    _, op, _ = _read_tlv(msg, pos)           # BindRequest
+                    _, _ver, p = _read_tlv(op, 0)
+                    _, dn, p = _read_tlv(op, p)
+                    _, pw, _ = _read_tlv(op, p)
+                    dn, pw = dn.decode(), pw.decode()
+                    self.seen.append((dn, pw))
+                    ok = dn == self.dn and pw == self.password
+                    code = 0 if ok else 49                   # invalidCredentials
+                    body = (b"\x0a\x01" + bytes([code])      # resultCode
+                            + b"\x04\x00\x04\x00")           # matchedDN, msg
+                    resp = (b"\x61" + bytes([len(body)]) + body)
+                    lm = b"\x02\x01" + mid + resp
+                    conn.sendall(b"\x30" + bytes([len(lm)]) + lm)
+                except Exception:
+                    pass
+
+    def close(self):
+        self._stop = True
+        self.sock.close()
+
+
+@pytest.fixture
+def ldap():
+    s = FakeLdapServer("uid=alice,ou=people,dc=example,dc=org", "s3cret")
+    yield s
+    s.close()
+
+
+def test_ber_roundtrip():
+    req = bind_request(1, "uid=x,dc=y", "pw")
+    # hand-decode: LDAPMessage { messageID, [APPLICATION 0] { 3, dn, pw } }
+    from h2o3_tpu.api.ldap_auth import _read_tlv
+    tag, msg, _ = _read_tlv(req, 0)
+    assert tag == 0x30
+    tag, mid, pos = _read_tlv(msg, 0)
+    assert (tag, mid) == (0x02, b"\x01")
+    tag, op, _ = _read_tlv(msg, pos)
+    assert tag == 0x60
+    _, ver, p = _read_tlv(op, 0)
+    assert ver == b"\x03"
+    _, dn, p = _read_tlv(op, p)
+    assert dn == b"uid=x,dc=y"
+    tag, pw, _ = _read_tlv(op, p)
+    assert (tag, pw) == (0x80, b"pw")
+
+
+def test_parse_bind_response_codes():
+    ok = b"\x30\x0c\x02\x01\x01\x61\x07\x0a\x01\x00\x04\x00\x04\x00"
+    bad = b"\x30\x0c\x02\x01\x01\x61\x07\x0a\x01\x31\x04\x00\x04\x00"
+    assert parse_bind_response(ok) == 0
+    assert parse_bind_response(bad) == 49
+    with pytest.raises(ValueError):
+        parse_bind_response(b"\x04\x02hi")
+
+
+def test_simple_bind_against_fake_server(ldap):
+    url = f"ldap://127.0.0.1:{ldap.port}"
+    good = "uid=alice,ou=people,dc=example,dc=org"
+    assert ldap_simple_bind(url, good, "s3cret")
+    assert not ldap_simple_bind(url, good, "wrong")
+    assert not ldap_simple_bind(url, "uid=bob,dc=example,dc=org", "s3cret")
+    # RFC 4513: empty password must be rejected client-side
+    assert not ldap_simple_bind(url, good, "")
+
+
+def test_authenticator_templates_and_escapes(ldap):
+    auth = ldap_authenticator(f"ldap://127.0.0.1:{ldap.port}",
+                              "uid={},ou=people,dc=example,dc=org")
+    assert auth("alice", "s3cret")
+    assert not auth("alice", "nope")
+    assert not auth("", "s3cret")
+    # DN metacharacters in the login name must be escaped, not injected
+    assert not auth("alice,ou=admins", "s3cret")
+    assert any("\\," in dn for dn, _ in ldap.seen)
+
+
+def test_connection_refused_rejects_closed():
+    auth = ldap_authenticator("ldap://127.0.0.1:1",     # nothing listens
+                              "uid={},dc=x")
+    assert not auth("alice", "pw")
+
+
+def test_rest_stack_behind_ldap(ldap):
+    """The full contract: Basic credentials on the REST API resolve
+    through the LDAP bind (reference: every request passes the JAAS
+    login)."""
+    from h2o3_tpu.api import H2OServer
+
+    auth = ldap_authenticator(f"ldap://127.0.0.1:{ldap.port}",
+                              "uid={},ou=people,dc=example,dc=org")
+    s = H2OServer(port=0, authenticator=auth).start()
+    try:
+        def cloud(user, pw):
+            req = urllib.request.Request(s.url + "/3/Cloud")
+            cred = base64.b64encode(f"{user}:{pw}".encode()).decode()
+            req.add_header("Authorization", f"Basic {cred}")
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+
+        st, body = cloud("alice", "s3cret")
+        assert st == 200 and body["cloud_healthy"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            cloud("alice", "wrong")
+        assert e.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(s.url + "/3/Cloud")   # no creds at all
+        assert e.value.code == 401
+    finally:
+        s.stop()
+
+
+def test_launch_flag_validation():
+    from h2o3_tpu.launch import main
+    with pytest.raises(SystemExit):
+        main(["--serve", "--ldap-login", "ldap://x"])    # missing template
