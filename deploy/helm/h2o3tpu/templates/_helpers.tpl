@@ -1,0 +1,7 @@
+{{- define "h2o3tpu.fullname" -}}
+{{- .Release.Name | trunc 52 | trimSuffix "-" -}}-h2o3tpu
+{{- end -}}
+{{- define "h2o3tpu.labels" -}}
+app.kubernetes.io/name: h2o3tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -105,15 +105,18 @@ def ldap_simple_bind(url: str, dn: str, password: str,
             s.settimeout(timeout)
             s.sendall(bind_request(1, dn, password))
             data = b""
-            while len(data) < 4096:
-                chunk = s.recv(4096)
+            # read until the outer LDAPMessage TLV is complete (responses
+            # with long diagnostics/referrals exceed any fixed byte cap)
+            while True:
+                chunk = s.recv(65536)
                 if not chunk:
                     break
                 data += chunk
                 try:
-                    return parse_bind_response(data) == 0
+                    _, msg, end = _read_tlv(data, 0)
                 except ValueError:
-                    continue        # partial read; keep receiving
+                    continue        # header/body still partial
+                return parse_bind_response(data[:end]) == 0
     except (OSError, ValueError):
         return False                # closed on any transport/format failure
     return False
@@ -129,6 +132,19 @@ def ldap_authenticator(url: str, user_template: str):
     if "{}" not in user_template:
         raise ValueError("user template needs a {} placeholder, e.g. "
                          "'uid={},ou=people,dc=example,dc=org'")
+
+    # short-TTL success cache: clients send Basic credentials on EVERY
+    # request (h2o-py polls jobs sub-second), and a fresh TCP+bind per
+    # call would hammer the directory. Key = (user, salted pw hash);
+    # only successes cache, so revocation takes effect within the TTL.
+    import hashlib
+    import os as _os
+    import threading as _th
+    import time as _time
+    cache: dict[tuple, float] = {}
+    lock = _th.Lock()
+    salt = _os.urandom(16)
+    ttl = 300.0
 
     def _escape_dn(v: str) -> str:
         out = []
@@ -148,7 +164,20 @@ def ldap_authenticator(url: str, user_template: str):
     def authenticate(user: str, password: str) -> bool:
         if not user:
             return False
-        return ldap_simple_bind(url, user_template.format(_escape_dn(user)),
-                                password or "")
+        key = (user, hashlib.sha256(salt + (password or "").encode())
+               .hexdigest())
+        now = _time.monotonic()
+        with lock:
+            exp = cache.get(key)
+            if exp is not None and now < exp:
+                return True
+        ok = ldap_simple_bind(url, user_template.format(_escape_dn(user)),
+                              password or "")
+        if ok:
+            with lock:
+                cache[key] = now + ttl
+                if len(cache) > 10000:      # bound memory under churn
+                    cache.clear()
+        return ok
 
     return authenticate

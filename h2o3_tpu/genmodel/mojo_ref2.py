@@ -1156,10 +1156,161 @@ class RefTargetEncoderModel(_RefModelBase):
         self.predict(frame)
 
 
+# -- XGBoost -----------------------------------------------------------------
+
+class _XgbTree:
+    __slots__ = ("cleft", "cright", "split_index", "default_left", "value")
+
+    def __init__(self, cleft, cright, split_index, default_left, value):
+        self.cleft, self.cright = cleft, cright
+        self.split_index, self.default_left = split_index, default_left
+        self.value = value                 # leaf value OR split condition
+
+
+def _parse_booster(blob: bytes):
+    """The pre-1.0 xgboost binary model format (the bytes H2O's
+    ``XGBoostMojoWriter`` embeds as ``boosterBytes`` and scores through
+    biz.k11i xgboost-predictor — ``XGBoostJavaMojoModel.java:63``):
+    LearnerModelParam (136 B: f32 base_score, u32 num_feature, i32
+    num_class, reserved), len-prefixed objective + booster names, then for
+    gbtree/dart a GBTreeModelParam (160 B) and per tree a TreeParam
+    (148 B) + nodes (20 B: parent, cleft, cright, sindex, value) + stats
+    (16 B) + tree_info group ids.  Layout probed against the reference's
+    own committed boosterBytes (offsets verified in tests)."""
+    base_score, num_feature, num_class = struct.unpack_from("<fIi", blob, 0)
+    pos = 136
+    (ln,) = struct.unpack_from("<Q", blob, pos)
+    obj = blob[pos + 8: pos + 8 + ln].decode()
+    pos += 8 + ln
+    (ln,) = struct.unpack_from("<Q", blob, pos)
+    booster = blob[pos + 8: pos + 8 + ln].decode()
+    pos += 8 + ln
+    if booster not in ("gbtree", "dart"):
+        raise ValueError(f"unsupported xgboost booster {booster!r}")
+    num_trees, _roots, _nf, _pad = struct.unpack_from("<iiii", blob, pos)
+    (num_output_group,) = struct.unpack_from("<i", blob, pos + 24)
+    (size_leaf_vector,) = struct.unpack_from("<i", blob, pos + 28)
+    pos += 160
+    trees = []
+    for _ in range(num_trees):
+        _r, n_nodes, _d, _md, _nf2, slv = struct.unpack_from("<6i", blob, pos)
+        pos += 148
+        nodes = np.frombuffer(blob, "<i4", n_nodes * 5, pos).reshape(n_nodes, 5)
+        vals = np.frombuffer(blob, "<f4", n_nodes * 5, pos).reshape(n_nodes, 5)
+        pos += n_nodes * 20
+        pos += n_nodes * 16                     # RTreeNodeStat
+        if slv:
+            # dmlc vector serialization: the u64 IS the total element
+            # count (slv * num_nodes) — skip exactly that many f32s
+            (lv,) = struct.unpack_from("<Q", blob, pos)
+            pos += 8 + 4 * lv
+        sindex = nodes[:, 3].astype(np.uint32)
+        trees.append(_XgbTree(
+            cleft=nodes[:, 1].copy(), cright=nodes[:, 2].copy(),
+            split_index=(sindex & 0x7FFFFFFF).astype(np.int64),
+            default_left=(sindex >> 31).astype(bool),
+            value=vals[:, 4].copy().astype(np.float64)))
+    tree_info = np.frombuffer(blob, "<i4", num_trees, pos)
+    pos += 4 * num_trees
+    weight_drop = None
+    if booster == "dart":
+        # std::vector<bst_float>: u64 count + f32 weights
+        (lv,) = struct.unpack_from("<Q", blob, pos)
+        weight_drop = np.frombuffer(blob, "<f4", lv, pos + 8
+                                    ).astype(np.float64)
+    return dict(base_score=float(base_score), num_feature=int(num_feature),
+                num_class=int(num_class), objective=obj,
+                trees=trees, tree_info=tree_info,
+                num_output_group=max(1, int(num_output_group)),
+                weight_drop=weight_drop)
+
+
+class RefXGBoostModel(_RefModelBase):
+    """Imported XGBoost MOJO: parse boosterBytes, score like the
+    reference's xgboost-predictor path (``XGBoostJavaMojoModel.score0`` +
+    ``OneHotEncoderFactory``: cats one-hot through GenModel.setCats, then
+    nums; sparse mode maps 0/not-hot to NaN so they take default paths)."""
+
+    algo = "xgboost"
+
+    def __init__(self, z, prefix, info, columns, domains):
+        super().__init__(info, columns, domains)
+        self.cats = int(_kv(info, "cats", 0))
+        self.nums = int(_kv(info, "nums", 0))
+        self.cat_offsets = _kv_ints(info, "cat_offsets", np.zeros(1, np.int64))
+        self.use_all_levels = _kv_bool(info, "use_all_factor_levels")
+        self.sparse = _kv_bool(info, "sparse")
+        self.booster = _parse_booster(z.read(prefix + "boosterBytes"))
+
+    def _encode(self, X: np.ndarray) -> np.ndarray:
+        """[n, catOffsets[cats] + nums] one-hot + raw nums; not-hot = 0
+        (dense) or NaN (sparse), num 0 -> NaN under sparse."""
+        n = X.shape[0]
+        not_hot = np.nan if self.sparse else 0.0
+        width = int(self.cat_offsets[self.cats]) + self.nums
+        out = np.full((n, width), not_hot)
+        for i in range(self.cats):
+            d = X[:, i]
+            lo, hi = int(self.cat_offsets[i]), int(self.cat_offsets[i + 1])
+            c = np.trunc(np.nan_to_num(d, nan=0.0)).astype(np.int64)
+            if self.use_all_levels:
+                idx = c + lo
+            else:
+                idx = np.where(c != 0, c - 1 + lo, -1)
+            idx = np.where(np.isnan(d), hi - 1, np.minimum(idx, hi - 1))
+            rows = np.arange(n)
+            hit = idx >= 0
+            out[rows[hit], idx[hit]] = 1.0
+        for j in range(self.nums):
+            v = X[:, self.cats + j]
+            if self.sparse:
+                v = np.where(v == 0, np.nan, v)
+            out[:, int(self.cat_offsets[self.cats]) + j] = v
+        return out
+
+    def _tree_scores(self, F: np.ndarray, t: _XgbTree) -> np.ndarray:
+        n = F.shape[0]
+        node = np.zeros(n, np.int64)
+        # loop until every row reaches a leaf; each step strictly descends
+        # the tree, so > num_nodes iterations means a cycle (corrupt blob)
+        for _ in range(len(t.value) + 1):
+            leaf = t.cleft[node] == -1
+            if leaf.all():
+                return t.value[node]
+            f = F[np.arange(n), t.split_index[node]]
+            is_na = np.isnan(f)
+            go_left = np.where(is_na, t.default_left[node],
+                               f < t.value[node])
+            nxt = np.where(go_left, t.cleft[node], t.cright[node])
+            node = np.where(leaf, node, nxt)
+        raise ValueError("cyclic xgboost tree structure (corrupt booster)")
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        F = self._encode(X)
+        b = self.booster
+        k = b["num_output_group"]
+        margins = np.full((X.shape[0], k), b["base_score"])
+        for ti, t in enumerate(b["trees"]):
+            w = 1.0 if b["weight_drop"] is None else b["weight_drop"][ti]
+            margins[:, int(b["tree_info"][ti])] += w * self._tree_scores(F, t)
+        obj = b["objective"]
+        if obj.startswith(("binary:logistic", "reg:logistic")):
+            p1 = 1.0 / (1.0 + np.exp(-margins[:, 0]))
+            return np.stack([1 - p1, p1], 1)
+        if obj.startswith("multi:"):
+            z = margins - margins.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
+        if obj.startswith("count:") or obj.startswith("reg:gamma") \
+                or obj.startswith("reg:tweedie"):
+            return np.exp(margins[:, 0])
+        return margins[:, 0]                    # reg:squarederror/linear
+
+
 # -- dispatch ----------------------------------------------------------------
 
 EXT_ALGOS = ("deeplearning", "pca", "glrm", "coxph", "word2vec",
-             "isotonicregression", "rulefit", "targetencoder")
+             "isotonicregression", "rulefit", "targetencoder", "xgboost")
 
 
 def load_ext_family(algo, z, prefix, info, columns, domains, load_sub):
@@ -1193,4 +1344,6 @@ def load_ext_family(algo, z, prefix, info, columns, domains, load_sub):
         return RefRuleFitModel(info, columns, domains, linear)
     if algo == "targetencoder":
         return RefTargetEncoderModel(z, prefix, info, columns, domains)
+    if algo == "xgboost":
+        return RefXGBoostModel(z, prefix, info, columns, domains)
     return None

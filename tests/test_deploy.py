@@ -1,0 +1,68 @@
+"""Deployment artifacts (reference: h2o-helm/ + h2o-k8s/).
+
+No helm binary ships in this image, so the chart is validated
+structurally: parseable Chart/values, and every ``.Values.*`` path the
+templates reference must exist in values.yaml (the drift that breaks
+``helm install`` at render time).
+"""
+
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deploy", "helm", "h2o3tpu")
+
+
+def test_chart_metadata():
+    c = yaml.safe_load(open(os.path.join(CHART, "Chart.yaml")))
+    assert c["apiVersion"] == "v2"
+    assert c["name"] == "h2o3tpu"
+    assert c["version"]
+
+
+def test_values_parse_and_defaults():
+    v = yaml.safe_load(open(os.path.join(CHART, "values.yaml")))
+    assert v["cloud"]["nodeCount"] >= 1
+    assert v["rest"]["port"] == 54321
+    assert v["tpu"]["chipsPerHost"] >= 1
+
+
+def test_every_template_value_exists():
+    v = yaml.safe_load(open(os.path.join(CHART, "values.yaml")))
+
+    def has_path(d, path):
+        for part in path:
+            if not isinstance(d, dict) or part not in d:
+                return False
+            d = d[part]
+        return True
+
+    tdir = os.path.join(CHART, "templates")
+    refs = set()
+    for fn in os.listdir(tdir):
+        src = open(os.path.join(tdir, fn)).read()
+        refs |= {tuple(m.split(".")) for m in
+                 re.findall(r"\.Values\.([A-Za-z0-9_.]+)", src)}
+    assert refs, "templates reference no values?"
+    missing = [r for r in refs if not has_path(v, r)]
+    assert not missing, missing
+
+
+def test_statefulset_wires_the_launcher():
+    src = open(os.path.join(CHART, "templates", "statefulset.yaml")).read()
+    for needle in ("h2o3_tpu.launch", "--coordinator", "--num-processes",
+                   "--process-id", "--serve", "google.com/tpu",
+                   "pod-index", "readinessProbe"):
+        assert needle in src, needle
+    # LDAP block is value-gated
+    assert "--ldap-login" in src and "if .Values.auth.ldapUrl" in src
+
+
+def test_plain_k8s_yaml_still_valid():
+    docs = list(yaml.safe_load_all(
+        open(os.path.join(REPO, "deploy", "k8s",
+                          "h2o3tpu-statefulset.yaml"))))
+    kinds = {d["kind"] for d in docs if d}
+    assert {"Service", "StatefulSet"} <= kinds
