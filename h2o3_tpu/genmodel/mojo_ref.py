@@ -685,7 +685,8 @@ def load_ref_mojo(path_or_bytes):
     >= 1.20), glm, kmeans, stackedensemble (nested submodels,
     MultiModelMojoReader layout), plus via ``mojo_ref2``: deeplearning,
     pca, glrm, coxph, word2vec, rulefit, targetencoder,
-    isotonicregression, xgboost (boosterBytes parsed natively).
+    isotonicregression, xgboost (boosterBytes parsed natively),
+    extendedisolationforest.
     Raises with a clear message otherwise — matching ``ModelMojoFactory``'s
     algo dispatch (``hex/genmodel/ModelMojoFactory.java``).
     """
@@ -781,5 +782,6 @@ def _load_from_zip(z: zipfile.ZipFile, prefix: str):
         f"unsupported reference MOJO algo {algo!r}; this importer handles "
         "gbm, drf, isolationforest, glm, kmeans, stackedensemble, "
         "deeplearning, pca, glrm, coxph, word2vec, rulefit, targetencoder, "
-        "isotonicregression, xgboost (export other families from this framework's "
+        "isotonicregression, xgboost, extendedisolationforest (export other "
+        "families from this framework's "
         "own MOJO v2 instead)")

@@ -1156,6 +1156,125 @@ class RefTargetEncoderModel(_RefModelBase):
         self.predict(frame)
 
 
+# -- ExtendedIsolationForest -------------------------------------------------
+
+_EULER_MASCHERONI = 0.5772156649
+
+
+def _eif_avg_path(n: float) -> float:
+    """MathUtils.harmonicNumberEstimation-based c(n) (EIF paper eq. 2)."""
+    if n < 2:
+        return 0.0
+    if n == 2:
+        return 1.0
+    return 2 * (math.log(n - 1) + _EULER_MASCHERONI) - 2.0 * (n - 1.0) / n
+
+
+class RefExtendedIsoForModel(_RefModelBase):
+    """Imported ExtendedIsolationForest MOJO: little-endian tree blobs of
+    heap-indexed records — [i32 node, u8 'N'|'L'] + (NODE: k doubles n,
+    k doubles p | LEAF: i32 num_rows); routing by dot(row - p, n) <= 0
+    (``ExtendedIsolationForestMojoModel.java:59-122`` scoreTree0)."""
+
+    algo = "extendedisolationforest"
+
+    def __init__(self, z, prefix, info, columns, domains):
+        super().__init__(info, columns, domains)
+        self.ntrees = int(_kv(info, "ntrees", 0))
+        self.sample_size = int(_kv(info, "sample_size", 0))
+        self.trees = [self._parse_tree(z.read(f"{prefix}trees/t{t:02d}.bin"))
+                      for t in range(self.ntrees)]
+
+    @staticmethod
+    def _parse_tree(blob: bytes):
+        """Dense heap-indexed arrays so scoring vectorizes across rows
+        like the module's other tree importers: is_leaf mask, split
+        normals/intercepts N/P, leaf row counts."""
+        (k,) = struct.unpack_from("<i", blob, 0)
+        pos = 4
+        nodes = {}
+        while pos < len(blob):
+            num, typ = struct.unpack_from("<iB", blob, pos)
+            pos += 5
+            if typ == ord("N"):
+                n = np.frombuffer(blob, "<f8", k, pos)
+                p = np.frombuffer(blob, "<f8", k, pos + 8 * k)
+                nodes[num] = ("N", n, p)
+                pos += 16 * k
+            elif typ == ord("L"):
+                (rows,) = struct.unpack_from("<i", blob, pos)
+                nodes[num] = ("L", rows)
+                pos += 4
+            else:
+                raise ValueError(f"unknown EIF node type {typ}")
+        size = max(nodes) + 1
+        is_leaf = np.zeros(size, bool)
+        N = np.zeros((size, k))
+        P = np.zeros((size, k))
+        leaf_rows = np.zeros(size)
+        for num, nd in nodes.items():
+            if nd[0] == "L":
+                is_leaf[num] = True
+                leaf_rows[num] = nd[1]
+            else:
+                N[num], P[num] = nd[1], nd[2]
+        return dict(is_leaf=is_leaf, N=N, P=P, rows=leaf_rows, size=size)
+
+    @property
+    def is_classifier(self) -> bool:
+        return False
+
+    @staticmethod
+    def _avg_path_vec(n: np.ndarray) -> np.ndarray:
+        out = np.where(n < 2, 0.0, np.where(
+            n == 2, 1.0,
+            2 * (np.log(np.maximum(n - 1, 1)) + _EULER_MASCHERONI)
+            - 2.0 * (n - 1.0) / np.maximum(n, 1)))
+        return out
+
+    def _tree_path_lengths(self, t: dict, X: np.ndarray) -> np.ndarray:
+        """Vectorized level-by-level heap descent (the
+        RefXGBoostModel._tree_scores pattern)."""
+        n = X.shape[0]
+        node = np.zeros(n, np.int64)
+        height = np.zeros(n)
+        for _ in range(t["size"] + 1):
+            leaf = t["is_leaf"][node]
+            if leaf.all():
+                return height + self._avg_path_vec(t["rows"][node])
+            mul = ((X - t["P"][node]) * t["N"][node]).sum(axis=1)
+            nxt = np.where(mul <= 0, 2 * node + 1, 2 * node + 2)
+            node = np.where(leaf, node, nxt)
+            height = height + (~leaf)
+        raise ValueError("cyclic EIF tree structure (corrupt blob)")
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        """[n, 2]: (anomaly_score, mean_length) — EIF paper eq. 1."""
+        pl = np.zeros(X.shape[0])
+        for t in self.trees:
+            pl += self._tree_path_lengths(t, X)
+        pl /= max(self.ntrees, 1)
+        denom = _eif_avg_path(self.sample_size)
+        score = 2.0 ** (-pl / denom) if denom > 0 else np.ones_like(pl)
+        return np.stack([score, pl], 1)
+
+    def _score_raw(self, frame):
+        import jax.numpy as jnp
+        raw = self.score(self._design(frame))[:, 0].astype(np.float32)
+        pad = frame.vecs[0].plen - frame.nrows
+        if pad > 0:
+            raw = np.pad(raw, (0, pad))
+        return jnp.asarray(raw)
+
+    def predict(self, frame):
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.vec import Vec
+        raw = self.score(self._design(frame))
+        return Frame(["anomaly_score", "mean_length"],
+                     [Vec.from_numpy(raw[:, 0].astype(np.float32)),
+                      Vec.from_numpy(raw[:, 1].astype(np.float32))])
+
+
 # -- XGBoost -----------------------------------------------------------------
 
 class _XgbTree:
@@ -1310,7 +1429,8 @@ class RefXGBoostModel(_RefModelBase):
 # -- dispatch ----------------------------------------------------------------
 
 EXT_ALGOS = ("deeplearning", "pca", "glrm", "coxph", "word2vec",
-             "isotonicregression", "rulefit", "targetencoder", "xgboost")
+             "isotonicregression", "rulefit", "targetencoder", "xgboost",
+             "extendedisolationforest")
 
 
 def load_ext_family(algo, z, prefix, info, columns, domains, load_sub):
@@ -1346,4 +1466,6 @@ def load_ext_family(algo, z, prefix, info, columns, domains, load_sub):
         return RefTargetEncoderModel(z, prefix, info, columns, domains)
     if algo == "xgboost":
         return RefXGBoostModel(z, prefix, info, columns, domains)
+    if algo == "extendedisolationforest":
+        return RefExtendedIsoForModel(z, prefix, info, columns, domains)
     return None

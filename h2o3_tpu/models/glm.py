@@ -649,7 +649,14 @@ class GLM(ModelBuilder):
                 v = frame.vec(c)
                 na = na | ((v.data < 0) if v.type is VecType.CAT
                            else jnp.isnan(v.data))
+            had_weight = float(jnp.sum(weights)) > 0.0
             weights = weights * (~na)
+            if float(jnp.sum(weights)) == 0.0:
+                raise ValueError(
+                    "missing_values_handling='Skip' removed every row "
+                    "(all rows have at least one NA predictor)"
+                    if had_weight else
+                    "no rows carry training weight (check weights_column)")
             # metrics + CV must see the same reduced row set (model_base
             # reads this after _fit)
             self._metrics_weights = weights

@@ -1,0 +1,207 @@
+"""Edge-case behavioral corpus (VERDICT r4 missing #7: the reference
+specs behavior via 1,387 pyunits; the thin spots here were NA-heavy
+frames, weird domains, and parameter interactions).
+
+Each test pins a behavior a migrating user hits in the wild — not happy
+paths (those live in the per-algo suites) but the frames that break
+implementations: 90%-NA columns, thousand-level categoricals, unicode
+levels, constant/extreme features, train/test domain drift.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.gbm import GBM, DRF
+from h2o3_tpu.models.glm import GLM
+from h2o3_tpu.utils.registry import DKV
+
+
+class TestNAHeavyFrames:
+    def test_gbm_90pct_na_feature_still_trains(self, rng):
+        n = 400
+        x = rng.normal(size=n).astype(np.float32)
+        sparse = x.copy()
+        sparse[rng.random(n) < 0.9] = np.nan
+        fr = Frame.from_arrays({
+            "mostly_na": sparse, "ok": x,
+            "y": np.where(x > 0, "t", "f").astype(object)})
+        m = GBM(ntrees=10, max_depth=3, seed=1).train(y="y",
+                                                      training_frame=fr)
+        assert m.training_metrics.auc > 0.9
+        p = m.predict(fr).vec("pt").to_numpy()[:n]
+        assert np.isfinite(p).all()
+
+    def test_all_na_feature_is_inert(self, rng):
+        """A 100%-NA column must neither crash nor influence the model
+        (reference: DHistogram gives it no splittable mass)."""
+        n = 256
+        x = rng.normal(size=n).astype(np.float32)
+        fr_with = Frame.from_arrays({
+            "dead": np.full(n, np.nan, np.float32), "x": x,
+            "y": (2 * x + 0.1 * rng.normal(size=n)).astype(np.float32)})
+        fr_without = Frame.from_arrays({
+            "x": fr_with.vec("x").to_numpy(),
+            "y": fr_with.vec("y").to_numpy()})
+        p_with = GBM(ntrees=5, max_depth=3, seed=2).train(
+            y="y", training_frame=fr_with).predict(fr_with) \
+            .vec("predict").to_numpy()[:n]
+        p_without = GBM(ntrees=5, max_depth=3, seed=2).train(
+            y="y", training_frame=fr_without).predict(fr_without) \
+            .vec("predict").to_numpy()[:n]
+        np.testing.assert_allclose(p_with, p_without, rtol=1e-5)
+
+    def test_glm_all_rows_have_some_na_with_skip_errors_clearly(self, rng):
+        """Skip with zero surviving rows must raise a real error, not
+        return a garbage fit."""
+        n = 64
+        a = np.full(n, np.nan, np.float32)
+        b = rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_arrays({"a": a, "b": b,
+                                "y": b.astype(np.float32)})
+        with pytest.raises(Exception):
+            GLM(family="gaussian", missing_values_handling="Skip").train(
+                y="y", training_frame=fr)
+
+    def test_na_response_rows_excluded_from_training(self, rng):
+        """Rows with NA response carry no training weight (reference:
+        response NA rows are skipped, not imputed)."""
+        n = 300
+        x = rng.normal(size=n).astype(np.float32)
+        y = (3 * x).astype(np.float32)
+        y_box = y.copy()
+        # poison a block of responses; features there are adversarial
+        y_box[:100] = np.nan
+        fr = Frame.from_arrays({"x": x, "y": y_box})
+        m = GLM(family="gaussian", lambda_=0.0, standardize=False).train(
+            y="y", training_frame=fr)
+        assert m.coef()["x"] == pytest.approx(3.0, abs=1e-2)
+
+
+class TestWeirdDomains:
+    def test_unicode_and_punctuated_levels_roundtrip(self, rng):
+        n = 240
+        levels = np.array(["naïve", "a,b", 'quo"te', "tab\tlevel", "ok"],
+                          object)
+        c = levels[rng.integers(0, len(levels), n)]
+        fr = Frame.from_arrays({
+            "c": c, "x": rng.normal(size=n).astype(np.float32),
+            "y": np.where(c == "naïve", "yes", "no").astype(object)})
+        m = GBM(ntrees=10, max_depth=3, seed=3).train(y="y",
+                                                      training_frame=fr)
+        assert m.training_metrics.auc > 0.99    # the level IS the signal
+        pred = m.predict(fr)
+        labels = pred.vec("predict").labels()[:n]
+        assert set(labels) <= {"yes", "no"}
+
+    def test_thousand_level_categorical(self, rng):
+        """High-cardinality enum: group splits must bucket levels, not
+        blow memory or time (reference nbins_cats semantics)."""
+        n = 2000
+        codes = rng.integers(0, 1000, n)
+        y = np.where(codes % 2 == 0, "even", "odd").astype(object)
+        fr = Frame.from_arrays({
+            "big": np.array([f"lv{c:04d}" for c in codes], object),
+            "noise": rng.normal(size=n).astype(np.float32), "y": y})
+        m = GBM(ntrees=15, max_depth=5, seed=4).train(y="y",
+                                                      training_frame=fr)
+        # parity-of-level is learnable only through per-level bucketing;
+        # anything above chance proves levels aren't being averaged away
+        assert m.training_metrics.auc > 0.6
+
+    def test_unseen_level_at_scoring_time(self, rng):
+        n = 200
+        tr_levels = np.array(["a", "b", "c"], object)
+        c = tr_levels[rng.integers(0, 3, n)]
+        fr = Frame.from_arrays({
+            "c": c, "x": rng.normal(size=n).astype(np.float32),
+            "y": np.where(c == "a", "t", "f").astype(object)})
+        m = GBM(ntrees=5, max_depth=3, seed=5).train(y="y",
+                                                     training_frame=fr)
+        test = Frame.from_arrays({
+            "c": np.array(["a", "zz_new", "b"], object),
+            "x": np.zeros(3, np.float32)})
+        p = m.predict(test).vec("pt").to_numpy()[:3]
+        assert np.isfinite(p).all()     # unseen level routes like NA
+
+
+class TestParameterInteractions:
+    def test_weights_plus_nfolds(self, rng):
+        """CV holdout masks must COMPOSE with user weights (both are
+        weight masks in this design — the overlap is the risky path)."""
+        n = 300
+        x = rng.normal(size=n).astype(np.float32)
+        w = rng.integers(1, 4, n).astype(np.float32)
+        fr = Frame.from_arrays({
+            "x": x, "w": w,
+            "y": np.where(x > 0, "t", "f").astype(object)})
+        m = GBM(ntrees=5, max_depth=3, seed=6, nfolds=3,
+                weights_column="w").train(y="y", training_frame=fr)
+        assert m.cross_validation_metrics is not None
+        assert 0.5 < m.cross_validation_metrics.auc <= 1.0
+
+    def test_checkpoint_plus_weights(self, rng):
+        n = 240
+        x = rng.normal(size=n).astype(np.float32)
+        w = np.where(np.arange(n) % 2 == 0, 2.0, 1.0).astype(np.float32)
+        fr = Frame.from_arrays({"x": x, "w": w,
+                                "y": (2 * x).astype(np.float32)})
+        half = GBM(ntrees=3, max_depth=3, seed=7, weights_column="w").train(
+            y="y", training_frame=fr)
+        full = GBM(ntrees=6, max_depth=3, seed=7, weights_column="w",
+                   checkpoint=half).train(y="y", training_frame=fr)
+        straight = GBM(ntrees=6, max_depth=3, seed=7,
+                       weights_column="w").train(y="y", training_frame=fr)
+        pr = full.predict(fr).vec("predict").to_numpy()[:n]
+        ps = straight.predict(fr).vec("predict").to_numpy()[:n]
+        np.testing.assert_allclose(pr, ps, atol=1e-5)
+
+    def test_drf_sampling_with_tiny_frame(self, rng):
+        """8-row frame: bootstrap sampling + min_rows must degrade to a
+        sane model, not an exception or empty forest."""
+        fr = Frame.from_arrays({
+            "x": np.arange(8, dtype=np.float32),
+            "y": np.array(["a", "b"] * 4, object)})
+        m = DRF(ntrees=5, max_depth=3, seed=8).train(y="y",
+                                                     training_frame=fr)
+        p = m.predict(fr).vec("pa").to_numpy()[:8]
+        assert np.isfinite(p).all()
+
+
+class TestExtremeValues:
+    def test_huge_magnitudes_bin_and_train(self, rng):
+        n = 256
+        x = (rng.normal(size=n) * 1e30).astype(np.float32)
+        fr = Frame.from_arrays({
+            "x": x, "y": np.where(x > 0, "t", "f").astype(object)})
+        m = GBM(ntrees=5, max_depth=2, seed=9).train(y="y",
+                                                     training_frame=fr)
+        assert m.training_metrics.auc > 0.95
+
+    def test_constant_feature_is_inert(self, rng):
+        n = 200
+        x = rng.normal(size=n).astype(np.float32)
+        fr = Frame.from_arrays({
+            "const": np.full(n, 3.14, np.float32), "x": x,
+            "y": (x * 2).astype(np.float32)})
+        m = GBM(ntrees=5, max_depth=3, seed=10).train(y="y",
+                                                      training_frame=fr)
+        vi = m.output.get("varimp")
+        if vi:
+            assert dict(vi).get("const", 0.0) == pytest.approx(0.0, abs=1e-9)
+
+    def test_glm_near_collinear_features(self, rng):
+        """x2 = x1 + tiny noise: IRLS must converge to finite
+        coefficients (the reference's gram regularization path)."""
+        n = 300
+        x1 = rng.normal(size=n)
+        x2 = x1 + 1e-4 * rng.normal(size=n)
+        y = (x1 + 0.05 * rng.normal(size=n))
+        fr = Frame.from_arrays({"a": x1.astype(np.float32),
+                                "b": x2.astype(np.float32),
+                                "y": y.astype(np.float32)})
+        m = GLM(family="gaussian", lambda_=1e-6).train(y="y",
+                                                       training_frame=fr)
+        assert all(np.isfinite(v) for v in m.coef().values())
+        p = m.predict(fr).vec("predict").to_numpy()[:n]
+        assert np.corrcoef(p, y)[0, 1] > 0.99

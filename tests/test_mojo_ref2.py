@@ -677,3 +677,38 @@ class TestXGBoostMojo:
         X = np.full((1, m.n_features), np.nan)   # all-NA row still scores
         p = m.score(X)
         assert np.isfinite(p).all()
+
+
+# -- ExtendedIsolationForest -------------------------------------------------
+
+class TestExtendedIsoForMojo:
+    def _fixture(self):
+        """One 2-dim EIF tree (extension level 1): root splits on
+        dot(row - p, n); left leaf isolates 1 row, right leaf holds 6."""
+        k = 2
+        def node(num, n, p):
+            return struct.pack("<iB", num, ord("N")) + \
+                np.asarray(n, "<f8").tobytes() + np.asarray(p, "<f8").tobytes()
+        def leaf(num, rows):
+            return struct.pack("<iB", num, ord("L")) + struct.pack("<i", rows)
+        blob = struct.pack("<i", k) + \
+            node(0, [1.0, 0.0], [0.5, 0.0]) + leaf(1, 1) + leaf(2, 6)
+        zb = _mojo_zip("extendedisolationforest", ["a", "b"], [None, None],
+                       {"ntrees": 1, "sample_size": 7},
+                       blobs={"trees/t00.bin": blob}, supervised=False)
+        return _load(zb)
+
+    def test_path_lengths_and_anomaly_score(self):
+        m = self._fixture()
+        X = np.array([[0.0, 0.0],    # (0-0.5)*1 <= 0 -> left leaf, 1 row
+                      [2.0, 0.0]])   # right leaf, 6 rows
+        out = m.score(X)
+        import math as _m
+        c = lambda n: 0.0 if n < 2 else (1.0 if n == 2 else
+            2 * (_m.log(n - 1) + 0.5772156649) - 2 * (n - 1) / n)
+        pl0, pl1 = 1 + c(1), 1 + c(6)
+        assert out[0, 1] == pytest.approx(pl0)
+        assert out[1, 1] == pytest.approx(pl1)
+        assert out[0, 0] == pytest.approx(2 ** (-pl0 / c(7)))
+        # the isolated row is MORE anomalous
+        assert out[0, 0] > out[1, 0]
