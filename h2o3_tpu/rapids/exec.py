@@ -375,6 +375,26 @@ def _eval(node, s: Session):
         return _colwise(fr, coerce)
     if op == "colnames":
         return [str(n) for n in args[0].names]
+    if op == "colnames=":
+        # AstColNames (mungers/AstColNames.java:17-55): rename selected
+        # columns IN PLACE — h2o-py's ``frame.columns = [...]`` setter
+        # speaks exactly this, and the reference mutates fr._names so
+        # every alias (session temps, DKV entry) sees the new names
+        fr = args[0]
+        cols = args[1]
+        cols = (list(np.atleast_1d(cols)) if isinstance(cols, np.ndarray)
+                else cols if isinstance(cols, list) else [cols])
+        names = args[2] if isinstance(args[2], list) else [args[2]]
+        if len(cols) != len(names):
+            raise ValueError("Must have the same number of column choices "
+                             "as names")
+        for c, nm in zip(cols, names):
+            ci = int(c)
+            if not 0 <= ci < fr.ncols:
+                raise ValueError(f"colnames=: column index {ci} out of "
+                                 f"range for {fr.ncols} columns")
+            fr.names[ci] = str(nm)
+        return fr
     if op == "levels":
         v = _as_vec(args[0])
         return list(v.domain or [])
@@ -762,7 +782,7 @@ _CHAIN_OPS = (
     "cumsum", "cumprod", "cummin", "cummax", "cut", "hist", "h2o.impute",
     "impute", "scale", "round", "signif", "table", "GB", "groupby", "pivot",
     "melt", "as.factor", "as.character", "as.numeric", "is.na", "is.factor",
-    "is.numeric", "colnames", "levels",
+    "is.numeric", "colnames", "colnames=", "levels",
     # prim closure (rapids/advprims.py)
     "cor", "spearman", "distance", "kfold_column", "modulo_kfold_column",
     "stratified_kfold_column", "h2o.random_stratified_split", "skewness",

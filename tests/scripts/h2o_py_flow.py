@@ -199,6 +199,33 @@ if os.path.exists(ref_fixture):
     lp = legacy.predict(pros).as_data_frame()
     assert len(lp) == pros.nrow and "p1" in lp.columns
 
+# round 5: parameter-semantics features through the REAL client — an
+# explicit fold column, Skip missing handling, and an imported reference
+# XGBoost MOJO (native boosterBytes parser server-side)
+fr_fold = fr.cbind(fr.kfold_column(n_folds=3, seed=42))
+fr_fold.columns = ["x1", "x2", "y", "fold"]
+gbm_fold = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=2,
+                                        fold_column="fold")
+gbm_fold.train(x=["x1", "x2"], y="y", training_frame=fr_fold)
+cvm = gbm_fold.model_performance(xval=True)
+assert 0.0 < cvm.auc() <= 1.0
+
+glm_skip = H2OGeneralizedLinearEstimator(
+    family="binomial", missing_values_handling="Skip", lambda_=0.0)
+glm_skip.train(x=["x1", "x2"], y="y", training_frame=tr)
+assert glm_skip.auc() > 0.5
+
+xgb_fixture = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data", "ref_mojo",
+    "xgboost_prostate_age.zip")
+if os.path.exists(xgb_fixture):
+    xgb_legacy = h2o.upload_mojo(xgb_fixture)
+    pros2 = h2o.import_file(os.path.join(
+        os.path.dirname(xgb_fixture), "prostate.csv"))
+    xp = xgb_legacy.predict(pros2).as_data_frame()
+    mse = ((xp["predict"] - pros2.as_data_frame()["AGE"]) ** 2).mean()
+    assert abs(mse - 3.3232581458216086) < 1e-3, mse
+
 h2o.remove_all()
 print("H2O_PY_COMPAT_OK")
 # skip h2o-py's atexit session teardown (its ExprNode.__del__ chain assumes

@@ -14,7 +14,6 @@ import pytest
 from h2o3_tpu import Frame
 from h2o3_tpu.models.gbm import GBM, DRF
 from h2o3_tpu.models.glm import GLM
-from h2o3_tpu.utils.registry import DKV
 
 
 class TestNAHeavyFrames:
@@ -59,7 +58,7 @@ class TestNAHeavyFrames:
         b = rng.normal(size=n).astype(np.float32)
         fr = Frame.from_arrays({"a": a, "b": b,
                                 "y": b.astype(np.float32)})
-        with pytest.raises(Exception):
+        with pytest.raises(ValueError, match="removed every row"):
             GLM(family="gaussian", missing_values_handling="Skip").train(
                 y="y", training_frame=fr)
 

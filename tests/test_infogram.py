@@ -6,9 +6,10 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.infogram import Infogram
 
 
-def _frame(rng, n=500):
+def _frame(rng, n=1500):
     x0 = rng.normal(size=n).astype(np.float32)          # strong signal
-    x1 = (x0 + rng.normal(scale=0.05, size=n)).astype(np.float32)  # redundant copy
+    # redundant copy of x0: tight noise so its unique information is ~zero
+    x1 = (x0 + rng.normal(scale=0.05, size=n)).astype(np.float32)
     x2 = rng.normal(size=n).astype(np.float32)          # pure noise
     x3 = rng.normal(size=n).astype(np.float32)          # independent signal
     logit = 2.0 * x0 + 1.5 * x3
