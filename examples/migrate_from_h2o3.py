@@ -4,9 +4,11 @@
 
 A user arriving from the reference framework brings ``.zip`` MOJOs exported
 by ``model.download_mojo()``. ``h2o.import_mojo`` reads them natively — GBM
-and DRF tree bytecode, GLM, K-means, IsolationForest, and StackedEnsemble
-archives with nested submodels — so existing models score here unchanged
-while retraining moves to the TPU-native builders.
+and DRF tree bytecode, GLM, K-means, IsolationForest (+Extended),
+StackedEnsemble archives with nested submodels, DeepLearning, PCA, GLRM,
+CoxPH, Word2Vec, RuleFit, TargetEncoder, Isotonic, and XGBoost (the
+embedded boosterBytes parsed natively) — so existing models score here
+unchanged while retraining moves to the TPU-native builders.
 """
 import os
 
@@ -39,6 +41,15 @@ def main():
     ens = h2o.import_mojo(os.path.join(FIXTURES, "ensemble_binomial.zip"))
     print("ensemble:", ens.output["source_algo"],
           "bases:", [b.algo for b in ens.output["mojo"].base_models])
+
+    # XGBoost MOJOs too: the xgboost binary model inside is parsed
+    # natively (no xgboost install), reproducing the artifact's own
+    # stored training MSE on its training data
+    xgb = h2o.import_mojo(os.path.join(FIXTURES, "xgboost_prostate_age.zip"))
+    xp = xgb.predict(fr).vec("predict").to_numpy()[: fr.nrows]
+    age = fr.vec("AGE").to_numpy()[: fr.nrows]
+    print(f"xgboost MOJO: train MSE {((xp - age) ** 2).mean():.6f} "
+          "(artifact stores 3.323258)")
 
 
 if __name__ == "__main__":
