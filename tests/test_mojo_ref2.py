@@ -88,6 +88,16 @@ def _load(zip_bytes: bytes):
     return load_ref_mojo(zip_bytes)
 
 
+def _splice_submodel(parent: bytes, sub: bytes, prefix: str) -> bytes:
+    """Embed a submodel zip under ``prefix`` inside the parent archive
+    (MultiModelMojoReader nested layout)."""
+    buf = io.BytesIO(parent)
+    with zipfile.ZipFile(buf, "a") as zp, zipfile.ZipFile(io.BytesIO(sub))             as zs:
+        for name in zs.namelist():
+            zp.writestr(prefix + name, zs.read(name))
+    return buf.getvalue()
+
+
 # -- DeepLearning ------------------------------------------------------------
 
 class TestDeepLearningMojo:
@@ -497,15 +507,9 @@ class TestRuleFitMojo:
         }
         parent = _mojo_zip("rulefit", ["x1", "y"], [None, None], parent_info,
                            extra_ini=rules_ini)
-        # splice the GLM submodel files into the parent archive
         sub = _mojo_zip("glm", glm_cols,
                         [rule_dom] + [None] * (len(glm_cols) - 1), glm_info)
-        buf = io.BytesIO(parent)
-        with zipfile.ZipFile(buf, "a") as zp, zipfile.ZipFile(
-                io.BytesIO(sub)) as zs:
-            for name in zs.namelist():
-                zp.writestr("models/m1/" + name, zs.read(name))
-        return _load(buf.getvalue())
+        return _load(_splice_submodel(parent, sub, "models/m1/"))
 
     def test_rules_and_linear_scoring(self):
         m = self._fixture()
@@ -712,3 +716,153 @@ class TestExtendedIsoForMojo:
         assert out[0, 0] == pytest.approx(2 ** (-pl0 / c(7)))
         # the isolated row is MORE anomalous
         assert out[0, 0] > out[1, 0]
+
+
+# -- less-traveled importer paths -------------------------------------------
+
+class TestMultinomialRuleFit:
+    def _fixture(self):
+        """3-class RULES-only RuleFit: per class one rule pair on x1
+        (varName grammar M{i}T{j}N{node}_{class}); multinomial GLM
+        submodel with 3 one-rule-column features M0T0C0/C1/C2."""
+        classes = ["lo", "mid", "verylo"]    # 'verylo' suffix-overlaps 'lo'
+        rule_lines = ["num_rules_M0T0 = 6"]
+        doms = {f"M0T0C{k}": [] for k in range(3)}
+        rid = 0
+        for k, cls in enumerate(classes):
+            for op_, thr, node in [(0, 0.0, 1), (1, 0.0, 2)]:
+                # the two leaves of an x1<0 stump
+                var = f"M0T0N{node}_{cls}"
+                doms[f"M0T0C{k}"].append(var)
+                cid = f"0_0_0_{rid}"
+                rule_lines += [
+                    f"num_conditions_rule_id_0_0_{rid} = 1",
+                    f"feature_index_{cid} = 0", f"type_{cid} = 1",
+                    f"num_treshold{cid} = {thr}", f"operator_{cid} = {op_}",
+                    f"feature_name_{cid} = x1",
+                    f"nas_included_{cid} = false",
+                    f"language_condition{cid} = c",
+                    f"prediction_value_rule_id_0_0_{rid} = 0.0",
+                    f"language_rule_rule_id_0_0_{rid} = r",
+                    f"coefficient_rule_id_0_0_{rid} = 0.1",
+                    f"var_name_rule_id_0_0_{rid} = {var}",
+                    f"support_rule_id_0_0_{rid} = 0.5",
+                ]
+                rid += 1
+        # multinomial GLM over the 3 rule columns, with DISTINCT winners:
+        # class 0 ('lo') keys on its N1 rule (x1 < 0), class 1 ('mid') on
+        # its N2 rule (x1 >= 0), class 2 ('verylo') never — so a grouping
+        # regression (e.g. 'lo' absorbing 'verylo' rules) flips argmax.
+        # P = 6 cat one-hots + intercept = 7
+        beta = [[0.0] * 7 for _ in range(3)]
+        beta[0][0] = 3.0     # col M0T0C0 level 0 (its N1 var)
+        beta[1][3] = 3.0     # col M0T0C1 level 1 (its N2 var)
+        glm_info = {
+            "family": "multinomial", "link": "multinomial",
+            "beta": [b for blk in beta for b in blk],
+            "cats": 3, "cat_offsets": [0, 2, 4, 6], "nums": 0,
+            "use_all_factor_levels": True, "mean_imputation": False,
+        }
+        sub = _mojo_zip("glm", ["M0T0C0", "M0T0C1", "M0T0C2", "y"],
+                        [doms["M0T0C0"], doms["M0T0C1"], doms["M0T0C2"],
+                         classes], glm_info, n_classes=3)
+        parent_info = {
+            "linear_model": "glm-1", "model_type": 2,
+            "depth": 1, "ntrees": 1, "data_from_rules_codes_len": 0,
+            "linear_names_len": 3, "linear_names_0": "M0T0C0",
+            "linear_names_1": "M0T0C1", "linear_names_2": "M0T0C2",
+            "submodel_count": 1, "submodel_key_0": "glm-1",
+            "submodel_dir_0": "models/m1/",
+        }
+        parent = _mojo_zip("rulefit", ["x1", "y"],
+                           [None, classes], parent_info,
+                           extra_ini="\n".join(rule_lines) + "\n",
+                           n_classes=3)
+        return _load(_splice_submodel(parent, sub, "models/m1/"))
+
+    def test_class_grouping_not_confused_by_suffix_overlap(self):
+        m = self._fixture()
+        P = m.score(np.array([[-1.0], [1.0]]))
+        assert P.shape == (2, 3)
+        assert np.allclose(P.sum(1), 1.0)
+        # exact softmax: the keyed class gets logit 3, the others 0
+        e3 = np.exp(3.0)
+        hot = e3 / (e3 + 2.0)
+        cold = 1.0 / (e3 + 2.0)
+        np.testing.assert_allclose(P[0], [hot, cold, cold], rtol=1e-6)
+        np.testing.assert_allclose(P[1], [cold, hot, cold], rtol=1e-6)
+
+
+class TestTargetEncoderInteractions:
+    def test_interaction_column_encoding(self):
+        """TE over a 2-column interaction: category = searchsorted of the
+        mixed-radix code in the stored interaction domain
+        (TargetEncoderMojoModel.interactionValue)."""
+        te = "feature_engineering/target_encoding/"
+        # domains: a in {p,q} (card 2), b in {u,v} (card 2); interaction
+        # codes: a + 3*b (multiplier card+1); training saw (p,u)=0,
+        # (q,u)=1, (p,v)=3 -> interaction domain [0, 1, 3]
+        texts = {
+            te + "encoding_map.ini":
+                "[a_b]\n0 = 2.0 4.0\n1 = 1.0 2.0\n2 = 3.0 4.0\n",
+            te + "te_column_name_to_missing_values_presence.ini":
+                "a_b = 0\n",
+            te + "input_encoding_columns_map.ini":
+                "[from]\na\nb\n[to]\na_b\n[to_domain]\n0\n1\n3\n",
+            te + "input_output_columns_map.ini":
+                "[from]\na\nb\n[to]\na_b_te\n",
+        }
+        zb = _mojo_zip("targetencoder", ["a", "b", "y"],
+                       [["p", "q"], ["u", "v"], ["no", "yes"]],
+                       {"with_blending": False, "non_predictors": "y",
+                        "keep_original_categorical_columns": True},
+                       texts=texts, n_classes=2)
+        m = _load(zb)
+        from h2o3_tpu.frame.frame import Frame
+        fr = Frame.from_arrays({
+            "a": np.array(["p", "q", "p", "q"], object),
+            "b": np.array(["u", "u", "v", "v"], object)})
+        out = m.transform(fr).vec("a_b_te").to_numpy()[:4]
+        prior = (2.0 + 1.0 + 3.0) / (4.0 + 2.0 + 4.0)
+        assert out[0] == pytest.approx(2.0 / 4.0)   # code 0 -> cat 0
+        assert out[1] == pytest.approx(1.0 / 2.0)   # code 1 -> cat 1
+        assert out[2] == pytest.approx(3.0 / 4.0)   # code 3 -> cat 2
+        assert out[3] == pytest.approx(prior)       # code 4 unseen -> prior
+
+
+class TestDeepLearningMaxout:
+    def test_maxout_weight_layout(self):
+        """Maxout k=2: wValues[maxK*(row*inSize+col)+k], bias[maxK*row+k],
+        output = max over k (NeuralNetwork.formNNInputsMaxOut)."""
+        in_size, out_size, k = 2, 2, 2
+        rng = np.random.default_rng(8)
+        w0 = rng.normal(size=out_size * in_size * k).round(3)
+        b0 = rng.normal(size=out_size * k).round(3)
+        w1 = rng.normal(size=out_size).round(3)
+        b1 = rng.normal(size=1).round(3)
+        info = {
+            "mojo_version": "1.10", "mini_batch_size": 1,
+            "nums": 2, "cats": 0, "cat_offsets": [0],
+            "norm_mul": [1.0, 1.0], "norm_sub": [0.0, 0.0],
+            "use_all_factor_levels": True, "activation": "Maxout",
+            "distribution": "gaussian", "mean_imputation": False,
+            "neural_network_sizes": [2, 2, 1],
+            "hidden_dropout_ratios": [0.0, 0.0],
+            "weight_layer0": w0, "bias_layer0": b0,
+            "weight_layer1": w1, "bias_layer1": b1,
+            "_genmodel_encoding": "AUTO",
+        }
+        m = _load(_mojo_zip("deeplearning", ["x1", "x2", "y"],
+                            [None, None, None], info))
+        x = np.array([[0.7, -1.2]])
+        # independent scalar computation of the Java layout
+        h = []
+        for r in range(out_size):
+            zs = []
+            for kk in range(k):
+                z = sum(np.float32(w0[k * (r * in_size + c) + kk]) * x[0, c]
+                        for c in range(in_size)) + b0[k * r + kk]
+                zs.append(z)
+            h.append(max(zs))
+        exp = sum(np.float32(w1[c]) * h[c] for c in range(out_size)) + b1[0]
+        assert m.score(x)[0] == pytest.approx(float(exp), rel=1e-5)
