@@ -474,3 +474,125 @@ def test_kmeans_recovers_separated_blobs(rng):
     pred = m.predict(fr).vec("predict").to_numpy()[:n].astype(int)
     for c in range(3):
         assert len(np.unique(pred[lab == c])) == 1
+
+
+# -- round-5 additions: offset, robust losses, structural params ------------
+
+class TestGBMOffsetAndLosses:
+    def test_gbm_gaussian_offset_equals_residual_fit(self, rng):
+        """pyunit offset_gbm: a gaussian GBM with offset o fits y - o and
+        adds o back at scoring time."""
+        n = 300
+        x = rng.normal(size=n).astype(np.float32)
+        off = rng.normal(size=n).astype(np.float32)
+        yv = (2 * x + off + 0.05 * rng.normal(size=n)).astype(np.float32)
+        fr = Frame.from_arrays({"x": x, "off": off, "y": yv})
+        fr_res = Frame.from_arrays({"x": x, "y": (yv - off)})
+        # learn_rate=1: the only divergence between the two formulations
+        # is the init constant c = f0 - f0', which every gaussian leaf
+        # absorbs and lr=1 cancels after the first tree (at lr<1 it decays
+        # geometrically as c(1-lr)^T — exact equivalence needs lr=1)
+        kw = dict(ntrees=10, max_depth=3, seed=3, learn_rate=1.0)
+        m_off = GBM(offset_column="off", **kw).train(y="y",
+                                                     training_frame=fr)
+        m_res = GBM(**kw).train(y="y", training_frame=fr_res)
+        p_off = m_off.predict(fr).vec("predict").to_numpy()[:n]
+        p_res = m_res.predict(fr_res).vec("predict").to_numpy()[:n] + off
+        np.testing.assert_allclose(p_off, p_res, atol=1e-4)
+
+    def test_huber_resists_outliers(self, rng):
+        """distribution='huber': a handful of wild outliers must distort
+        predictions far less than under gaussian loss (pyunit huber)."""
+        n = 400
+        x = rng.normal(size=n).astype(np.float32)
+        yv = (2 * x).astype(np.float32)
+        yv[:8] += 500.0                          # gross outliers
+        fr = Frame.from_arrays({"x": x, "y": yv})
+        clean = 2 * x[8:]
+        kw = dict(ntrees=30, max_depth=3, learn_rate=0.2, seed=4)
+        p_g = GBM(distribution="gaussian", **kw).train(
+            y="y", training_frame=fr).predict(fr) \
+            .vec("predict").to_numpy()[8:n]
+        p_h = GBM(distribution="huber", huber_alpha=0.9, **kw).train(
+            y="y", training_frame=fr).predict(fr) \
+            .vec("predict").to_numpy()[8:n]
+        err_g = float(np.abs(p_g - clean).mean())
+        err_h = float(np.abs(p_h - clean).mean())
+        assert err_h < 0.5 * err_g, (err_h, err_g)
+
+    def test_min_split_improvement_prunes(self, rng):
+        """A large min_split_improvement must yield a strictly simpler
+        model (fewer effective leaves -> coarser predictions)."""
+        fr = _reg_frame(rng)
+        loose = GBM(ntrees=5, max_depth=5, seed=5,
+                    min_split_improvement=0.0).train(y="y",
+                                                     training_frame=fr)
+        tight = GBM(ntrees=5, max_depth=5, seed=5,
+                    min_split_improvement=1e6).train(y="y",
+                                                     training_frame=fr)
+        n = fr.nrows
+        u_loose = len(np.unique(
+            loose.predict(fr).vec("predict").to_numpy()[:n].round(5)))
+        u_tight = len(np.unique(
+            tight.predict(fr).vec("predict").to_numpy()[:n].round(5)))
+        assert u_tight < u_loose
+
+    def test_nbins_cats_buckets_levels(self, rng):
+        """nbins_cats smaller than the cardinality forces range-grouped
+        levels — the model coarsens but still trains (pyunit_bigcat)."""
+        n = 600
+        codes = rng.integers(0, 60, n)
+        # parity signal: adjacent levels alternate classes, so RANGE
+        # buckets (what nbins_cats=4 forces) cannot separate them while
+        # per-level group splits (nbins_cats=64) can
+        y = np.where(codes % 2 == 0, "a", "b").astype(object)
+        fr = Frame.from_arrays({
+            "c": np.array([f"l{c:02d}" for c in codes], object), "y": y})
+        fine = GBM(ntrees=5, max_depth=3, seed=6, nbins_cats=64).train(
+            y="y", training_frame=fr)
+        coarse = GBM(ntrees=5, max_depth=3, seed=6, nbins_cats=4).train(
+            y="y", training_frame=fr)
+        n = fr.nrows
+        pf = fine.predict(fr).vec("pa").to_numpy()[:n]
+        pc = coarse.predict(fr).vec("pa").to_numpy()[:n]
+        # 4 buckets over 60 levels MUST coarsen the model — identical
+        # predictions would mean nbins_cats is ignored
+        assert not np.allclose(pf, pc)
+        assert fine.training_metrics.auc > coarse.training_metrics.auc
+        assert coarse.training_metrics.auc > 0.5
+
+
+class TestDRFSemantics:
+    def test_mtries_minus_one_is_sqrt(self, rng):
+        """DRF default mtries=-1 samples ~sqrt(F) features per split —
+        with one dominant feature among many, per-tree feature sampling
+        must still find it overall (pyunit drf defaults)."""
+        n = 400
+        X = rng.normal(size=(n, 9)).astype(np.float32)
+        cols = {f"x{i}": X[:, i] for i in range(9)}
+        cols["y"] = np.where(X[:, 0] > 0, "t", "f").astype(object)
+        fr = Frame.from_arrays(cols)
+        m = DRF(ntrees=20, max_depth=4, seed=7).train(y="y",
+                                                      training_frame=fr)
+        assert m.training_metrics.auc > 0.9
+        # per-split feature sampling must actually happen: with all 9
+        # features available every tree's first split would pick the
+        # dominant x0, so single trees would agree everywhere; sqrt(9)=3
+        # sampling makes some trees split elsewhere first
+        m_all = DRF(ntrees=20, max_depth=4, seed=7, mtries=9).train(
+            y="y", training_frame=fr)
+        n = fr.vec("x0").nrows
+        pa = m.predict(fr).vec("pt").to_numpy()[:n]
+        pb = m_all.predict(fr).vec("pt").to_numpy()[:n]
+        assert not np.allclose(pa, pb)
+
+    def test_sample_rate_below_one_changes_trees(self, rng):
+        fr = _bin_frame(rng)
+        full = DRF(ntrees=5, max_depth=3, seed=8, sample_rate=1.0).train(
+            y="y", training_frame=fr)
+        boot = DRF(ntrees=5, max_depth=3, seed=8, sample_rate=0.5).train(
+            y="y", training_frame=fr)
+        n = fr.nrows
+        p1 = full.predict(fr).vec("pyes").to_numpy()[:n]
+        p2 = boot.predict(fr).vec("pyes").to_numpy()[:n]
+        assert not np.allclose(p1, p2)
