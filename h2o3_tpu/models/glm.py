@@ -401,9 +401,12 @@ class GLM(ModelBuilder):
             #                           style [{"names","lower_bounds",...}]
             offset_column=None,       # per-row margin offset
             interactions=None,        # columns to cross (DataInfo interactions)
-            # MeanImputation (default) | Skip (reference GLMParameters.
-            # MissingValuesHandling; PlugValues needs a plug frame — not yet)
+            # MeanImputation (default) | Skip | PlugValues (reference
+            # GLMParameters.MissingValuesHandling)
             missing_values_handling="MeanImputation",
+            # with PlugValues: {numeric_col: value} or a 1-row-frame DKV
+            # key (reference _plug_values); categorical plugs not yet
+            plug_values=None,
         )
 
     def _fit_ordinal(self, job: Job, frame, x, y, weights, yvec) -> "GLMModel":
@@ -419,8 +422,7 @@ class GLM(ModelBuilder):
         if params.get("interactions") or params.get("offset_column"):
             raise ValueError("interactions/offset_column are not supported "
                              "for the ordinal family")
-        di = DataInfo.make(frame, x, standardize=params["standardize"],
-                           use_all_factor_levels=params["use_all_factor_levels"])
+        di = self._make_data_info(frame, x)
         X = di.expand(frame)
         codes = yvec.data.astype(jnp.int32)
         valid = codes >= 0
@@ -632,12 +634,55 @@ class GLM(ModelBuilder):
         beta = jnp.asarray(best["beta"])
         return beta, best["deviance"], 0, best["lambda_"], path
 
+    def _make_data_info(self, frame: Frame, x) -> DataInfo:
+        """DataInfo with the configured missing-value mode baked into the
+        imputation vector: PlugValues overrides the per-column means the
+        expander substitutes for NaN — at training AND scoring (reference
+        GLM.java imputes with _plug_values wherever MeanImputation would
+        use means)."""
+        params = self.params
+        di = DataInfo.make(frame, x, standardize=params["standardize"],
+                           use_all_factor_levels=params["use_all_factor_levels"])
+        if self._mvh_mode() != "plugvalues":
+            return di
+        plugs = params.get("plug_values")
+        if isinstance(plugs, str):
+            from h2o3_tpu.utils.registry import DKV
+            pf = DKV[plugs]
+            if pf.nrows != 1:
+                raise ValueError(f"plug_values frame {plugs!r} must have "
+                                 f"exactly 1 row, got {pf.nrows}")
+            # keep EVERY plug column: unknown/categorical names must hit
+            # the same validation the dict path gets, not silently drop
+            plugs = {c: float(pf.vec(c).to_numpy()[0]) for c in pf.names}
+        if not isinstance(plugs, dict) or not plugs:
+            raise ValueError("missing_values_handling='PlugValues' needs "
+                             "plug_values ({column: value} or a 1-row "
+                             "frame key)")
+        bad = [c for c in plugs if c in di.cat_cols]
+        if bad:
+            raise ValueError(f"categorical plug values not supported yet: "
+                             f"{bad}")
+        unknown = [c for c in plugs if c not in di.num_cols]
+        if unknown:
+            raise ValueError(f"plug_values name unknown numeric columns: "
+                             f"{unknown}")
+        means = np.array(di.num_means, np.float32).copy()
+        for c, v in plugs.items():
+            means[di.num_cols.index(c)] = float(v)
+        di.num_means = means
+        return di
+
+    def _mvh_mode(self) -> str:
+        """Canonical missing_values_handling (h2o-py sends lowercase enum
+        forms like mean_imputation) — the ONE normalization site."""
+        return str(self.params.get("missing_values_handling")
+                   or "MeanImputation").replace("_", "").lower()
+
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GLMModel:
         params = self.params
         self._iter_devs = []    # per-IRLS-iteration deviances → scoring_history
-        mvh = str(params.get("missing_values_handling")
-                  or "MeanImputation").replace("_", "").lower()
-        # h2o-py sends lowercase enum forms (mean_imputation / skip)
+        mvh = self._mvh_mode()
         self._metrics_weights = None
         if mvh == "skip":
             # rows with any NA among the used predictors drop out of the
@@ -660,10 +705,10 @@ class GLM(ModelBuilder):
             # metrics + CV must see the same reduced row set (model_base
             # reads this after _fit)
             self._metrics_weights = weights
-        elif mvh != "meanimputation":
+        elif mvh not in ("meanimputation", "plugvalues"):
             raise ValueError(
-                f"missing_values_handling {mvh!r} unsupported (MeanImputation"
-                " | Skip; reference PlugValues needs a plug-values frame)")
+                f"missing_values_handling {mvh!r} unsupported "
+                "(MeanImputation | Skip | PlugValues)")
         if int(params["max_iterations"]) == -1:
             # reference: -1 means solver-chosen default (GLM.java auto)
             params["max_iterations"] = 50
@@ -709,8 +754,7 @@ class GLM(ModelBuilder):
                                         self._interaction_domains)
             x = list(x) + [c for c in frame.names if c not in before]
 
-        di = DataInfo.make(frame, x, standardize=params["standardize"],
-                           use_all_factor_levels=params["use_all_factor_levels"])
+        di = self._make_data_info(frame, x)
         X = di.expand(frame)
         from h2o3_tpu.models.data_info import response_as_float
         yy, valid = response_as_float(yvec)
@@ -799,8 +843,7 @@ class GLM(ModelBuilder):
         if params.get("interactions") or params.get("offset_column"):
             raise ValueError("interactions/offset_column are not supported "
                              "for multinomial")
-        di = DataInfo.make(frame, x, standardize=params["standardize"],
-                           use_all_factor_levels=params["use_all_factor_levels"])
+        di = self._make_data_info(frame, x)
         X = di.expand(frame)
         from h2o3_tpu.models.data_info import response_as_float
         yy, valid = response_as_float(yvec)

@@ -596,3 +596,71 @@ class TestDRFSemantics:
         p1 = full.predict(fr).vec("pyes").to_numpy()[:n]
         p2 = boot.predict(fr).vec("pyes").to_numpy()[:n]
         assert not np.allclose(p1, p2)
+
+
+class TestGLMPlugValues:
+    """GLMParameters.MissingValuesHandling.PlugValues: NA predictors
+    impute to USER values (training and scoring) instead of means."""
+
+    def test_plug_value_changes_fit_and_scoring(self, rng):
+        n = 300
+        a = rng.normal(size=n).astype(np.float64)
+        b = rng.normal(size=n).astype(np.float64)
+        yv = (a - 2 * b).astype(np.float32)
+        a_na = a.copy()
+        a_na[:60] = np.nan
+        fr = Frame.from_arrays({"a": a_na.astype(np.float32),
+                                "b": b.astype(np.float32), "y": yv})
+        # equivalent explicit fill with the plug value 5.0
+        filled = Frame.from_arrays({
+            "a": np.where(np.isnan(a_na), 5.0, a_na).astype(np.float32),
+            "b": b.astype(np.float32), "y": yv})
+        m_plug = GLM(family="gaussian", lambda_=0.0, standardize=False,
+                     missing_values_handling="PlugValues",
+                     plug_values={"a": 5.0}).train(y="y", training_frame=fr)
+        m_fill = GLM(family="gaussian", lambda_=0.0,
+                     standardize=False).train(y="y", training_frame=filled)
+        for k in ("a", "b", "Intercept"):
+            assert m_plug.coef()[k] == pytest.approx(m_fill.coef()[k],
+                                                     abs=1e-4)
+        # scoring imputes with the plug too
+        test = Frame.from_arrays({"a": np.array([np.nan], np.float32),
+                                  "b": np.zeros(1, np.float32)})
+        p = m_plug.predict(test).vec("predict").to_numpy()[0]
+        exp = (m_plug.coef()["a"] * 5.0 + m_plug.coef()["Intercept"])
+        assert p == pytest.approx(exp, abs=1e-4)
+
+    def test_plug_values_frame_key_and_validation(self, rng):
+        from h2o3_tpu.utils.registry import DKV
+        n = 64
+        fr = Frame.from_arrays({
+            "a": rng.normal(size=n).astype(np.float32),
+            "y": rng.normal(size=n).astype(np.float32)})
+        DKV.put("plugs", Frame.from_arrays({"a": np.array([1.5], np.float32)}))
+        m = GLM(family="gaussian", missing_values_handling="PlugValues",
+                plug_values="plugs").train(y="y", training_frame=fr)
+        assert m is not None
+        with pytest.raises(ValueError, match="plug_values"):
+            GLM(family="gaussian",
+                missing_values_handling="PlugValues").train(
+                y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="unknown numeric"):
+            GLM(family="gaussian", missing_values_handling="PlugValues",
+                plug_values={"zzz": 1.0}).train(y="y", training_frame=fr)
+
+    def test_plug_frame_misuse_rejected(self, rng):
+        from h2o3_tpu.utils.registry import DKV
+        n = 64
+        fr = Frame.from_arrays({
+            "a": rng.normal(size=n).astype(np.float32),
+            "y": rng.normal(size=n).astype(np.float32)})
+        DKV.put("pv_bad", Frame.from_arrays(
+            {"typo": np.array([1.0], np.float32)}))
+        with pytest.raises(ValueError, match="unknown numeric"):
+            GLM(family="gaussian", missing_values_handling="PlugValues",
+                plug_values="pv_bad").train(y="y", training_frame=fr)
+        DKV.put("pv_multi", Frame.from_arrays(
+            {"a": np.arange(3, dtype=np.float32)}))
+        with pytest.raises(ValueError, match="exactly 1 row"):
+            GLM(family="gaussian", missing_values_handling="PlugValues",
+                plug_values="pv_multi").train(y="y", training_frame=fr)
