@@ -644,6 +644,11 @@ class GLM(ModelBuilder):
         di = DataInfo.make(frame, x, standardize=params["standardize"],
                            use_all_factor_levels=params["use_all_factor_levels"])
         if self._mvh_mode() != "plugvalues":
+            if params.get("plug_values") is not None:
+                # reference GLM.java errors on this mismatch — silent
+                # mean-imputation would not be what the user configured
+                raise ValueError("plug_values requires "
+                                 "missing_values_handling='PlugValues'")
             return di
         plugs = params.get("plug_values")
         if isinstance(plugs, str):
@@ -654,7 +659,14 @@ class GLM(ModelBuilder):
                                  f"exactly 1 row, got {pf.nrows}")
             # keep EVERY plug column: unknown/categorical names must hit
             # the same validation the dict path gets, not silently drop
-            plugs = {c: float(pf.vec(c).to_numpy()[0]) for c in pf.names}
+            # (string cells become NaN here and fail the finiteness check)
+            def _cell(c):
+                v = pf.vec(c).to_numpy()[0]
+                try:
+                    return float(v)
+                except (TypeError, ValueError):
+                    return float("nan")
+            plugs = {c: _cell(c) for c in pf.names}
         if not isinstance(plugs, dict) or not plugs:
             raise ValueError("missing_values_handling='PlugValues' needs "
                              "plug_values ({column: value} or a 1-row "
@@ -667,6 +679,11 @@ class GLM(ModelBuilder):
         if unknown:
             raise ValueError(f"plug_values name unknown numeric columns: "
                              f"{unknown}")
+        bad_vals = [c for c, v in plugs.items()
+                    if not np.isfinite(float(v))]
+        if bad_vals:
+            raise ValueError(f"plug_values must be finite numbers; got "
+                             f"non-finite for {bad_vals}")
         means = np.array(di.num_means, np.float32).copy()
         for c, v in plugs.items():
             means[di.num_cols.index(c)] = float(v)

@@ -477,6 +477,21 @@ def _eval(node, s: Session):
         return _wrap(ap.relevel_by_freq(
             _vec1(), None, int(args[1]) if len(args) > 1 else -1))
     if op == "rename":
+        # AstRename (mungers/AstRename.java:20-46): a DKV KEY rename —
+        # (rename "old" "new") — not a column rename (that is colnames=);
+        # h2o.rename / model re-keying speak this form
+        if isinstance(args[0], str):
+            old, new = str(args[0]), str(args[1])
+            obj = s.lookup(old)
+            if obj is None:
+                raise KeyError(f"rename: unknown key {old!r}")
+            s.remove(old)
+            if hasattr(obj, "key"):
+                obj.key = new
+            DKV.put(new, obj)
+            return float("nan")
+        # legacy column-rename form (frame, col, name) kept for callers
+        # that used it before colnames= existed
         return ap.rename(args[0], args[1], str(args[2]))
     if op == "setDomain":
         return _wrap(ap.set_domain(_vec1(), [str(s) for s in args[1]]))

@@ -110,3 +110,24 @@ def test_rectangle_assign_categorical_and_frame_src(binfr):
     DKV.put(src.key, src)
     out2 = rapids("(:= rmfr rmsrc [0] [4 5])")
     assert np.allclose(out2.vec("x0").to_numpy()[4:6], [5.0, 6.0])
+
+
+def test_rename_is_a_dkv_key_rename(rng):
+    """AstRename (mungers/AstRename.java:20-46): (rename "old" "new")
+    re-keys a DKV object — NOT a column rename (that is colnames=)."""
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.rapids import rapids
+    from h2o3_tpu.utils.registry import DKV
+
+    fr = Frame.from_arrays({"a": rng.normal(size=8).astype(np.float32)},
+                           key="rn_old")
+    DKV.put("rn_old", fr)
+    rapids('(rename "rn_old" "rn_new")')
+    assert "rn_old" not in DKV and "rn_new" in DKV
+    assert DKV["rn_new"].names == ["a"]
+    assert DKV["rn_new"].key == "rn_new"
+    import pytest
+    with pytest.raises(KeyError, match="unknown key"):
+        rapids('(rename "rn_missing" "x")')
+    DKV.remove("rn_new")

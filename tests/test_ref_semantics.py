@@ -664,3 +664,17 @@ class TestGLMPlugValues:
         with pytest.raises(ValueError, match="exactly 1 row"):
             GLM(family="gaussian", missing_values_handling="PlugValues",
                 plug_values="pv_multi").train(y="y", training_frame=fr)
+
+    def test_plug_values_mode_mismatch_and_nonfinite_rejected(self, rng):
+        n = 64
+        fr = Frame.from_arrays({
+            "a": rng.normal(size=n).astype(np.float32),
+            "y": rng.normal(size=n).astype(np.float32)})
+        with pytest.raises(ValueError, match="requires "
+                                             "missing_values_handling"):
+            GLM(family="gaussian", plug_values={"a": 1.0}).train(
+                y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="finite"):
+            GLM(family="gaussian", missing_values_handling="PlugValues",
+                plug_values={"a": float("nan")}).train(
+                y="y", training_frame=fr)
