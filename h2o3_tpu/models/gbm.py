@@ -1348,6 +1348,10 @@ class DRF(SharedTreeBuilder):
         d["max_depth"] = 14
         d["min_rows"] = 1.0
         d["sample_rate"] = 0.632
+        # reference DRF.java: binomial normally trains ONE tree per round
+        # (complement trick); this opts into a tree per class like
+        # multinomial (ktrees=2), normalized by vote sum
+        d["binomial_double_trees"] = False
         return d
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DRFModel:
@@ -1374,12 +1378,17 @@ class DRF(SharedTreeBuilder):
         ntrees = int(p["ntrees"])
         fmask = jnp.ones(F, bool)
 
-        if nclass > 2:
+        if nclass > 2 or (nclass == 2 and p.get("binomial_double_trees")):
             # one class-indicator tree per class per round; leaf = in-node
-            # class fraction (reference: DRF.java multinomial ktrees)
+            # class fraction (reference: DRF.java multinomial ktrees —
+            # binomial_double_trees routes 2-class fits here too)
             trees_multi: list[list[Tree]] = [[] for _ in range(nclass)]
             done = 0
             if cp is not None:
+                if cp.output.get("trees_multi") is None:
+                    raise ValueError(
+                        "checkpoint was trained without binomial_double_"
+                        "trees; the tree layouts are incompatible")
                 trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
                 done = len(trees_multi[0])
             keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
@@ -1409,7 +1418,15 @@ class DRF(SharedTreeBuilder):
             )
 
         trees: list[Tree] = []
-        if cp is not None and cp.output.get("trees") is not None:
+        if cp is not None:
+            if cp.output.get("trees") is None:
+                # the reverse of the guard above: a double-trees (or
+                # multinomial-layout) checkpoint cannot continue as a
+                # single-tree forest — refusing beats silently dropping
+                # every checkpointed tree
+                raise ValueError(
+                    "checkpoint was trained with binomial_double_trees; "
+                    "the tree layouts are incompatible")
             trees = list(cp.output["trees"])
         done = len(trees)
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]

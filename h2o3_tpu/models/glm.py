@@ -657,16 +657,7 @@ class GLM(ModelBuilder):
             if pf.nrows != 1:
                 raise ValueError(f"plug_values frame {plugs!r} must have "
                                  f"exactly 1 row, got {pf.nrows}")
-            # keep EVERY plug column: unknown/categorical names must hit
-            # the same validation the dict path gets, not silently drop
-            # (string cells become NaN here and fail the finiteness check)
-            def _cell(c):
-                v = pf.vec(c).to_numpy()[0]
-                try:
-                    return float(v)
-                except (TypeError, ValueError):
-                    return float("nan")
-            plugs = {c: _cell(c) for c in pf.names}
+            plugs = {c: pf.vec(c).to_numpy()[0] for c in pf.names}
         if not isinstance(plugs, dict) or not plugs:
             raise ValueError("missing_values_handling='PlugValues' needs "
                              "plug_values ({column: value} or a 1-row "
@@ -679,8 +670,15 @@ class GLM(ModelBuilder):
         if unknown:
             raise ValueError(f"plug_values name unknown numeric columns: "
                              f"{unknown}")
-        bad_vals = [c for c, v in plugs.items()
-                    if not np.isfinite(float(v))]
+        def _coerce(v) -> float:
+            # None / strings / non-numerics all fail the SAME way: as a
+            # non-finite plug, caught below with a curated message
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return float("nan")
+        plugs = {c: _coerce(v) for c, v in plugs.items()}
+        bad_vals = [c for c, v in plugs.items() if not np.isfinite(v)]
         if bad_vals:
             raise ValueError(f"plug_values must be finite numbers; got "
                              f"non-finite for {bad_vals}")

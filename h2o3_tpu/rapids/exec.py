@@ -480,14 +480,19 @@ def _eval(node, s: Session):
         # AstRename (mungers/AstRename.java:20-46): a DKV KEY rename —
         # (rename "old" "new") — not a column rename (that is colnames=);
         # h2o.rename / model re-keying speak this form
-        if isinstance(args[0], str):
+        if len(args) == 2:
             old, new = str(args[0]), str(args[1])
             obj = s.lookup(old)
             if obj is None:
                 raise KeyError(f"rename: unknown key {old!r}")
+            was_temp = old in s._tmp
             s.remove(old)
             if hasattr(obj, "key"):
                 obj.key = new
+            if was_temp:
+                # a renamed session temp stays session-scoped (reclaimed
+                # by Session.end like before the rename)
+                s._tmp[new] = obj
             DKV.put(new, obj)
             return float("nan")
         # legacy column-rename form (frame, col, name) kept for callers

@@ -678,3 +678,36 @@ class TestGLMPlugValues:
             GLM(family="gaussian", missing_values_handling="PlugValues",
                 plug_values={"a": float("nan")}).train(
                 y="y", training_frame=fr)
+
+    def test_binomial_double_trees(self, rng):
+        """DRF.java binomial_double_trees: one tree per class instead of
+        the single-tree complement — different forests, same task."""
+        fr = _bin_frame(rng)
+        n = fr.nrows
+        single = DRF(ntrees=10, max_depth=4, seed=9).train(
+            y="y", training_frame=fr)
+        double = DRF(ntrees=10, max_depth=4, seed=9,
+                     binomial_double_trees=True).train(
+            y="y", training_frame=fr)
+        assert double.output.get("trees_multi") is not None
+        assert len(double.output["trees_multi"]) == 2
+        p1 = single.predict(fr).vec("pyes").to_numpy()[:n]
+        p2 = double.predict(fr).vec("pyes").to_numpy()[:n]
+        assert np.allclose(
+            double.predict(fr).vec("pno").to_numpy()[:n] + p2, 1.0,
+            atol=1e-5)
+        assert not np.allclose(p1, p2)       # genuinely different forests
+        assert double.training_metrics.auc > 0.85
+        # checkpoint across modes must refuse, not mis-stack trees
+        with pytest.raises(ValueError, match="binomial_double"):
+            DRF(ntrees=12, max_depth=4, seed=9, binomial_double_trees=True,
+                checkpoint=single).train(y="y", training_frame=fr)
+
+    def test_double_trees_checkpoint_reverse_direction_refused(self, rng):
+        fr = _bin_frame(rng, n=128)
+        double = DRF(ntrees=3, max_depth=3, seed=9,
+                     binomial_double_trees=True).train(
+            y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="binomial_double"):
+            DRF(ntrees=5, max_depth=3, seed=9,
+                checkpoint=double).train(y="y", training_frame=fr)
