@@ -229,6 +229,9 @@ def model_v3(model) -> dict:
                                                 model.response_domain),
                "cross_validation_metrics": metrics_v3(
                    model.cross_validation_metrics, model.response_domain),
+               "cross_validation_metrics_summary":
+                   _cv_summary_v3(getattr(model, "cv_metrics_summary",
+                                          None)),
                # folds share one compiled program (CV by weight masking), so
                # no per-fold model keys exist; h2o-py reads this key
                # unconditionally when CV metrics are present
@@ -300,6 +303,20 @@ def raw_frame_v3(key: str, nbytes: int) -> dict:
                         "default_percentiles": [], "compatible_models": [],
                         "chunk_summary": None,
                         "distribution_summary": None}]}
+
+
+def _cv_summary_v3(summary) -> dict | None:
+    """Per-fold CV metric table (reference ModelBuilder's
+    cross_validation_metrics_summary: rows = metrics, columns = mean, sd,
+    cv_{k}_valid; h2o-py renders it verbatim)."""
+    if summary is None:
+        return None
+    _names, nfolds, rows = summary
+    cols = [("", "string", "%s"), ("mean", "double", "%f"),
+            ("sd", "double", "%f")] + [(f"cv_{k + 1}_valid", "double", "%f")
+                                       for k in range(nfolds)]
+    return twodim_table_v3("Cross-Validation Metrics Summary",
+                           "per-fold holdout metrics", cols, rows)
 
 
 def twodim_table_v3(name: str, description: str,

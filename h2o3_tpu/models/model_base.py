@@ -79,6 +79,8 @@ class Model:
         self.cross_validation_metrics = None
         self.cv_holdout_predictions = None   # [plen] or [plen, K] OOF preds
         self.cv_holdout_mask = None
+        # (metric_names, nfolds, rows) for the per-fold summary table
+        self.cv_metrics_summary = None
         self.run_time_ms: int = 0
         # per-scoring-event table (reference: Model.Output._scoring_history
         # TwoDimTable, surfaced as h2o-py model.scoring_history()):
@@ -532,6 +534,27 @@ class ModelBuilder:
             # (reference: keep_cross_validation_predictions + holdout frames)
             model.cv_holdout_predictions = pooled
             model.cv_holdout_mask = any_mask
+        if model is not None:
+            # per-fold metric table (reference: ModelBuilder
+            # cross_validation_metrics_summary TwoDimTable — mean/sd +
+            # one column per fold; h2o-py's
+            # model.cross_validation_metrics_summary() reads it)
+            per_fold = [compute_metrics(r, yy, m, nclass)
+                        for r, m in zip(raws, masks)]
+            names = [f for f in ("mse", "rmse", "logloss", "auc", "pr_auc",
+                                 "mae", "r2", "mean_per_class_error")
+                     if getattr(per_fold[0], f, None) is not None]
+            rows = []
+            for f in names:
+                vals = np.array([float(getattr(pf, f)) for pf in per_fold])
+                # an empty-holdout fold (all rows zero-weight / NA
+                # response) yields NaN metrics; mean/sd summarize the
+                # FINITE folds so one bad fold can't blank the table
+                fin = vals[np.isfinite(vals)]
+                mean = float(fin.mean()) if fin.size else float("nan")
+                sd = float(fin.std(ddof=1)) if fin.size > 1 else 0.0
+                rows.append([f, mean, sd] + [float(v) for v in vals])
+            model.cv_metrics_summary = (names, nfolds, rows)
         return compute_metrics(pooled, yy, any_mask, nclass)
 
 

@@ -711,3 +711,30 @@ class TestGLMPlugValues:
         with pytest.raises(ValueError, match="binomial_double"):
             DRF(ntrees=5, max_depth=3, seed=9,
                 checkpoint=double).train(y="y", training_frame=fr)
+
+
+def test_cv_metrics_summary_table(rng):
+    """ModelBuilder's cross_validation_metrics_summary: rows = metrics,
+    columns = mean, sd, cv_{k}_valid — h2o-py renders it verbatim."""
+    from h2o3_tpu.api import schemas
+    fr = _bin_frame(rng, n=240)
+    m = GBM(ntrees=5, max_depth=3, seed=1, nfolds=3).train(
+        y="y", training_frame=fr)
+    names, nfolds, rows = m.cv_metrics_summary
+    assert nfolds == 3 and "auc" in names
+    t = schemas.model_v3(m)["output"]["cross_validation_metrics_summary"]
+    cols = [c["name"] for c in t["columns"]]
+    assert cols == ["", "mean", "sd", "cv_1_valid", "cv_2_valid",
+                    "cv_3_valid"]
+    auc_row = [r for r in rows if r[0] == "auc"][0]
+    per_fold = np.array(auc_row[3:])
+    assert auc_row[1] == pytest.approx(per_fold.mean())
+    assert auc_row[2] == pytest.approx(per_fold.std(ddof=1))
+    # fold-column CV serves the summary too
+    n = 240
+    cols2 = {c: fr.vec(c).to_numpy() for c in fr.names if c != "y"}
+    fr2 = Frame.from_arrays({**cols2, "y": fr.vec("y").labels(),
+                             "fold": (np.arange(n) % 3).astype(np.float32)})
+    m2 = GBM(ntrees=3, max_depth=3, seed=1, fold_column="fold").train(
+        y="y", training_frame=fr2)
+    assert m2.cv_metrics_summary[1] == 3
