@@ -320,6 +320,11 @@ def main() -> None:
     # probe; the ratio is explicitly null.
     if CPU_FALLBACK or SMOKE or out["extra"]["backend"] == "cpu":
         out["vs_baseline"] = None
+    # metrics snapshot rides along in the artifact (dispatch counts, parse
+    # bytes, model-build latencies) so the perf trajectory carries telemetry;
+    # buckets omitted to keep the JSON line compact
+    from h2o3_tpu.utils.telemetry import METRICS
+    out["extra"]["telemetry"] = METRICS.snapshot(include_buckets=False)
     print(json.dumps(out))
     print(f"# detail: {json.dumps(extra)}", file=sys.stderr)
 

@@ -193,6 +193,28 @@ class H2OClient:
     def jobs(self) -> list[dict]:
         return self.request("GET", "/3/Jobs")["jobs"]
 
+    # -- observability (h2o-py: cluster().timeline / get_log; plus metrics) --
+
+    def timeline(self) -> list[dict]:
+        """Runtime event ring: dispatches, model fits, faults
+        (``GET /3/Timeline``)."""
+        return self.request("GET", "/3/Timeline")["events"]
+
+    def logs(self, node: int = 0, name: str = "info") -> str:
+        """Formatted server log lines from the LogRing
+        (``GET /3/Logs/nodes/{n}/files/{name}``)."""
+        return self.request("GET", f"/3/Logs/nodes/{node}/files/{name}")["log"]
+
+    def metrics(self) -> list[dict]:
+        """JSON metrics snapshot: flat {name, type, labels, value} rows
+        (``GET /3/Metrics``)."""
+        return self.request("GET", "/3/Metrics")["metrics"]
+
+    def metrics_text(self) -> str:
+        """Raw Prometheus/OpenMetrics exposition (``GET /metrics``)."""
+        with urllib.request.urlopen(self.url + "/metrics") as resp:
+            return resp.read().decode()
+
     def ping(self) -> bool:
         return bool(self.request("GET", "/3/Ping").get("healthy"))
 

@@ -13,8 +13,10 @@ exactly like against the reference server.
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
+import time
 import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -24,7 +26,17 @@ from h2o3_tpu.api import schemas
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV, LOCKS
+
+_LOG = logging.getLogger("h2o3_tpu")
+
+
+def _route_label_of(pat: str) -> str:
+    """Metric label for a route regex: regex classes become placeholders and
+    escaped literals unescape, so ``/3/WaterMeterCpuTicks/\\d+`` labels as
+    ``/3/WaterMeterCpuTicks/{n}`` (not the mangled ``.../d+``)."""
+    return pat.replace(r"\d+", "{n}").replace("\\", "")
 
 _ALGOS = None
 
@@ -96,6 +108,11 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):   # route logs to our logger, not stderr
         pass
 
+    def send_response(self, code, message=None):
+        # status capture for the per-route request metrics (_route)
+        self._last_status = code
+        super().send_response(code, message)
+
     def _reply(self, obj, code: int = 200):
         meta = obj.get("__meta") if isinstance(obj, dict) else None
         if isinstance(meta, dict) and "schema_name" not in meta:
@@ -112,6 +129,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _error(self, code: int, msg: str):
         import time as _t
+        if code >= 500:   # server faults land in the log ring (/3/Logs)
+            _LOG.warning("HTTP %d on %s: %s", code, self.path, msg)
         self._reply({"__meta": {"schema_type": "H2OErrorV3"},
                      "http_status": code, "msg": msg, "exception_msg": msg,
                      "timestamp": int(_t.time() * 1000),
@@ -262,12 +281,29 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
+        t0 = time.perf_counter()
+        self._last_status = 0
+        self._route_label = None
+        try:
+            self._dispatch(method, path)
+        finally:
+            # per-route request count/status/latency — labelled by ROUTE
+            # PATTERN (bounded cardinality), never by the raw path
+            route = self._route_label or "(unmatched)"
+            _tm.REQUESTS.labels(route=route, method=method,
+                                status=str(self._last_status)).inc()
+            _tm.REQUEST_SECONDS.labels(route=route, method=method).observe(
+                time.perf_counter() - t0)
+
+    def _dispatch(self, method: str, path: str):
         if path not in self._AUTH_EXEMPT and not self._check_auth():
+            self._route_label = "(unauthorized)"
             return
         try:
             for pat, m, fn in _ROUTES:
                 match = re.fullmatch(pat, path)
                 if match and m == method:
+                    self._route_label = _route_label_of(pat)
                     fn(self, *match.groups())
                     return
             # extension-contributed routes (reference RestApiExtension SPI)
@@ -275,6 +311,7 @@ class _Handler(BaseHTTPRequestHandler):
             for pat, m, fn in _ext.rest_routes():
                 match = re.fullmatch(pat, path)
                 if match and m == method:
+                    self._route_label = _route_label_of(pat)
                     fn(self, *match.groups())
                     return
             self._error(404, f"no route for {method} {path}")
@@ -843,14 +880,17 @@ class _Handler(BaseHTTPRequestHandler):
                      "traces": jstack()})
 
     def r_profiler(self):
-        # reference: ProfileCollectorTask samples stacks `depth` times
+        # reference: ProfileCollectorTask samples stacks `depth` times,
+        # excluding the collector thread itself — a profile dominated by the
+        # sampling loop would show no real work
         import time as _t
         from h2o3_tpu.utils.timeline import jstack
         p = self._params()
         samples = max(1, min(int(p.get("depth", 5)), 50))
+        me = {threading.get_ident()}
         counts: dict[str, int] = {}
         for _ in range(samples):
-            for tr in jstack():
+            for tr in jstack(exclude=me):
                 counts[tr["stack"]] = counts.get(tr["stack"], 0) + 1
             _t.sleep(0.01)
         entries = sorted(counts.items(), key=lambda kv: -kv[1])
@@ -879,13 +919,40 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def r_logs(self):
-        # reference: LogsHandler /3/Logs/nodes/{n}/files/{name}
-        import logging
+        self.r_logs_file("0", "info")
+
+    def r_logs_file(self, node: str, name: str):
+        """Reference: LogsHandler ``/3/Logs/nodes/{n}/files/{name}`` (the
+        route h2o-py's ``h2o.cluster().get_log`` requests). Backed by the
+        LogRing on the ``h2o3_tpu`` logger; the reference's per-level log
+        *files* map to a minimum-level filter over the ring."""
+        ring = _tm.install_log_ring()     # idempotent; survives cold fetches
+        min_level = _tm.LOG_FILES.get(name.lower())
+        if min_level is None:
+            raise KeyError(f"unknown log file {name!r}; one of "
+                           f"{sorted(_tm.LOG_FILES)}")
         self._reply({"__meta": {"schema_type": "LogsV3"},
-                     "log": "\n".join(
-                         h.format(r) if hasattr(h, "format") else str(r)
-                         for h in logging.getLogger("h2o3_tpu").handlers
-                         for r in getattr(h, "buffer", []))})
+                     "nodeidx": int(node),
+                     "name": name,
+                     "log": "\n".join(ring.lines(min_level))})
+
+    def r_metrics_json(self):
+        """JSON metrics snapshot — flat {name, type, labels, value} rows
+        (TwoDimTable-friendly; the Python client's ``client.metrics()``)."""
+        self._reply({"__meta": {"schema_type": "MetricsV3"},
+                     "metrics": _tm.METRICS.snapshot()})
+
+    def r_metrics_text(self):
+        """Prometheus/OpenMetrics exposition at ``/metrics`` — point a
+        Prometheus scrape job at this path (docs/OBSERVABILITY.md)."""
+        body = _tm.METRICS.to_openmetrics().encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     # -- round-2 parity sweep: the routes the real h2o-py client traffics
     #    (reference registrations: water/api/RegisterV3Api.java) -------------
@@ -1527,6 +1594,9 @@ _ROUTES = [
     (r"/3/WaterMeterCpuTicks/\d+", "GET", _Handler.r_cpu_ticks),
     (r"/3/WaterMeterIo", "GET", _Handler.r_io_meter),
     (r"/3/Logs", "GET", _Handler.r_logs),
+    (r"/3/Logs/nodes/(-?\d+)/files/([^/]+)", "GET", _Handler.r_logs_file),
+    (r"/3/Metrics", "GET", _Handler.r_metrics_json),
+    (r"/metrics", "GET", _Handler.r_metrics_text),
     (r"/", "GET", _Handler.r_flow),
     (r"/flow/index\.html", "GET", _Handler.r_flow),
     # round-2 parity sweep (reference: RegisterV3Api.java)
@@ -1656,6 +1726,9 @@ class H2OServer:
         return f"{self.scheme}://{self.host}:{self.port}"
 
     def start(self) -> "H2OServer":
+        # log ring first (reference: Log.init runs before the API is up), so
+        # startup lines are the first thing /3/Logs serves
+        _tm.install_log_ring()
         # extension lifecycle (reference: ExtensionManager hooks run during
         # H2O.main before the REST API is declared up)
         from h2o3_tpu.utils import extensions as _ext
@@ -1664,6 +1737,8 @@ class H2OServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        import os
+        _LOG.info("REST server up at %s (pid %d)", self.url, os.getpid())
         _ext.report("cloud_up", url=self.url)
         return self
 

@@ -21,7 +21,24 @@ import os
 import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV
+
+
+def _note_parse(frame, path: str | None = None, nbytes: int | None = None):
+    """Record parse throughput (rows/bytes/chunks) for a finished parse;
+    returns the frame so terminal sites can ``return _note_parse(...)``."""
+    if nbytes is None:
+        nbytes = 0
+        if path and "://" not in path:
+            try:
+                nbytes = os.path.getsize(path)
+            except OSError:
+                pass
+    _tm.PARSE_ROWS.inc(getattr(frame, "nrows", 0) or 0)
+    _tm.PARSE_BYTES.inc(nbytes or 0)
+    _tm.PARSE_CHUNKS.inc(len(getattr(frame, "vecs", None) or ()))
+    return frame
 
 
 def import_file(path: str, key: str | None = None, header: int | None = 0,
@@ -56,29 +73,29 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
         import pyarrow.orc as orc
         df = orc.ORCFile(path).read().to_pandas()
     elif ext == "svmlight" or ext == "svm":
-        return _parse_svmlight(path, key)
+        return _note_parse(_parse_svmlight(path, key), path)
     elif ext == "arff":
-        return _parse_arff(path, key)
+        return _note_parse(_parse_arff(path, key), path)
     elif ext == "avro":
         from h2o3_tpu.frame.binfmt import parse_avro
-        return parse_avro(path, key or _key_from_path(path))
+        return _note_parse(parse_avro(path, key or _key_from_path(path)), path)
     elif ext in ("xlsx", "xls"):
         from h2o3_tpu.frame.binfmt import parse_xlsx
-        return parse_xlsx(path, key or _key_from_path(path))
+        return _note_parse(parse_xlsx(path, key or _key_from_path(path)), path)
     else:
         if ext in ("csv", "txt", "data") and na_strings is None and header == 0 \
                 and (sep is None or len(sep) == 1):
             frame = _parse_csv_native(path, sep or ",", key)
             if frame is not None:
                 DKV.put(frame.key, frame)
-                return frame
+                return _note_parse(frame, path)
         kw = dict(header=header, na_values=na_strings, compression="infer")
         if sep is not None:
             kw["sep"] = sep
         df = pd.read_csv(path, engine="c", **kw)
     frame = Frame.from_pandas(df, key=key or _key_from_path(path))
     DKV.put(frame.key, frame)
-    return frame
+    return _note_parse(frame, path)
 
 
 def _parse_csv_native(path: str, sep: str, key: str | None) -> Frame | None:
@@ -155,7 +172,7 @@ def parse_raw(text: str, key: str | None = None, **kw) -> Frame:
     frame = Frame.from_pandas(df, key=key)
     if key:
         DKV.put(key, frame)
-    return frame
+    return _note_parse(frame, nbytes=len(text))
 
 
 def _parse_arff(path: str, key: str | None) -> Frame:

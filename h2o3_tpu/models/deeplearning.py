@@ -40,6 +40,7 @@ from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.models.data_info import DataInfo, response_as_float
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.utils.timeline import timed_event
 
 
 # ---------------------------------------------------------------------------
@@ -396,10 +397,11 @@ class DeepLearning(ModelBuilder):
             else:
                 ybt = jnp.take(yy, perm, axis=0).reshape(nb, B)
             key, ek = jax.random.split(key)
-            params, opt, _, samples, mloss = _train_epoch(
-                params, opt, Xb, ybt, wb, ek, samples,
-                act, loss, nclasses, cfg)
-            ml = float(jax.device_get(mloss))
+            with timed_event("iteration", "dl_epoch"):
+                params, opt, _, samples, mloss = _train_epoch(
+                    params, opt, Xb, ybt, wb, ek, samples,
+                    act, loss, nclasses, cfg)
+                ml = float(jax.device_get(mloss))
             score_history.append({"epoch": ep + 1, "train_loss": ml})
             job.update((ep + 1) / n_epochs, f"epoch {ep + 1} loss {ml:.5f}")
             if job.cancelled:

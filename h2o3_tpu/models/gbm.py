@@ -26,6 +26,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.utils.timeline import timed_event
 from jax import lax
 
 from h2o3_tpu.models.tree import (Tree, _grow_tree_device, predict_binned,
@@ -1193,12 +1194,13 @@ class GBM(SharedTreeBuilder):
                                        np.full(per - take, take - 1)])
                 kchunk = kchunk[reps]
             F_prev = Fcur
-            Fcur, heap, extras, Fvend = _boost_scan(
-                binned, edges, yc, w, fmask_base, Fcur, kchunk,
-                track=metric, val=valid, **kwargs)
-            # ONE batched host transfer per chunk (tunnel round-trips are
-            # ~40ms each; per-leaf gets would pay a dozen of them)
-            heap_h, extras_h = jax.device_get((heap, extras))
+            with timed_event("tree", f"{self.algo}:chunk"):
+                Fcur, heap, extras, Fvend = _boost_scan(
+                    binned, edges, yc, w, fmask_base, Fcur, kchunk,
+                    track=metric, val=valid, **kwargs)
+                # ONE batched host transfer per chunk (tunnel round-trips are
+                # ~40ms each; per-leaf gets would pay a dozen of them)
+                heap_h, extras_h = jax.device_get((heap, extras))
             heap_h = jax.tree.map(np.asarray, heap_h)
             new_trees = collect(heap_h, take)
             ts = np.asarray(extras_h[0], np.float64)[:take]

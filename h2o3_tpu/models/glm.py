@@ -29,6 +29,7 @@ from h2o3_tpu.models.distributions import get_family
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
                                         make_model_key)
+from h2o3_tpu.utils.timeline import timed_event
 
 
 def _fam(family: str, tweedie_p: float):
@@ -563,16 +564,17 @@ class GLM(ModelBuilder):
         bounds = getattr(self, "_beta_bounds", None)
         off = getattr(self, "_offset", 0.0)
         for it in range(int(params["max_iterations"])):
-            beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
-                                       non_negative=nn, off=off)
-            if bounds is not None:
-                # projected Newton (reference: GLM.java applies the bounds
-                # inside the ADMM solve; projection after each IRLS step
-                # converges to the same box-constrained optimum for the
-                # smooth objectives handled here)
-                beta_new = jnp.clip(beta_new, bounds[0], bounds[1])
-            dev = float(jax.device_get(dev))
-            delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
+            with timed_event("iteration", "glm_irls"):
+                beta_new, dev = _irls_step(family, tw, X, yy, w, beta, lam,
+                                           non_negative=nn, off=off)
+                if bounds is not None:
+                    # projected Newton (reference: GLM.java applies the bounds
+                    # inside the ADMM solve; projection after each IRLS step
+                    # converges to the same box-constrained optimum for the
+                    # smooth objectives handled here)
+                    beta_new = jnp.clip(beta_new, bounds[0], bounds[1])
+                dev = float(jax.device_get(dev))
+                delta = float(jax.device_get(jnp.max(jnp.abs(beta_new - beta))))
             beta = beta_new
             if hasattr(self, "_iter_devs"):
                 self._iter_devs.append(dev)
@@ -874,9 +876,10 @@ class GLM(ModelBuilder):
         dev_prev = np.inf
         nn = bool(params.get("non_negative"))
         for it in range(int(params["max_iterations"])):
-            B, dev = _multinomial_step(K, X, yoh, w, B, jnp.float32(lam),
-                                       jnp.float32(lam1), nn)
-            dev = float(jax.device_get(dev))
+            with timed_event("iteration", "glm_multinomial"):
+                B, dev = _multinomial_step(K, X, yoh, w, B, jnp.float32(lam),
+                                           jnp.float32(lam1), nn)
+                dev = float(jax.device_get(dev))
             job.update((it + 1) / int(params["max_iterations"]),
                        f"iter {it} deviance {dev:.4f}")
             if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \

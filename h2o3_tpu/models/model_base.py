@@ -38,7 +38,9 @@ from h2o3_tpu.models.metrics import (
     multinomial_metrics,
     regression_metrics,
 )
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV, LOCKS
+from h2o3_tpu.utils.timeline import timed_event
 
 
 class ModelParameters(dict):
@@ -326,7 +328,11 @@ class ModelBuilder:
         def locked_driver(job: Job, _ext) -> Model:
             _ext.report("model_build_start", algo=self.algo, job=job.key,
                         frame=frame.key)
-            model = self._fit(job, frame, x, y, base_w)
+            # build wall-time lands in the timeline ring (kind "model") and
+            # in the metrics registry; scoring history carries it through
+            # run_time_ms (reference: TwoDimTable duration column)
+            with timed_event("model", f"{self.algo}:fit"):
+                model = self._fit(job, frame, x, y, base_w)
             # a builder may shrink the effective row set during fit (GLM
             # missing_values_handling=Skip zeroes NA-row weights); metrics
             # and CV must see the same rows the fit saw (reference: Skip
@@ -335,6 +341,9 @@ class ModelBuilder:
             if w_metrics is None:
                 w_metrics = base_w
             model.run_time_ms = int((time.time() - t0) * 1000)
+            _tm.MODEL_BUILDS.labels(algo=self.algo).inc()
+            _tm.MODEL_BUILD_SECONDS.labels(algo=self.algo).observe(
+                model.run_time_ms / 1000.0)
             # user UDF metric: either an in-process python callable
             # (preds, y, w) -> value, or the reference's wire form
             # "python:key=module.Class" naming a /3/PutKey upload

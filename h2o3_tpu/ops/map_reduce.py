@@ -94,13 +94,25 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
         fn = jax.jit(_shard_map(shard_body, mesh=mesh, in_specs=in_specs, out_specs=P()),
                      donate_argnums=tuple(range(len(cols))) if donate else ())
         _cache_put(key, fn)
+    from h2o3_tpu.utils import telemetry as _tm
     from h2o3_tpu.utils import timeline as _tl
     if _tl.FAULTS is not None:
         _tl.FAULTS.maybe_fault("map_reduce")
     t0 = time.time_ns()
-    out = fn(*cols)
-    _tl.TIMELINE.record("collective", getattr(map_fn, "__name__", "map_reduce"),
-                        time.time_ns() - t0)
+    # block before stamping: JAX dispatch is async, and an enqueue-time
+    # measurement would never see a slow collective. The psum-reduced
+    # partials are small and every caller consumes them immediately, so the
+    # sync costs nothing beyond what the caller's next op would pay.
+    out = jax.block_until_ready(fn(*cols))
+    dur_ns = time.time_ns() - t0
+    name = getattr(map_fn, "__name__", "map_reduce")
+    _tl.TIMELINE.record("collective", name, dur_ns)
+    # dispatch count + partition (shard) count + duration distribution; the
+    # histogram's min/max spread is the straggler signal (under SPMD all
+    # shards run one program, so a straggler shows as dispatch max >> min)
+    _tm.MR_DISPATCHES.labels(fn=name).inc()
+    _tm.MR_PARTITIONS.inc(mesh.size)
+    _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(dur_ns / 1e9)
     return out
 
 

@@ -17,8 +17,19 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.frame.types import VecType
 from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.utils import telemetry as _tm
 
 _MAGIC = "h2o3_tpu-frame-v1"
+
+
+def _snapshot_bytes(path: str) -> int:
+    total = 0
+    for name in ("columns.npz", "frame.json"):
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            pass
+    return total
 
 
 def save_frame(frame: Frame, path: str) -> str:
@@ -41,6 +52,7 @@ def save_frame(frame: Frame, path: str) -> str:
     np.savez_compressed(os.path.join(path, "columns.npz"), **arrays)
     with open(os.path.join(path, "frame.json"), "w") as fh:
         json.dump(meta, fh)
+    _tm.PERSIST_WRITE_BYTES.labels(what="frame").inc(_snapshot_bytes(path))
     return path
 
 
@@ -74,6 +86,7 @@ def load_frame(path: str, key: str | None = None) -> Frame:
     fr = Frame(meta["names"], vecs, key=key)
     if key:
         DKV.put(key, fr)
+    _tm.PERSIST_READ_BYTES.labels(what="frame").inc(_snapshot_bytes(path))
     return fr
 
 
@@ -84,8 +97,13 @@ def export_file(frame: Frame, path: str, header: bool = True, sep: str = ",") ->
     scheme = path.split("://", 1)[0].lower() if "://" in path else ""
     if scheme in ("s3", "s3a", "s3n", "gs", "gcs", "hdfs"):
         from h2o3_tpu.persist.cloud import MANAGER
-        MANAGER.put(path, df.to_csv(index=False, header=header,
-                                    sep=sep).encode())
+        data = df.to_csv(index=False, header=header, sep=sep).encode()
+        MANAGER.put(path, data)
+        _tm.PERSIST_WRITE_BYTES.labels(what="csv").inc(len(data))
         return path
     df.to_csv(path, index=False, header=header, sep=sep)
+    try:
+        _tm.PERSIST_WRITE_BYTES.labels(what="csv").inc(os.path.getsize(path))
+    except OSError:
+        pass
     return path

@@ -10,10 +10,13 @@ re-uploads constants on first use. One file, any mesh size.
 
 from __future__ import annotations
 
+import os
 import pickle
 
 import jax
 import numpy as np
+
+from h2o3_tpu.utils import telemetry as _tm
 
 _MAGIC = b"h2o3_tpu-model-v1\n"
 
@@ -49,6 +52,10 @@ def save_model(model, path: str) -> str:
     with open(path, "wb") as fh:
         fh.write(_MAGIC)
         pickle.dump(m, fh)
+    try:
+        _tm.PERSIST_WRITE_BYTES.labels(what="model").inc(os.path.getsize(path))
+    except OSError:
+        pass
     return path
 
 
@@ -59,6 +66,10 @@ def load_model(path: str):
         if fh.read(len(_MAGIC)) != _MAGIC:
             raise ValueError(f"{path} is not a saved model")
         m = pickle.load(fh)
+    try:
+        _tm.PERSIST_READ_BYTES.labels(what="model").inc(os.path.getsize(path))
+    except OSError:
+        pass
     from h2o3_tpu.utils.registry import DKV
     DKV.put(m.key, m)
     return m

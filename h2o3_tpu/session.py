@@ -38,6 +38,8 @@ def init(port: int = 54321, strict_port: bool = False,
     """
     from h2o3_tpu.api.client import H2OClient
     from h2o3_tpu.api.server import H2OServer
+    from h2o3_tpu.utils.telemetry import install_log_ring
+    install_log_ring()   # session startup: /3/Logs serves from here on
     global _server, _client
     if _client is not None:
         return _client

@@ -16,6 +16,8 @@ import contextlib
 import threading
 from typing import Any, Iterator
 
+from h2o3_tpu.utils import telemetry as _tm
+
 
 class KeyedStore:
     def __init__(self):
@@ -27,6 +29,9 @@ class KeyedStore:
             return None
         with self._lock:
             self._store[key] = value
+            n = len(self._store)
+        _tm.DKV_PUTS.inc()
+        _tm.DKV_KEYS.set(n)
         if type(value).__name__ == "Frame":
             # Cleaner hook (reference: Cleaner LRU sweep on heap pressure);
             # no-op unless a budget is enabled
@@ -51,16 +56,21 @@ class KeyedStore:
     def get(self, key: str, default: Any = None) -> Any:
         with self._lock:
             v = self._store.get(key, default)
+        _tm.DKV_GETS.inc()
         return self._resolve(key, v)
 
     def __getitem__(self, key: str) -> Any:
         with self._lock:
             v = self._store[key]
+        _tm.DKV_GETS.inc()
         return self._resolve(key, v)
 
     def remove(self, key: str) -> Any:
         with self._lock:
             v = self._store.pop(key, None)
+            n = len(self._store)
+        _tm.DKV_REMOVES.inc()
+        _tm.DKV_KEYS.set(n)
         if type(v).__name__ == "SwappedFrame":
             import contextlib
             import os
@@ -94,6 +104,8 @@ class KeyedStore:
         with self._lock:
             items = list(self._store.items())
             self._store.clear()
+        _tm.DKV_REMOVES.inc(len(items))
+        _tm.DKV_KEYS.set(0)
         import contextlib
         import os
         for _k, v in items:

@@ -1,0 +1,422 @@
+"""Runtime telemetry — metrics registry, OpenMetrics export, structured log ring.
+
+Reference: H2O-3's observability surface — ``water/util/Log.java`` (level-split
+log files behind ``water/api/LogsHandler`` → ``/3/Logs``), the ``WaterMeter*``
+meters, and the per-request timing Jetty keeps. Here the runtime equivalents
+are a process-local :class:`MetricsRegistry` (counters / gauges / fixed-bucket
+histograms with optional labels) exported as JSON (``/3/Metrics``) and
+Prometheus/OpenMetrics text (``/metrics``), plus a :class:`LogRing` handler —
+a fixed-size ring of formatted log lines in H2O's ``MM-dd HH:mm:ss.SSS`` line
+format, installed on the ``h2o3_tpu`` logger at session/server startup.
+
+Design constraints:
+
+- **Always-on and off the jit hot path.** Every record site is host-side
+  Python around a dispatch (a lock-protected float add, ~µs); nothing is ever
+  traced into an XLA program.
+- **Thread-safe and exact.** One registry lock guards family creation AND all
+  child mutations, so concurrent increments from REST handler threads and
+  training jobs never lose counts.
+- **Bounded cardinality.** Label values are route patterns / algo names /
+  function names — never keys, paths, or user data.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import logging
+import math
+import threading
+
+# Latency buckets (seconds) for request/dispatch histograms: µs-scale
+# dispatches up through slow requests.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Build-latency buckets: model builds run seconds to an hour — resolution
+# must extend past the minute mark or every real build lands in +Inf.
+BUILD_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                 600.0, 1800.0, 3600.0)
+
+
+def _fmt(v: float) -> str:
+    """OpenMetrics number rendering: integral floats print as integers."""
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+class _Counter:
+    """Monotone counter child (one label combination)."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self.value += amount
+
+
+class _Gauge:
+    """Settable gauge child."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class _Histogram:
+    """Fixed-bucket histogram child; also tracks min/max so per-dispatch
+    duration spreads (straggler visibility) survive aggregation."""
+
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, lock: threading.Lock, buckets: tuple):
+        self._lock = lock
+        self.buckets = buckets              # ascending upper bounds, no +Inf
+        self.counts = [0] * (len(buckets) + 1)   # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.sum += v
+            self.count += 1
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+
+_KINDS = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
+
+
+class _Family:
+    """One named metric family: type + help + label schema + children."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, kind: str,
+                 help: str, labelnames: tuple, buckets: tuple | None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = buckets
+        self._lock = registry._lock
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, **labels):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(f"{self.name} wants labels {self.labelnames}, "
+                             f"got {tuple(labels)}")
+        key = tuple(str(labels[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                cls = _KINDS[self.kind]
+                child = (cls(self._lock, self.buckets)
+                         if self.kind == "histogram" else cls(self._lock))
+                self._children[key] = child
+        return child
+
+    # label-less convenience: the family IS its single child
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def children(self) -> list[tuple[dict, object]]:
+        with self._lock:
+            items = list(self._children.items())
+        return [(dict(zip(self.labelnames, key)), child)
+                for key, child in items]
+
+
+class MetricsRegistry:
+    """Thread-safe registry of counter/gauge/histogram families.
+
+    Declaring an existing name returns the same family (idempotent — safe to
+    declare at every call site); re-declaring with a different type raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help: str, labelnames,
+                buckets=None) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind:
+                    raise ValueError(f"metric {name!r} already registered as "
+                                     f"{fam.kind}, not {kind}")
+                return fam
+            fam = _Family(self, name, kind, help, tuple(labelnames), buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> _Family:
+        return self._family(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> _Family:
+        return self._family(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames=(),
+                  buckets: tuple = DEFAULT_BUCKETS) -> _Family:
+        return self._family(name, "histogram", help, labelnames,
+                            tuple(sorted(buckets)))
+
+    def reset(self) -> None:
+        """Drop every family (tests only — production metrics are append-only)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- exporters -----------------------------------------------------------
+
+    def snapshot(self, include_buckets: bool = True) -> list[dict]:
+        """Flat sample rows — uniform {name, type, labels, value} dicts, so
+        the REST layer can serve them TwoDimTable-style and ``bench.py`` can
+        embed them in an artifact."""
+        # the registry RLock also guards every child mutation, so holding it
+        # across the read pass yields a consistent snapshot (no torn
+        # bucket-vs-count reads mid-observe); exports are rare and fast
+        with self._lock:
+            return self._snapshot_locked(include_buckets)
+
+    def _snapshot_locked(self, include_buckets: bool) -> list[dict]:
+        out: list[dict] = []
+        for fam in self._families.values():
+            for labels, child in fam.children():
+                if fam.kind == "histogram":
+                    if include_buckets:
+                        cum = 0
+                        for ub, c in zip(fam.buckets, child.counts):
+                            cum += c
+                            out.append(dict(name=f"{fam.name}_bucket",
+                                            type="histogram",
+                                            labels={**labels, "le": _fmt(ub)},
+                                            value=cum))
+                        out.append(dict(name=f"{fam.name}_bucket",
+                                        type="histogram",
+                                        labels={**labels, "le": "+Inf"},
+                                        value=child.count))
+                    out.append(dict(name=f"{fam.name}_count",
+                                    type="histogram", labels=labels,
+                                    value=child.count))
+                    out.append(dict(name=f"{fam.name}_sum",
+                                    type="histogram", labels=labels,
+                                    value=child.sum))
+                    if child.count:
+                        out.append(dict(name=f"{fam.name}_min",
+                                        type="histogram", labels=labels,
+                                        value=child.min))
+                        out.append(dict(name=f"{fam.name}_max",
+                                        type="histogram", labels=labels,
+                                        value=child.max))
+                elif fam.kind == "counter":
+                    out.append(dict(name=f"{fam.name}_total", type="counter",
+                                    labels=labels, value=child.value))
+                else:
+                    out.append(dict(name=fam.name, type="gauge",
+                                    labels=labels, value=child.value))
+        return out
+
+    def to_openmetrics(self) -> str:
+        """Prometheus/OpenMetrics exposition text (ends with ``# EOF``).
+        Rendered under the registry lock for the same consistency guarantee
+        as :meth:`snapshot` (monotone cumulative buckets vs ``_count``)."""
+        with self._lock:
+            return self._openmetrics_locked()
+
+    def _openmetrics_locked(self) -> str:
+        lines: list[str] = []
+        for fam in self._families.values():
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+            for labels, child in fam.children():
+                ls = _label_str(labels)
+                if fam.kind == "counter":
+                    lines.append(f"{fam.name}_total{ls} {_fmt(child.value)}")
+                elif fam.kind == "gauge":
+                    lines.append(f"{fam.name}{ls} {_fmt(child.value)}")
+                else:
+                    cum = 0
+                    for ub, c in zip(fam.buckets, child.counts):
+                        cum += c
+                        bl = _label_str({**labels, "le": _fmt(ub)})
+                        lines.append(f"{fam.name}_bucket{bl} {cum}")
+                    bl = _label_str({**labels, "le": "+Inf"})
+                    lines.append(f"{fam.name}_bucket{bl} {child.count}")
+                    lines.append(f"{fam.name}_count{ls} {child.count}")
+                    lines.append(f"{fam.name}_sum{ls} {_fmt(child.sum)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Log ring — the LogsHandler backing store.
+
+#: H2O's log line format: ``MM-dd HH:mm:ss.SSS pid thread LEVEL logger: msg``
+#: (reference: ``water/util/Log.java`` ``logHeader``).
+LOG_FORMAT = ("%(asctime)s.%(msecs)03d %(process)d %(threadName)s "
+              "%(levelname)-5s %(name)s: %(message)s")
+LOG_DATEFMT = "%m-%d %H:%M:%S"
+
+LOG_RING_SIZE = 2048
+
+
+class LogRing(logging.Handler):
+    """Fixed-size ring of formatted log records (reference: the in-memory
+    tail ``LogsHandler`` serves per level-file). ``deque(maxlen=...)`` gives
+    lock-free thread-safe appends under the GIL."""
+
+    def __init__(self, capacity: int = LOG_RING_SIZE):
+        super().__init__()
+        self.capacity = capacity
+        self.buffer: collections.deque = collections.deque(maxlen=capacity)
+        self.setFormatter(logging.Formatter(LOG_FORMAT, LOG_DATEFMT))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.buffer.append((record.levelno, self.format(record)))
+        except Exception:   # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+    def lines(self, min_level: int = 0) -> list[str]:
+        return [line for lv, line in list(self.buffer) if lv >= min_level]
+
+
+LOG_RING: LogRing | None = None
+
+#: reference log *files* → minimum level served (``water/util/Log.java``
+#: writes one file per level; ``h2o-py``'s ``get_log`` names one of these)
+LOG_FILES = {"trace": 0, "debug": logging.DEBUG, "default": logging.INFO,
+             "info": logging.INFO, "httpd": logging.INFO,
+             "stdout": logging.INFO, "stderr": logging.WARNING,
+             "warn": logging.WARNING, "error": logging.ERROR,
+             "fatal": logging.CRITICAL}
+
+
+def install_log_ring(capacity: int = LOG_RING_SIZE) -> LogRing:
+    """Idempotently attach the ring to the ``h2o3_tpu`` logger (called at
+    session/server startup; safe to call from any thread, any number of
+    times)."""
+    global LOG_RING
+    logger = logging.getLogger("h2o3_tpu")
+    for h in logger.handlers:
+        if isinstance(h, LogRing):
+            LOG_RING = h
+            return h
+    ring = LogRing(capacity)
+    logger.addHandler(ring)
+    if logger.level == logging.NOTSET:
+        # the root logger defaults to WARNING; INFO here keeps startup /
+        # LogAndEcho lines flowing into the ring without touching root
+        logger.setLevel(logging.INFO)
+    LOG_RING = ring
+    return ring
+
+
+# ---------------------------------------------------------------------------
+# Metric catalog — every always-on instrument in the runtime declares here,
+# so the name inventory (docs/OBSERVABILITY.md) has one source of truth.
+
+METRICS = MetricsRegistry()
+
+# REST surface (recorded in api/server.py:_route)
+REQUESTS = METRICS.counter(
+    "h2o3_requests", "REST requests served, by route pattern/method/status",
+    ("route", "method", "status"))
+REQUEST_SECONDS = METRICS.histogram(
+    "h2o3_request_duration_seconds", "REST request latency",
+    ("route", "method"))
+
+# map_reduce substrate (ops/map_reduce.py)
+MR_DISPATCHES = METRICS.counter(
+    "h2o3_mapreduce_dispatches", "map_reduce collective dispatches", ("fn",))
+MR_PARTITIONS = METRICS.counter(
+    "h2o3_mapreduce_partitions",
+    "row shards (mesh devices) covered by dispatches")
+MR_DISPATCH_SECONDS = METRICS.histogram(
+    "h2o3_mapreduce_dispatch_seconds",
+    "per-dispatch wall time; min/max spread flags stragglers", ("fn",))
+
+# ingest (frame/parse.py)
+PARSE_ROWS = METRICS.counter("h2o3_parse_rows", "rows parsed into frames")
+PARSE_BYTES = METRICS.counter("h2o3_parse_bytes", "source bytes parsed")
+PARSE_CHUNKS = METRICS.counter(
+    "h2o3_parse_chunks", "column chunks (vecs) created by parses")
+
+# DKV (utils/registry.py)
+DKV_PUTS = METRICS.counter("h2o3_dkv_puts", "DKV puts")
+DKV_GETS = METRICS.counter("h2o3_dkv_gets", "DKV gets")
+DKV_REMOVES = METRICS.counter("h2o3_dkv_removes", "DKV removes")
+DKV_KEYS = METRICS.gauge("h2o3_dkv_keys", "resident DKV keys")
+
+# persist layer (persist/frame_io.py, persist/model_io.py)
+PERSIST_READ_BYTES = METRICS.counter(
+    "h2o3_persist_read_bytes", "bytes read by the persist layer", ("what",))
+PERSIST_WRITE_BYTES = METRICS.counter(
+    "h2o3_persist_write_bytes", "bytes written by the persist layer", ("what",))
+
+# model builds (models/model_base.py)
+MODEL_BUILDS = METRICS.counter(
+    "h2o3_model_builds", "completed model builds", ("algo",))
+MODEL_BUILD_SECONDS = METRICS.histogram(
+    "h2o3_model_build_seconds", "model build wall time", ("algo",),
+    buckets=BUILD_BUCKETS)
+
+# fault injection (utils/timeline.py FaultInjector)
+FAULTS_INJECTED = METRICS.counter(
+    "h2o3_faults_injected", "faults injected into dispatches", ("kind",))

@@ -73,11 +73,17 @@ class timed_event:
         return False
 
 
-def jstack() -> list[dict]:
-    """All Python thread stacks (reference: JStackCollectorTask → /3/JStack)."""
+def jstack(exclude: "set[int] | None" = None) -> list[dict]:
+    """All Python thread stacks (reference: JStackCollectorTask → /3/JStack).
+
+    ``exclude`` drops the given thread idents — the sampling profiler passes
+    its own ident so profiles show real work, not the sampler itself
+    (reference: ProfileCollectorTask skips the collector thread)."""
     frames = sys._current_frames()
     out = []
     for th in threading.enumerate():
+        if exclude and th.ident in exclude:
+            continue
         fr = frames.get(th.ident)
         stack = traceback.format_stack(fr) if fr is not None else []
         out.append(dict(name=th.name, daemon=th.daemon, alive=th.is_alive(),
@@ -131,14 +137,19 @@ class FaultInjector:
         self.delayed = 0
 
     def maybe_fault(self, what: str) -> None:
+        # injected faults surface as metrics too, so fault-injection runs are
+        # observable through /metrics alongside the timeline events
+        from h2o3_tpu.utils.telemetry import FAULTS_INJECTED
         r = self._rng.random()
         if self.drop_rate > 0 and r < self.drop_rate:
             self.dropped += 1
             TIMELINE.record("fault", f"drop:{what}")
+            FAULTS_INJECTED.labels(kind="drop").inc()
             raise FaultInjected(what)
         if self.delay_rate > 0 and self._rng.random() < self.delay_rate:
             self.delayed += 1
             TIMELINE.record("fault", f"delay:{what}")
+            FAULTS_INJECTED.labels(kind="delay").inc()
             time.sleep(self.delay_ms / 1000.0)
 
 

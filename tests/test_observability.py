@@ -1,0 +1,322 @@
+"""Telemetry layer tests: MetricsRegistry semantics, LogRing, OpenMetrics
+export, the observability REST surface, and the route-coverage smoke sweep
+(reference: water/util/Log + LogsHandler, WaterMeter*, TimelineHandler)."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import H2OServer
+from h2o3_tpu.api.client import H2OClient
+from h2o3_tpu.utils.telemetry import (DEFAULT_BUCKETS, LogRing,
+                                      MetricsRegistry, install_log_ring)
+
+# -- MetricsRegistry semantics (fresh registries: global METRICS accumulates
+#    across the whole test process, so assertions there are delta-based) ----
+
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", "requests", ("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(2)
+    c.labels(route="/b").inc()
+    vals = {s["labels"]["route"]: s["value"] for s in reg.snapshot()}
+    assert vals == {"/a": 3, "/b": 1}
+    with pytest.raises(ValueError):
+        c.labels(route="/a").inc(-1)          # counters are monotone
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")                   # label schema enforced
+
+
+def test_registration_idempotent_but_type_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x", "first")
+    b = reg.counter("x", "second")
+    assert a is b                             # same family back
+    with pytest.raises(ValueError):
+        reg.gauge("x")                        # type mismatch refused
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("keys")
+    g.set(10)
+    g.inc(5)
+    g.dec(3)
+    [s] = reg.snapshot()
+    assert s["value"] == 12 and s["type"] == "gauge"
+
+
+def test_histogram_buckets_sum_count_minmax():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    rows = {s["name"]: s for s in reg.snapshot() if "le" not in s["labels"]}
+    assert rows["lat_count"]["value"] == 5
+    assert rows["lat_sum"]["value"] == pytest.approx(56.05)
+    assert rows["lat_min"]["value"] == pytest.approx(0.05)
+    assert rows["lat_max"]["value"] == pytest.approx(50.0)
+    buckets = {s["labels"]["le"]: s["value"] for s in reg.snapshot()
+               if "le" in s["labels"]}
+    # cumulative per OpenMetrics: le=0.1 → 1, le=1 → 3, le=10 → 4, +Inf → 5
+    assert buckets == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+
+
+def test_concurrent_increments_are_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("hits")
+    h = reg.histogram("obs", buckets=DEFAULT_BUCKETS)
+
+    def worker():
+        for _ in range(2000):
+            c.inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples = {s["name"]: s["value"] for s in reg.snapshot()
+               if "le" not in s["labels"]}
+    assert samples["hits_total"] == 8 * 2000
+    assert samples["obs_count"] == 8 * 2000
+
+
+def test_openmetrics_text_shape():
+    reg = MetricsRegistry()
+    reg.counter("c", "a counter", ("k",)).labels(k='va"l\\ue').inc()
+    reg.gauge("g").set(2.5)
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    text = reg.to_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert "# TYPE c counter" in text
+    assert re.search(r'^c_total\{k="va\\"l\\\\ue"\} 1$', text, re.M)
+    assert "# TYPE g gauge" in text and "\ng 2.5\n" in text
+    assert '\nh_bucket{le="1"} 1\n' in text
+    assert '\nh_bucket{le="+Inf"} 1\n' in text
+    assert "\nh_count 1\n" in text and "\nh_sum 0.5\n" in text
+
+
+# -- LogRing ----------------------------------------------------------------
+
+# MM-dd HH:mm:ss.SSS pid thread LEVEL logger: msg (thread names may contain
+# spaces, e.g. "Thread-14 (process_request_thread)")
+H2O_LINE = re.compile(r"^\d\d-\d\d \d\d:\d\d:\d\d\.\d\d\d \d+ .+ "
+                      r"(DEBUG|INFO|WARNI?N?G?|ERROR|CRITICAL)\s*"
+                      r"h2o3_tpu(\.\S+)?: .")
+
+
+def test_log_ring_format_capacity_and_levels():
+    import logging
+    ring = LogRing(capacity=4)
+    logger = logging.Logger("h2o3_tpu.test")   # detached: no global handlers
+    logger.addHandler(ring)
+    for i in range(6):
+        logger.info("line %d", i)
+    logger.warning("boom")
+    lines = ring.lines()
+    assert len(lines) == 4                     # ring wrapped
+    assert all(H2O_LINE.match(ln) for ln in lines)
+    assert ring.lines(logging.WARNING) == [lines[-1]]
+    assert "boom" in lines[-1]
+
+
+def test_install_log_ring_idempotent():
+    import logging
+    r1 = install_log_ring()
+    r2 = install_log_ring()
+    assert r1 is r2
+    handlers = [h for h in logging.getLogger("h2o3_tpu").handlers
+                if isinstance(h, LogRing)]
+    assert len(handlers) == 1
+
+
+# -- REST surface -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def exercised(server, tmp_path_factory):
+    """Drive real traffic through the stack once per module: a REST parse,
+    a map_reduce dispatch, and a REST model build."""
+    import jax.numpy as jnp
+    from h2o3_tpu.ops.map_reduce import map_reduce
+
+    csv = tmp_path_factory.mktemp("obs") / "obs.csv"
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=200)
+    csv.write_text("x,y\n" + "\n".join(
+        f"{v:.4f},{3 * v + rng.normal() * .1:.4f}" for v in x))
+    client = H2OClient(server.url)
+    frame_key = client.import_file(str(csv))
+
+    def shard_total(shard):
+        return shard.sum()
+
+    map_reduce(shard_total, jnp.ones(64, jnp.float32))
+    model = client.train("glm", frame_key, y="y")
+    return client, frame_key, model
+
+
+def test_openmetrics_endpoint_populated(server, exercised):
+    """Acceptance: /metrics serves OpenMetrics text with a route-latency
+    histogram, map_reduce dispatch counters, and parse byte counters — all
+    populated by real traffic."""
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        assert "openmetrics-text" in r.headers["Content-Type"]
+        text = r.read().decode()
+    assert text.endswith("# EOF\n")
+    assert "# TYPE h2o3_request_duration_seconds histogram" in text
+    lat = re.search(r'h2o3_request_duration_seconds_count\{route="/3/'
+                    r'ImportFiles",method="POST"\} (\d+)', text)
+    assert lat and int(lat.group(1)) >= 1
+    mr = re.search(r'h2o3_mapreduce_dispatches_total\{fn="shard_total"\} '
+                   r'(\d+)', text)
+    assert mr and int(mr.group(1)) >= 1
+    pb = re.search(r"^h2o3_parse_bytes_total (\d+)", text, re.M)
+    assert pb and int(pb.group(1)) > 0
+    assert re.search(r'h2o3_model_builds_total\{algo="glm"\} \d+', text)
+    assert re.search(r"^h2o3_dkv_keys \d+", text, re.M)
+
+
+def test_metrics_json_snapshot(server, exercised):
+    out = _get(server, "/3/Metrics")
+    assert out["__meta"]["schema_type"] == "MetricsV3"
+    rows = out["metrics"]
+    assert rows and all(set(r) == {"name", "type", "labels", "value"}
+                        for r in rows)
+    names = {r["name"] for r in rows}
+    assert "h2o3_requests_total" in names
+    assert "h2o3_parse_rows_total" in names
+
+
+def test_client_accessors(server, exercised):
+    client = exercised[0]
+    assert any(s["name"] == "h2o3_requests_total" for s in client.metrics())
+    assert "# EOF" in client.metrics_text()
+    assert any(e["kind"] == "collective" for e in client.timeline())
+    assert H2O_LINE.match(client.logs().splitlines()[0])
+
+
+def test_logs_endpoint_serves_real_lines(server, exercised):
+    # write a known line through the reference's log-and-echo route
+    body = urllib.parse.urlencode({"message": "obs-test-sentinel"}).encode()
+    urllib.request.urlopen(urllib.request.Request(
+        server.url + "/3/LogAndEcho", data=body, method="POST"))
+    out = _get(server, "/3/Logs")
+    lines = out["log"].splitlines()
+    assert lines and all(H2O_LINE.match(ln) for ln in lines)
+    assert any("obs-test-sentinel" in ln for ln in lines)
+    # reference-parity file route (h2o-py get_log); warn file filters INFO out
+    noded = _get(server, "/3/Logs/nodes/0/files/info")
+    assert noded["name"] == "info" and "obs-test-sentinel" in noded["log"]
+    warn = _get(server, "/3/Logs/nodes/0/files/warn")
+    assert "obs-test-sentinel" not in warn["log"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/3/Logs/nodes/0/files/nope")
+    assert ei.value.code == 404
+
+
+def test_timeline_carries_dispatch_and_model_build(server, exercised):
+    kinds = {e["kind"] for e in _get(server, "/3/Timeline")["events"]}
+    assert "collective" in kinds      # map_reduce dispatch
+    assert "model" in kinds           # ModelBuilder fit wall-time
+    assert "iteration" in kinds       # GLM IRLS loop
+
+
+def test_jstack_and_watermeters(server):
+    js = _get(server, "/3/JStack")
+    assert any(t["name"] == "MainThread" for t in js["traces"])
+    cpu = _get(server, "/3/WaterMeterCpuTicks/0")
+    assert "cpu" in cpu["cpu_ticks"]
+    io = _get(server, "/3/WaterMeterIo")
+    assert isinstance(io["persist_stats"], dict)
+
+
+def test_profiler_excludes_its_own_thread(server):
+    prof = _get(server, "/3/Profiler?depth=3")
+    assert prof["stacktraces"], "profiler must still see other threads"
+    assert not any("r_profiler" in st for st in prof["stacktraces"])
+
+
+def test_fault_injection_counts_surface_as_metrics(server):
+    import jax.numpy as jnp
+    from h2o3_tpu.ops.map_reduce import map_reduce
+    from h2o3_tpu.utils.timeline import FaultInjected, inject_faults
+
+    def before():
+        m = re.search(r'h2o3_faults_injected_total\{kind="drop"\} (\d+)',
+                      _text())
+        return int(m.group(1)) if m else 0
+
+    def _text():
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            return r.read().decode()
+
+    n0 = before()
+    with inject_faults(drop_rate=1.0):
+        with pytest.raises(FaultInjected):
+            map_reduce(lambda s: s.sum(), jnp.ones(16, jnp.float32))
+    assert before() == n0 + 1
+
+
+def test_request_metrics_label_by_pattern_not_path(server, exercised):
+    _, frame_key, _ = exercised
+    try:
+        # the frame may have been swept by the per-test DKV clear; a 404 on
+        # the matched route still records the route-pattern label
+        _get(server, f"/3/Frames/{frame_key}")
+    except urllib.error.HTTPError:
+        pass
+    _get(server, "/3/WaterMeterCpuTicks/0")
+    with urllib.request.urlopen(server.url + "/metrics") as r:
+        text = r.read().decode()
+    assert re.search(r'h2o3_requests_total\{route="/3/Frames/\(\[\^/\]\+\)"',
+                     text)
+    # regex classes render as placeholders, not mangled literals ("d+")
+    assert 'route="/3/WaterMeterCpuTicks/{n}"' in text
+    assert frame_key not in text      # raw keys never become label values
+
+
+# -- route-coverage smoke sweep (CI guard for the dead-handler bug class) ---
+
+
+def test_every_parameterless_get_route_is_not_5xx(server):
+    """GET every parameterless GET route; anything ≥500 is a dead handler
+    (the /3/Logs bug class: a route wired to state that doesn't exist)."""
+    from h2o3_tpu.api.server import _ROUTES
+    failures = []
+    for pat, method, fn in _ROUTES:
+        if method != "GET" or "(" in pat:
+            continue
+        # \d+ routes get a concrete path so the handler actually runs
+        # (a literal "d+" path would 404 at the router and hide a dead
+        # handler); \. unescapes to the literal dot
+        path = pat.replace(r"\d+", "0").replace("\\", "")
+        try:
+            with urllib.request.urlopen(server.url + path) as r:
+                code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        if code >= 500:
+            failures.append((path, code, fn.__name__))
+    assert not failures, f"dead GET handlers: {failures}"
