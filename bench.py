@@ -240,7 +240,31 @@ def _probe_backend(timeout_s: float | None = None):
         return None, f"backend probe produced unparseable output: {out!r}"
 
 
+def _lint_gate() -> None:
+    """Refuse to stamp a perf artifact from a tree carrying non-baselined
+    graftlint findings: a new host-sync / lock-discipline / REST violation
+    is exactly the class of regression the numbers are meant to certify
+    against. Override with H2O3TPU_BENCH_SKIP_LINT=1 (diagnostics only)."""
+    if os.environ.get("H2O3TPU_BENCH_SKIP_LINT", "") == "1":
+        return
+    from pathlib import Path
+
+    from h2o3_tpu.tools.lint import (DEFAULT_BASELINE, load_baseline,
+                                     run_lint, split_findings)
+    pkg_root = Path(__file__).resolve().parent / "h2o3_tpu"
+    new, _old = split_findings(run_lint(pkg_root),
+                               load_baseline(DEFAULT_BASELINE))
+    if new:
+        for f in new:
+            print(f"# graftlint: {f.render()}", file=sys.stderr)
+        print(f"# bench REFUSED: {len(new)} non-baselined graftlint "
+              "finding(s) — fix or baseline them before stamping an "
+              "artifact", file=sys.stderr)
+        sys.exit(3)
+
+
 def main() -> None:
+    _lint_gate()
     # -- TPU preflight ------------------------------------------------------
     # One clear diagnostic line + a CPU re-exec at reduced scale beats a
     # traceback in the artifact: the driver still gets rc=0 and a parsed

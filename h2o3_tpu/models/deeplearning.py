@@ -40,6 +40,7 @@ from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.models.data_info import DataInfo, response_as_float
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 
 
@@ -386,7 +387,7 @@ class DeepLearning(ModelBuilder):
         n_epochs = max(int(np.ceil(epochs)), 1)
 
         samples = jnp.float32(0.0)
-        score_history = []
+        epoch_losses = []        # device scalars; fetched once after the loop
         for ep in range(n_epochs):
             key, pk = jax.random.split(key)
             perm = jax.random.permutation(pk, plen)[:used]
@@ -397,15 +398,22 @@ class DeepLearning(ModelBuilder):
             else:
                 ybt = jnp.take(yy, perm, axis=0).reshape(nb, B)
             key, ek = jax.random.split(key)
-            with timed_event("iteration", "dl_epoch"):
+            with timed_event("iteration", "dl_epoch",
+                             observe=_tm.ITER_SECONDS.labels(loop="dl_epoch")):
                 params, opt, _, samples, mloss = _train_epoch(
                     params, opt, Xb, ybt, wb, ek, samples,
                     act, loss, nclasses, cfg)
-                ml = float(jax.device_get(mloss))
-            score_history.append({"epoch": ep + 1, "train_loss": ml})
-            job.update((ep + 1) / n_epochs, f"epoch {ep + 1} loss {ml:.5f}")
+            # NO per-epoch fetch: float(device_get(mloss)) here forced a
+            # device sync every epoch, serializing the dispatch pipeline
+            # (graftlint TRC003); the loss series is fetched in one batched
+            # transfer below, so epochs overlap host-side batching work
+            epoch_losses.append(mloss)
+            job.update((ep + 1) / n_epochs, f"epoch {ep + 1}/{n_epochs}")
             if job.cancelled:
                 break
+        score_history = [
+            {"epoch": i + 1, "train_loss": float(v)}
+            for i, v in enumerate(jax.device_get(epoch_losses))]
 
         from h2o3_tpu.models.model_base import ModelParameters
         model = DeepLearningModel(

@@ -26,6 +26,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 from jax import lax
 
@@ -472,13 +473,15 @@ class SharedTreeModel(Model):
         rel = np.zeros(len(cols))
         all_trees = self.output.get("trees") or [
             t for ts in self.output.get("trees_multi", []) for t in ts]
-        for t in all_trees:
-            # getattr: artifacts pickled before the gain/cover channels restore
-            # __dict__ directly, bypassing the dataclass defaults
-            if getattr(t, "gain", None) is None:
-                continue
-            feat = np.asarray(jax.device_get(t.feat))
-            gain = np.asarray(jax.device_get(t.gain))
+        # getattr: artifacts pickled before the gain/cover channels restore
+        # __dict__ directly, bypassing the dataclass defaults
+        with_gain = [t for t in all_trees
+                     if getattr(t, "gain", None) is not None]
+        # ONE batched transfer for the whole ensemble — per-tree device_gets
+        # paid 2 host round-trips per tree (graftlint TRC003)
+        fetched = jax.device_get([(t.feat, t.gain) for t in with_gain])
+        for feat, gain in fetched:
+            feat, gain = np.asarray(feat), np.asarray(gain)
             ok = feat >= 0
             np.add.at(rel, feat[ok], np.maximum(gain[ok], 0.0))
         mx = rel.max() if rel.max() > 0 else 1.0
@@ -1194,13 +1197,17 @@ class GBM(SharedTreeBuilder):
                                        np.full(per - take, take - 1)])
                 kchunk = kchunk[reps]
             F_prev = Fcur
-            with timed_event("tree", f"{self.algo}:chunk"):
+            with timed_event("tree", f"{self.algo}:chunk",
+                             observe=_tm.ITER_SECONDS.labels(
+                                 loop=f"{self.algo}_chunk")):
                 Fcur, heap, extras, Fvend = _boost_scan(
                     binned, edges, yc, w, fmask_base, Fcur, kchunk,
                     track=metric, val=valid, **kwargs)
                 # ONE batched host transfer per chunk (tunnel round-trips are
-                # ~40ms each; per-leaf gets would pay a dozen of them)
-                heap_h, extras_h = jax.device_get((heap, extras))
+                # ~40ms each; per-leaf gets would pay a dozen of them); the
+                # fetch feeds the host-side early-stopping decision
+                heap_h, extras_h = jax.device_get(  # graftlint: ok(batched chunk fetch)
+                    (heap, extras))
             heap_h = jax.tree.map(np.asarray, heap_h)
             new_trees = collect(heap_h, take)
             ts = np.asarray(extras_h[0], np.float64)[:take]

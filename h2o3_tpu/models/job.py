@@ -36,6 +36,11 @@ class Job:
         self.exception: BaseException | None = None
         self.traceback: str | None = None
         self.result: Any = None
+        # guards every post-construction field mutation: the worker thread
+        # writes status/progress/result while REST handler threads serialize
+        # the job (schemas.job_v3 polls) — unlocked multi-field transitions
+        # let a poller observe DONE with a stale result/progress
+        self._lock = threading.Lock()
         self._cancel_requested = threading.Event()
         self._done = threading.Event()
         DKV.put(self.key, self)
@@ -52,27 +57,38 @@ class Job:
         return self
 
     def _exec(self, fn):
-        self.status = Job.RUNNING
-        self.start_time = time.time()
+        with self._lock:
+            self.status = Job.RUNNING
+            self.start_time = time.time()
         try:
-            self.result = fn(self)
-            self.status = Job.CANCELLED if self._cancel_requested.is_set() else Job.DONE
-            self.progress = 1.0
+            result = fn(self)      # the lock is NOT held across the work
+            with self._lock:
+                # status is written LAST: pollers read fields lock-free in
+                # (status, progress, result) order, so once they observe a
+                # terminal status the other fields are already final
+                self.result = result
+                self.progress = 1.0
+                self.status = (Job.CANCELLED if self._cancel_requested.is_set()
+                               else Job.DONE)
         except JobCancelled:
-            self.status = Job.CANCELLED
+            with self._lock:
+                self.status = Job.CANCELLED
         except BaseException as e:
             # Job is the error carrier (REST/background polls read it); the
             # synchronous caller re-raises from job.exception after run().
-            self.status = Job.FAILED
-            self.exception = e
-            self.traceback = traceback.format_exc()
+            with self._lock:
+                self.status = Job.FAILED
+                self.exception = e
+                self.traceback = traceback.format_exc()
         finally:
-            self.end_time = time.time()
+            with self._lock:
+                self.end_time = time.time()
             self._done.set()
 
     def update(self, progress: float, msg: str = "") -> None:
-        self.progress = float(progress)
-        self.progress_msg = msg
+        with self._lock:
+            self.progress = float(progress)
+            self.progress_msg = msg
         if self._cancel_requested.is_set():
             raise JobCancelled(self.key)
 

@@ -1,0 +1,19 @@
+"""graftlint — AST-based static analysis for the h2o3_tpu runtime.
+
+Three rule families guard the invariants this codebase lives and dies by
+(see docs/STATIC_ANALYSIS.md for the full catalog):
+
+- **tracer-safety** (``TRC``): no implicit device→host syncs or trace
+  breaks inside jit-traced code, and no un-batched per-iteration
+  ``device_get`` in host convergence loops that dispatch jitted programs
+  (the TensorFlow paper's "unintended host round-trips in the hot path").
+- **lock-discipline** (``LCK``): an attribute mutated under a lock
+  anywhere must be mutated under that lock everywhere; thread-shared
+  classes must not mutate state unlocked; module singletons' private
+  state is owned by their class, not by callers.
+- **REST-surface** (``RST``): every registered route has a handler of
+  matching arity producing a schema-typed reply, and every client
+  accessor targets a registered route.
+
+Run ``python -m h2o3_tpu.tools.lint`` (or the ``lint`` console script).
+"""

@@ -1,0 +1,329 @@
+"""graftlint core — package AST index, call graph, findings, suppressions.
+
+Analysis is pure stdlib (``ast`` + ``re``): the code UNDER ANALYSIS is
+never imported or executed — no backend initializes because a model file
+was scanned. (The CLI itself lives inside ``h2o3_tpu``, so running it does
+import the package's ``__init__``; point ``run_lint`` at any source tree
+to analyze code that isn't importable here.)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+#: inline suppression marker — put ``# graftlint: ok(<reason>)`` on the
+#: offending line (or on its own line directly above) to accept a finding
+#: as a documented, deliberate exception.
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # e.g. "TRC003"
+    path: str       # package-relative posix path
+    line: int
+    where: str      # qualname of the enclosing function/class ("" = module)
+    message: str
+    detail: str = ""   # short, line-number-free slug for fingerprinting
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity across unrelated edits: no line numbers, so a
+        baseline survives code motion above the finding."""
+        return f"{self.rule}:{self.path}:{self.where}:{self.detail}"
+
+    def render(self) -> str:
+        where = f" [{self.where}]" if self.where else ""
+        return f"{self.path}:{self.line}: {self.rule}{where} {self.message}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str            # module-relative, e.g. "GLM._irls_fit"
+    module: "ModuleInfo"
+    node: ast.AST            # FunctionDef | AsyncFunctionDef
+    class_name: str | None   # enclosing class, if a method
+    parent: str | None       # qualname of enclosing function (nested defs)
+    is_jit_root: bool = False
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                # dotted module name relative to scan root
+    path: str                # posix relpath
+    tree: ast.Module
+    lines: list[str]
+    suppressed: set[int]
+    # local name -> module-relative qualname for top-level defs
+    top_defs: dict[str, str] = dataclasses.field(default_factory=dict)
+    # class name -> {method name -> qualname}
+    classes: dict[str, dict[str, str]] = dataclasses.field(default_factory=dict)
+    # imported name -> dotted source ("h2o3_tpu.models.glm._irls_step")
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+#: compound statements — a marker inside their BODY must not blanket the
+#: whole block, only the simple statement it sits on
+_COMPOUND = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+             ast.AsyncWith, ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+             ast.ClassDef, ast.Match)
+
+
+def _suppressed_lines(lines: list[str], tree: ast.Module) -> set[int]:
+    """1-based line numbers covered by a suppression marker, scoped to the
+    STATEMENT the marker annotates: a trailing marker covers every physical
+    line of its own (possibly multi-line) simple statement; a comment-only
+    marker line covers the statement starting directly below. Nothing
+    leaks to neighbouring statements."""
+    marked = {i for i, text in enumerate(lines, start=1)
+              if SUPPRESS_RE.search(text)}
+    out = set(marked)
+    if not marked:
+        return out
+    comment_only = {i for i in marked if lines[i - 1].lstrip().startswith("#")}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt) or isinstance(node, _COMPOUND):
+            continue
+        lo = node.lineno
+        hi = getattr(node, "end_lineno", None) or lo
+        span = set(range(lo, hi + 1))
+        if (span & marked) or (lo - 1) in comment_only:
+            out |= span
+    return out
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+_JIT_MARKERS = {"jit", "pjit"}
+
+
+def decorator_is_jit(dec: ast.AST) -> bool:
+    """True for ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)`` and
+    friends — any decorator expression mentioning a ``jit`` name."""
+    for node in ast.walk(dec):
+        if isinstance(node, ast.Name) and node.id in _JIT_MARKERS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _JIT_MARKERS:
+            return True
+    return False
+
+
+class PackageIndex:
+    """Parsed view of every ``*.py`` under a root directory."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}  # "mod::qual" -> info
+        self.errors: list[str] = []
+        self._edges: dict[str, set[str]] | None = None
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def scan(cls, root: Path) -> "PackageIndex":
+        idx = cls(root)
+        for path in sorted(Path(root).rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(root).as_posix()
+            try:
+                src = path.read_text()
+                tree = ast.parse(src, filename=rel)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                idx.errors.append(f"{rel}: unparseable: {e}")
+                continue
+            lines = src.splitlines()
+            name = rel[:-3].replace("/", ".")
+            if name.endswith(".__init__"):
+                name = name[: -len(".__init__")]
+            mod = ModuleInfo(name=name, path=rel, tree=tree, lines=lines,
+                             suppressed=_suppressed_lines(lines, tree))
+            idx.modules[name] = mod
+            idx._index_module(mod)
+        return idx
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = alias.name
+
+        def register(fn: ast.AST, qual: str, cls: str | None,
+                     parent: str | None) -> None:
+            info = FunctionInfo(
+                qualname=qual, module=mod, node=fn, class_name=cls,
+                parent=parent,
+                is_jit_root=any(decorator_is_jit(d)
+                                for d in fn.decorator_list))
+            self.functions[f"{mod.name}::{qual}"] = info
+            for child in ast.iter_child_nodes(fn):
+                visit(child, cls, qual)
+
+        def visit(node: ast.AST, cls: str | None, parent: str | None) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{parent}.{node.name}" if parent else (
+                    f"{cls}.{node.name}" if cls else node.name)
+                if cls and not parent:
+                    mod.classes.setdefault(cls, {})[node.name] = qual
+                elif not cls and not parent:
+                    mod.top_defs[node.name] = qual
+                register(node, qual, cls, parent)
+            elif isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, node.name, None)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    visit(child, cls, parent)
+
+        for child in ast.iter_child_nodes(mod.tree):
+            visit(child, None, None)
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, fn: FunctionInfo, call: ast.Call) -> str | None:
+        """Resolve a call inside ``fn`` to a ``mod::qual`` key, if it names
+        a function defined in the scanned package."""
+        mod = fn.module
+        name = call_name(call)
+        if name is None:
+            return None
+        # self.method() -> same-class method
+        if name.startswith("self.") and fn.class_name:
+            meth = name[5:]
+            if "." not in meth:
+                qual = mod.classes.get(fn.class_name, {}).get(meth)
+                if qual:
+                    return f"{mod.name}::{qual}"
+            return None
+        head, _, rest = name.partition(".")
+        # bare local name: nested sibling, top-level def, or import
+        if not rest:
+            if fn.parent:
+                key = f"{mod.name}::{fn.parent}.{head}"
+                if key in self.functions:
+                    return key
+            if head in mod.top_defs:
+                return f"{mod.name}::{mod.top_defs[head]}"
+            src = mod.imports.get(head)
+            if src:
+                return self._resolve_dotted(src)
+            return None
+        # imported-module attribute: ``mod_alias.fn``
+        src = mod.imports.get(head)
+        if src:
+            return self._resolve_dotted(f"{src}.{rest}")
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> str | None:
+        """``pkg.mod.func`` -> ``mod-name::func`` if scanned. Module names
+        in the index are root-relative; accept full package paths too by
+        matching on suffixes."""
+        mod_part, _, fn_part = dotted.rpartition(".")
+        if not mod_part:
+            return None
+        for mname, mod in self.modules.items():
+            if mname == mod_part or mod_part.endswith("." + mname):
+                if fn_part in mod.top_defs:
+                    return f"{mname}::{mod.top_defs[fn_part]}"
+        return None
+
+    # -- traced / dispatcher sets -------------------------------------------
+
+    def jit_roots(self) -> set[str]:
+        """jit-decorated functions plus functions dispatched through
+        ``map_reduce`` (the MRTask substrate traces its map_fn)."""
+        roots = {k for k, f in self.functions.items() if f.is_jit_root}
+        for key, fn in self.functions.items():
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    nm = call_name(node)
+                    if nm and nm.split(".")[-1] == "map_reduce" and node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Name):
+                            tgt = self.resolve_call(fn, ast.Call(
+                                func=arg, args=[], keywords=[]))
+                            if tgt:
+                                roots.add(tgt)
+        return roots
+
+    def call_edges(self) -> dict[str, set[str]]:
+        """Package-local call graph; memoized — the AST walk + call
+        resolution dominates lint wall time and both traced_functions()
+        and dispatchers() need it."""
+        if self._edges is not None:
+            return self._edges
+        edges: dict[str, set[str]] = {}
+        for key, fn in self.functions.items():
+            out: set[str] = set()
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    tgt = self.resolve_call(fn, node)
+                    if tgt and tgt != key:
+                        out.add(tgt)
+            edges[key] = out
+        self._edges = edges
+        return edges
+
+    def traced_functions(self) -> set[str]:
+        """Functions whose bodies run under a jax trace: jit roots, their
+        nested defs, and everything reachable through package-local calls."""
+        edges = self.call_edges()
+        nested: dict[str, list[str]] = {}
+        for key in self.functions:
+            mod, _, qual = key.partition("::")
+            parent = self.functions[key].parent
+            if parent:
+                nested.setdefault(f"{mod}::{parent}", []).append(key)
+        seen: set[str] = set()
+        work = list(self.jit_roots())
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(edges.get(cur, ()))
+            work.extend(nested.get(cur, ()))
+        return seen
+
+    def dispatchers(self, traced: set[str] | None = None) -> set[str]:
+        """Non-traced functions from which a jit root is reachable — the
+        host-side drivers whose loops pay per-iteration dispatch latency."""
+        traced = self.traced_functions() if traced is None else traced
+        edges = self.call_edges()
+        roots = self.jit_roots()
+        # reverse-reachability from roots
+        rev: dict[str, set[str]] = {}
+        for src, outs in edges.items():
+            for dst in outs:
+                rev.setdefault(dst, set()).add(src)
+        seen: set[str] = set()
+        work = list(roots)
+        while work:
+            cur = work.pop()
+            for caller in rev.get(cur, ()):
+                if caller not in seen:
+                    seen.add(caller)
+                    work.append(caller)
+        return (seen | roots) - (traced - roots)

@@ -1,0 +1,143 @@
+"""graftlint driver — run every rule family, diff against the baseline.
+
+Analysis never imports the code it scans (pure AST); only this CLI's own
+import pulls in the ``h2o3_tpu`` package it ships inside.
+
+Usage::
+
+    python -m h2o3_tpu.tools.lint            # human output, repo baseline
+    python -m h2o3_tpu.tools.lint --json     # machine output
+    python -m h2o3_tpu.tools.lint --update-baseline
+    python -m h2o3_tpu.tools.lint path/to/pkg --no-baseline
+
+Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
+findings, 2 = internal/usage error.
+
+The baseline (``h2o3_tpu/tools/baseline.json``) holds fingerprint counts
+of accepted pre-existing findings: they print as warnings and do not fail
+the run, so the analyzer can land before every legacy site is fixed while
+still failing on *new* violations. Fingerprints carry no line numbers, so
+unrelated edits don't churn the file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+from pathlib import Path
+
+from h2o3_tpu.tools import locks, rest, tracer
+from h2o3_tpu.tools.core import Finding, PackageIndex
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def run_lint(root: Path) -> list[Finding]:
+    """All non-suppressed findings for the package at ``root``, in stable
+    (path, line, rule) order."""
+    index = PackageIndex.scan(Path(root))
+    findings = tracer.check(index) + locks.check(index) + rest.check(index)
+    out = []
+    for f in findings:
+        mod = next((m for m in index.modules.values() if m.path == f.path),
+                   None)
+        if mod is not None and f.line in mod.suppressed:
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: Path) -> dict[str, int]:
+    if not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text())
+    return {str(k): int(v) for k, v in data.get("fingerprints", {}).items()}
+
+
+def save_baseline(path: Path, findings: list[Finding]) -> None:
+    counts = collections.Counter(f.fingerprint for f in findings)
+    doc = {
+        "comment": "graftlint accepted pre-existing findings; regenerate "
+                   "with `python -m h2o3_tpu.tools.lint --update-baseline`",
+        "fingerprints": dict(sorted(counts.items())),
+    }
+    Path(path).write_text(json.dumps(doc, indent=1) + "\n")
+
+
+def split_findings(findings: list[Finding], baseline: dict[str, int]
+                   ) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined): occurrences beyond a fingerprint's baselined
+    count are new."""
+    budget = dict(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m h2o3_tpu.tools.lint",
+        description="graftlint: tracer-safety, lock-discipline and "
+                    "REST-surface analysis for h2o3_tpu")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="package root to scan (default: the installed "
+                         "h2o3_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding fails the run")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[1]
+    if not root.exists():
+        print(f"graftlint: no such path: {root}", file=sys.stderr)
+        return 2
+    baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+
+    findings = run_lint(root)
+
+    if args.update_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"graftlint: baselined {len(findings)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, old = split_findings(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+            "baselined": [vars(f) | {"fingerprint": f.fingerprint}
+                          for f in old],
+        }, indent=1))
+    else:
+        for f in old:
+            print(f"warning: {f.render()} (baselined)")
+        for f in new:
+            print(f"error: {f.render()}")
+        print(f"graftlint: {len(new)} new, {len(old)} baselined, "
+              f"{len(findings)} total finding(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""graftlint lock-discipline rules (LCK) — unguarded shared-state mutation.
+
+- **LCK001** — inconsistent guarding: an instance attribute that is
+  mutated under a lock somewhere in its class (``with self._lock:``)
+  is mutated WITHOUT the lock elsewhere in the same class. Half-guarded
+  state is worse than unguarded: the lock documents an invariant the
+  unguarded site silently breaks. ``__init__`` is exempt (construction
+  is single-threaded).
+- **LCK002** — thread-shared class without locking: a class that runs one
+  of its own methods on a worker thread (``threading.Thread(
+  target=self.method)``) mutates instance attributes outside any lock.
+  Those attributes are read concurrently by definition.
+- **LCK003** — cross-object private mutation: module code reaching into a
+  singleton's underscore state (``CLEANER._touch.pop(...)``) bypasses
+  whatever locking the owning class provides. Add a method on the owner
+  that takes its own lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from h2o3_tpu.tools.core import Finding, ModuleInfo, PackageIndex
+
+#: method names that mutate their receiver in place
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "add", "discard", "setdefault", "sort",
+             "appendleft", "extendleft"}
+
+_LOCKISH = re.compile(r"lock|cond|_mu\b|mutex|sem", re.IGNORECASE)
+_SINGLETON = re.compile(r"^[A-Z][A-Z0-9_]+$")
+
+
+def _is_lockish_with(node: ast.With) -> bool:
+    for item in node.items:
+        try:
+            src = ast.unparse(item.context_expr)
+        except Exception:   # pragma: no cover - unparse is total on 3.9+
+            continue
+        if _LOCKISH.search(src):
+            return True
+    return False
+
+
+def _self_attr_of(node: ast.AST) -> str | None:
+    """``self.X`` (possibly through a subscript ``self.X[...]``) -> X."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mutations(stmt: ast.AST) -> list[tuple[str, int]]:
+    """(attr, line) pairs for direct mutations of ``self.X`` in one node
+    (no recursion into children — the walker handles that)."""
+    out: list[tuple[str, int]] = []
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            tgts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+            for t in tgts:
+                attr = _self_attr_of(t)
+                if attr:
+                    out.append((attr, t.lineno))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        attr = _self_attr_of(stmt.target)
+        if attr and getattr(stmt, "value", True) is not None:
+            out.append((attr, stmt.target.lineno))
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            attr = _self_attr_of(t)
+            if attr:
+                out.append((attr, t.lineno))
+    elif isinstance(stmt, ast.Call):
+        f = stmt.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr_of(f.value)
+            if attr:
+                out.append((attr, stmt.lineno))
+    return out
+
+
+def _walk_method(fn: ast.AST):
+    """Yield ``(node, under_lock)`` for every node in a method body,
+    tracking lock-protected ``with`` regions; skips nested defs."""
+
+    def visit(node: ast.AST, locked: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            child_locked = locked or (
+                isinstance(child, ast.With) and _is_lockish_with(child))
+            yield child, child_locked
+            yield from visit(child, child_locked)
+
+    yield from visit(fn, False)
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> set[str]:
+    """Own methods handed to ``threading.Thread(target=self.m)``."""
+    out: set[str] = set()
+    method_names = {n.name for n in cls.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (isinstance(f, ast.Name) and f.id == "Thread") or (
+            isinstance(f, ast.Attribute) and f.attr == "Thread")
+        if not is_thread:
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                attr = _self_attr_of(kw.value)
+                if attr in method_names:
+                    out.add(attr)
+    return out
+
+
+def _check_class(mod: ModuleInfo, cls: ast.ClassDef,
+                 findings: list[Finding]) -> None:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    guarded: set[str] = set()
+    for m in methods:
+        for node, locked in _walk_method(m):
+            if locked:
+                for attr, _line in _mutations(node):
+                    guarded.add(attr)
+    thread_methods = _thread_target_methods(cls)
+    for m in methods:
+        if m.name == "__init__":
+            continue
+        for node, locked in _walk_method(m):
+            if locked:
+                continue
+            for attr, line in _mutations(node):
+                qual = f"{cls.name}.{m.name}"
+                if attr in guarded:
+                    findings.append(Finding(
+                        "LCK001", mod.path, line, qual,
+                        f"`self.{attr}` is mutated under a lock elsewhere "
+                        f"in {cls.name} but not here — take the same lock "
+                        "or make the update atomic", detail=attr))
+                elif thread_methods:
+                    findings.append(Finding(
+                        "LCK002", mod.path, line, qual,
+                        f"{cls.name} runs on a worker thread "
+                        f"(Thread(target=self.{next(iter(sorted(thread_methods)))})) "
+                        f"but mutates `self.{attr}` without a lock — "
+                        "concurrent readers can observe torn multi-field "
+                        "state", detail=attr))
+
+
+def _check_singletons(mod: ModuleInfo, findings: list[Finding]) -> None:
+    def base_singleton_attr(node: ast.AST) -> str | None:
+        """``NAME._attr`` (optionally through a subscript) -> 'NAME._attr'
+        for ALL_CAPS module singletons."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_") and \
+                isinstance(node.value, ast.Name) and \
+                _SINGLETON.match(node.value.id):
+            return f"{node.value.id}.{node.attr}"
+        return None
+
+    # singletons INSTANTIATED in this module: their defining module is the
+    # owner and may manage the private state next to the class
+    own = {n.targets[0].id for n in ast.walk(mod.tree)
+           if isinstance(n, ast.Assign) and len(n.targets) == 1
+           and isinstance(n.targets[0], ast.Name)
+           and isinstance(n.value, ast.Call)}
+
+    for node in ast.walk(mod.tree):
+        hits: list[tuple[str, int]] = []
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                ref = base_singleton_attr(tgt)
+                if ref:
+                    hits.append((ref, tgt.lineno))
+        elif isinstance(node, ast.AugAssign):
+            ref = base_singleton_attr(node.target)
+            if ref:
+                hits.append((ref, node.target.lineno))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                ref = base_singleton_attr(tgt)
+                if ref:
+                    hits.append((ref, tgt.lineno))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                ref = base_singleton_attr(f.value)
+                if ref:
+                    hits.append((ref, node.lineno))
+        for ref, line in hits:
+            if ref.split(".")[0] in own:
+                continue
+            findings.append(Finding(
+                "LCK003", mod.path, line, "",
+                f"mutation of `{ref}` reaches into another object's "
+                "private state, bypassing its locking — add a locked "
+                "method on the owner", detail=ref))
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                _check_class(mod, node, findings)
+        _check_singletons(mod, findings)
+    return findings

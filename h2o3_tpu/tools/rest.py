@@ -1,0 +1,229 @@
+"""graftlint REST-surface rules (RST) — route ↔ schema ↔ client consistency.
+
+Cross-checks the three files that define the wire surface:
+``api/server.py`` (the ``_ROUTES`` table + handlers), ``api/schemas.py``
+(serializer functions), ``api/client.py`` (accessor methods).
+
+- **RST001** — a registered route's handler produces neither a
+  schema-typed reply (``self._reply`` / ``schemas.*`` / ``_done_job``)
+  nor a raw byte response: the route would 200 with no body contract.
+- **RST002** — handler arity drift: the route regex captures N groups but
+  the handler does not accept N path arguments (dispatch calls
+  ``fn(self, *match.groups())`` — a mismatch is a guaranteed 500).
+- **RST003** — a client accessor requests a (method, path) no route
+  serves: the call can only ever 404.
+- **RST004** — duplicate (pattern, method) registration: the second
+  entry is dead code the first shadows.
+- **RST005** — ``schemas.<name>`` referenced by the server but not
+  defined in ``api/schemas.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from h2o3_tpu.tools.core import Finding, ModuleInfo, PackageIndex
+
+#: placeholder substituted for f-string fields in client paths; matches
+#: every capture class the route table uses ([^/]+, [^/]*, \d+, -?\d+, .+)
+_PLACEHOLDER = "0"
+
+
+def _find_module(index: PackageIndex, suffix: str) -> ModuleInfo | None:
+    for name, mod in index.modules.items():
+        if name == suffix or name.endswith("." + suffix):
+            return mod
+    return None
+
+
+def _routes_table(server: ModuleInfo) -> list[tuple[str, str, str, int]]:
+    """(pattern, method, handler_name, line) rows from the ``_ROUTES``
+    literal."""
+    rows: list[tuple[str, str, str, int]] = []
+    for node in ast.walk(server.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_ROUTES"
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            continue
+        for elt in node.value.elts:
+            if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 3):
+                continue
+            pat, method, fn = elt.elts
+            if not (isinstance(pat, ast.Constant)
+                    and isinstance(method, ast.Constant)):
+                continue
+            handler = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "?")
+            rows.append((str(pat.value), str(method.value), handler,
+                         elt.lineno))
+    return rows
+
+
+def _handler_classes(server: ModuleInfo) -> dict[str, ast.FunctionDef]:
+    """Every method defined on any class in server.py, by name (handlers
+    live on the request-handler class; name collisions don't matter for
+    arity/reply checks)."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(server.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(item.name, item)
+    return out
+
+
+_REPLY_CALLS = {"_reply", "_error"}
+_RAW_MARKERS = {"wfile", "send_response"}
+
+
+def _replies(fn: ast.FunctionDef, methods: dict[str, ast.FunctionDef],
+             seen: set[str] | None = None) -> bool:
+    """True if the handler (transitively through same-class helpers)
+    produces a schema-typed or raw-byte reply."""
+    seen = seen or set()
+    if fn.name in seen:
+        return False
+    seen.add(fn.name)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            if node.attr in _RAW_MARKERS:
+                return True
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _REPLY_CALLS:
+                return True
+            if isinstance(f.value, ast.Name) and f.value.id == "schemas":
+                return True
+            if isinstance(f.value, ast.Name) and f.value.id == "self" and \
+                    f.attr in methods and _replies(methods[f.attr],
+                                                   methods, seen):
+                return True
+        elif isinstance(f, ast.Name):
+            if f.id == "_done_job":
+                return True
+            if f.id in methods and _replies(methods[f.id], methods, seen):
+                return True
+    return False
+
+
+def _arity(fn: ast.FunctionDef) -> tuple[int, int]:
+    """(required, max) positional path-arg counts, excluding self."""
+    args = fn.args
+    pos = [a for a in list(args.posonlyargs) + list(args.args)
+           if a.arg != "self"]
+    required = len(pos) - len(args.defaults)
+    maxn = len(pos) if args.vararg is None else 10**6
+    return max(required, 0), maxn
+
+
+def _client_paths(client: ModuleInfo) -> list[tuple[str, str, int]]:
+    """(method, path_template, line) for every ``self.request(...)`` call;
+    f-string fields become the placeholder, query strings are stripped."""
+    out: list[tuple[str, str, int]] = []
+    for node in ast.walk(client.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "request"
+                and isinstance(f.value, ast.Name) and f.value.id == "self"):
+            continue
+        if len(node.args) < 2 or not isinstance(node.args[0], ast.Constant):
+            continue
+        method = str(node.args[0].value)
+        path_node = node.args[1]
+        if isinstance(path_node, ast.Constant):
+            template = str(path_node.value)
+        elif isinstance(path_node, ast.JoinedStr):
+            parts = []
+            for v in path_node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                else:
+                    parts.append(_PLACEHOLDER)
+            template = "".join(parts)
+        else:
+            continue        # dynamically-built path: out of scope
+        template = template.split("?", 1)[0]
+        out.append((method, template, node.lineno))
+    return out
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    server = _find_module(index, "api.server")
+    schemas = _find_module(index, "api.schemas")
+    client = _find_module(index, "api.client")
+    if server is None:
+        return []
+    findings: list[Finding] = []
+    routes = _routes_table(server)
+    methods = _handler_classes(server)
+
+    seen_keys: set[tuple[str, str]] = set()
+    compiled: list[tuple[re.Pattern, str]] = []
+    for pat, method, handler, line in routes:
+        key = (pat, method)
+        if key in seen_keys:
+            findings.append(Finding(
+                "RST004", server.path, line, "_ROUTES",
+                f"duplicate registration of {method} {pat} — the first "
+                "entry shadows this one", detail=f"{method} {pat}"))
+        seen_keys.add(key)
+        try:
+            rx = re.compile(pat)
+        except re.error as e:
+            findings.append(Finding(
+                "RST002", server.path, line, "_ROUTES",
+                f"unparseable route pattern {pat!r}: {e}", detail=pat))
+            continue
+        compiled.append((rx, method))
+        fn = methods.get(handler)
+        if fn is None:
+            findings.append(Finding(
+                "RST002", server.path, line, "_ROUTES",
+                f"route {method} {pat} names handler {handler!r} which is "
+                "not defined on the handler class", detail=f"{handler}"))
+            continue
+        required, maxn = _arity(fn)
+        if not (required <= rx.groups <= maxn):
+            findings.append(Finding(
+                "RST002", server.path, line, "_ROUTES",
+                f"route {method} {pat} captures {rx.groups} group(s) but "
+                f"handler {handler} takes {required}"
+                + (f"..{maxn}" if maxn != required else "")
+                + " path arg(s) — dispatch would raise TypeError",
+                detail=f"{handler}/{rx.groups}"))
+        if not _replies(fn, methods):
+            findings.append(Finding(
+                "RST001", server.path, fn.lineno, handler,
+                f"handler {handler} for {method} {pat} produces no "
+                "schema-typed or raw reply — the route has no response "
+                "contract", detail=handler))
+
+    # schemas.* references must exist
+    if schemas is not None:
+        defined = set(schemas.top_defs)
+        for node in ast.walk(server.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "schemas" and node.attr not in defined:
+                findings.append(Finding(
+                    "RST005", server.path, node.lineno, "",
+                    f"`schemas.{node.attr}` is referenced but not defined "
+                    "in api/schemas.py", detail=node.attr))
+
+    # client accessors must hit registered routes
+    if client is not None:
+        for method, template, line in _client_paths(client):
+            if any(m == method and rx.fullmatch(template)
+                   for rx, m in compiled):
+                continue
+            findings.append(Finding(
+                "RST003", client.path, line, "",
+                f"client requests {method} {template} but no route "
+                "serves it — the call can only 404",
+                detail=f"{method} {template}"))
+    return findings

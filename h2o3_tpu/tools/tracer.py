@@ -1,0 +1,244 @@
+"""graftlint tracer-safety rules (TRC) — device→host syncs and trace breaks.
+
+- **TRC001** — host-sync call inside jit-traced code: ``float()/int()/
+  bool()`` / ``np.asarray()`` on array-derived values, ``.item()``,
+  ``jax.device_get``. Under a trace each is either a
+  ``ConcretizationTypeError`` waiting to happen or a silent host round-trip
+  that serializes the device pipeline.
+- **TRC002** — Python ``if``/``while`` branching on a tracer value inside
+  jit-traced code: breaks the trace (use ``jnp.where``/``lax.cond``).
+  Branching on *static* parameters (strings, config flags) is the normal
+  ``static_argnames`` pattern and is not flagged — only tests over values
+  produced by jax ops inside the function.
+- **TRC003** — per-iteration host sync in a host loop that dispatches
+  device work: ``jax.device_get``/``.item()``/``np.asarray(jax value)``
+  inside a ``for``/``while`` whose body also calls into a jitted program
+  (or runs eager jax ops). Each sync blocks on device completion once per
+  iteration — batch them into one transfer per iteration, or keep the
+  check on-device (PAPER.md §1; Abadi et al. §3.3).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import (Finding, FunctionInfo, PackageIndex,
+                                 call_name)
+
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_CAST = {"float", "int", "bool"}
+_JAX_HEADS = {"jax", "jnp", "lax"}
+#: attribute reads that are static under a trace (aval metadata, not data)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval"}
+#: jax calls that return host constants, not tracers
+_STATIC_CALLS = {"jax.default_backend", "jax.devices", "jax.device_count",
+                 "jax.local_device_count"}
+
+
+def _is_item_call(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "item" and not call.args)
+
+
+def _mentions_jax(node: ast.AST) -> bool:
+    """Expression references a jax/jnp/lax name anywhere."""
+    return any(isinstance(sub, ast.Name) and sub.id in _JAX_HEADS
+               for sub in ast.walk(node))
+
+
+def _jaxish_call(call: ast.Call) -> bool:
+    return call_name(call) not in _STATIC_CALLS and _mentions_jax(call)
+
+
+def _static_ids(node: ast.AST) -> set[int]:
+    """ids of Name nodes appearing under a static-attribute chain
+    (``x.shape[1]`` uses x's metadata, not its device buffer)."""
+    out: set[int] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            for d in ast.walk(sub):
+                if isinstance(d, ast.Name):
+                    out.add(id(d))
+    return out
+
+
+def _arg_tainted(call: ast.Call, tainted: set[str]) -> bool:
+    if not call.args:
+        return False
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant):
+        return False
+    static = _static_ids(arg)
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Name) and sub.id in tainted and \
+                id(sub) not in static:
+            return True
+        if isinstance(sub, ast.Call) and _jaxish_call(sub):
+            return True
+    return False
+
+
+def _traced_sync_kind(call: ast.Call, tainted: set[str]) -> str | None:
+    """Host-sync classification inside traced code. Builtin casts and
+    np.asarray are gated on the taint set so trace-time work on static
+    values (shapes, config) stays legal."""
+    name = call_name(call)
+    if name in _DEVICE_GET:
+        return name
+    if _is_item_call(call):
+        return ".item()"
+    if (name in _NP_SYNC or name in _CAST) and _arg_tainted(call, tainted):
+        return f"{name}()"
+    return None
+
+
+def _loop_sync_kind(call: ast.Call) -> str | None:
+    """Host-sync classification in host loops: only unambiguous syncs —
+    ``device_get``, ``.item()``, and ``np.asarray`` over a jax expression."""
+    name = call_name(call)
+    if name in _DEVICE_GET:
+        return name
+    if _is_item_call(call):
+        return ".item()"
+    if name in _NP_SYNC and call.args and _mentions_jax(call.args[0]):
+        return f"{name}()"
+    return None
+
+
+def _tainted_names(fn: ast.AST) -> set[str]:
+    """Names assigned from jax expressions inside the function —
+    transitively through names, one forward pass. Bare parameters are
+    deliberately excluded: jit params may be static (``static_argnames``
+    strings, config scalars), and branching on or casting those is the
+    normal pattern."""
+    assigned: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            if value is None:
+                continue
+            src_tainted = _mentions_jax(value) or any(
+                isinstance(s, ast.Name) and s.id in assigned
+                for s in ast.walk(value))
+            if not src_tainted:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        assigned.add(sub.id)
+    return assigned
+
+
+def _own_nodes(fn: ast.AST) -> list[ast.AST]:
+    """Descendant nodes EXCLUDING nested function/class bodies (nested
+    defs have their own FunctionInfo and are checked separately)."""
+    out: list[ast.AST] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            out.append(child)
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                visit(child)
+
+    visit(fn)
+    return out
+
+
+def _check_traced(info: FunctionInfo, findings: list[Finding]) -> None:
+    fn = info.node
+    tainted = _tainted_names(fn)
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call):
+            kind = _traced_sync_kind(node, tainted)
+            if kind:
+                findings.append(Finding(
+                    "TRC001", info.module.path, node.lineno, info.qualname,
+                    f"host sync `{kind}` inside jit-traced code — a "
+                    "device→host round-trip (or trace error) in the "
+                    "compiled hot path", detail=kind))
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            # identity tests (`x is None`) inspect trace-time structure,
+            # not device data — static, never a trace break
+            if isinstance(test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                continue
+            static = _static_ids(test)
+            is_tracer = any(
+                isinstance(s, ast.Call) and _jaxish_call(s)
+                for s in ast.walk(test)) or any(
+                isinstance(s, ast.Name) and s.id in tainted
+                and id(s) not in static
+                for s in ast.walk(test))
+            if is_tracer:
+                kw = "while" if isinstance(node, ast.While) else "if"
+                findings.append(Finding(
+                    "TRC002", info.module.path, node.lineno, info.qualname,
+                    f"Python `{kw}` branches on a tracer value inside "
+                    "jit-traced code — breaks the trace (use jnp.where / "
+                    "lax.cond / lax.while_loop)", detail=kw))
+
+
+def _check_loops(info: FunctionInfo, index: PackageIndex,
+                 dispatchers: set[str], findings: list[Finding]) -> None:
+    def loop_nodes(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.For, ast.While)):
+                yield child
+            yield from loop_nodes(child)
+
+    for loop in loop_nodes(info.node):
+        body_nodes = [n for stmt in loop.body for n in ast.walk(stmt)]
+        dispatches = False
+        for n in body_nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            nm = call_name(n)
+            if nm and (nm.split(".", 1)[0] in _JAX_HEADS
+                       or nm.split(".")[-1] == "map_reduce"):
+                dispatches = True
+                break
+            if index.resolve_call(info, n) in dispatchers:
+                dispatches = True
+                break
+        if not dispatches:
+            continue
+        syncs = [n for n in body_nodes
+                 if isinstance(n, ast.Call) and _loop_sync_kind(n)]
+        # a sync nested inside another flagged sync is the same round-trip
+        # (np.asarray(jax.device_get(x)) is ONE transfer, not two)
+        inner: set[int] = set()
+        for s in syncs:
+            for sub in ast.walk(s):
+                if sub is not s and isinstance(sub, ast.Call) and \
+                        _loop_sync_kind(sub):
+                    inner.add(id(sub))
+        for s in syncs:
+            if id(s) in inner:
+                continue
+            kind = _loop_sync_kind(s)
+            findings.append(Finding(
+                "TRC003", info.module.path, s.lineno, info.qualname,
+                f"per-iteration host sync `{kind}` in a loop that "
+                "dispatches device work — batch transfers into one "
+                "device_get per iteration or keep the check on-device",
+                detail=kind or "sync"))
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    traced = index.traced_functions()
+    dispatchers = index.dispatchers(traced)
+    for key, info in index.functions.items():
+        if key in traced:
+            _check_traced(info, findings)
+        else:
+            _check_loops(info, index, dispatchers, findings)
+    return findings

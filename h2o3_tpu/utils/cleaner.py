@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 import time
 
 from h2o3_tpu.utils.registry import DKV
@@ -46,6 +47,10 @@ class Cleaner:
             int(env) if env else None)
         self.ice_root = ice_root or os.path.join(
             tempfile.gettempdir(), "h2o3_tpu_ice")
+        # LRU bookkeeping is mutated from every DKV.put/get/remove caller
+        # thread; the lock keeps it owned HERE — callers must use touch/
+        # forget, never reach into ``_touch`` (graftlint LCK003)
+        self._lock = threading.Lock()
         self._touch: dict[str, float] = {}
 
     # -- bookkeeping ---------------------------------------------------------
@@ -59,7 +64,22 @@ class Cleaner:
         return total
 
     def touch(self, key: str) -> None:
-        self._touch[key] = time.monotonic()
+        with self._lock:
+            self._touch[key] = time.monotonic()
+
+    def forget(self, key: str) -> None:
+        """Drop LRU state for a removed key (DKV.remove calls this)."""
+        with self._lock:
+            self._touch.pop(key, None)
+
+    def forget_all(self) -> None:
+        """Drop all LRU state (DKV.clear calls this)."""
+        with self._lock:
+            self._touch.clear()
+
+    def last_touched(self, key: str) -> float:
+        with self._lock:
+            return self._touch.get(key, 0.0)
 
     def resident_frames(self):
         from h2o3_tpu.frame.frame import Frame
@@ -82,7 +102,7 @@ class Cleaner:
         if total <= self.budget:
             return []
         os.makedirs(self.ice_root, exist_ok=True)
-        order = sorted(frames, key=lambda kv: self._touch.get(kv[0], 0.0))
+        order = sorted(frames, key=lambda kv: self.last_touched(kv[0]))
         spilled = []
         from h2o3_tpu.persist.frame_io import save_frame
         for k, fr in order:

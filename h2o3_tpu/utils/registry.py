@@ -77,10 +77,10 @@ class KeyedStore:
             from h2o3_tpu.utils.cleaner import CLEANER
             with contextlib.suppress(OSError):
                 os.remove(v.path)
-            CLEANER._touch.pop(key, None)
+            CLEANER.forget(key)
             return None
         from h2o3_tpu.utils.cleaner import CLEANER
-        CLEANER._touch.pop(key, None)
+        CLEANER.forget(key)
         return v
 
     def keys(self) -> list[str]:
@@ -113,7 +113,7 @@ class KeyedStore:
                 with contextlib.suppress(OSError):
                     os.remove(v.path)
         from h2o3_tpu.utils.cleaner import CLEANER
-        CLEANER._touch.clear()
+        CLEANER.forget_all()
 
 
 class KeyLocks:

@@ -329,7 +329,16 @@ class LogRing(logging.Handler):
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
-            self.buffer.append((record.levelno, self.format(record)))
+            text = self.format(record)
+            if "\n" in text:
+                # one record = one ring line: multi-line payloads
+                # (tracebacks, thread dumps) fold onto the header line so
+                # every line /3/Logs serves keeps the H2O line format —
+                # consumers (h2o-py get_log, the format-parity tests) parse
+                # the ``MM-dd HH:mm:ss.SSS pid thread LEVEL`` header per line
+                text = " | ".join(
+                    ln.rstrip() for ln in text.splitlines() if ln.strip())
+            self.buffer.append((record.levelno, text))
         except Exception:   # noqa: BLE001 — logging must never raise
             self.handleError(record)
 
@@ -416,6 +425,14 @@ MODEL_BUILDS = METRICS.counter(
 MODEL_BUILD_SECONDS = METRICS.histogram(
     "h2o3_model_build_seconds", "model build wall time", ("algo",),
     buckets=BUILD_BUCKETS)
+
+# host-driven convergence loops (models/*.py drivers): per-iteration wall
+# time — IRLS steps, boosting chunks, DL epochs. The before/after evidence
+# for host-sync batching fixes (graftlint TRC003) lives here: fewer
+# device→host round-trips per iteration shifts this histogram left.
+ITER_SECONDS = METRICS.histogram(
+    "h2o3_iteration_seconds",
+    "per-iteration wall time of host-driven convergence loops", ("loop",))
 
 # fault injection (utils/timeline.py FaultInjector)
 FAULTS_INJECTED = METRICS.counter(

@@ -59,17 +59,26 @@ TIMELINE = TimeLine()
 
 
 class timed_event:
-    """Context manager recording a timed event into the global timeline."""
+    """Context manager recording a timed event into the global timeline.
 
-    def __init__(self, kind: str, what: str):
+    ``observe`` optionally takes a telemetry histogram child (anything
+    with an ``observe(seconds)`` method) so convergence-loop call sites
+    feed the ``h2o3_iteration_seconds`` histogram and the timeline ring
+    from one wrapper."""
+
+    def __init__(self, kind: str, what: str, observe=None):
         self.kind, self.what = kind, what
+        self._observe = observe
 
     def __enter__(self):
         self._t0 = time.time_ns()
         return self
 
     def __exit__(self, *exc):
-        TIMELINE.record(self.kind, self.what, time.time_ns() - self._t0)
+        dur_ns = time.time_ns() - self._t0
+        TIMELINE.record(self.kind, self.what, dur_ns)
+        if self._observe is not None:
+            self._observe.observe(dur_ns / 1e9)
         return False
 
 

@@ -1,0 +1,450 @@
+"""graftlint analyzer tests — each rule family against known-bad /
+known-good fixture snippets, suppression + baseline round-trips, and a
+meta-test pinning the live package at zero non-baselined findings.
+
+The fixtures are SOURCE-only mini packages written to tmp_path: graftlint
+is pure-AST, nothing here is imported or executed.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from h2o3_tpu.tools.lint import (DEFAULT_BASELINE, load_baseline, main,
+                                 run_lint, save_baseline, split_findings)
+
+
+def make_pkg(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- tracer-safety -----------------------------------------------------------
+
+def test_trc001_host_sync_in_jit(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            s = jnp.sum(x)
+            v = float(jax.device_get(s))      # sync inside trace
+            t = s.item()                      # and another
+            return v + t
+    """})
+    findings = run_lint(pkg)
+    assert [f.rule for f in findings].count("TRC001") >= 2
+    assert all(f.where == "step" for f in findings)
+
+
+def test_trc001_reachable_helper_flagged(tmp_path):
+    # helper is not decorated but is called from a jit root -> traced
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            s = jnp.sum(x)
+            return float(s)
+
+        @jax.jit
+        def step(x):
+            return helper(x) + 1.0
+    """})
+    findings = run_lint(pkg)
+    assert any(f.rule == "TRC001" and f.where == "helper" for f in findings)
+
+
+def test_trc002_tracer_branch(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            s = jnp.sum(x)
+            if s > 0:                         # trace break
+                return s
+            while jnp.max(x) > 0:             # and another
+                x = x - 1
+            return x
+    """})
+    findings = run_lint(pkg)
+    assert [f.rule for f in findings].count("TRC002") == 2
+
+
+def test_tracer_static_patterns_are_clean(tmp_path):
+    # static param branch, .shape math, is-None tests, backend probe:
+    # all legal trace-time work — zero findings
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x, mode, extra=None):
+            k = int(np.log2(x.shape[1] + 1))
+            if mode == "fast":
+                x = x * 2
+            if extra is not None:
+                x = x + extra
+            if jax.default_backend() != "tpu":
+                k = k + 1
+            return jnp.sum(x) * k
+    """})
+    assert run_lint(pkg) == []
+
+
+def test_trc003_loop_sync_flagged_and_batched_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"bad.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(b):
+            nb = b + 1
+            return nb, jnp.sum(nb), jnp.max(jnp.abs(nb - b))
+
+        def fit(b):
+            for _ in range(10):
+                b, dev, delta = step(b)
+                d = float(jax.device_get(dev))       # sync 1
+                e = float(jax.device_get(delta))     # sync 2
+                if e < 1e-6:
+                    break
+            return b, d
+    """, "good.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(b):
+            nb = b + 1
+            return nb, jnp.sum(nb), jnp.max(jnp.abs(nb - b))
+
+        def fit(b):
+            devs = []
+            for _ in range(10):
+                b, dev, delta = step(b)
+                devs.append(dev)
+            return b, jax.device_get(devs)           # hoisted: one transfer
+    """})
+    findings = run_lint(pkg)
+    assert [f.rule for f in findings] == ["TRC003", "TRC003"]
+    assert all(f.path == "bad.py" for f in findings)
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+def test_lck001_half_guarded_attr(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def drop(self, k):
+                self._data.pop(k, None)        # unguarded!
+    """})
+    findings = run_lint(pkg)
+    assert rules_of(findings) == ["LCK001"]
+    assert findings[0].where == "Store.drop"
+
+
+def test_lck001_fully_guarded_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def drop(self, k):
+                with self._lock:
+                    self._data.pop(k, None)
+    """})
+    assert run_lint(pkg) == []
+
+
+def test_lck002_thread_shared_unlocked(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.state = "idle"
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.state = "running"         # unlocked, thread-shared
+    """})
+    findings = run_lint(pkg)
+    assert rules_of(findings) == ["LCK002"]
+    assert findings[0].detail == "state"
+
+
+def test_lck003_singleton_private_mutation(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "owner.py": """
+            class Cache:
+                def __init__(self):
+                    self._data = {}
+
+            CACHE = Cache()
+        """,
+        "user.py": """
+            from owner import CACHE
+
+            def evict(k):
+                CACHE._data.pop(k, None)       # reaches into private state
+        """})
+    findings = run_lint(pkg)
+    assert rules_of(findings) == ["LCK003"]
+    assert findings[0].path == "user.py"
+
+
+# -- REST surface ------------------------------------------------------------
+
+_REST_GOOD = {
+    "api/__init__.py": "",
+    "api/server.py": """
+        from api import schemas
+
+        class _Handler:
+            def _reply(self, obj):
+                pass
+
+            def r_thing(self, key):
+                self._reply(schemas.thing_v3(key))
+
+            def r_list(self):
+                self._reply({"__meta": {"schema_type": "ListV3"}})
+
+        _ROUTES = [
+            (r"/3/Things/([^/]+)", "GET", _Handler.r_thing),
+            (r"/3/Things", "GET", _Handler.r_list),
+        ]
+    """,
+    "api/schemas.py": """
+        def thing_v3(key):
+            return {"__meta": {"schema_type": "ThingV3"}, "key": key}
+    """,
+    "api/client.py": """
+        class Client:
+            def request(self, method, path, data=None):
+                pass
+
+            def thing(self, key):
+                return self.request("GET", f"/3/Things/{key}")
+    """,
+}
+
+
+def test_rest_consistent_surface_clean(tmp_path):
+    assert run_lint(make_pkg(tmp_path, _REST_GOOD)) == []
+
+
+def test_rest_drift_all_rules(tmp_path):
+    files = dict(_REST_GOOD)
+    files["api/server.py"] = """
+        from api import schemas
+
+        class _Handler:
+            def _reply(self, obj):
+                pass
+
+            def r_thing(self, key):
+                self._reply(schemas.thing_v3(key))
+
+            def r_list(self):
+                self._reply({"__meta": {"schema_type": "ListV3"}})
+
+            def r_silent(self):
+                x = 1                              # RST001: no reply at all
+
+            def r_ghost(self):
+                self._reply(schemas.ghost_v3())    # RST005: undefined schema
+
+        _ROUTES = [
+            (r"/3/Things/([^/]+)", "GET", _Handler.r_thing),
+            (r"/3/Things", "GET", _Handler.r_list),
+            (r"/3/Things", "GET", _Handler.r_list),      # RST004: duplicate
+            (r"/3/Two/([^/]+)/([^/]+)", "GET", _Handler.r_thing),  # RST002
+            (r"/3/Silent", "GET", _Handler.r_silent),
+            (r"/3/Ghost", "GET", _Handler.r_ghost),
+        ]
+    """
+    files["api/client.py"] = """
+        class Client:
+            def request(self, method, path, data=None):
+                pass
+
+            def thing(self, key):
+                return self.request("GET", f"/3/Things/{key}")
+
+            def nothing(self):
+                return self.request("DELETE", "/3/Nothing")   # RST003
+    """
+    findings = run_lint(make_pkg(tmp_path, files))
+    assert rules_of(findings) == ["RST001", "RST002", "RST003", "RST004",
+                                  "RST005"]
+
+
+# -- suppression + baseline --------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(b):
+            return b + 1, jnp.sum(b)
+
+        def fit(b):
+            for _ in range(10):
+                b, dev = step(b)
+                d = float(  # graftlint: ok(deliberate convergence fetch)
+                    jax.device_get(dev))
+            return b, d
+    """})
+    assert run_lint(pkg) == []
+
+
+def test_suppression_does_not_leak_to_next_statement(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(b):
+            return b + 1, jnp.sum(b), jnp.max(b)
+
+        def fit(b):
+            for _ in range(10):
+                b, dev, mx = step(b)
+                d = float(jax.device_get(dev))  # graftlint: ok(reason)
+                e = float(jax.device_get(mx))
+            return b, d, e
+    """})
+    findings = run_lint(pkg)
+    # the annotated statement is suppressed; the unannotated one right
+    # below it is NOT
+    assert [f.rule for f in findings] == ["TRC003"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def drop(self, k):
+                self._data.pop(k, None)
+    """})
+    findings = run_lint(pkg)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(bl_path, findings)
+    baseline = load_baseline(bl_path)
+    new, old = split_findings(run_lint(pkg), baseline)
+    assert new == [] and len(old) == len(findings)
+    # fingerprints are line-number-free: prepending code must not churn
+    src = (pkg / "mod.py").read_text()
+    (pkg / "mod.py").write_text("import os\n\n" + src)
+    new, old = split_findings(run_lint(pkg), baseline)
+    assert new == []
+    # but an ADDITIONAL occurrence of the same defect is new
+    (pkg / "mod.py").write_text(src.replace(
+        "self._data.pop(k, None)",
+        "self._data.pop(k, None)\n        self._data.clear()"))
+    new, _ = split_findings(run_lint(pkg), baseline)
+    assert len(new) == 1
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.state = "idle"
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.state = "running"
+    """})
+    assert main([str(pkg), "--no-baseline"]) == 1
+    bl = tmp_path / "bl.json"
+    assert main([str(pkg), "--baseline", str(bl), "--update-baseline"]) == 0
+    assert main([str(pkg), "--baseline", str(bl)]) == 0
+    out = capsys.readouterr().out
+    assert "baselined" in out
+    assert main([str(tmp_path / "nope"), "--no-baseline"]) == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    pkg = make_pkg(tmp_path, {"mod.py": "x = 1\n"})
+    assert main([str(pkg), "--json", "--no-baseline"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == {"new": [], "baselined": []}
+
+
+# -- the live package --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_findings():
+    """One full-package scan shared by the meta-tests (the AST walk +
+    call-graph build is the expensive part; tier-1 should pay it once)."""
+    return run_lint(DEFAULT_BASELINE.parent.parent)   # .../h2o3_tpu
+
+
+def test_package_has_no_new_findings(live_findings):
+    """The repo ships lint-clean: every remaining finding is explicitly
+    baselined (h2o3_tpu/tools/baseline.json) or inline-suppressed with a
+    reason. A failure here means a NEW tracer-safety / lock-discipline /
+    REST-surface violation entered the tree."""
+    new, _old = split_findings(live_findings, load_baseline(DEFAULT_BASELINE))
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_package_fix_targets_stay_clean(live_findings):
+    """The hot paths fixed alongside the analyzer must not regress into
+    the baseline: no findings at all (baselined or new) in the GLM/GBM/DL
+    loops, Job, and the DKV registry."""
+    fixed = {"models/glm.py", "models/glm_sparse.py", "models/gbm.py",
+             "models/deeplearning.py", "models/job.py", "utils/registry.py"}
+    hits = [f for f in live_findings if f.path in fixed]
+    assert hits == [], "\n".join(f.render() for f in hits)
