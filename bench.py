@@ -198,6 +198,95 @@ def bench_automl(ndev: int) -> dict:
     return out
 
 
+def bench_tracing(ndev: int) -> dict:
+    """Trace-store overhead + the slowest trace's critical path.
+
+    Trains the same GLM with the tracer ON (under a root span, so every
+    IRLS iteration and dispatch records) and OFF (``H2O3TPU_TRACE_OFF=1``),
+    min-of-2 each; the ratio is the tracer's wall-time overhead. The
+    slowest completed trace's critical path is embedded so the artifact
+    carries per-request causality, not just aggregate counters."""
+    import jax
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.utils import tracing as tr
+
+    # real runs time at the 1M airlines scale so the 2% gate compares
+    # seconds, not scheduler noise; smoke/fallback only prove the plumbing
+    n = 3_000 if SMOKE else (50_000 if CPU_FALLBACK else 1_000_000)
+    iters = 10 if SMOKE else 25
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    logit = X[:, :5] @ np.array([0.8, -0.5, 0.3, -0.2, 0.4], np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit)))
+    cols = {f"x{i}": X[:, i] for i in range(12)}
+    cols["resp"] = np.where(y, "YES", "NO")
+    fr = Frame.from_arrays(cols)
+
+    def train():
+        GLM(family="binomial", lambda_=1e-4, max_iterations=iters).train(
+            y="resp", training_frame=fr)
+
+    def timed(traced: bool) -> float:
+        t0 = time.perf_counter()
+        if traced:
+            with tr.TRACER.span("bench:glm_traced", kind="bench", root=True):
+                train()
+        else:
+            os.environ["H2O3TPU_TRACE_OFF"] = "1"
+            try:
+                train()
+            finally:
+                os.environ.pop("H2O3TPU_TRACE_OFF", None)
+        return time.perf_counter() - t0
+
+    train()                       # warm-up: compiles out of the timed region
+    jax.effects_barrier()
+    reps = 1 if SMOKE else 2      # min-of-2 damps scheduler noise
+    t_on = min(timed(True) for _ in range(reps))
+    t_off = min(timed(False) for _ in range(reps))
+    overhead = t_on / max(t_off, 1e-9) - 1.0
+
+    traces = tr.TRACER.list_traces()
+    bench_traces = [t for t in traces if t["name"] == "bench:glm_traced"]
+    out = dict(seconds_traced=round(t_on, 3), seconds_untraced=round(t_off, 3),
+               overhead_pct=round(overhead * 100, 2),
+               trace_count=len(traces))
+    if bench_traces:
+        slowest = max(bench_traces, key=lambda t: t["dur_ns"])
+        full = tr.TRACER.get_trace(slowest["trace_id"])
+        out["slowest_trace"] = dict(
+            trace_id=slowest["trace_id"], nspans=slowest["nspans"],
+            dur_ms=round(slowest["dur_ns"] / 1e6, 2))
+        out["critical_path"] = [
+            dict(name=e["name"], kind=e["kind"],
+                 dur_ms=round(e["dur_ns"] / 1e6, 2),
+                 self_ms=round(e["self_ns"] / 1e6, 2))
+            for e in tr.critical_path(full)]
+    return out
+
+
+def _tracing_gate(trc: dict) -> None:
+    """Refuse to stamp an artifact whose tracing section is hollow: an
+    empty trace store after an instrumented run means the span plumbing
+    regressed, and >2% tracer overhead on the traced GLM breaks the
+    always-on contract (enforced on real runs; smoke/fallback captures
+    annotate only — sub-second CPU runs put 2% under scheduler noise)."""
+    if trc.get("error"):
+        print(f"# bench REFUSED: tracing section failed: {trc['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if trc["trace_count"] == 0 or not trc.get("critical_path"):
+        print("# bench REFUSED: trace store empty after an instrumented "
+              "run — span recording is broken", file=sys.stderr)
+        sys.exit(3)
+    if not SMOKE and not CPU_FALLBACK and trc["overhead_pct"] > 2.0:
+        print(f"# bench REFUSED: tracer overhead {trc['overhead_pct']}% "
+              "exceeds the 2% always-on budget", file=sys.stderr)
+        sys.exit(3)
+
+
 def _probe_backend(timeout_s: float | None = None):
     """Initialize the default JAX backend in a THROWAWAY subprocess so a
     sick TPU runtime cannot wedge or crash the bench parent (round 3 lost
@@ -344,6 +433,14 @@ def main() -> None:
     # probe; the ratio is explicitly null.
     if CPU_FALLBACK or SMOKE or out["extra"]["backend"] == "cpu":
         out["vs_baseline"] = None
+    # tracing: overhead measurement + the slowest trace's critical path;
+    # gates below refuse to stamp when the span plumbing is broken
+    try:
+        trc = bench_tracing(ndev)
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        trc = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["tracing"] = trc
+    _tracing_gate(trc)
     # metrics snapshot rides along in the artifact (dispatch counts, parse
     # bytes, model-build latencies) so the perf trajectory carries telemetry;
     # buckets omitted to keep the JSON line compact

@@ -519,6 +519,24 @@ h2o.auc <- function(perf) perf$auc
 h2o.rmse <- function(perf) perf$rmse
 h2o.logloss <- function(perf) perf$logloss
 
+# -- distributed tracing (server /3/Traces*; docs/OBSERVABILITY.md) ----------
+
+h2o.traces <- function() {
+  # completed-trace summaries, newest first (trace_id/name/dur_ns/status)
+  .http("GET", "/3/Traces")$traces
+}
+
+h2o.trace <- function(trace_id) {
+  # full span tree + computed critical path for one trace
+  .http("GET", paste0("/3/Traces/", trace_id))
+}
+
+h2o.traceExport <- function(trace_id) {
+  # Chrome trace-event JSON (as a parsed list); the Python client or a
+  # plain curl of /3/Traces/{id}/export writes the file Perfetto loads
+  .http("GET", paste0("/3/Traces/", trace_id, "/export"))
+}
+
 h2o.shutdown <- function(prompt = FALSE) {
   invisible(tryCatch(.http("POST", "/3/Shutdown"), error = function(e) NULL))
 }

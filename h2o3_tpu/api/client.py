@@ -19,6 +19,9 @@ class H2OClient:
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
+        # trace id of the most recent request (from the server's W3C
+        # ``traceparent`` response header) — feed it to :meth:`trace`
+        self.last_trace_id: str | None = None
 
     # -- transport -----------------------------------------------------------
 
@@ -35,6 +38,9 @@ class H2OClient:
                                      headers=headers)
         try:
             with urllib.request.urlopen(req) as resp:
+                tp = resp.headers.get("traceparent", "")
+                if tp.count("-") >= 2:
+                    self.last_trace_id = tp.split("-")[1]
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             payload = e.read().decode()
@@ -214,6 +220,21 @@ class H2OClient:
         """Raw Prometheus/OpenMetrics exposition (``GET /metrics``)."""
         with urllib.request.urlopen(self.url + "/metrics") as resp:
             return resp.read().decode()
+
+    def traces(self) -> list[dict]:
+        """Completed-trace summaries, newest first (``GET /3/Traces``)."""
+        return self.request("GET", "/3/Traces")["traces"]
+
+    def trace(self, trace_id: str) -> dict:
+        """Full span tree + critical path for one trace
+        (``GET /3/Traces/{id}``)."""
+        return self.request("GET", f"/3/Traces/{trace_id}")
+
+    def trace_export(self, trace_id: str) -> dict:
+        """Chrome trace-event JSON for Perfetto / chrome://tracing
+        (``GET /3/Traces/{id}/export``); ``json.dump`` it to a file and
+        load at https://ui.perfetto.dev."""
+        return self.request("GET", f"/3/Traces/{trace_id}/export")
 
     def ping(self) -> bool:
         return bool(self.request("GET", "/3/Ping").get("healthy"))

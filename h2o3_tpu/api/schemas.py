@@ -403,8 +403,27 @@ def job_v3(job_id: str, job) -> dict:
          "auto_recoverable": False,  # these three are read unconditionally
          "exception": None,          # by h2o-py's H2OJob init/poll loop
          "warnings": None,
+         # the trace the job's execution reports into (None when it was
+         # created outside any trace) — pollers correlate via /3/Traces/{id}
+         "trace_id": getattr(job, "trace_id", None),
          "dest": {"name": getattr(job, "dest_key", None) or job_id}}
     if job.status == "FAILED" and job.exception is not None:
         d["exception"] = str(job.exception)
         d["stacktrace"] = ""
     return d
+
+
+def trace_v3(trace: dict) -> dict:
+    """One completed trace (``GET /3/Traces/{id}``): flat span list, the
+    nested span tree, and the computed critical path — the chain of spans
+    that determined the request's wall time."""
+    from h2o3_tpu.utils import tracing
+    return {**_meta("TraceV3"),
+            "trace_id": trace["trace_id"], "name": trace["name"],
+            "start_ns": trace["start_ns"], "dur_ns": trace["dur_ns"],
+            "nspans": trace["nspans"], "dropped": trace.get("dropped", 0),
+            "status": trace["status"],
+            "in_progress": bool(trace.get("in_progress")),
+            "spans": trace.get("spans", []),
+            "tree": tracing.span_tree(trace),
+            "critical_path": tracing.critical_path(trace)}

@@ -27,6 +27,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import Model
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils import tracing as _tr
 from h2o3_tpu.utils.registry import DKV, LOCKS
 
 _LOG = logging.getLogger("h2o3_tpu")
@@ -112,6 +113,12 @@ class _Handler(BaseHTTPRequestHandler):
         # status capture for the per-route request metrics (_route)
         self._last_status = code
         super().send_response(code, message)
+        # W3C response propagation: every reply names its root span so the
+        # caller can fetch the request's trace (client.trace(trace_id))
+        span = getattr(self, "_trace_span", None)
+        if span is not None:
+            self.send_header("traceparent",
+                             _tr.format_traceparent(span.context))
 
     def _reply(self, obj, code: int = 200):
         meta = obj.get("__meta") if isinstance(obj, dict) else None
@@ -279,21 +286,57 @@ class _Handler(BaseHTTPRequestHandler):
     #: paths reachable without credentials (the login flow itself)
     _AUTH_EXEMPT = {"/login", "/logout"}
 
+    #: high-frequency read endpoints whose solo traces would churn the
+    #: completed-trace ring (h2o-py polls /3/Jobs ~2×/s during builds,
+    #: Prometheus scrapes /metrics, Flow refreshes /): their root spans
+    #: still propagate context and return a traceparent, but the finished
+    #: trace is discarded — unless the caller sent a traceparent, which is
+    #: an explicit request to record the call in the caller's trace
+    _TRACE_NOISE = re.compile(
+        r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
+        r"Logs(?:/.*)?|Metrics|Timeline|JStack|WaterMeter[^/]*(?:/\d+)?|"
+        r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
+
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
         t0 = time.perf_counter()
         self._last_status = 0
         self._route_label = None
-        try:
-            self._dispatch(method, path)
-        finally:
-            # per-route request count/status/latency — labelled by ROUTE
-            # PATTERN (bounded cardinality), never by the raw path
-            route = self._route_label or "(unmatched)"
-            _tm.REQUESTS.labels(route=route, method=method,
-                                status=str(self._last_status)).inc()
-            _tm.REQUEST_SECONDS.labels(route=route, method=method).observe(
-                time.perf_counter() - t0)
+        # root span per request; an incoming W3C traceparent joins the
+        # caller's trace (and its span becomes our root's parent)
+        parent = _tr.parse_traceparent(self.headers.get("traceparent"))
+        ephemeral = (parent is None and method == "GET"
+                     and re.fullmatch(self._TRACE_NOISE, path) is not None)
+        with _tr.TRACER.span(f"{method} {path}", kind="server", root=True,
+                             parent=parent, ephemeral=ephemeral,
+                             attrs={"method": method}) as span:
+            self._trace_span = span
+            try:
+                self._dispatch(method, path)
+            finally:
+                # per-route request count/status/latency — labelled by ROUTE
+                # PATTERN (bounded cardinality), never by the raw path
+                route = self._route_label or "(unmatched)"
+                if span is not None:
+                    # rename to the matched pattern so trace listings stay
+                    # readable; raw path survives as an attr for debugging
+                    if self._route_label:
+                        span.set_attrs(path=path)
+                        span.name = f"{method} {self._route_label}"
+                    span.set_attrs(http_status=self._last_status)
+                    if self._last_status >= 500:
+                        span.set_status("error")
+                    if parent is None and route in ("(unmatched)",
+                                                    "(unauthorized)"):
+                        # only known-after-routing noise: a scanner hitting
+                        # unknown paths (or failing auth) must not churn
+                        # the completed-trace ring either
+                        _tr.TRACER.make_ephemeral(span.trace_id)
+                _tm.REQUESTS.labels(route=route, method=method,
+                                    status=str(self._last_status)).inc()
+                _tm.REQUEST_SECONDS.labels(
+                    route=route, method=method).observe(
+                    time.perf_counter() - t0)
 
     def _dispatch(self, method: str, path: str):
         if path not in self._AUTH_EXEMPT and not self._check_auth():
@@ -954,6 +997,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # -- distributed tracing (reference analog: water/api/TimelineHandler —
+    #    the cluster-wide causally-ordered event snapshot; here per-request
+    #    span trees, see docs/OBSERVABILITY.md "Tracing") --------------------
+
+    def r_traces(self):
+        """Completed traces, newest first (summaries; span lists via
+        ``/3/Traces/{trace_id}``)."""
+        self._reply({"__meta": {"schema_type": "TracesV3"},
+                     "traces": _tr.TRACER.list_traces()})
+
+    def r_trace(self, trace_id):
+        """One trace: flat spans + nested tree + computed critical path."""
+        try:
+            trace = _tr.TRACER.get_trace(trace_id)
+        except KeyError:
+            raise KeyError(f"no trace {trace_id!r} (completed-trace ring "
+                           f"holds the last {_tr.TRACE_RING_SIZE})")
+        self._reply(schemas.trace_v3(trace))
+
+    def r_trace_export(self, trace_id):
+        """Chrome trace-event JSON — save and open in Perfetto
+        (https://ui.perfetto.dev) or chrome://tracing."""
+        trace = _tr.TRACER.get_trace(trace_id)
+        self._reply(_tr.to_chrome_trace(trace))
+
     # -- round-2 parity sweep: the routes the real h2o-py client traffics
     #    (reference registrations: water/api/RegisterV3Api.java) -------------
 
@@ -1597,6 +1665,9 @@ _ROUTES = [
     (r"/3/Logs/nodes/(-?\d+)/files/([^/]+)", "GET", _Handler.r_logs_file),
     (r"/3/Metrics", "GET", _Handler.r_metrics_json),
     (r"/metrics", "GET", _Handler.r_metrics_text),
+    (r"/3/Traces", "GET", _Handler.r_traces),
+    (r"/3/Traces/([^/]+)", "GET", _Handler.r_trace),
+    (r"/3/Traces/([^/]+)/export", "GET", _Handler.r_trace_export),
     (r"/", "GET", _Handler.r_flow),
     (r"/flow/index\.html", "GET", _Handler.r_flow),
     # round-2 parity sweep (reference: RegisterV3Api.java)

@@ -15,6 +15,7 @@ import traceback
 import uuid
 from typing import Any, Callable
 
+from h2o3_tpu.utils import tracing as _tracing
 from h2o3_tpu.utils.registry import DKV
 
 
@@ -43,6 +44,14 @@ class Job:
         self._lock = threading.Lock()
         self._cancel_requested = threading.Event()
         self._done = threading.Event()
+        # the creating request's span context rides into the worker thread
+        # (contextvars do not cross threads) so REST polling and execution
+        # correlate under one trace; capture() RETAINS the trace until the
+        # job span ends — the root span may close (response sent) before
+        # the background thread even starts
+        self._span_ctx = _tracing.TRACER.capture()
+        self.trace_id = (self._span_ctx.trace_id
+                         if self._span_ctx is not None else None)
         DKV.put(self.key, self)
 
     # -- driver side ---------------------------------------------------------
@@ -57,33 +66,45 @@ class Job:
         return self
 
     def _exec(self, fn):
-        with self._lock:
-            self.status = Job.RUNNING
-            self.start_time = time.time()
-        try:
-            result = fn(self)      # the lock is NOT held across the work
+        # adopt the creating request's span context: the job's work appears
+        # as a child span in that trace, and the retention taken at
+        # construction is released when the job span (tree) ends
+        with _tracing.TRACER.adopt(self._span_ctx,
+                                   f"job:{self.description}", kind="job",
+                                   attrs={"job": self.key}) as jspan:
             with self._lock:
-                # status is written LAST: pollers read fields lock-free in
-                # (status, progress, result) order, so once they observe a
-                # terminal status the other fields are already final
-                self.result = result
-                self.progress = 1.0
-                self.status = (Job.CANCELLED if self._cancel_requested.is_set()
-                               else Job.DONE)
-        except JobCancelled:
-            with self._lock:
-                self.status = Job.CANCELLED
-        except BaseException as e:
-            # Job is the error carrier (REST/background polls read it); the
-            # synchronous caller re-raises from job.exception after run().
-            with self._lock:
-                self.status = Job.FAILED
-                self.exception = e
-                self.traceback = traceback.format_exc()
-        finally:
-            with self._lock:
-                self.end_time = time.time()
-            self._done.set()
+                self.status = Job.RUNNING
+                self.start_time = time.time()
+            try:
+                result = fn(self)      # the lock is NOT held across the work
+                with self._lock:
+                    # status is written LAST: pollers read fields lock-free
+                    # in (status, progress, result) order, so once they
+                    # observe a terminal status the other fields are final
+                    self.result = result
+                    self.progress = 1.0
+                    self.status = (Job.CANCELLED
+                                   if self._cancel_requested.is_set()
+                                   else Job.DONE)
+            except JobCancelled:
+                with self._lock:
+                    self.status = Job.CANCELLED
+                if jspan is not None:
+                    jspan.set_status("cancelled")
+            except BaseException as e:
+                # Job is the error carrier (REST/background polls read it);
+                # the synchronous caller re-raises from job.exception.
+                with self._lock:
+                    self.status = Job.FAILED
+                    self.exception = e
+                    self.traceback = traceback.format_exc()
+                if jspan is not None:
+                    jspan.set_status("error")
+                    jspan.set_attrs(exception=f"{type(e).__name__}: {e}")
+            finally:
+                with self._lock:
+                    self.end_time = time.time()
+                self._done.set()
 
     def update(self, progress: float, msg: str = "") -> None:
         with self._lock:

@@ -38,9 +38,17 @@ from h2o3_tpu.models.metrics import (
     multinomial_metrics,
     regression_metrics,
 )
+from h2o3_tpu.ops.map_reduce import map_reduce
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV, LOCKS
 from h2o3_tpu.utils.timeline import timed_event
+
+
+def _weight_rollup(w):
+    """Per-shard (rows-with-weight, weight-sum) partial — the classic
+    MRTask row count specialized to the padded-weight representation
+    (padding and Skip rows carry weight 0, so they never count)."""
+    return jnp.sum((w > 0).astype(jnp.float32)), jnp.sum(w)
 
 
 class ModelParameters(dict):
@@ -333,6 +341,21 @@ class ModelBuilder:
             # run_time_ms (reference: TwoDimTable duration column)
             with timed_event("model", f"{self.algo}:fit"):
                 model = self._fit(job, frame, x, y, base_w)
+                # effective-rows rollup through the EXPLICIT MRTask path
+                # (reference: every build's GLMIterationTask-style row
+                # count): one tiny psum per build keeps partition dispatch —
+                # and its per-shard straggler attribution — in every model's
+                # trace subtree, and nobs/weight-sum land in the output.
+                # Runs AFTER fit over the weights the fit actually used
+                # (GLM Skip zeroes NA-row weights into _metrics_weights)
+                w_eff = getattr(self, "_metrics_weights", None)
+                if w_eff is None:
+                    w_eff = base_w
+                nobs_d, wsum_d = map_reduce(_weight_rollup, w_eff)
+                nobs, wsum = (float(v) for v in
+                              jax.device_get((nobs_d, wsum_d)))
+                model.output.setdefault("effective_nobs", int(nobs))
+                model.output.setdefault("weight_sum", wsum)
             # a builder may shrink the effective row set during fit (GLM
             # missing_values_handling=Skip zeroes NA-row weights); metrics
             # and CV must see the same rows the fit saw (reference: Skip

@@ -96,16 +96,25 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
         _cache_put(key, fn)
     from h2o3_tpu.utils import telemetry as _tm
     from h2o3_tpu.utils import timeline as _tl
-    if _tl.FAULTS is not None:
-        _tl.FAULTS.maybe_fault("map_reduce")
-    t0 = time.time_ns()
-    # block before stamping: JAX dispatch is async, and an enqueue-time
-    # measurement would never see a slow collective. The psum-reduced
-    # partials are small and every caller consumes them immediately, so the
-    # sync costs nothing beyond what the caller's next op would pay.
-    out = jax.block_until_ready(fn(*cols))
-    dur_ns = time.time_ns() - t0
+    from h2o3_tpu.utils import tracing as _tr
     name = getattr(map_fn, "__name__", "map_reduce")
+    # child span per dispatch (no-op outside an active trace); faults
+    # injected below mark THIS span, so fault runs read in trace trees
+    with _tr.TRACER.span(f"map_reduce:{name}", kind="dispatch",
+                         attrs={"fn": name,
+                                "partitions": mesh.size}) as span:
+        if _tl.FAULTS is not None:
+            _tl.FAULTS.maybe_fault("map_reduce")
+        t0 = time.time_ns()
+        # block before stamping: JAX dispatch is async, and an enqueue-time
+        # measurement would never see a slow collective. The psum-reduced
+        # partials are small and every caller consumes them immediately, so
+        # the sync costs nothing beyond what the caller's next op would pay.
+        out = fn(*cols)
+        if span is not None:
+            _partition_spans(span, out, mesh, t0)
+        out = jax.block_until_ready(out)
+        dur_ns = time.time_ns() - t0
     _tl.TIMELINE.record("collective", name, dur_ns)
     # dispatch count + partition (shard) count + duration distribution; the
     # histogram's min/max spread is the straggler signal (under SPMD all
@@ -114,6 +123,53 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     _tm.MR_PARTITIONS.inc(mesh.size)
     _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(dur_ns / 1e9)
     return out
+
+
+def _partition_spans(span, out, mesh, t0: int) -> None:
+    """Per-partition sub-spans under a traced dispatch: block on each
+    device's output shard in device order and stamp when it became ready.
+    The max/argmax of those readiness times is the straggler attribution
+    (recorded as span attrs); the per-shard sync costs nothing the caller's
+    own block_until_ready would not pay. Best-effort: a trace must never
+    break a dispatch."""
+    try:
+        from h2o3_tpu.utils import tracing as _tr
+        leaves = jax.tree.leaves(out)
+        shards0 = getattr(leaves[0], "addressable_shards", None) \
+            if leaves else None
+        if not shards0:
+            return
+        ends = []
+        for i in range(len(shards0)):
+            for leaf in leaves:
+                sh = getattr(leaf, "addressable_shards", ())
+                if i < len(sh):
+                    jax.block_until_ready(sh[i].data)
+            ends.append(time.time_ns())
+        durs = [e - t0 for e in ends]
+        waits = _shard_waits(ends, t0)
+        argmax = waits.index(max(waits))
+        devices = [str(s.device) for s in shards0]
+        for i, end in enumerate(ends):
+            _tr.TRACER.add_span(f"partition:{i}", "partition", span,
+                                start_ns=t0, end_ns=end,
+                                attrs={"device": devices[i],
+                                       "wait_ns": waits[i]},
+                                tid=f"partition-{i}")
+        span.set_attrs(part_dur_min_ns=min(durs), part_dur_max_ns=max(durs),
+                       straggler=argmax, straggler_device=devices[argmax])
+    except Exception:   # noqa: BLE001 — tracing is best-effort by contract
+        pass
+
+
+def _shard_waits(ends: "list[int]", t0: int) -> "list[int]":
+    """Per-shard incremental wait from sequential readiness stamps: shards
+    are blocked on in device order, so the CUMULATIVE times are monotone
+    and their argmax would always name the last shard; the true straggler is
+    where the readiness time JUMPS — a shard already finished while an
+    earlier one was blocking shows ~zero incremental wait."""
+    return [max(e - (ends[i - 1] if i else t0), 0)
+            for i, e in enumerate(ends)]
 
 
 def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:

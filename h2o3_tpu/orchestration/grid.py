@@ -23,6 +23,7 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.model_base import Model, ModelBuilder
 from h2o3_tpu.utils.registry import DKV
+from h2o3_tpu.utils.tracing import TRACER
 
 
 def _metric_value(model: Model, metric: str | None, prefer_cv: bool) -> float:
@@ -143,6 +144,14 @@ class GridSearch:
 
     def train(self, x=None, y=None, training_frame: Frame | None = None,
               validation_frame: Frame | None = None, **kw) -> Grid:
+        # the whole search is one subtree in the caller's trace; each
+        # combo's build_one hangs its own span under it
+        with TRACER.span(f"grid:{self.grid_id}", kind="orchestration",
+                         attrs={"algo": self.builder_cls.algo}):
+            return self._train(x, y, training_frame, validation_frame, **kw)
+
+    def _train(self, x, y, training_frame: Frame | None,
+               validation_frame: Frame | None, **kw) -> Grid:
         max_models = int(self.search_criteria.get("max_models", 0) or 0)
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0.0) or 0.0)
         t0 = time.time()
@@ -180,9 +189,15 @@ class GridSearch:
             # positional counter would collide with recovered models)
             tag = hashlib.md5(combo_key(combo).encode()).hexdigest()[:8]
             params["model_id"] = f"{self.grid_id}_model_{tag}"
-            b = self.builder_cls(**params)
-            m = b.train(x=x, y=y, training_frame=training_frame,
-                        validation_frame=validation_frame, **kw)
+            # child span per grid model: the parent run's trace shows every
+            # combo as its own subtree (no-op outside an active trace)
+            with TRACER.span(f"grid_model:{self.builder_cls.algo}",
+                             kind="build",
+                             attrs={"grid": self.grid_id,
+                                    "model_id": params["model_id"]}):
+                b = self.builder_cls(**params)
+                m = b.train(x=x, y=y, training_frame=training_frame,
+                            validation_frame=validation_frame, **kw)
             m.output["hyper_values"] = combo
             return m
 

@@ -16,6 +16,8 @@ from __future__ import annotations
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Iterable
 
+from h2o3_tpu.utils import tracing as _tracing
+
 
 def windowed_parallel(
     items: Iterable[Any],
@@ -57,6 +59,10 @@ def windowed_parallel(
     n_sub = 0
     n_failed = 0
     stream_ended = False
+    # pool threads don't inherit the submitter's contextvars: carry the
+    # active span context across so overlapped builds stay linked to the
+    # parent run's trace (the submitter blocks here, so no retention needed)
+    span_ctx = _tracing.TRACER.current()
     with ThreadPoolExecutor(max_workers=par,
                             thread_name_prefix="model-build") as ex:
         while True:
@@ -69,7 +75,8 @@ def windowed_parallel(
                 except StopIteration:
                     stream_ended = True
                     break
-                futs[ex.submit(run_one, item)] = (n_sub, item)
+                futs[ex.submit(_tracing.run_in_context, span_ctx,
+                               run_one, item)] = (n_sub, item)
                 n_sub += 1
             if not futs:
                 break

@@ -22,36 +22,48 @@ import threading
 import time
 import traceback
 
+from h2o3_tpu.utils import tracing as _tracing
+
 RING_SIZE = 2048   # reference: TimeLine.MAX_EVENTS=2048
 
 
 class TimeLine:
-    """Fixed-size event ring (reference: water/TimeLine ring buffer)."""
+    """Fixed-size event ring (reference: water/TimeLine ring buffer).
+
+    Events carry a monotonic **epoch**: :meth:`clear` bumps it instead of
+    swapping the buffer out, so a reader that raced a clear can never be
+    served stale-index events from the previous generation — snapshot
+    filters on the epoch it captured under the lock."""
 
     def __init__(self, size: int = RING_SIZE):
         self._size = size
-        self._events: list[tuple] = [None] * size   # (ns, kind, what, dur_ns)
+        # (ns, kind, what, dur_ns, epoch)
+        self._events: list[tuple] = [None] * size
         self._idx = 0
+        self._epoch = 0
         self._lock = threading.Lock()
 
     def record(self, kind: str, what: str, dur_ns: int = 0) -> None:
         with self._lock:
             self._events[self._idx % self._size] = (
-                time.time_ns(), kind, what, dur_ns)
+                time.time_ns(), kind, what, dur_ns, self._epoch)
             self._idx += 1
 
     def snapshot(self) -> list[dict]:
         """Events oldest→newest (reference: TimelineHandler snapshot)."""
         with self._lock:
+            epoch = self._epoch
             n = min(self._idx, self._size)
             start = self._idx - n
             evs = [self._events[(start + i) % self._size] for i in range(n)]
         return [dict(ns=e[0], kind=e[1], what=e[2], dur_ns=e[3])
-                for e in evs if e is not None]
+                for e in evs if e is not None and e[4] == epoch]
 
     def clear(self) -> None:
+        # epoch bump retires every live event without reallocating the
+        # buffer or letting a concurrent snapshot mix generations
         with self._lock:
-            self._events = [None] * self._size
+            self._epoch += 1
             self._idx = 0
 
 
@@ -64,13 +76,19 @@ class timed_event:
     ``observe`` optionally takes a telemetry histogram child (anything
     with an ``observe(seconds)`` method) so convergence-loop call sites
     feed the ``h2o3_iteration_seconds`` histogram and the timeline ring
-    from one wrapper."""
+    from one wrapper. The same wrapper also opens a child **span** under
+    the active trace (:mod:`h2o3_tpu.utils.tracing`) — IRLS iterations,
+    DL epochs, and GBM chunks become span-tree nodes with zero extra
+    instrumentation at the call sites (and zero cost when no trace is
+    active: the span hook is a contextvar read returning None)."""
 
     def __init__(self, kind: str, what: str, observe=None):
         self.kind, self.what = kind, what
         self._observe = observe
 
     def __enter__(self):
+        self._scope = _tracing.TRACER.span(self.what, kind=self.kind)
+        self._scope.__enter__()
         self._t0 = time.time_ns()
         return self
 
@@ -79,6 +97,7 @@ class timed_event:
         TIMELINE.record(self.kind, self.what, dur_ns)
         if self._observe is not None:
             self._observe.observe(dur_ns / 1e9)
+        self._scope.__exit__(*exc)
         return False
 
 
@@ -147,19 +166,30 @@ class FaultInjector:
 
     def maybe_fault(self, what: str) -> None:
         # injected faults surface as metrics too, so fault-injection runs are
-        # observable through /metrics alongside the timeline events
+        # observable through /metrics alongside the timeline events; the
+        # active span (if a trace is open) is marked so fault-injection runs
+        # are visible in trace trees
         from h2o3_tpu.utils.telemetry import FAULTS_INJECTED
         r = self._rng.random()
         if self.drop_rate > 0 and r < self.drop_rate:
             self.dropped += 1
             TIMELINE.record("fault", f"drop:{what}")
             FAULTS_INJECTED.labels(kind="drop").inc()
+            _tracing.TRACER.mark_active(status="error",
+                                        fault=f"drop:{what}")
             raise FaultInjected(what)
         if self.delay_rate > 0 and self._rng.random() < self.delay_rate:
             self.delayed += 1
-            TIMELINE.record("fault", f"delay:{what}")
-            FAULTS_INJECTED.labels(kind="delay").inc()
+            t0 = time.time_ns()
             time.sleep(self.delay_ms / 1000.0)
+            dur_ns = time.time_ns() - t0
+            # the event carries the TRUE injected stall, not 0 — delay
+            # faults are stragglers and must read as such in the timeline
+            TIMELINE.record("fault", f"delay:{what}", dur_ns)
+            FAULTS_INJECTED.labels(kind="delay").inc()
+            _tracing.TRACER.mark_active(status="delayed",
+                                        fault=f"delay:{what}",
+                                        delay_ns=dur_ns)
 
 
 class FaultInjected(RuntimeError):
