@@ -267,6 +267,67 @@ def bench_tracing(ndev: int) -> dict:
     return out
 
 
+def bench_memory() -> dict:
+    """Memory accounting for the artifact: host/device watermarks over the
+    whole bench run, DKV byte totals by kind, and a leak-detector pass over
+    the workload's resident keys (enough sweeps for the detector to express
+    an opinion; nothing should flag on a clean run)."""
+    from h2o3_tpu.utils import memory as _mem
+
+    _mem.MEMORY.refresh()      # reconcile in-place mutation before sweeping
+    rss, dev = _mem.MEMORY.sample()
+    # one more observation of the final state, then capture GROWTH flags
+    # BEFORE the idle passes below: a static post-workload sweep resets
+    # growth streaks by definition, so reading them later would make the
+    # gate unreachable
+    _mem.MEMORY.leak_sweep()
+    growing = [f for f in _mem.MEMORY.leak_report()["flagged"]
+               if "growing" in f["reasons"]]
+    for _ in range(_mem.MEMORY.detector.sweeps + 1):
+        _mem.MEMORY.leak_sweep()
+    rep = _mem.MEMORY.leak_report()
+    wm = _mem.MEMORY.watermarks
+    total, by_kind, nkeys = _mem.MEMORY.dkv_totals()
+    return dict(host_rss_bytes=rss,
+                host_rss_peak_bytes=wm["host_rss_peak_bytes"],
+                device_bytes_in_use=dev,
+                device_peak_bytes=wm["device_peak_bytes"],
+                device_source=_mem.device_stats()["source"],
+                dkv_bytes=total, dkv_by_kind=by_kind, dkv_keys=nkeys,
+                leak_sweeps=rep["sweeps"],
+                leak_growing=growing,
+                leak_flagged=rep["flagged"])
+
+
+def _memory_gate(memsec: dict) -> None:
+    """Refuse to stamp an artifact when the leak detector fires on a real
+    run (keys growing or idle-resident above the floor across sweeps are
+    exactly what pages someone at 3am), or when the meter itself reads
+    hollow — a zero host watermark means the accounting regressed."""
+    if memsec.get("error"):
+        print(f"# bench REFUSED: memory section failed: {memsec['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if SMOKE or CPU_FALLBACK:
+        return          # annotate-only (smoke proves shape; /proc may be absent)
+    if memsec["host_rss_peak_bytes"] <= 0:
+        print("# bench REFUSED: memory meter reports a zero host watermark "
+              "— byte accounting is broken", file=sys.stderr)
+        sys.exit(3)
+    # gate on GROWTH flags only (captured by bench_memory BEFORE its idle
+    # passes — those manufacture idle streaks by construction and would
+    # reset growth streaks): bytes that kept rising across the interleaved
+    # workload sweeps are the real signal; idle-only flags still ride in
+    # the artifact for inspection.
+    growing = memsec["leak_growing"]
+    if growing:
+        for f in growing:
+            print(f"# leak: {f}", file=sys.stderr)
+        print(f"# bench REFUSED: leak detector flagged {len(growing)} "
+              "growing key(s) on a real run", file=sys.stderr)
+        sys.exit(3)
+
+
 def _tracing_gate(trc: dict) -> None:
     """Refuse to stamp an artifact whose tracing section is hollow: an
     empty trace store after an instrumented run means the span plumbing
@@ -404,6 +465,13 @@ def main() -> None:
         ("glm_airlines_1m", bench_glm, (ndev,)),
         ("dl_mlp_mnist", bench_dl, (ndev,)),
         ("automl_leaderboard_100k", bench_automl, (ndev,)))
+    # leak-detector generations interleave with the workloads (without an
+    # HBM budget the Cleaner never sweeps): a key whose bytes keep RISING
+    # across configs accumulates a growth streak that the memory gate
+    # refuses — post-hoc back-to-back sweeps alone could never see growth
+    from h2o3_tpu.utils.memory import MEMORY
+    MEMORY.refresh()
+    MEMORY.leak_sweep()
     for name, fn, args in secondary:
         t0 = time.perf_counter()
         try:
@@ -412,6 +480,8 @@ def main() -> None:
             extra[name] = {"error": f"{type(e).__name__}: {e}"}
         print(f"# bench: {name} done in {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
+        MEMORY.refresh()        # catch in-place growth, not just re-puts
+        MEMORY.leak_sweep()
 
     out = {
         "metric": "gbm_hist_train_rows_per_sec_per_chip",
@@ -441,6 +511,15 @@ def main() -> None:
         trc = {"error": f"{type(e).__name__}: {e}"}
     out["extra"]["tracing"] = trc
     _tracing_gate(trc)
+    # memory: host/device watermarks + DKV byte totals + leak-detector pass
+    # over the bench's resident keys; the gate refuses to stamp when the
+    # detector fires on a real run (docs/OBSERVABILITY.md "Memory")
+    try:
+        memsec = bench_memory()
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        memsec = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["memory"] = memsec
+    _memory_gate(memsec)
     # metrics snapshot rides along in the artifact (dispatch counts, parse
     # bytes, model-build latencies) so the perf trajectory carries telemetry;
     # buckets omitted to keep the JSON line compact

@@ -537,6 +537,25 @@ h2o.traceExport <- function(trace_id) {
   .http("GET", paste0("/3/Traces/", trace_id, "/export"))
 }
 
+# -- memory/thread observability (server /3/Memory, /3/JStack, /3/Profiler;
+#    docs/OBSERVABILITY.md "Memory") ----------------------------------------
+
+h2o.memory <- function(top = 10) {
+  # device/host byte accounting: host RSS, per-device HBM stats, DKV bytes
+  # by kind with the top-N keys, watermarks, and the leak-detector report
+  .http("GET", paste0("/3/Memory?top=", as.integer(top)))
+}
+
+h2o.jstack <- function() {
+  # all server thread stacks (reference: h2o-r h2o.killMinus3 analog reads)
+  .http("GET", "/3/JStack")$traces
+}
+
+h2o.profiler <- function(depth = 5) {
+  # sampled stack profile, hottest-first (reference ProfilerHandler)
+  .http("GET", paste0("/3/Profiler?depth=", as.integer(depth)))
+}
+
 h2o.shutdown <- function(prompt = FALSE) {
   invisible(tryCatch(.http("POST", "/3/Shutdown"), error = function(e) NULL))
 }

@@ -216,6 +216,22 @@ class H2OClient:
         (``GET /3/Metrics``)."""
         return self.request("GET", "/3/Metrics")["metrics"]
 
+    def memory(self, top: int = 10) -> dict:
+        """Device/host byte accounting: host RSS, per-device HBM stats,
+        DKV bytes by kind + top-N keys, watermarks, and the leak report
+        (``GET /3/Memory``)."""
+        return self.request("GET", f"/3/Memory?top={int(top)}")
+
+    def jstack(self) -> list[dict]:
+        """All server thread stacks (``GET /3/JStack``; h2o-py:
+        ``h2o.cluster().get_status`` → JStack)."""
+        return self.request("GET", "/3/JStack")["traces"]
+
+    def profiler(self, depth: int = 5) -> dict:
+        """Sampled stack profile: ``{"stacktraces": [...], "counts": [...]}``
+        ordered hottest-first (``GET /3/Profiler?depth=N``)."""
+        return self.request("GET", f"/3/Profiler?depth={int(depth)}")
+
     def metrics_text(self) -> str:
         """Raw Prometheus/OpenMetrics exposition (``GET /metrics``)."""
         with urllib.request.urlopen(self.url + "/metrics") as resp:

@@ -38,10 +38,25 @@ def _meta(schema_type: str) -> dict:
 
 
 def cloud_v3(version: str) -> dict:
+    import os as _os
+
     import jax
+
+    from h2o3_tpu.utils.memory import MEMORY, host_stats
     devs = jax.devices()
-    # field set mirrors water/api/schemas3/CloudV3.java — the real h2o-py
-    # client's H2OCluster reads these at connect time
+    # real memory accounting behind the reference's per-node heap fields
+    # (water/api/schemas3/CloudV3.java semantics): max_mem = machine total,
+    # free_mem = machine available, mem_value_size = bytes resident in the
+    # DKV (the K/V store the reference's MemoryManager meters — HERE that
+    # includes device HBM chunks; the per-device split lives in /3/Memory),
+    # pojo_mem = process RSS not attributable to HOST-resident DKV bytes
+    # (the "everything else" heap — HBM bytes are never subtracted from
+    # RSS, they live in a different memory). One process serves the whole
+    # device cloud, so the process numbers ride on every node row.
+    host = host_stats()
+    dkv_bytes, _by_kind, nkeys = MEMORY.dkv_totals()
+    pojo = max(host["rss_bytes"] - MEMORY.dkv_host_bytes(), 0)
+    pid = _os.getpid()
     return {**_meta("CloudV3"), "version": version, "cloud_name": "h2o3_tpu",
             "cloud_size": len(devs), "cloud_healthy": True, "bad_nodes": 0,
             "consensus": True, "locked": True, "is_client": False,
@@ -51,13 +66,24 @@ def cloud_v3(version: str) -> dict:
             "cloud_internal_timezone": "UTC",
             "datafile_parser_timezone": "UTC",
             "nodes": [{"h2o": str(d), "healthy": True, "num_cpus": 1,
-                       "cpus_allowed": 1, "free_mem": 0, "max_mem": 0,
-                       "mem_value_size": 0, "pojo_mem": 0, "swap_mem": 0,
-                       "free_disk": 0, "max_disk": 0, "num_keys": 0,
+                       "cpus_allowed": 1,
+                       "free_mem": host["available_bytes"],
+                       "max_mem": host["total_bytes"],
+                       "mem_value_size": dkv_bytes, "pojo_mem": pojo,
+                       "swap_mem": 0,
+                       "free_disk": 0, "max_disk": 0, "num_keys": nkeys,
                        "tcps_active": 0, "open_fds": 0, "rpcs_active": 0,
                        "last_ping": 0, "sys_load": 0.0,
-                       "my_cpu_pct": 0, "sys_cpu_pct": 0, "pid": 0}
+                       "my_cpu_pct": 0, "sys_cpu_pct": 0, "pid": pid}
                       for d in devs]}
+
+
+def memory_v3(summary: dict) -> dict:
+    """``GET /3/Memory`` — the three-level byte accounting: host RSS +
+    machine totals, per-device HBM (``memory_stats`` or live-array
+    fallback), DKV totals by kind with the top-N keys, monotonic
+    watermarks, and the leak-detector report (utils/memory.py)."""
+    return {**_meta("MemoryV3"), **_clean(summary)}
 
 
 def _column_histogram(vec, r, nbins: int = 20) -> dict:

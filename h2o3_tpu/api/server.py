@@ -294,7 +294,7 @@ class _Handler(BaseHTTPRequestHandler):
     #: an explicit request to record the call in the caller's trace
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
-        r"Logs(?:/.*)?|Metrics|Timeline|JStack|WaterMeter[^/]*(?:/\d+)?|"
+        r"Logs(?:/.*)?|Memory|Metrics|Timeline|JStack|WaterMeter[^/]*(?:/\d+)?|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
 
     def _route(self, method: str):
@@ -962,7 +962,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def r_logs(self):
-        self.r_logs_file("0", "info")
+        """``GET /3/Logs[?level=...]`` — the whole LogRing, optionally
+        filtered by minimum severity. ``level`` accepts the reference's
+        per-level file names (``water/util/Log.java`` writes one file per
+        level: trace/debug/info/warn/error/fatal) or a numeric logging
+        level; absent = unfiltered (every ring line)."""
+        p = self._params()
+        level = p.get("level")
+        ring = _tm.install_log_ring()
+        if level is None:
+            self._reply({"__meta": {"schema_type": "LogsV3"},
+                         "nodeidx": 0, "name": "unfiltered",
+                         "log": "\n".join(ring.lines())})
+            return
+        min_level = _tm.LOG_FILES.get(str(level).lower())
+        if min_level is None:
+            try:
+                min_level = int(level)
+            except ValueError:
+                raise KeyError(f"unknown log level {level!r}; one of "
+                               f"{sorted(_tm.LOG_FILES)} or a numeric "
+                               "logging level") from None
+        self._reply({"__meta": {"schema_type": "LogsV3"},
+                     "nodeidx": 0, "name": str(level),
+                     "log": "\n".join(ring.lines(min_level))})
 
     def r_logs_file(self, node: str, name: str):
         """Reference: LogsHandler ``/3/Logs/nodes/{n}/files/{name}`` (the
@@ -978,6 +1001,20 @@ class _Handler(BaseHTTPRequestHandler):
                      "nodeidx": int(node),
                      "name": name,
                      "log": "\n".join(ring.lines(min_level))})
+
+    def r_memory(self):
+        """``GET /3/Memory[?top=N]`` — device/host byte accounting: host
+        RSS + machine totals, per-device HBM stats, DKV bytes by kind with
+        the top-N keys, monotonic watermarks, and the leak-detector report
+        (docs/OBSERVABILITY.md "Memory")."""
+        from h2o3_tpu.utils.memory import MEMORY
+        p = self._params()
+        try:
+            top = max(1, min(int(p.get("top", 10)), 1000))
+        except ValueError:
+            raise KeyError(f"top must be an integer, got "
+                           f"{p.get('top')!r}") from None
+        self._reply(schemas.memory_v3(MEMORY.summary(top_n=top)))
 
     def r_metrics_json(self):
         """JSON metrics snapshot — flat {name, type, labels, value} rows
@@ -1663,6 +1700,7 @@ _ROUTES = [
     (r"/3/WaterMeterIo", "GET", _Handler.r_io_meter),
     (r"/3/Logs", "GET", _Handler.r_logs),
     (r"/3/Logs/nodes/(-?\d+)/files/([^/]+)", "GET", _Handler.r_logs_file),
+    (r"/3/Memory", "GET", _Handler.r_memory),
     (r"/3/Metrics", "GET", _Handler.r_metrics_json),
     (r"/metrics", "GET", _Handler.r_metrics_text),
     (r"/3/Traces", "GET", _Handler.r_traces),

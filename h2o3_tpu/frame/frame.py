@@ -126,6 +126,12 @@ class Frame:
         return (self.nrows, self.ncols)
 
     @property
+    def nbytes(self) -> int:
+        """Summed resident bytes of every column (device chunks + host
+        payloads) — what `/3/Memory` reports for this frame's key."""
+        return sum(v.nbytes for v in self.vecs)
+
+    @property
     def types(self) -> dict[str, str]:
         return {n: str(v.type) for n, v in zip(self.names, self.vecs)}
 

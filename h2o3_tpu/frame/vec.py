@@ -142,6 +142,14 @@ class Vec:
         return self.data.shape[0] if self.data is not None else padded_len(self.nrows)
 
     @property
+    def nbytes(self) -> int:
+        """Resident bytes of this column: the padded device chunk plus any
+        host-side payload (reference: summed ``Chunk`` byte sizes — the
+        per-key accounting ``utils/memory.py`` registers with the DKV)."""
+        from h2o3_tpu.utils.memory import vec_nbytes
+        return vec_nbytes(self)
+
+    @property
     def is_categorical(self) -> bool:
         return self.type is VecType.CAT
 

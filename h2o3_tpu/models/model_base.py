@@ -416,6 +416,12 @@ class ModelBuilder:
             if nfolds >= 2 and y is not None:
                 model.cross_validation_metrics = self._cross_validate(
                     job, frame, x, y, w_metrics, nfolds, model)
+            # artifact size (summed bytes of the model's array tree —
+            # coefficients / tree arrays / DL weights) rides in the output
+            # and is what /3/Memory reports for the model's DKV key
+            from h2o3_tpu.utils.memory import array_tree_bytes
+            model.output.setdefault("artifact_bytes",
+                                    array_tree_bytes(model))
             DKV.put(model.key, model)
             _ext.report("model_build_end", algo=self.algo, model=model.key,
                         job=job.key)

@@ -105,6 +105,14 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
                                 "partitions": mesh.size}) as span:
         if _tl.FAULTS is not None:
             _tl.FAULTS.maybe_fault("map_reduce")
+        # device-byte attribution per TRACED dispatch — only through the
+        # runtime's memory_stats counters (~µs): the live-array fallback
+        # walks every resident buffer and has no place on this hot path,
+        # so backends without stats (CPU) skip it (fast probe returns None)
+        mem0 = None
+        if span is not None:
+            from h2o3_tpu.utils.memory import fast_device_bytes
+            mem0 = fast_device_bytes()
         t0 = time.time_ns()
         # block before stamping: JAX dispatch is async, and an enqueue-time
         # measurement would never see a slow collective. The psum-reduced
@@ -115,6 +123,16 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
             _partition_spans(span, out, mesh, t0)
         out = jax.block_until_ready(out)
         dur_ns = time.time_ns() - t0
+        if mem0 is not None:
+            mem1 = fast_device_bytes()
+            if mem1 is not None:
+                # max of the two in-use samples, NOT the runtime's
+                # peak_bytes_in_use counter — that one is process-lifetime
+                # monotonic, so after any big build every later dispatch
+                # would report the global high-water mark instead of its
+                # own footprint (same semantic as the model-span attr)
+                span.set_attrs(peak_device_bytes=max(mem0[0], mem1[0]),
+                               device_bytes_delta=mem1[0] - mem0[0])
     _tl.TIMELINE.record("collective", name, dur_ns)
     # dispatch count + partition (shard) count + duration distribution; the
     # histogram's min/max spread is the straggler signal (under SPMD all

@@ -53,7 +53,11 @@ def save_model(model, path: str) -> str:
         fh.write(_MAGIC)
         pickle.dump(m, fh)
     try:
-        _tm.PERSIST_WRITE_BYTES.labels(what="model").inc(os.path.getsize(path))
+        size = os.path.getsize(path)
+        _tm.PERSIST_WRITE_BYTES.labels(what="model").inc(size)
+        # the serialized size is the ground-truth artifact measure; stash
+        # it on the live model so /3/Memory and ModelsV3 can report it
+        model.artifact_file_bytes = size
     except OSError:
         pass
     return path
@@ -67,7 +71,8 @@ def load_model(path: str):
             raise ValueError(f"{path} is not a saved model")
         m = pickle.load(fh)
     try:
-        _tm.PERSIST_READ_BYTES.labels(what="model").inc(os.path.getsize(path))
+        m.artifact_file_bytes = os.path.getsize(path)
+        _tm.PERSIST_READ_BYTES.labels(what="model").inc(m.artifact_file_bytes)
     except OSError:
         pass
     from h2o3_tpu.utils.registry import DKV

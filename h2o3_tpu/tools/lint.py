@@ -28,7 +28,7 @@ import json
 import sys
 from pathlib import Path
 
-from h2o3_tpu.tools import locks, rest, tracer
+from h2o3_tpu.tools import locks, mem, rest, tracer
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -38,7 +38,8 @@ def run_lint(root: Path) -> list[Finding]:
     """All non-suppressed findings for the package at ``root``, in stable
     (path, line, rule) order."""
     index = PackageIndex.scan(Path(root))
-    findings = tracer.check(index) + locks.check(index) + rest.check(index)
+    findings = (tracer.check(index) + locks.check(index) + rest.check(index)
+                + mem.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),

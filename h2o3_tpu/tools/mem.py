@@ -1,0 +1,137 @@
+"""graftlint memory rule (MEM) — silent host copies of device arrays.
+
+- **MEM001** — an ``np.asarray``/``np.array`` call over a device (jax)
+  value inside a ``timed_event``-wrapped **hot loop** (the call sits under
+  both a ``for``/``while`` loop and a ``with timed_event(...)`` block, in
+  either nesting order). Each such call materializes a full host copy of
+  the device buffer *per iteration* — the array then exists twice (HBM +
+  host RSS), a silent 2× memory cost in exactly the loops the memory meter
+  watches (``h2o3_iteration_seconds`` call sites). Fetch once outside the
+  loop, batch the transfer (``jax.device_get`` of a tuple), or keep the
+  computation on-device.
+
+The deviceish-argument test mirrors the tracer family's taint rules: the
+argument mentions a jax/jnp/lax name, reads a ``.data`` buffer (a Vec's
+device chunk) or ``.as_float()``/``.matrix()`` device views, or names a
+variable assigned from such an expression in the same function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import (Finding, FunctionInfo, PackageIndex,
+                                 call_name)
+from h2o3_tpu.tools.tracer import _mentions_jax, _NP_SYNC
+
+#: attribute reads that yield device buffers/views on framework objects
+_DEVICE_ATTRS = {"data"}
+_DEVICE_METHODS = {"as_float", "matrix"}
+
+
+def _deviceish_expr(node: ast.AST, tainted: set[str]) -> bool:
+    if _mentions_jax(node):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _DEVICE_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _DEVICE_METHODS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _device_tainted_names(fn: ast.AST) -> set[str]:
+    """Names assigned from deviceish expressions — one forward pass,
+    transitive through names (the tracer family's taint discipline)."""
+    assigned: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            if value is None:
+                continue
+            if not _deviceish_expr(value, assigned):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        assigned.add(sub.id)
+    return assigned
+
+
+def _already_host(node: ast.AST) -> bool:
+    """``np.asarray(jax.device_get(x))`` wraps a value that is ALREADY on
+    host — the transfer is explicit and the asarray is zero-copy. That
+    pattern is TRC003's business (sync placement), not a silent 2× copy."""
+    if isinstance(node, ast.Call):
+        nm = call_name(node)
+        if nm and nm.split(".")[-1] in ("device_get", "to_numpy", "fetch"):
+            return True
+    return False
+
+
+def _is_timed_event_with(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return False
+    for item in node.items:
+        call = item.context_expr
+        if isinstance(call, ast.Call):
+            nm = call_name(call)
+            if nm and nm.split(".")[-1] == "timed_event":
+                return True
+    return False
+
+
+def _check_fn(info: FunctionInfo, findings: list[Finding]) -> None:
+    fn = info.node
+    tainted = _device_tainted_names(fn)
+
+    def flag(call: ast.Call) -> None:
+        nm = call_name(call)
+        if nm in _NP_SYNC and call.args and \
+                not _already_host(call.args[0]) and \
+                _deviceish_expr(call.args[0], tainted):
+            findings.append(Finding(
+                "MEM001", info.module.path, call.lineno, info.qualname,
+                f"`{nm}` copies a device array to host inside a "
+                "timed_event-wrapped hot loop — the buffer exists "
+                "twice (HBM + host RSS) every iteration; hoist the "
+                "fetch out of the loop or batch it into one "
+                "device_get", detail=nm))
+
+    def visit(node: ast.AST, in_loop: bool, in_timed: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not fn:
+            return           # nested defs get their own FunctionInfo pass
+        if in_loop and in_timed and isinstance(node, ast.Call):
+            flag(node)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            # the iter expression runs ONCE per loop entry — the
+            # recommended hoisted-fetch form must not re-flag
+            visit(node.target, in_loop, in_timed)
+            visit(node.iter, in_loop, in_timed)
+            for stmt in node.body + node.orelse:
+                visit(stmt, True, in_timed)
+            return
+        if isinstance(node, ast.While):
+            # unlike a For header, the While test re-runs every iteration
+            visit(node.test, True, in_timed)
+            for stmt in node.body + node.orelse:
+                visit(stmt, True, in_timed)
+            return
+        timed = in_timed or _is_timed_event_with(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop, timed)
+
+    visit(fn, False, False)
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for info in index.functions.values():
+        _check_fn(info, findings)
+    return findings

@@ -97,6 +97,11 @@ class Cleaner:
         """Spill LRU frames until under budget; returns spilled keys."""
         if self.budget is None:
             return []
+        # every budgeted sweep advances one leak-detector generation: the
+        # detector snapshots keyed bytes across sweeps and flags keys that
+        # grow or sit untouched for N of them (utils/memory.py)
+        from h2o3_tpu.utils.memory import MEMORY
+        MEMORY.leak_sweep()
         frames = self.resident_frames()
         total = sum(self._frame_bytes(f) for _, f in frames)
         if total <= self.budget:

@@ -27,9 +27,17 @@ class KeyedStore:
     def put(self, key: str | None, value: Any) -> str | None:
         if key is None:
             return None
+        # per-key byte accounting (reference: MemoryManager metering the
+        # K/V store) — registered INSIDE the store lock so a racing
+        # remove of the same key cannot leave the meter (and the
+        # h2o3_dkv_bytes gauges) counting a key the store no longer holds.
+        # Lock order store→meter is acyclic: the meter never touches the
+        # store while holding its own lock.
+        from h2o3_tpu.utils.memory import MEMORY
         with self._lock:
             self._store[key] = value
             n = len(self._store)
+            MEMORY.register(key, value)
         _tm.DKV_PUTS.inc()
         _tm.DKV_KEYS.set(n)
         if type(value).__name__ == "Frame":
@@ -57,18 +65,25 @@ class KeyedStore:
         with self._lock:
             v = self._store.get(key, default)
         _tm.DKV_GETS.inc()
+        if v is not None:
+            from h2o3_tpu.utils.memory import MEMORY
+            MEMORY.note_access(key)     # resets the leak detector's idle streak
         return self._resolve(key, v)
 
     def __getitem__(self, key: str) -> Any:
         with self._lock:
             v = self._store[key]
         _tm.DKV_GETS.inc()
+        from h2o3_tpu.utils.memory import MEMORY
+        MEMORY.note_access(key)
         return self._resolve(key, v)
 
     def remove(self, key: str) -> Any:
+        from h2o3_tpu.utils.memory import MEMORY
         with self._lock:
             v = self._store.pop(key, None)
             n = len(self._store)
+            MEMORY.unregister(key)
         _tm.DKV_REMOVES.inc()
         _tm.DKV_KEYS.set(n)
         if type(v).__name__ == "SwappedFrame":
@@ -101,9 +116,11 @@ class KeyedStore:
         return iter(self.keys())
 
     def clear(self) -> None:
+        from h2o3_tpu.utils.memory import MEMORY
         with self._lock:
             items = list(self._store.items())
             self._store.clear()
+            MEMORY.clear()
         _tm.DKV_REMOVES.inc(len(items))
         _tm.DKV_KEYS.set(0)
         import contextlib

@@ -413,6 +413,22 @@ DKV_GETS = METRICS.counter("h2o3_dkv_gets", "DKV gets")
 DKV_REMOVES = METRICS.counter("h2o3_dkv_removes", "DKV removes")
 DKV_KEYS = METRICS.gauge("h2o3_dkv_keys", "resident DKV keys")
 
+# memory accounting (utils/memory.py MemoryMeter)
+DKV_BYTES = METRICS.gauge(
+    "h2o3_dkv_bytes", "resident DKV bytes by value kind "
+    "(frame/model/raw/swapped/job/other)", ("kind",))
+HOST_RSS_BYTES = METRICS.gauge(
+    "h2o3_host_rss_bytes", "process resident set size (/proc/self/status)")
+HOST_RSS_PEAK_BYTES = METRICS.gauge(
+    "h2o3_host_rss_peak_bytes", "monotonic high-water mark of host RSS")
+DEVICE_BYTES = METRICS.gauge(
+    "h2o3_device_bytes_in_use",
+    "device (HBM) bytes in use, summed over devices; from "
+    "device.memory_stats() or live-array accounting on backends without it")
+DEVICE_PEAK_BYTES = METRICS.gauge(
+    "h2o3_device_peak_bytes",
+    "monotonic high-water mark of device bytes in use")
+
 # persist layer (persist/frame_io.py, persist/model_io.py)
 PERSIST_READ_BYTES = METRICS.counter(
     "h2o3_persist_read_bytes", "bytes read by the persist layer", ("what",))

