@@ -504,6 +504,56 @@ h2o.predict <- function(object, newdata) {
   h2o.getFrame(out$predictions_frame$name)
 }
 
+# -- batched request-sized scoring (server /3/Score; docs/SERVING.md) --------
+
+.json_write <- function(x) {
+  # minimal JSON writer (the package is dependency-free; see .json_parse):
+  # named list -> object, unnamed list / length>1 vector -> array
+  if (is.factor(x)) x <- as.character(x)   # enum columns arrive as factors
+  if (is.null(x) || (length(x) == 1 && is.na(x))) return("null")
+  if (is.list(x)) {
+    nm <- names(x)
+    if (!is.null(nm) && all(nzchar(nm))) {
+      return(paste0("{", paste0(
+        vapply(nm, function(k) paste0('"', .json_escape(k), '":',
+                                      .json_write(x[[k]])), character(1)),
+        collapse = ","), "}"))
+    }
+    return(paste0("[", paste0(
+      vapply(x, .json_write, character(1)), collapse = ","), "]"))
+  }
+  if (length(x) > 1) {
+    return(paste0("[", paste0(
+      vapply(x, .json_write, character(1)), collapse = ","), "]"))
+  }
+  if (is.character(x)) return(paste0('"', .json_escape(x), '"'))
+  if (is.logical(x)) return(if (x) "true" else "false")
+  as.character(x)
+}
+
+h2o.score <- function(object, rows, columns = NULL) {
+  # request-sized scoring through the compiled, batched serving tier:
+  # `rows` is a data.frame or a list of named lists; no DKV frame
+  # round-trip. Returns the ScoreV3 payload (predictions column lists +
+  # the batch shape the request rode in).
+  model_id <- if (is.list(object) && !is.null(object$model_id)) object$model_id else object
+  if (is.data.frame(rows)) {
+    columns <- names(rows)
+    rows <- lapply(seq_len(nrow(rows)), function(i) {
+      r <- as.list(rows[i, , drop = FALSE])
+      stats::setNames(r, columns)
+    })
+  }
+  body <- list(rows = .json_write(rows))
+  if (!is.null(columns)) body$columns <- .json_write(as.character(columns))
+  .http("POST", paste0("/3/Score/", model_id), body)
+}
+
+h2o.serving <- function() {
+  # scoring-tier residency + compiled-scorer cache counters (GET /3/Score)
+  .http("GET", "/3/Score")
+}
+
 h2o.performance <- function(model, newdata = NULL) {
   if (is.null(newdata)) {
     mm <- model$json$output$training_metrics

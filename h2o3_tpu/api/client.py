@@ -126,6 +126,28 @@ class H2OClient:
                            f"/3/Predictions/models/{model_key}/frames/{frame_key}")
         return out["predictions_frame"]["name"]
 
+    def score(self, model_key: str, rows: list, columns: list | None = None) -> dict:
+        """Request-sized scoring through the batched serving tier
+        (``POST /3/Score/{model}``): ``rows`` is a list of dicts (column-
+        keyed) or a list of lists ordered by ``columns``. Returns the
+        ScoreV3 payload — ``predictions`` column lists plus the batch
+        shape this request rode in (docs/SERVING.md)."""
+        d: dict = {"rows": rows}
+        if columns:
+            d["columns"] = list(columns)
+        return self.request("POST", f"/3/Score/{model_key}", d)
+
+    def serving(self) -> dict:
+        """Scoring-tier residency + compiled-scorer cache counters
+        (``GET /3/Score``)."""
+        return self.request("GET", "/3/Score")
+
+    def serving_evict(self, model_key: str) -> bool:
+        """Drop a model's scoring residency (``DELETE /3/Score/{model}``);
+        its DKV copy stays — the next score re-admits it."""
+        return bool(self.request("DELETE",
+                                 f"/3/Score/{model_key}").get("evicted"))
+
     def rapids(self, ast: str, id: str | None = None) -> dict:
         d = {"ast": ast}
         if id:

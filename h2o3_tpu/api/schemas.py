@@ -439,6 +439,21 @@ def job_v3(job_id: str, job) -> dict:
     return d
 
 
+def score_v3(payload: dict) -> dict:
+    """``POST /3/Score/{model}`` — batched request-sized predictions:
+    ``predictions`` maps output columns (``predict``, ``p{level}``) to
+    value lists; ``batch_rows``/``batch_requests`` report how the
+    micro-batcher fused this request (docs/SERVING.md)."""
+    return {**_meta("ScoreV3"), **_clean(payload)}
+
+
+def serving_v3(stats: dict) -> dict:
+    """``GET /3/Score`` — scoring-tier state: resident models with
+    artifact bytes + request counts, residency budget, eviction count,
+    compiled-signature cache hit/miss counters, memory watermarks."""
+    return {**_meta("ServingV3"), **_clean(stats)}
+
+
 def trace_v3(trace: dict) -> dict:
     """One completed trace (``GET /3/Traces/{id}``): flat span list, the
     nested span tree, and the computed critical path — the chain of spans

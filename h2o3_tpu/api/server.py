@@ -131,19 +131,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (getattr(self, "_extra_headers", None) or {}).items():
+            self.send_header(k, v)     # e.g. Retry-After on a 503
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, msg: str):
+    def _error(self, code: int, msg: str, headers: dict | None = None):
         import time as _t
         if code >= 500:   # server faults land in the log ring (/3/Logs)
             _LOG.warning("HTTP %d on %s: %s", code, self.path, msg)
-        self._reply({"__meta": {"schema_type": "H2OErrorV3"},
-                     "http_status": code, "msg": msg, "exception_msg": msg,
-                     "timestamp": int(_t.time() * 1000),
-                     "error_url": self.path, "dev_msg": msg,
-                     "exception_type": "java.lang.RuntimeException",
-                     "values": {}, "stacktrace": []}, code)
+        self._extra_headers = headers
+        try:
+            self._reply({"__meta": {"schema_type": "H2OErrorV3"},
+                         "http_status": code, "msg": msg,
+                         "exception_msg": msg,
+                         "timestamp": int(_t.time() * 1000),
+                         "error_url": self.path, "dev_msg": msg,
+                         "exception_type": "java.lang.RuntimeException",
+                         "values": {}, "stacktrace": []}, code)
+        finally:
+            self._extra_headers = None
 
     #: non-upload request bodies are parameter payloads; cap them (the
     #: reference relies on Jetty's request limits). File content goes
@@ -294,7 +301,8 @@ class _Handler(BaseHTTPRequestHandler):
     #: an explicit request to record the call in the caller's trace
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
-        r"Logs(?:/.*)?|Memory|Metrics|Timeline|JStack|WaterMeter[^/]*(?:/\d+)?|"
+        r"Logs(?:/.*)?|Memory|Metrics|Score|Timeline|JStack|"
+        r"WaterMeter[^/]*(?:/\d+)?|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
 
     def _route(self, method: str):
@@ -647,9 +655,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "JobsV3"}})
 
     def r_predict(self, model_key, frame_key):
+        # fetch under the read lock (a delete that already won must 404),
+        # but SCORE outside it: scoring is read-only over refs this thread
+        # now holds, and keeping the lock would serialize concurrent
+        # predictions against the same model for no protection in return
         with LOCKS.read(model_key, frame_key):
             m, fr = DKV[model_key], DKV[frame_key]
-            pred = m.predict(fr)
+        pred = m.predict(fr)
         dest = f"prediction_{uuid.uuid4().hex[:8]}"
         pred.key = dest
         DKV.put(dest, pred)
@@ -668,10 +680,12 @@ class _Handler(BaseHTTPRequestHandler):
 
         def driver(j: Job):
             # fetch INSIDE the lock: a delete that wins the race must 404
-            # this job, not be resurrected by a stale reference
+            # this job, not be resurrected by a stale reference. The predict
+            # itself runs OUTSIDE — it is read-only over refs held here, and
+            # concurrent predictions must not serialize on the key lock
             with LOCKS.read(model_key, frame_key):
                 m, fr = DKV[model_key], DKV[frame_key]
-                pred = m.predict(fr)
+            pred = m.predict(fr)
             pred.key = dest
             DKV.put(dest, pred)
             return pred
@@ -679,6 +693,60 @@ class _Handler(BaseHTTPRequestHandler):
         job.run(driver, background=False)
         self._reply({"__meta": {"schema_type": "JobV4"},
                      "job": schemas.job_v3(job.key, job)})
+
+    # -- scoring tier (serving/; docs/SERVING.md) ---------------------------
+
+    def r_score(self, model_key):
+        """``POST /3/Score/{model}`` — request-sized scoring: JSON rows in,
+        predictions out, no DKV frame round-trip. Concurrent requests for
+        one model are fused into one device dispatch by the micro-batcher;
+        compiled executables are cached per (model, shape, batch-bucket).
+        Over the residency budget the reply is 503 + Retry-After, never an
+        OOM (docs/SERVING.md)."""
+        from h2o3_tpu.serving import (SCORING, NotServable,
+                                      ServiceUnavailable)
+        p = self._params()
+        try:
+            rows = p.get("rows")
+            if isinstance(rows, str):
+                rows = json.loads(rows)
+            columns = p.get("columns")
+            if isinstance(columns, str):
+                columns = _parse_list(columns)
+        except (json.JSONDecodeError, ValueError) as e:
+            self._error(400, f"rows is not valid JSON: {e}")
+            return
+        try:
+            out = SCORING.score(model_key, rows, columns)
+        except ServiceUnavailable as e:
+            retry_s = max(1, int(round(e.retry_after_ms / 1000.0)))
+            self._error(503, str(e), headers={
+                "Retry-After": str(retry_s),
+                "X-Retry-After-Ms": str(e.retry_after_ms)})
+            return
+        except (NotServable, ValueError) as e:
+            self._error(400, str(e))
+            return
+        self._reply(schemas.score_v3(out))
+
+    def r_score_stats(self):
+        """``GET /3/Score`` — scoring-tier residency and cache counters:
+        resident models (bytes/requests/idle), budget, evictions, compiled-
+        signature hit/miss counts, memory watermarks."""
+        from h2o3_tpu.serving import SCORING
+        self._reply(schemas.serving_v3(SCORING.stats()))
+
+    def r_score_evict(self, model_key):
+        """``DELETE /3/Score/{model}`` — drop a model's scoring residency
+        (compiled signatures + batcher); its DKV copy is untouched."""
+        from h2o3_tpu.serving import SCORING, ServiceUnavailable
+        try:
+            evicted = SCORING.evict(model_key)
+        except ServiceUnavailable as e:
+            self._error(503, str(e), headers={"Retry-After": "1"})
+            return
+        self._reply({"__meta": {"schema_type": "ScoreV3"},
+                     "evicted": bool(evicted), "model": model_key})
 
     def r_rapids(self):
         p = self._params()
@@ -1680,6 +1748,9 @@ _ROUTES = [
     (r"/3/Jobs/([^/]+)/cancel", "POST", _Handler.r_job_cancel),
     (r"/3/Predictions/models/([^/]+)/frames/([^/]+)", "POST", _Handler.r_predict),
     (r"/4/Predictions/models/([^/]+)/frames/([^/]+)", "POST", _Handler.r_predict_v4),
+    (r"/3/Score/([^/]+)", "POST", _Handler.r_score),
+    (r"/3/Score", "GET", _Handler.r_score_stats),
+    (r"/3/Score/([^/]+)", "DELETE", _Handler.r_score_evict),
     (r"/99/Rapids", "POST", _Handler.r_rapids),
     (r"/99/Grid/([^/]+)", "POST", _Handler.r_grid),
     (r"/99/Grids/([^/]+)", "GET", _Handler.r_grid_get),

@@ -107,6 +107,10 @@ def main(args=None) -> int:
     if ns.coordinator is not None:
         from h2o3_tpu.parallel.distributed import init_distributed
         init_distributed(ns.coordinator, ns.num_processes, ns.process_id)
+    # persistent XLA compile cache (H2O3TPU_COMPILE_CACHE=1|path): every
+    # process in the cloud shares recompile savings across launches
+    from h2o3_tpu.utils import compile_cache
+    compile_cache.enable()
     if ns.serve:
         import jax
         from h2o3_tpu.api import H2OServer

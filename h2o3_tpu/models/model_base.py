@@ -131,13 +131,7 @@ class Model:
         n = frame.nrows
         if not self.is_classifier:
             return Frame(["predict"], [Vec.from_device(raw, n, VecType.NUM)])
-        thr = getattr(self, "_default_threshold", None)
-        if thr is not None and self.nclasses == 2:
-            # reset-able binomial decision threshold (reference:
-            # AstModelResetThreshold / defaultThreshold); argmax == 0.5
-            labels = (raw[:, 1] >= float(thr)).astype(jnp.int32)
-        else:
-            labels = jnp.argmax(raw, axis=1).astype(jnp.int32)
+        labels = decision_labels(self, raw).astype(jnp.int32)
         names = ["predict"] + [f"p{d}" for d in self.response_domain]
         vecs = [Vec.from_device(labels, n, VecType.CAT, domain=self.response_domain)]
         for k in range(self.nclasses):
@@ -185,6 +179,19 @@ class Model:
         if self.cross_validation_metrics:
             lines.append(f"  cv:    {self.cross_validation_metrics!r}")
         return "\n".join(lines)
+
+
+def decision_labels(model, raw):
+    """Class labels from raw ``[n, K]`` probabilities — THE one home of the
+    reset-able binomial decision threshold (reference:
+    ``AstModelResetThreshold`` / ``defaultThreshold``; argmax == 0.5) vs
+    argmax choice. Array-agnostic (numpy or jax input, same-kind output):
+    ``Model.predict`` and the serving tier's batched finalizer both call
+    here, so the two paths cannot drift."""
+    thr = getattr(model, "_default_threshold", None)
+    if thr is not None and getattr(model, "nclasses", 0) == 2:
+        return raw[:, 1] >= float(thr)
+    return raw.argmax(axis=1)
 
 
 def compute_metrics(raw: jax.Array, y: jax.Array, mask: jax.Array, nclasses: int):

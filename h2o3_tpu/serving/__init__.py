@@ -1,0 +1,17 @@
+"""Serving tier — compiled, batched, multi-model scoring (docs/SERVING.md).
+
+The production scoring path (ROADMAP item 2): ``/3/Score/{model}`` takes
+row payloads (no DKV frame round-trip), the micro-batcher fuses concurrent
+requests into one device dispatch, the ScorerCache keeps one compiled
+executable per (model, signature, batch-bucket), and multi-model residency
+is byte-accounted with LRU eviction under a budget.
+"""
+
+from h2o3_tpu.serving.batcher import ModelBatcher
+from h2o3_tpu.serving.schema import NotServable, ServingSchema, serving_schema
+from h2o3_tpu.serving.scorer import CompiledScorer, ScorerCache, bucket_for
+from h2o3_tpu.serving.service import SCORING, ScoringService, ServiceUnavailable
+
+__all__ = ["SCORING", "ScoringService", "ServiceUnavailable", "ScorerCache",
+           "CompiledScorer", "ModelBatcher", "ServingSchema", "NotServable",
+           "serving_schema", "bucket_for"]

@@ -1,0 +1,130 @@
+"""ScorerCache — one compiled executable per (model, signature, bucket).
+
+Reference template (PAPERS.md, the TensorFlow-serving design): compile a
+model's inference program once per input signature and keep the warm
+executable; arbitrary request sizes land in padded power-of-two batch
+buckets so the steady state never recompiles. The scorer body is the
+model's existing :meth:`Model._score_raw` — the same jitted batch program
+training-side scoring uses — traced over a frame REBUILT from raw request
+columns (:meth:`ServingSchema.build_frame`), so the serving path cannot
+drift from ``model.predict``.
+
+Signatures are ``(model identity, n_num, n_cat, dtype, bucket)``. A hit
+returns the warm executable (counted — the bench and tests assert the
+second same-shape request compiles nothing); a miss traces + compiles
+eagerly via ``jit(...).lower(...).compile()`` so compile cost is paid at
+miss time, never mid-batch. Models whose ``_score_raw`` cannot trace
+(host-side branches on data) fall back to an eager scorer — still batched,
+still correct, just not fused into one executable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+
+from h2o3_tpu.serving.schema import ServingSchema
+from h2o3_tpu.utils import telemetry as _tm
+
+#: requests larger than the max bucket are scored in max-bucket slices
+MAX_BUCKET = int(os.environ.get("H2O3TPU_SCORE_MAX_BUCKET", "4096"))
+
+#: smallest bucket — tiny interactive requests share one executable
+MIN_BUCKET = 8
+
+
+def bucket_for(n: int) -> int:
+    """Smallest power-of-two bucket holding ``n`` rows (clamped to
+    [MIN_BUCKET, MAX_BUCKET])."""
+    b = MIN_BUCKET
+    while b < n and b < MAX_BUCKET:
+        b <<= 1
+    return b
+
+
+class CompiledScorer:
+    """One signature's executable: ``score(num, cat)`` over padded host
+    arrays returns host predictions ([bucket] or [bucket, K])."""
+
+    __slots__ = ("bucket", "mode", "_fn")
+
+    def __init__(self, model, schema: ServingSchema, bucket: int):
+        self.bucket = bucket
+
+        def raw_fn(num, cat):
+            frame = schema.build_frame(num, cat, bucket)
+            return model._score_raw(frame)
+
+        num_spec = jax.ShapeDtypeStruct((bucket, len(schema.num_cols)),
+                                        np.float32)
+        cat_spec = jax.ShapeDtypeStruct((bucket, len(schema.cat_cols)),
+                                        np.int32)
+        try:
+            self._fn = jax.jit(raw_fn).lower(num_spec, cat_spec).compile()
+            self.mode = "compiled"
+        except Exception:   # noqa: BLE001 — host-side branches in _score_raw
+            self._fn = raw_fn
+            self.mode = "eager"
+
+    def score(self, num: np.ndarray, cat: np.ndarray) -> np.ndarray:
+        return np.asarray(jax.device_get(self._fn(num, cat)))
+
+
+class ScorerCache:
+    """Thread-safe signature → :class:`CompiledScorer` cache with LRU-able
+    per-model grouping (evicting a model drops all its signatures)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (model_token, n_num, n_cat, dtype, bucket) -> CompiledScorer
+        self._entries: dict[tuple, CompiledScorer] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _signature(model, schema: ServingSchema, bucket: int) -> tuple:
+        # id(model) versions the cache: a reloaded model under the same DKV
+        # key is a new object and must recompile against its new arrays
+        return (getattr(model, "key", None), id(model),
+                len(schema.num_cols), len(schema.cat_cols), "f32i32", bucket)
+
+    def get(self, model, schema: ServingSchema, bucket: int) -> CompiledScorer:
+        sig = self._signature(model, schema, bucket)
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is not None:
+                self.hits += 1
+                _tm.SCORER_CACHE.labels(event="hit").inc()
+                return entry
+        # compile OUTSIDE the cache lock: a cold signature must not stall
+        # warm-signature scorers for the seconds a trace+compile takes
+        entry = CompiledScorer(model, schema, bucket)
+        with self._lock:
+            won = self._entries.setdefault(sig, entry)
+            self.misses += 1
+            _tm.SCORER_CACHE.labels(event="miss").inc()
+        return won
+
+    def drop_model(self, model) -> int:
+        """Evict every signature of ``model``; returns how many dropped."""
+        token = (getattr(model, "key", None), id(model))
+        with self._lock:
+            victims = [s for s in self._entries if s[:2] == token]
+            for s in victims:
+                del self._entries[s]
+            if victims:
+                _tm.SCORER_CACHE.labels(event="evict").inc(len(victims))
+            return len(victims)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"signatures": len(self._entries),
+                    "hits": self.hits, "misses": self.misses}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = 0

@@ -1,0 +1,283 @@
+"""ScoringService — multi-model residency with byte-accounted admission.
+
+Reference composite (PAPERS.md): TensorFlow-serving's compile-once/serve-
+many lifecycle plus Clipper-style multi-model residency — many models
+share the device, cold ones are evicted by bytes, and an over-budget
+request degrades to a retryable error instead of an OOM.
+
+A model scored through ``/3/Score`` becomes *resident*: its serving schema
+is derived once, a :class:`ModelBatcher` worker owns its request queue,
+and its compiled signatures accumulate in the shared
+:class:`ScorerCache`. Residency is byte-accounted with the same measure
+``/3/Memory`` reports per DKV key (``value_kind_bytes`` — the PR-5
+MemoryMeter's artifact-size walk): admission of a cold model under a
+configured budget (``H2O3TPU_SERVE_BUDGET_BYTES``) LRU-evicts idle
+resident models first, and when nothing evictable remains the request
+gets :class:`ServiceUnavailable` — the REST layer maps it to
+``503 + Retry-After`` rather than letting the device OOM. Models with
+in-flight batches are never evicted. Eviction drops the scorer-cache
+signatures and the worker thread; the DKV copy is untouched (that *is*
+the cold tier — the next request re-admits it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from h2o3_tpu.serving.batcher import Evicted, ModelBatcher
+from h2o3_tpu.serving.schema import NotServable, serving_schema
+from h2o3_tpu.serving.scorer import ScorerCache
+from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.memory import MEMORY, value_kind_bytes
+from h2o3_tpu.utils.registry import DKV
+
+
+class ServiceUnavailable(RuntimeError):
+    """Admission refused under the residency budget (HTTP 503 + retry)."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 1000):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class _Resident:
+    """One resident model: schema + batcher + byte accounting."""
+
+    __slots__ = ("key", "model", "schema", "cache", "batcher", "nbytes",
+                 "last_used", "requests")
+
+    def __init__(self, key: str, model, schema, cache: ScorerCache,
+                 nbytes: int):
+        self.key = key
+        self.model = model
+        self.schema = schema
+        self.cache = cache
+        self.nbytes = nbytes     # computed once by the admitting caller
+        self.last_used = time.monotonic()
+        self.requests = 0
+        self.batcher = ModelBatcher(self)
+
+
+class ScoringService:
+    """Process-wide scoring tier (singleton :data:`SCORING`)."""
+
+    def __init__(self, budget_bytes: int | None = None):
+        env = os.environ.get("H2O3TPU_SERVE_BUDGET_BYTES")
+        #: residency budget in artifact bytes; None = unlimited (no eviction)
+        self.budget_bytes = budget_bytes if budget_bytes is not None else (
+            int(env) if env else None)
+        self._lock = threading.RLock()
+        self._resident: dict[str, _Resident] = {}
+        self.cache = ScorerCache()
+        self.evictions = 0
+
+    # -- scoring -------------------------------------------------------------
+
+    def score(self, model_key: str, rows, columns=None) -> dict:
+        """Score JSON ``rows`` against ``model_key`` through the batched
+        path; returns the ``/3/Score`` payload dict."""
+        t0 = time.perf_counter()
+        if not isinstance(rows, (list, tuple)) or not rows:
+            # reject before admission: an invalid request must not be able
+            # to churn residency (evicting warm models under a budget) for
+            # rows that could never score
+            raise ValueError("rows must be a non-empty JSON array")
+        try:
+            entry = self._admit(model_key)
+        except Exception:
+            # admission failures (404 / unservable / over budget) must move
+            # the error counter too, or a failing tier reads healthy; the
+            # algo is unknown before admission — one bounded label value
+            _tm.SCORE_REQUESTS.labels(algo="unknown", status="error").inc()
+            raise
+        algo = getattr(entry.model, "algo", "model")
+        try:
+            # an eviction can race the window between _admit releasing the
+            # service lock and submit() enqueueing (budgeted admit of
+            # another model, or a key re-put): transient — re-admit once
+            # rather than surfacing a server error
+            for attempt in (0, 1):
+                num, cat = entry.schema.adapt_rows(rows, columns)
+                try:
+                    pending = entry.batcher.submit(num, cat, len(rows))
+                    break
+                except TimeoutError as e:
+                    # a queue that never drained within the wait ceiling is
+                    # a load condition: retryable 503, not a server fault
+                    raise ServiceUnavailable(str(e)) from None
+                except Evicted:
+                    if attempt:
+                        raise ServiceUnavailable(
+                            f"{model_key!r} keeps losing residency under "
+                            "the budget; retry shortly")
+                    with self._lock:
+                        # a stopped batcher can never serve again: drop the
+                        # entry if it somehow remained resident, so the
+                        # re-admit below builds a fresh one
+                        if self._resident.get(model_key) is entry:
+                            self._evict_locked(entry)
+                    entry = self._admit(model_key)
+            out = _finalize(entry.model, pending.result, len(rows))
+        except Exception:
+            _tm.SCORE_REQUESTS.labels(algo=algo, status="error").inc()
+            raise
+        out.update(model=model_key, rows=len(rows),
+                   batch_rows=pending.batch_rows,
+                   batch_requests=pending.batch_requests)
+        _tm.SCORE_REQUESTS.labels(algo=algo, status="ok").inc()
+        _tm.SCORE_SECONDS.labels(algo=algo).observe(time.perf_counter() - t0)
+        return out
+
+    # -- residency / admission ----------------------------------------------
+
+    def _admit(self, model_key: str) -> _Resident:
+        with self._lock:
+            entry = self._resident.get(model_key)
+            if entry is not None and entry.model is DKV.get(model_key):
+                entry.last_used = time.monotonic()
+                entry.requests += 1
+                return entry
+        # cold path: the heavy work — artifact byte walk + schema/level-map
+        # derivation — runs OUTSIDE the service lock so warm-path scorers
+        # of other models never stall behind an admission (same reason
+        # ScorerCache compiles outside its lock); re-checked under the lock
+        # below since a concurrent admit may have won
+        model = DKV[model_key]         # KeyError → 404 upstream
+        if not hasattr(model, "_score_raw"):
+            raise NotServable(f"{model_key!r} is not a scorable model")
+        incoming = value_kind_bytes(model)[1]
+        schema = serving_schema(model)
+        with self._lock:
+            entry = self._resident.get(model_key)
+            if entry is not None and entry.model is model:
+                entry.last_used = time.monotonic()
+                entry.requests += 1
+                return entry           # concurrent admit won the race
+            if entry is not None:      # key re-put: stale resident copy
+                self._evict_locked(entry)
+            self._make_room_locked(incoming, model_key)
+            entry = _Resident(model_key, model, schema, self.cache, incoming)
+            self._resident[model_key] = entry
+            entry.requests += 1
+            self._export_locked()
+            return entry
+
+    def _make_room_locked(self, incoming: int, for_key: str) -> None:
+        if self.budget_bytes is None:
+            return
+        if incoming > self.budget_bytes:
+            # no amount of eviction can ever fit it: a terminal client
+            # error, not a 503 a well-behaved retrier would loop on forever
+            raise NotServable(
+                f"{for_key!r} needs {incoming} artifact bytes but the "
+                f"residency budget is {self.budget_bytes}; raise "
+                "H2O3TPU_SERVE_BUDGET_BYTES to serve this model")
+        def resident_bytes():   # noqa: E306
+            return sum(e.nbytes for e in self._resident.values())
+        if resident_bytes() + incoming <= self.budget_bytes:
+            return
+        # LRU eviction of IDLE models only: a model with queued requests or
+        # a batch on the device is hot by definition. Feasibility first —
+        # evicting warm signatures for a request that 503s anyway would
+        # make an infeasible admission also destroy working residents.
+        victims = [v for v in sorted(self._resident.values(),
+                                     key=lambda e: e.last_used)
+                   if v.key != for_key and not v.batcher.busy()]
+        evictable = sum(v.nbytes for v in victims)
+        if resident_bytes() - evictable + incoming > self.budget_bytes:
+            raise ServiceUnavailable(
+                f"scoring tier over budget: {incoming} artifact bytes for "
+                f"{for_key!r} do not fit in "
+                f"{self.budget_bytes} with {len(self._resident)} resident "
+                "model(s) busy; retry shortly")
+        for v in victims:
+            self._evict_locked(v)
+            if resident_bytes() + incoming <= self.budget_bytes:
+                return
+
+    def _evict_locked(self, entry: _Resident) -> None:
+        self._resident.pop(entry.key, None)       # graftlint: ok(caller holds self._lock — _locked suffix contract)
+        entry.batcher.stop()
+        self.cache.drop_model(entry.model)
+        self.evictions += 1                        # graftlint: ok(caller holds self._lock — _locked suffix contract)
+        self._export_locked()
+
+    def _export_locked(self) -> None:
+        _tm.SCORE_RESIDENT_MODELS.set(len(self._resident))
+        _tm.SCORE_RESIDENT_BYTES.set(
+            sum(e.nbytes for e in self._resident.values()))
+
+    def evict(self, model_key: str) -> bool:
+        """Explicit eviction (REST DELETE + tests)."""
+        with self._lock:
+            entry = self._resident.get(model_key)
+            if entry is None:
+                return False
+            if entry.batcher.busy():
+                raise ServiceUnavailable(
+                    f"{model_key!r} has in-flight batches; retry")
+            self._evict_locked(entry)
+            return True
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``GET /3/Score`` payload: residency + cache counters; the
+        device/host watermarks ride along so admission decisions can be
+        read against the same numbers ``/3/Memory`` serves."""
+        with self._lock:
+            resident = [{"model": e.key,
+                         "algo": getattr(e.model, "algo", "model"),
+                         "bytes": e.nbytes, "requests": e.requests,
+                         "idle_secs": round(time.monotonic() - e.last_used, 3)}
+                        for e in sorted(self._resident.values(),
+                                        key=lambda e: -e.last_used)]
+            budget = self.budget_bytes
+            evictions = self.evictions
+        return {"resident": resident,
+                "resident_bytes": sum(r["bytes"] for r in resident),
+                "budget_bytes": budget, "evictions": evictions,
+                "cache": self.cache.stats(),
+                "watermarks": MEMORY.watermarks}
+
+    def reset(self) -> None:
+        """Evict everything and zero counters (tests + shutdown). The
+        cache clears wholesale — no per-model drops, which would inflate
+        the ``evict`` telemetry counter with non-budget evictions."""
+        with self._lock:
+            for entry in list(self._resident.values()):
+                entry.batcher.stop()
+            self._resident.clear()
+            self.cache.clear()
+            self.evictions = 0
+            self._export_locked()
+
+
+def _finalize(model, raw, n: int) -> dict:
+    """Raw device predictions → the response payload, mirroring
+    :meth:`Model.predict` exactly (labels via the resettable binomial
+    threshold / argmax, ``p{level}`` probability columns) so batched REST
+    results are bit-identical to the frame path."""
+    import numpy as np
+
+    from h2o3_tpu.models.model_base import decision_labels
+    raw = np.asarray(raw)[:n]
+    nclasses = getattr(model, "nclasses", 0)
+    if not nclasses or nclasses < 2 or raw.ndim != 2:
+        if raw.ndim == 2:       # multi-output regression (PCA/GLRM shapes)
+            return {"predictions": {f"predict_{k}": raw[:, k].tolist()
+                                    for k in range(raw.shape[1])}}
+        return {"predictions": {"predict": raw.tolist()}}
+    labels = np.asarray(decision_labels(model, raw)).astype(np.int64)
+    domain = list(getattr(model, "response_domain", None)
+                  or [str(k) for k in range(raw.shape[1])])
+    preds = {"predict": [domain[int(c)] for c in labels]}
+    for k, lvl in enumerate(domain[: raw.shape[1]]):
+        preds[f"p{lvl}"] = raw[:, k].tolist()
+    return {"predictions": preds}
+
+
+#: the process-wide scoring tier (reference: the serving sidecar singleton)
+SCORING = ScoringService()

@@ -1,0 +1,101 @@
+"""Persistent XLA compilation cache — one switch, observable hit/miss counts.
+
+The standard TPU production setup: ``jax_compilation_cache_dir`` persists
+compiled executables across processes, so repeated same-shape programs
+(an AutoML leaderboard's many model configs, every serving cold start)
+stop paying compile time. The r04→r05 ``automl_leaderboard_100k`` wobble
+(32.6s→42.2s) is mostly recompiles — ROADMAP item 5's compile-cache down
+payment lives here.
+
+Behavior is controlled by ``H2O3TPU_COMPILE_CACHE``:
+
+- unset → caller's default (``enable()`` is opt-in; ``bench.py`` and
+  session init pass ``default_on=True``/``False`` respectively);
+- ``0``/``off`` → disabled;
+- ``1``/``on`` → enabled at the default directory
+  (``~/.cache/h2o3_tpu/jax`` or ``$XDG_CACHE_HOME``);
+- any other value → enabled at that path.
+
+Hit/miss counts come from JAX's own monitoring events
+(``/jax/compilation_cache/cache_hits`` / ``cache_misses``), registered
+once at enable time; :func:`stats` snapshots them plus the on-disk entry
+count so bench artifacts can carry cache effectiveness per round.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_state = {"enabled": False, "dir": None, "hits": 0, "misses": 0,
+          "listener": False}
+
+
+def _default_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "h2o3_tpu", "jax")
+
+
+def _on_event(event: str, **_kw) -> None:
+    # cache_misses arrives as a duration event on some jax versions and a
+    # plain event on others; both funnel here
+    if event == "/jax/compilation_cache/cache_hits":
+        with _lock:
+            _state["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        with _lock:
+            _state["misses"] += 1
+
+
+def enable(cache_dir: str | None = None, *, default_on: bool = False,
+           min_compile_secs: float = 1.0) -> bool:
+    """Configure the persistent compile cache per the env policy above.
+    Returns True when the cache is active. Idempotent; never raises (an
+    old jax without the feature simply reports disabled)."""
+    env = os.environ.get("H2O3TPU_COMPILE_CACHE", "").strip()
+    if env.lower() in ("0", "off", "false"):
+        return False
+    if not env and not default_on and cache_dir is None:
+        return False
+    if env and env.lower() not in ("1", "on", "true"):
+        cache_dir = env
+    cache_dir = cache_dir or _default_dir()
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception:   # noqa: BLE001 — older jax: feature absent
+        return False
+    with _lock:
+        _state["enabled"] = True
+        _state["dir"] = cache_dir
+        if not _state["listener"]:
+            try:
+                from jax._src import monitoring as _mon
+                _mon.register_event_listener(
+                    lambda event, **kw: _on_event(event, **kw))
+                _mon.register_event_duration_secs_listener(
+                    lambda event, _dur, **kw: _on_event(event, **kw))
+                _state["listener"] = True
+            except Exception:   # noqa: BLE001 — private API may move
+                pass
+    return True
+
+
+def stats() -> dict:
+    """{enabled, dir, entries, hits, misses} — ``entries`` counts on-disk
+    cache files (an absolute view; hits/misses are this process only)."""
+    with _lock:
+        out = {"enabled": _state["enabled"], "dir": _state["dir"],
+               "hits": _state["hits"], "misses": _state["misses"]}
+    entries = 0
+    if out["dir"]:
+        try:
+            entries = sum(1 for _ in os.scandir(out["dir"]))
+        except OSError:
+            pass
+    out["entries"] = entries
+    return out

@@ -453,3 +453,31 @@ ITER_SECONDS = METRICS.histogram(
 # fault injection (utils/timeline.py FaultInjector)
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))
+
+# scoring tier (serving/ — docs/SERVING.md). Batch-size buckets are row
+# counts (the micro-batcher's power-of-two buckets), not seconds.
+SCORE_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                       256.0, 512.0, 1024.0, 2048.0, 4096.0)
+SCORE_REQUESTS = METRICS.counter(
+    "h2o3_score_requests", "scoring requests served by /3/Score",
+    ("algo", "status"))
+SCORE_SECONDS = METRICS.histogram(
+    "h2o3_score_seconds",
+    "end-to-end /3/Score request latency (enqueue -> slice handed back)",
+    ("algo",))
+SCORE_BATCH_SIZE = METRICS.histogram(
+    "h2o3_score_batch_size",
+    "rows fused into one scoring dispatch by the micro-batcher",
+    buckets=SCORE_BATCH_BUCKETS)
+SCORE_BATCH_REQUESTS = METRICS.histogram(
+    "h2o3_score_batch_requests",
+    "concurrent requests coalesced per scoring dispatch",
+    buckets=SCORE_BATCH_BUCKETS)
+SCORER_CACHE = METRICS.counter(
+    "h2o3_scorer_cache",
+    "compiled-scorer signature cache events (hit/miss/evict)", ("event",))
+SCORE_RESIDENT_BYTES = METRICS.gauge(
+    "h2o3_score_resident_bytes",
+    "artifact bytes of models resident in the scoring tier")
+SCORE_RESIDENT_MODELS = METRICS.gauge(
+    "h2o3_score_resident_models", "models resident in the scoring tier")
