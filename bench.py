@@ -603,30 +603,15 @@ def _lint_gate() -> None:
         sys.exit(3)
 
 
-def _resolve_vs_baseline(out: dict) -> None:
-    """Baseline continuity (BENCH_r05 stamped ``vs_baseline: null``): a TPU
-    run rates against the per-chip anchor; a CPU run must NEVER read as an
-    anchor ratio (VERDICT r4 weak #6), so it rates against the most recent
-    PRIOR ARTIFACT on the same backend instead — the trajectory stays
-    comparable round over round whatever hardware the round drew.
-    ``baseline_source`` names which comparator was used."""
-    backend = out["extra"]["backend"]
-    if SMOKE:
-        out["vs_baseline"] = None      # toy-scale numbers rate nothing
-        out["baseline_source"] = "none (smoke mode)"
-        return
-    if backend != "cpu" and not CPU_FALLBACK:
-        out["baseline_source"] = \
-            f"anchor {ANCHOR_ROWS_PER_SEC:.1e} rows*trees/sec/chip"
-        return                         # anchor ratio already stamped
+def _latest_prior_artifact(backend: str):
+    """(filename, artifact-dict) of the most recent prior ``BENCH_r*.json``
+    stamped on the same backend (honoring H2O3TPU_BENCH_BASELINE_EXCLUDE so
+    a re-run never self-compares), or ``(None, None)``. Shared by the
+    vs_baseline continuity path and the dispatch-audit regression gate."""
     import glob
     import re
     here = os.path.dirname(os.path.abspath(__file__))
-    prior = None
-    # a manual RE-run after the driver already stamped this round's file
-    # would otherwise self-compare (ratio ~1.0 masking a regression):
-    # baseline_source names the comparator so that reads loudly, and the
-    # rerunner can exclude the current round's file explicitly
+    prior = (None, None)
     exclude = os.environ.get("H2O3TPU_BENCH_BASELINE_EXCLUDE", "")
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
                        key=lambda p: [int(s) for s in re.findall(r"\d+", p)]):
@@ -644,14 +629,88 @@ def _resolve_vs_baseline(out: dict) -> None:
         ext = art.get("extra") or {}
         if isinstance(val, (int, float)) and val > 0 \
                 and ext.get("backend") == backend:
-            prior = (os.path.basename(path), float(val))
-    if prior is None:
+            prior = (os.path.basename(path), art)
+    return prior
+
+
+def _resolve_vs_baseline(out: dict) -> None:
+    """Baseline continuity (BENCH_r05 stamped ``vs_baseline: null``): a TPU
+    run rates against the per-chip anchor; a CPU run must NEVER read as an
+    anchor ratio (VERDICT r4 weak #6), so it rates against the most recent
+    PRIOR ARTIFACT on the same backend instead — the trajectory stays
+    comparable round over round whatever hardware the round drew.
+    ``baseline_source`` names which comparator was used."""
+    backend = out["extra"]["backend"]
+    if SMOKE:
+        out["vs_baseline"] = None      # toy-scale numbers rate nothing
+        out["baseline_source"] = "none (smoke mode)"
+        return
+    if backend != "cpu" and not CPU_FALLBACK:
+        out["baseline_source"] = \
+            f"anchor {ANCHOR_ROWS_PER_SEC:.1e} rows*trees/sec/chip"
+        return                         # anchor ratio already stamped
+    # a manual RE-run after the driver already stamped this round's file
+    # would otherwise self-compare (ratio ~1.0 masking a regression):
+    # baseline_source names the comparator so that reads loudly, and the
+    # rerunner can exclude the current round's file explicitly
+    fname, art = _latest_prior_artifact(backend)
+    if art is None:
         out["vs_baseline"] = None
         out["baseline_source"] = f"none (no prior {backend} artifact)"
         return
-    fname, pval = prior
+    pval = float(art["value"])
     out["vs_baseline"] = round(out["value"] / pval, 3)
     out["baseline_source"] = f"{fname} ({backend} prior artifact, {pval})"
+
+
+def _dispatch_audit_section(backend: str) -> dict:
+    """Host-sync economy of the convergence loops this bench just ran:
+    blocking device→host fetches per logical iteration (GLM IRLS iteration,
+    GBM boosting round, DL epoch), read from the
+    ``h2o3_dispatches_per_iteration`` gauges the drivers publish, with a
+    ``vs_prior`` comparison against the latest prior same-backend artifact
+    so the CPU trajectory keeps rating the sync economy round over round."""
+    from h2o3_tpu.utils.telemetry import DISPATCHES_PER_ITER
+    current = {labels["loop"]: round(child.value, 4)
+               for labels, child in DISPATCHES_PER_ITER.children()}
+    sec: dict = {"syncs_per_step": current}
+    fname, art = _latest_prior_artifact(backend)
+    prior = ((art or {}).get("extra") or {}).get("dispatch_audit") or {}
+    prior_steps = prior.get("syncs_per_step") or {}
+    if prior_steps:
+        sec["vs_prior"] = {
+            loop: {"prior": prior_steps[loop], "current": cur,
+                   "ratio": round(cur / max(prior_steps[loop], 1e-9), 3)}
+            for loop, cur in current.items() if loop in prior_steps}
+        sec["baseline_source"] = fname
+    else:
+        sec["vs_prior"] = None
+        sec["baseline_source"] = (f"none (no prior {backend} artifact with "
+                                  "a dispatch audit)")
+    return sec
+
+
+def _dispatch_gate(out: dict) -> None:
+    """Refuse to stamp a real-run artifact whose syncs-per-step count
+    REGRESSED versus the previous same-backend round: a loop paying more
+    blocking host fetches per iteration than it used to means a
+    per-iteration fetch crept back into a hot path — exactly what the
+    megastep refactor (ISSUE 7) exists to prevent."""
+    if SMOKE:
+        return          # toy scale proves artifact shape only
+    audit = (out["extra"].get("dispatch_audit") or {})
+    regressed = [
+        (loop, cmp["prior"], cmp["current"])
+        for loop, cmp in (audit.get("vs_prior") or {}).items()
+        if cmp["current"] > cmp["prior"] + 1e-6]
+    if regressed:
+        for loop, prior, cur in regressed:
+            print(f"# dispatch regression: {loop} now pays {cur} host "
+                  f"syncs/step (prior round: {prior})", file=sys.stderr)
+        print(f"# bench REFUSED: {len(regressed)} loop(s) regressed their "
+              "syncs-per-step vs the prior same-backend artifact",
+              file=sys.stderr)
+        sys.exit(3)
 
 
 def main() -> None:
@@ -737,6 +796,12 @@ def main() -> None:
             f"TPU unavailable ({CPU_FALLBACK}); CPU at reduced scale — "
             "NOT comparable to per-chip baselines")
     _resolve_vs_baseline(out)
+    # dispatch accounting: blocking host syncs per GLM iteration / GBM round
+    # / DL epoch, gated against the prior same-backend round (ISSUE 7 — a
+    # reintroduced per-iteration fetch refuses to stamp)
+    out["extra"]["dispatch_audit"] = _dispatch_audit_section(
+        out["extra"]["backend"])
+    _dispatch_gate(out)
     # serving path: score_qps through the compiled/batched /3/Score tier
     # vs the per-request predict path (ISSUE 6: the scoring tier gets the
     # same perf trajectory the training path has)

@@ -1723,6 +1723,7 @@ class _Handler(BaseHTTPRequestHandler):
         import jax
         import jax.numpy as jnp
         t0 = _t.time()
+        # graftlint: ok(latency endpoint — the sync IS the measurement)
         jax.block_until_ready(jnp.sum(jnp.ones(1024)))
         dt = (_t.time() - t0) * 1e3
         self._reply({"__meta": {"schema_type": "NetworkTestV3"},

@@ -39,7 +39,8 @@ from h2o3_tpu.frame.types import VecType
 from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.models.data_info import DataInfo, response_as_float
 from h2o3_tpu.models.job import Job
-from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, make_model_key,
+                                        megastep_k, publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 
@@ -111,10 +112,10 @@ def _row_loss(out, y, w, loss: str, nclasses: int, huber_delta: float):
 # one jitted training "iteration": scan over minibatches
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("act", "loss", "nclasses", "cfg"))
-def _train_epoch(params, opt, Xb, yb, wb, key, samples0,
+def _epoch_steps(params, opt, Xb, yb, wb, key, samples0,
                  act: str, loss: str, nclasses: int, cfg: tuple):
-    """Scan all minibatches of one (shuffled) epoch.
+    """Scan all minibatches of one (shuffled) epoch — the traceable body
+    the K-epoch megastep scan runs per epoch.
 
     Xb: [nb, B, K] minibatched design matrix, yb: [nb, B], wb: [nb, B].
     cfg is a hashable tuple of hyperparameters (see _fit for layout).
@@ -189,6 +190,39 @@ def _train_epoch(params, opt, Xb, yb, wb, key, samples0,
     (params, opt, key, samples), losses = jax.lax.scan(
         step, (params, opt, key, samples0), (Xb, yb, wb))
     return params, opt, key, samples, losses.mean()
+
+
+@partial(jax.jit, static_argnames=("act", "loss", "nclasses", "cfg", "k",
+                                   "nb", "B", "autoenc"))
+def _train_epochs(params, opt, X, yy, w, key, samples0,
+                  act: str, loss: str, nclasses: int, cfg: tuple, k: int,
+                  nb: int, B: int, autoenc: bool):
+    """K whole epochs in ONE compiled dispatch: shuffle → minibatch →
+    step-scan all run on device, so consecutive epochs pipeline with zero
+    host dispatches between them (the K-step megastep of the DL loop).
+
+    The PRNG stream is split in exactly the order the per-epoch host loop
+    used (``key → pk`` for the permutation, then ``key → ek`` for the
+    in-epoch dropout/minibatch stream), so K-epoch training is
+    reproducibility-identical to K single-epoch dispatches."""
+    used = nb * B
+    K = X.shape[1]
+
+    def epoch(carry, _):
+        params, opt, key, samples = carry
+        key, pk = jax.random.split(key)
+        perm = jax.random.permutation(pk, X.shape[0])[:used]
+        Xb = jnp.take(X, perm, axis=0).reshape(nb, B, K)
+        wb = jnp.take(w, perm, axis=0).reshape(nb, B)
+        ybt = Xb if autoenc else jnp.take(yy, perm, axis=0).reshape(nb, B)
+        key, ek = jax.random.split(key)
+        params, opt, _, samples, mloss = _epoch_steps(
+            params, opt, Xb, ybt, wb, ek, samples, act, loss, nclasses, cfg)
+        return (params, opt, key, samples), mloss
+
+    (params, opt, key, samples), losses = jax.lax.scan(
+        epoch, (params, opt, key, samples0), None, length=k)
+    return params, opt, key, samples, losses
 
 
 @partial(jax.jit, static_argnames=("act",))
@@ -387,33 +421,44 @@ class DeepLearning(ModelBuilder):
         n_epochs = max(int(np.ceil(epochs)), 1)
 
         samples = jnp.float32(0.0)
-        epoch_losses = []        # device scalars; fetched once after the loop
-        for ep in range(n_epochs):
-            key, pk = jax.random.split(key)
-            perm = jax.random.permutation(pk, plen)[:used]
-            Xb = jnp.take(X, perm, axis=0).reshape(nb, B, K)
-            wb = jnp.take(w, perm, axis=0).reshape(nb, B)
-            if autoenc:
-                ybt = Xb
-            else:
-                ybt = jnp.take(yy, perm, axis=0).reshape(nb, B)
-            key, ek = jax.random.split(key)
-            with timed_event("iteration", "dl_epoch",
-                             observe=_tm.ITER_SECONDS.labels(loop="dl_epoch")):
-                params, opt, _, samples, mloss = _train_epoch(
-                    params, opt, Xb, ybt, wb, ek, samples,
-                    act, loss, nclasses, cfg)
-            # NO per-epoch fetch: float(device_get(mloss)) here forced a
-            # device sync every epoch, serializing the dispatch pipeline
-            # (graftlint TRC003); the loss series is fetched in one batched
-            # transfer below, so epochs overlap host-side batching work
-            epoch_losses.append(mloss)
-            job.update((ep + 1) / n_epochs, f"epoch {ep + 1}/{n_epochs}")
+        k_mega = megastep_k()
+        epoch_losses = []        # [k] device arrays; fetched once post-loop
+        ep = 0
+        dispatches = 0
+        import time as _time
+        while ep < n_epochs:
+            # K epochs per compiled dispatch (trailing chunk compiles its own
+            # smaller K once); shuffle + minibatching run inside the program,
+            # so the host dispatches WORK, not steps
+            kk = min(k_mega, n_epochs - ep)
+            t0 = _time.time_ns()
+            with timed_event("iteration", "dl_epoch"):
+                params, opt, key, samples, losses_k = _train_epochs(
+                    params, opt, X, yy, w, key, samples,
+                    act, loss, nclasses, cfg, kk, nb, B, autoenc)
+            # NO per-epoch fetch: the loss series stays on device and is
+            # fetched in one batched transfer below, so megasteps pipeline
+            epoch_losses.append(losses_k)
+            dispatches += 1
+            ep += kk
+            # per-EPOCH latency: megastep wall amortized over its epochs, so
+            # the histogram count keeps matching epochs (same contract as
+            # the GLM loops; like the old per-epoch path this is dispatch
+            # enqueue time — the loss fetch below pays the real wait)
+            dt = (_time.time_ns() - t0) / 1e9
+            for _ in range(kk):
+                _tm.ITER_SECONDS.labels(loop="dl_epoch").observe(dt / kk)
+            job.update(ep / n_epochs, f"epoch {ep}/{n_epochs}")
             if job.cancelled:
                 break
+        publish_dispatch_audit(self, "dl_epoch", iterations=max(ep, 1),
+                               host_syncs=1, device_dispatches=dispatches)
         score_history = [
             {"epoch": i + 1, "train_loss": float(v)}
-            for i, v in enumerate(jax.device_get(epoch_losses))]
+            for i, v in enumerate(np.concatenate(
+                [np.atleast_1d(np.asarray(a))
+                 for a in jax.device_get(epoch_losses)])
+                if epoch_losses else [])]
 
         from h2o3_tpu.models.model_base import ModelParameters
         model = DeepLearningModel(

@@ -25,7 +25,8 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
 from h2o3_tpu.models.job import Job
-from h2o3_tpu.models.model_base import Model, ModelBuilder, make_model_key
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, make_model_key,
+                                        publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 from jax import lax
@@ -280,6 +281,7 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
     hp = _pack_hp(col_rate, sample_rate, col_tree_rate, min_rows,
                   reg_lambda, reg_alpha, gamma, min_split_improvement,
                   lr, quantile_alpha, huber_alpha, tweedie_power)
+    from h2o3_tpu.models.tree import hist_mesh
     return _boost_scan_jit(
         binned, edges, yc, w, fmask_base, Fcur0, keys, hp,
         dist=dist, depth=depth, n_bins=n_bins, bootstrap=bootstrap, drf=drf,
@@ -289,14 +291,14 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
         do_col_sample=bool(col_rate < 1.0),
         mono=mono, reach=reach, cat_feats=cat_feats, track=track, val=val,
         ntrees_prior=ntrees_prior, custom_id=custom_id,
-        custom_link=custom_link)
+        custom_link=custom_link, mesh=hist_mesh(binned))
 
 
 @partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "bootstrap",
                                    "drf", "nclass", "do_row_sample",
                                    "do_tree_col_sample", "do_col_sample",
                                    "track", "ntrees_prior", "custom_id",
-                                   "custom_link"))
+                                   "custom_link", "mesh"))
 def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
                     dist: str, depth: int, n_bins: int, bootstrap: bool,
                     drf: bool, nclass: int, do_row_sample: bool,
@@ -304,7 +306,7 @@ def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
                     mono=None, reach=None, cat_feats=None,
                     track: str | None = None, val=None,
                     ntrees_prior: int = 0, custom_id: int = -1,
-                    custom_link: str | None = None):
+                    custom_link: str | None = None, mesh=None):
     (col_rate, sample_rate, col_tree_rate, min_rows, reg_lambda, reg_alpha,
      gamma, min_split_improvement, lr, quantile_alpha, huber_alpha,
      tweedie_power) = hp
@@ -334,7 +336,7 @@ def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
             binned, binned_T, edges, g, h, wt, fmask, k3, depth, n_bins,
             min_rows, reg_lambda, reg_alpha, gamma, min_split_improvement,
             col_rate, do_col_sample=do_col_sample,
-            mono=mono, reach=reach, cat_feats=cat_feats)
+            mono=mono, reach=reach, cat_feats=cat_feats, mesh=mesh)
 
     # -- optional per-tree metric tracking (fused ScoreKeeper) ---------------
     # `track` emits one train-metric scalar per tree from the carried
@@ -906,6 +908,12 @@ class GBM(SharedTreeBuilder):
             huber_alpha=0.9,       # huber delta = this quantile of |residual|
             tweedie_power=1.5,
             custom_distribution_func=None,  # "python:key=module.Class" UDF
+            # boosting rounds per compiled device program (0 = auto-size to
+            # the watchdog budget); each dispatch pays ONE host sync for the
+            # early-stopping decision. GBM/XGBoost only: DRF and the other
+            # bagging builders grow their whole forest in one dispatch, so
+            # the knob would be inert there
+            trees_per_dispatch=0,
         )
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> GBMModel:
@@ -1168,14 +1176,22 @@ class GBM(SharedTreeBuilder):
         # AutoML values (50, 100, 200 trees) all balance to 25-tree chunks
         # and share one compile per (depth, bins) config; other ntrees get
         # waste-free balanced chunks (per = ceil(M/k)) at the cost of their
-        # own shape.
-        cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
-        per = max(1, min(int(1.5e8 // cost), 25))
-        if sr > 0:
-            # bound the discarded overshoot past the stopping point; ≥16
-            # trees per chunk keeps the dispatch count low (each chunk pays
-            # a host round-trip for the stopping decision)
-            per = min(per, max(4 * sr, 16))
+        # own shape. `trees_per_dispatch` overrides the auto sizing (an
+        # upper bound per compiled program — balanced chunking below may
+        # round it down to avoid padded surplus trees).
+        tpd = int(p.get("trees_per_dispatch") or 0)
+        if tpd < 0:
+            raise ValueError("trees_per_dispatch must be >= 0 (0 = auto)")
+        if tpd > 0:
+            per = max(1, min(tpd, max(M, 1)))
+        else:
+            cost = max(binned.shape[0], 1) * max(int(kwargs["n_bins"]), 64) // 64
+            per = max(1, min(int(1.5e8 // cost), 25))
+            if sr > 0:
+                # bound the discarded overshoot past the stopping point; ≥16
+                # trees per chunk keeps the dispatch count low (each chunk
+                # pays a host round-trip for the stopping decision)
+                per = min(per, max(4 * sr, 16))
         # balanced chunks: ceil(M/k) for k = chunk count. Padding then wastes
         # at most k-1 trees per train instead of up to per-1 (a 20-tree run
         # with per=13 must grow 2x10, not 13 + a padded 7->13)
@@ -1185,6 +1201,7 @@ class GBM(SharedTreeBuilder):
         lr = float(kwargs["lr"])
         nbins = int(kwargs["n_bins"])
         best, since = np.inf, 0
+        chunks = 0
         for s0 in range(0, M, per):
             kchunk = keys[s0:s0 + per]
             take = kchunk.shape[0]
@@ -1208,6 +1225,7 @@ class GBM(SharedTreeBuilder):
                 # fetch feeds the host-side early-stopping decision
                 heap_h, extras_h = jax.device_get(  # graftlint: ok(batched chunk fetch)
                     (heap, extras))
+            chunks += 1
             heap_h = jax.tree.map(np.asarray, heap_h)
             new_trees = collect(heap_h, take)
             ts = np.asarray(extras_h[0], np.float64)[:take]
@@ -1249,6 +1267,11 @@ class GBM(SharedTreeBuilder):
             if stop_at is not None:
                 break
         self._score_series = (metric, tser, vser if vser else None)
+        # dispatch economy: ONE host sync (the stopping/heap fetch) per
+        # `trees_per_dispatch`-sized chunk, not per boosting round
+        publish_dispatch_audit(self, f"{self.algo}_round",
+                               iterations=max(len(out_trees), 1),
+                               host_syncs=chunks, device_dispatches=chunks)
         return out_trees, Fcur
 
     def _fit_multinomial(self, job: Job, frame, x, y, w, yc, yvec,

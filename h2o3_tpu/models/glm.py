@@ -16,6 +16,7 @@ L1 is handled by ADMM over the cached Cholesky factor, mirroring the reference.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -28,7 +29,8 @@ from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.models.distributions import get_family
 from h2o3_tpu.models.job import Job
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
-                                        make_model_key)
+                                        make_model_key, megastep_k,
+                                        publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 
@@ -125,6 +127,60 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
     return new_beta, dev, jnp.max(jnp.abs(new_beta - beta))
 
 
+@partial(jax.jit, static_argnames=("family", "tweedie_p", "non_negative",
+                                   "k", "has_bounds"))
+def _irls_megastep(family: str, tweedie_p: float, X, y, w, beta, l2, k: int,
+                   it0, max_it, beta_eps, obj_eps, dev_prev0,
+                   non_negative: bool = False, off=0.0, lo=None, hi=None,
+                   has_bounds: bool = False):
+    """Up to ``k`` IRLS iterations in ONE compiled dispatch, with the
+    convergence predicate evaluated ON DEVICE — the host fetches the
+    per-step deviances + step count once per megastep instead of blocking
+    on (dev, delta) every iteration (the FireCaffe lesson: no host
+    round-trip between steps). Semantics are step-for-step identical to the
+    per-iteration driver: once the predicate fires (or ``max_it`` global
+    iterations are reached) the carry freezes, so iteration counts,
+    deviance history, and coefficients match the old loop exactly.
+
+    Returns ``(beta, devs[k], ran[k], done)``: ``ran`` marks which steps
+    executed (``devs`` is NaN on unexecuted slots), ``done`` = converged.
+    A ``lax.while_loop`` (not a frozen scan) so convergence mid-megastep
+    stops COMPUTING, not just updating — the per-iteration cost must drop
+    even on CPU, where the Gram dominates and wasted post-convergence
+    steps would eat the round-trip savings.
+    """
+    def cond(state):
+        _, _, it, i, done, _, _ = state
+        return (~done) & (i < k) & (it < max_it)
+
+    def body(state):
+        beta, dev_prev, it, i, done, devs, ran = state
+        beta_new, dev, delta = _irls_step(family, tweedie_p, X, y, w, beta,
+                                          l2, non_negative=non_negative,
+                                          off=off)
+        if has_bounds:
+            # projected Newton, as in the host driver: clip into the box,
+            # re-measure the step against the projected point
+            beta_new = jnp.clip(beta_new, lo, hi)
+            delta = jnp.max(jnp.abs(beta_new - beta))
+        stop = delta < beta_eps
+        if family == "gaussian" and not non_negative:
+            # weighted LS solves exactly in one step; the second confirms
+            stop = stop | (it >= 1)
+        stop = stop | (jnp.isfinite(dev_prev)
+                       & (jnp.abs(dev_prev - dev)
+                          <= obj_eps * jnp.maximum(jnp.abs(dev_prev), 1.0)))
+        return (beta_new, dev, it + 1, i + 1, stop,
+                devs.at[i].set(dev), ran.at[i].set(True))
+
+    state = (beta, jnp.asarray(dev_prev0, jnp.float32),
+             jnp.asarray(it0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(False), jnp.full(k, jnp.nan, jnp.float32),
+             jnp.zeros(k, bool))
+    beta, _, _, _, done, devs, ran = jax.lax.while_loop(cond, body, state)
+    return beta, devs, ran, done
+
+
 @partial(jax.jit, static_argnames=("family", "tweedie_p"))
 def _l1_threshold(family: str, tweedie_p: float, X, y, w, beta, lam1, lam2,
                   off=0.0):
@@ -219,6 +275,36 @@ def _multinomial_step(nclasses: int, X, yoh, w, B, l2, l1, non_negative: bool = 
     logp = jax.nn.log_softmax(eta, axis=1)
     dev = -2.0 * (w * (yoh * logp).sum(axis=1)).sum()
     return B, dev
+
+
+@partial(jax.jit, static_argnames=("nclasses", "non_negative", "k"))
+def _multinomial_megastep(nclasses: int, X, yoh, w, B, l2, l1, k: int,
+                          it0, max_it, obj_eps, dev_prev0,
+                          non_negative: bool = False):
+    """Up to ``k`` cyclic per-class IRLS sweeps in ONE compiled dispatch;
+    the deviance-plateau stopping test runs on device and the host fetches
+    the per-step deviances once per megastep (same stop-computing-on-
+    converge ``while_loop`` contract as :func:`_irls_megastep`)."""
+    def cond(state):
+        _, _, it, i, done, _, _ = state
+        return (~done) & (i < k) & (it < max_it)
+
+    def body(state):
+        B, dev_prev, it, i, done, devs, ran = state
+        B_new, dev = _multinomial_step(nclasses, X, yoh, w, B, l2, l1,
+                                       non_negative)
+        stop = (jnp.isfinite(dev_prev)
+                & (jnp.abs(dev_prev - dev)
+                   <= obj_eps * jnp.maximum(jnp.abs(dev_prev), 1.0)))
+        return (B_new, dev, it + 1, i + 1, stop,
+                devs.at[i].set(dev), ran.at[i].set(True))
+
+    state = (B, jnp.asarray(dev_prev0, jnp.float32),
+             jnp.asarray(it0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(False), jnp.full(k, jnp.nan, jnp.float32),
+             jnp.zeros(k, bool))
+    B, _, _, _, done, devs, ran = jax.lax.while_loop(cond, body, state)
+    return B, devs, ran, done
 
 
 class GLMModel(Model):
@@ -563,41 +649,57 @@ class GLM(ModelBuilder):
     def _irls_fit(self, job: Job, family, tw, X, yy, w, beta, lambda_: float,
                   params) -> tuple[jax.Array, float, int]:
         """IRLS to convergence at ONE lambda (reference: GLM.java IRLSM
-        iteration loop); elastic-net L1 handled by the ADMM pass."""
+        iteration loop); elastic-net L1 handled by the ADMM pass.
+
+        The loop runs in K-step MEGASTEPS (``H2O3TPU_MEGASTEP_K``): one
+        compiled dispatch carries up to K iterations with the convergence
+        test on device, and the host blocks exactly ONCE per megastep to
+        fetch the per-step deviances + how many steps actually ran — the
+        fetch reconciles exact iteration counts for scoring history."""
         lam = lambda_ * (1.0 - float(params["alpha"]))
-        dev_prev, dev, it = np.inf, np.inf, 0
+        k = megastep_k()
         nn = bool(params.get("non_negative"))
         bounds = getattr(self, "_beta_bounds", None)
         off = getattr(self, "_offset", 0.0)
-        for it in range(int(params["max_iterations"])):
-            with timed_event("iteration", "glm_irls",
-                             observe=_tm.ITER_SECONDS.labels(loop="glm_irls")):
-                beta_new, dev_d, delta_d = _irls_step(
-                    family, tw, X, yy, w, beta, lam, non_negative=nn, off=off)
-                if bounds is not None:
-                    # projected Newton (reference: GLM.java applies the bounds
-                    # inside the ADMM solve; projection after each IRLS step
-                    # converges to the same box-constrained optimum for the
-                    # smooth objectives handled here)
-                    beta_new = jnp.clip(beta_new, bounds[0], bounds[1])
-                    delta_d = jnp.max(jnp.abs(beta_new - beta))
-                # ONE batched transfer per iteration — deviance + step size
-                # together; the fetch is the convergence test itself
-                dev, delta = map(  # graftlint: ok(batched convergence fetch)
-                    float, jax.device_get((dev_d, delta_d)))
-            beta = beta_new
-            if hasattr(self, "_iter_devs"):
-                self._iter_devs.append(dev)
-            job.update((it + 1) / int(params["max_iterations"]),
-                       f"iter {it} deviance {dev:.4f}")
-            if family == "gaussian" and not nn and it >= 1:
-                break
-            if delta < float(params["beta_epsilon"]):
-                break
-            if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
-                    float(params["objective_epsilon"]) * max(abs(dev_prev), 1.0):
-                break
+        lo, hi = bounds if bounds is not None else (None, None)
+        max_it = int(params["max_iterations"])
+        beta_eps = float(params["beta_epsilon"])
+        obj_eps = float(params["objective_epsilon"])
+        dev_prev, dev, it_total, done = np.inf, np.inf, 0, False
+        megasteps = 0
+        while it_total < max_it and not done:
+            t0 = time.time_ns()
+            with timed_event("iteration", "glm_irls"):
+                beta, devs_d, ran_d, done_d = _irls_megastep(
+                    family, tw, X, yy, w, beta, lam, k, it_total, max_it,
+                    beta_eps, obj_eps, dev_prev, non_negative=nn, off=off,
+                    lo=lo, hi=hi, has_bounds=bounds is not None)
+                # the ONE blocking transfer per megastep — per-step deviances,
+                # executed-step mask, converged flag together; this fetch IS
+                # the convergence test
+                devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
+                    np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+            megasteps += 1
+            n = int(ran.sum())
+            steps = [float(d) for d in devs[:n]]
+            dev = steps[-1] if steps else dev
             dev_prev = dev
+            done = bool(done)
+            it_total += n
+            if hasattr(self, "_iter_devs"):
+                self._iter_devs.extend(steps)
+            # per-ITERATION latency: the megastep's wall time amortized over
+            # the steps it carried (histogram count keeps matching iterations)
+            dt = (time.time_ns() - t0) / 1e9
+            for _ in range(max(n, 1)):
+                _tm.ITER_SECONDS.labels(loop="glm_irls").observe(
+                    dt / max(n, 1))
+            job.update(it_total / max_it,
+                       f"iter {it_total - 1} deviance {dev:.4f}")
+        it = max(it_total - 1, 0)
+        publish_dispatch_audit(self, "glm_irls", iterations=max(it_total, 1),
+                               host_syncs=megasteps,
+                               device_dispatches=megasteps)
         if float(params["alpha"]) > 0 and lambda_ > 0:
             local = ModelParameters(params)
             local["lambda_"] = lambda_
@@ -885,23 +987,40 @@ class GLM(ModelBuilder):
         B = jnp.zeros((P + 1, K), jnp.float32)
         lam = float(params["lambda_"]) * (1.0 - float(params["alpha"]))
         lam1 = float(params["lambda_"]) * float(params["alpha"])
-        dev_prev = np.inf
         nn = bool(params.get("non_negative"))
-        for it in range(int(params["max_iterations"])):
-            with timed_event("iteration", "glm_multinomial",
-                             observe=_tm.ITER_SECONDS.labels(
-                                 loop="glm_multinomial")):
-                B, dev = _multinomial_step(K, X, yoh, w, B, jnp.float32(lam),
-                                           jnp.float32(lam1), nn)
-                # single scalar fetch — the deviance IS the stopping test
-                dev = float(  # graftlint: ok(single convergence scalar)
-                    jax.device_get(dev))
-            job.update((it + 1) / int(params["max_iterations"]),
-                       f"iter {it} deviance {dev:.4f}")
-            if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
-                    float(params["objective_epsilon"]) * max(abs(dev_prev), 1.0):
-                break
+        k = megastep_k()
+        max_it = int(params["max_iterations"])
+        obj_eps = float(params["objective_epsilon"])
+        dev_prev, dev, it_total, done = np.inf, np.inf, 0, False
+        megasteps = 0
+        while it_total < max_it and not done:
+            t0 = time.time_ns()
+            with timed_event("iteration", "glm_multinomial"):
+                B, devs_d, ran_d, done_d = _multinomial_megastep(
+                    K, X, yoh, w, B, jnp.float32(lam), jnp.float32(lam1), k,
+                    it_total, max_it, obj_eps, dev_prev, non_negative=nn)
+                # ONE blocking fetch per K-step megastep — the per-step
+                # deviance series IS the stopping test
+                devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
+                    np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+            megasteps += 1
+            n = int(ran.sum())
+            steps = [float(d) for d in devs[:n]]
+            dev = steps[-1] if steps else dev
             dev_prev = dev
+            done = bool(done)
+            it_total += n
+            dt = (time.time_ns() - t0) / 1e9
+            for _ in range(max(n, 1)):
+                _tm.ITER_SECONDS.labels(loop="glm_multinomial").observe(
+                    dt / max(n, 1))
+            job.update(it_total / max_it,
+                       f"iter {it_total - 1} deviance {dev:.4f}")
+        it = max(it_total - 1, 0)
+        publish_dispatch_audit(self, "glm_multinomial",
+                               iterations=max(it_total, 1),
+                               host_syncs=megasteps,
+                               device_dispatches=megasteps)
 
         # destandardized per-class coefficients
         b = np.asarray(jax.device_get(B), np.float64)

@@ -16,6 +16,7 @@ intercept rides as an appended virtual all-ones column.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -73,11 +74,47 @@ def _sparse_irls_step(family: str, data, row, col, nrows: int, ncols: int,
     return beta_new, dev
 
 
+@partial(jax.jit, static_argnames=("family", "k", "nrows", "ncols"))
+def _sparse_irls_megastep(family: str, data, row, col, nrows: int, ncols: int,
+                         y, w, beta, lam, k: int, it0, max_it, beta_eps,
+                         dev_prev0):
+    """Up to ``k`` CG-IRLS iterations in ONE compiled dispatch with the
+    convergence test on device; the host fetches per-step deviances + step
+    count once per megastep (stop-computing-on-converge ``while_loop``,
+    same contract as the dense
+    :func:`h2o3_tpu.models.glm._irls_megastep`)."""
+    def cond(state):
+        _, _, it, i, done, _, _ = state
+        return (~done) & (i < k) & (it < max_it)
+
+    def body(state):
+        beta, dev_prev, it, i, done, devs, ran = state
+        beta_new, dev = _sparse_irls_step(family, data, row, col, nrows,
+                                          ncols, y, w, beta, lam)
+        delta = jnp.max(jnp.abs(beta_new - beta))
+        stop = delta < beta_eps
+        if family == "gaussian":
+            stop = stop | (it >= 1)
+        stop = stop | (jnp.isfinite(dev_prev)
+                       & (jnp.abs(dev_prev - dev)
+                          <= 1e-6 * jnp.maximum(jnp.abs(dev_prev), 1.0)))
+        return (beta_new, dev, it + 1, i + 1, stop,
+                devs.at[i].set(dev), ran.at[i].set(True))
+
+    state = (beta, jnp.asarray(dev_prev0, jnp.float32),
+             jnp.asarray(it0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(False), jnp.full(k, jnp.nan, jnp.float32),
+             jnp.zeros(k, bool))
+    beta, _, _, _, done, devs, ran = jax.lax.while_loop(cond, body, state)
+    return beta, devs, ran, done
+
+
 def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
     """Driver for GLM on a :class:`SparseFrame`; returns a GLMModel."""
     from h2o3_tpu.models.glm import GLMModel
     from h2o3_tpu.models.model_base import (ModelParameters, compute_metrics,
-                                            make_model_key)
+                                            make_model_key, megastep_k,
+                                            publish_dispatch_audit)
 
     p = builder.params
     family = str(p["family"]).lower()
@@ -109,31 +146,37 @@ def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
 
     beta = jnp.zeros(X.ncols + 1, jnp.float32)
     lam = float(p.get("lambda_") or 0.0)
-    dev_prev = np.inf
-    it = 0
-    for it in range(mi):
-        with timed_event("iteration", "glm_sparse_irls",
-                         observe=_tm.ITER_SECONDS.labels(
-                             loop="glm_sparse_irls")):
-            beta_new, dev_d = _sparse_irls_step(
+    k = megastep_k()
+    beta_eps = float(p.get("beta_epsilon") or 1e-4)
+    dev_prev, dev, it_total, done = np.inf, np.inf, 0, False
+    megasteps = 0
+    while it_total < mi and not done:
+        t0 = time.time_ns()
+        with timed_event("iteration", "glm_sparse_irls"):
+            beta, devs_d, ran_d, done_d = _sparse_irls_megastep(
                 family, X.data, X.row, X.col, X.nrows, X.ncols, yy, w, beta,
-                lam)
-            # ONE batched transfer per iteration — deviance + step size
-            # (two separate device_gets doubled host round-trips: TRC003)
-            dev, delta = map(  # graftlint: ok(batched convergence fetch)
-                float, jax.device_get(
-                    (dev_d, jnp.max(jnp.abs(beta_new - beta)))))
-        beta = beta_new
-        job.update((it + 1) / mi,
-                   f"sparse IRLS iter {it} deviance {dev:.4f}")
-        if family == "gaussian" and it >= 1:
-            break
-        if delta < float(p.get("beta_epsilon") or 1e-4):
-            break
-        if np.isfinite(dev_prev) and abs(dev_prev - dev) <= \
-                1e-6 * max(abs(dev_prev), 1.0):
-            break
+                lam, k, it_total, mi, beta_eps, dev_prev)
+            # ONE blocking transfer per K-step megastep — the per-step
+            # deviance series + executed count IS the convergence test
+            devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
+                np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+        megasteps += 1
+        n = int(ran.sum())
+        steps = [float(d) for d in devs[:n]]
+        dev = steps[-1] if steps else dev
         dev_prev = dev
+        done = bool(done)
+        it_total += n
+        dt = (time.time_ns() - t0) / 1e9
+        for _ in range(max(n, 1)):
+            _tm.ITER_SECONDS.labels(loop="glm_sparse_irls").observe(
+                dt / max(n, 1))
+        job.update(it_total / mi,
+                   f"sparse IRLS iter {it_total - 1} deviance {dev:.4f}")
+    it = max(it_total - 1, 0)
+    publish_dispatch_audit(builder, "glm_sparse_irls",
+                           iterations=max(it_total, 1),
+                           host_syncs=megasteps, device_dispatches=megasteps)
 
     nclasses = 2 if family == "binomial" else 0
     mparams = ModelParameters(p)

@@ -20,6 +20,7 @@ TPU-first redesign decisions:
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from typing import Any, Sequence
@@ -42,6 +43,40 @@ from h2o3_tpu.ops.map_reduce import map_reduce
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV, LOCKS
 from h2o3_tpu.utils.timeline import timed_event
+
+
+def megastep_k(default: int = 4) -> int:
+    """K-step megastep width for device-resident convergence loops
+    (``H2O3TPU_MEGASTEP_K``, default 4). The host fetches convergence
+    scalars ONCE per K-step megastep instead of once per iteration — with
+    JAX async dispatch the K compiled steps pipeline on device and the
+    per-step host round-trip disappears from the critical path. Iteration
+    counts and results stay exact: the megastep freezes its carry once the
+    on-device convergence predicate fires, and the single fetch reconciles
+    how many steps actually ran."""
+    try:
+        k = int(os.environ.get("H2O3TPU_MEGASTEP_K", "") or default)
+    except ValueError:
+        k = default
+    return max(k, 1)
+
+
+def publish_dispatch_audit(builder, loop: str, iterations: int,
+                           host_syncs: int, device_dispatches: int) -> None:
+    """Record a convergence loop's host-sync economy: how many blocking
+    device→host fetches and compiled dispatches the loop paid for how many
+    logical iterations. Feeds ``h2o3_dispatches_per_iteration{loop}`` and
+    the builder's ``_dispatch_audit`` (bench embeds it as
+    ``extra.dispatch_audit`` and refuses to stamp on a regression)."""
+    iters = max(int(iterations), 1)
+    audit = getattr(builder, "_dispatch_audit", None)
+    if audit is None:
+        audit = builder._dispatch_audit = {}
+    audit[loop] = dict(iterations=int(iterations),
+                       host_syncs=int(host_syncs),
+                       device_dispatches=int(device_dispatches),
+                       syncs_per_iteration=round(host_syncs / iters, 4))
+    _tm.DISPATCHES_PER_ITER.labels(loop=loop).set(host_syncs / iters)
 
 
 def _weight_rollup(w):

@@ -33,6 +33,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 @dataclasses.dataclass
@@ -83,14 +89,58 @@ def _level_histograms(binned, node_local, g, h, w, n_nodes: int, n_bins_tot: int
     return hists
 
 
+def hist_mesh(arr):
+    """The mesh to fuse histogram reductions over, from an input array's
+    sharding — or None when fusion buys nothing (single device, no named
+    mesh, or rows not divisible by the row axis). Called OUTSIDE jit by the
+    dispatch wrappers; the mesh then rides into the compiled program as a
+    STATIC argument, so a trace can never reuse a stale mesh after the
+    global mesh changes (shard_map bakes its mesh in at trace time)."""
+    from h2o3_tpu.parallel.mesh import ROWS
+    sharding = getattr(arr, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None or getattr(mesh, "axis_names", None) is None:
+        return None
+    if ROWS not in mesh.axis_names or mesh.shape[ROWS] <= 1:
+        return None
+    if arr.shape[0] % mesh.shape[ROWS] != 0:
+        return None
+    return mesh
+
+
+def _level_histograms_fused(binned, node_local, g, h, w, n_nodes: int,
+                            n_bins_tot: int, mesh):
+    """One-collective level histograms on a multi-device mesh: shard-local
+    segment-sums inside ``shard_map``, then ONE ``lax.psum`` of the whole
+    stacked ``[F, n_nodes*n_bins_tot, 3]`` payload over the row axis — the
+    FireCaffe shape: few, large, tree-reduced collectives. The implicit-SPMD
+    path instead lowers one small all-reduce per feature-scan step, which is
+    exactly the 4-tiny-collectives-per-level pattern MULTICHIP_r05 flagged."""
+    from h2o3_tpu.parallel.mesh import ROWS
+    rows = P(ROWS)
+
+    def local(b, nl, gg, hh, ww):
+        return lax.psum(
+            _level_histograms(b, nl, gg, hh, ww, n_nodes, n_bins_tot), ROWS)
+
+    fused = _shard_map(local, mesh=mesh,
+                       in_specs=(P(ROWS, None), rows, rows, rows, rows),
+                       out_specs=P())
+    return fused(binned, node_local, g, h, w)
+
+
 def _histograms(binned, binned_T, node_local, g, h, w, n_nodes: int,
-                n_bins_tot: int):
+                n_bins_tot: int, mesh=None):
     """Dispatch: Pallas MXU kernel on TPU (≈4× the XLA scatter path inside the
-    fused tree program), segment_sum elsewhere / beyond the kernel's VMEM
+    fused tree program), one fused-collective shard_map reduction on a
+    multi-device mesh, segment_sum elsewhere / beyond the kernel's VMEM
     envelope."""
     from h2o3_tpu.ops.pallas_hist import hist_pallas, pallas_available
     if pallas_available(n_nodes, binned.shape[1], n_bins_tot):
         return hist_pallas(binned_T, node_local, g, h, w, n_nodes, n_bins_tot)
+    if mesh is not None:
+        return _level_histograms_fused(binned, node_local, g, h, w, n_nodes,
+                                       n_bins_tot, mesh)
     return _level_histograms(binned, node_local, g, h, w, n_nodes, n_bins_tot)
 
 
@@ -226,7 +276,7 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
                       depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                       gamma, min_split_improvement, col_rate: float,
                       do_col_sample: bool | None = None,
-                      mono=None, reach=None, cat_feats=None):
+                      mono=None, reach=None, cat_feats=None, mesh=None):
     """Grow one whole tree on device; the level loop unrolls at trace time.
 
     Returns heap arrays + per-row training predictions (leaf of each row).
@@ -270,7 +320,8 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
             # the forced index may miss feat_mask; never let the level go empty
             lmask = jnp.where(lmask.any(), lmask, feat_mask)
         if d == 0:
-            hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt)
+            hists = _histograms(binned, binned_T, node_local, g, h, w, N, Bt,
+                                mesh=mesh)
         else:
             P = N // 2
             # chosen child id per parent; rows elsewhere mask to -1
@@ -280,7 +331,8 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
             par = jnp.where(act, node_local // 2, 0)
             at_chosen = act & (node_local == chosen[par])
             node_slot = jnp.where(at_chosen, par, -1)
-            part = _histograms(binned, binned_T, node_slot, g, h, w, P, Bt)
+            part = _histograms(binned, binned_T, node_slot, g, h, w, P, Bt,
+                               mesh=mesh)
             part4 = part.reshape(F, P, Bt, 3)
             prev4 = prev_hists.reshape(F, P, Bt, 3)
             # sibling by subtraction — only where the parent really split
@@ -367,18 +419,18 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate", "min_rows",
                                    "reg_lambda", "reg_alpha", "gamma",
-                                   "min_split_improvement"))
+                                   "min_split_improvement", "mesh"))
 def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
                   depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                   gamma, min_split_improvement, col_rate: float,
-                  mono=None, reach=None, cat_feats=None):
+                  mono=None, reach=None, cat_feats=None, mesh=None):
     """K trees in ONE dispatch: vmap over the stats axis (class trees of a
     multinomial round, or K=1). binned/edges are shared (in_axes=None)."""
     binned_T = binned.T   # once per round; the Pallas kernel wants [F, rows]
     fn = lambda gk, hk, wk, mk, kk: _grow_tree_device(
         binned, binned_T, edges, gk, hk, wk, mk, kk, depth, n_bins, min_rows,
         reg_lambda, reg_alpha, gamma, min_split_improvement, col_rate,
-        mono=mono, reach=reach, cat_feats=cat_feats)
+        mono=mono, reach=reach, cat_feats=cat_feats, mesh=mesh)
     return jax.vmap(fn)(g, h, w, feat_mask, keys)
 
 
@@ -409,7 +461,8 @@ def grow_trees_batched(binned, edges, g, h, w, params: TreeParams, feat_mask,
         params.max_depth, params.nbins, float(params.min_rows),
         float(params.reg_lambda), float(params.reg_alpha),
         float(params.gamma), float(params.min_split_improvement),
-        float(col_rate), mono=mono, reach=reach, cat_feats=cat_feats)
+        float(col_rate), mono=mono, reach=reach, cat_feats=cat_feats,
+        mesh=hist_mesh(binned))
     hf, ht, htv, hna, hsp, hlf, hg, hc = out[:8]
     hm = out[8] if cat_feats is not None else None
     preds = out[-1]

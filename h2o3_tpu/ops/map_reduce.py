@@ -26,6 +26,8 @@ Two styles are supported, and most algorithm code uses the second:
 
 from __future__ import annotations
 
+import itertools
+import os
 import time
 from functools import partial
 from typing import Callable
@@ -46,10 +48,11 @@ except ImportError:  # pragma: no cover
 # Compiled-program cache: jit executables are tied to the wrapper instance, so
 # re-wrapping per call would recompile every invocation (deadly in iterative
 # algorithms like tree building). Keyed by (fn, mesh, arg ranks, donate) with
-# FIFO eviction: fresh-lambda callers get no hits but can't grow the dict
-# unboundedly (evicted entries simply recompile on reuse). Pass a module-level
-# function or a stable partial to benefit from caching. jax.jit's own cache
-# handles shape/dtype specialization underneath.
+# LRU eviction (``move_to_end`` on hit): hot entries survive fresh-lambda
+# churn, fresh-lambda callers get no hits but can't grow the dict unboundedly
+# (evicted entries simply recompile on reuse). Pass a module-level function or
+# a stable partial to benefit from caching. jax.jit's own cache handles
+# shape/dtype specialization underneath.
 from collections import OrderedDict
 
 _COMPILED_MAX = 256
@@ -71,6 +74,22 @@ def _cache_put(key, value):
     _compiled[key] = value
     while len(_compiled) > _COMPILED_MAX:
         _compiled.popitem(last=False)
+
+
+# Telemetry sampling for the dispatch path. JAX dispatch is ASYNC: blocking on
+# the result to measure an accurate duration (and to stamp per-partition
+# readiness) serializes back-to-back collectives — exactly the host-as-clock
+# pattern this module exists to avoid. So accurate duration/straggler probes
+# are SAMPLED: every Nth dispatch (H2O3TPU_DISPATCH_SAMPLE, default 16; the
+# first dispatch always samples so short sessions still measure something)
+# pays one sync for the `h2o3_mapreduce_dispatch_seconds` observation and —
+# when a trace is active — the straggler attrs. Per-partition sub-spans are
+# additionally gated behind H2O3TPU_TRACE_PARTITIONS=1 (full fidelity: every
+# traced dispatch syncs and stamps shard readiness). Unsampled dispatches
+# record only enqueue-side counters and return un-synced outputs, so the
+# device pipelines K-step megasteps without the host in the loop.
+_SAMPLE_EVERY = max(int(os.environ.get("H2O3TPU_DISPATCH_SAMPLE", "16") or 16), 1)
+_dispatch_seq = itertools.count()
 
 
 def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
@@ -100,56 +119,69 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     name = getattr(map_fn, "__name__", "map_reduce")
     # child span per dispatch (no-op outside an active trace); faults
     # injected below mark THIS span, so fault runs read in trace trees
+    # sampled telemetry sync (see the note at _SAMPLE_EVERY): full partition
+    # fidelity under H2O3TPU_TRACE_PARTITIONS=1, else every Nth dispatch
+    full = _tr.trace_partitions_enabled()
+    sampled = full or (next(_dispatch_seq) % _SAMPLE_EVERY == 0)
     with _tr.TRACER.span(f"map_reduce:{name}", kind="dispatch",
                          attrs={"fn": name,
-                                "partitions": mesh.size}) as span:
+                                "partitions": mesh.size,
+                                "sampled": sampled}) as span:
         if _tl.FAULTS is not None:
             _tl.FAULTS.maybe_fault("map_reduce")
-        # device-byte attribution per TRACED dispatch — only through the
-        # runtime's memory_stats counters (~µs): the live-array fallback
+        # device-byte attribution per TRACED SAMPLED dispatch — only through
+        # the runtime's memory_stats counters (~µs): the live-array fallback
         # walks every resident buffer and has no place on this hot path,
         # so backends without stats (CPU) skip it (fast probe returns None)
         mem0 = None
-        if span is not None:
+        if span is not None and sampled:
             from h2o3_tpu.utils.memory import fast_device_bytes
             mem0 = fast_device_bytes()
         t0 = time.time_ns()
-        # block before stamping: JAX dispatch is async, and an enqueue-time
-        # measurement would never see a slow collective. The psum-reduced
-        # partials are small and every caller consumes them immediately, so
-        # the sync costs nothing beyond what the caller's next op would pay.
+        # NO unconditional sync: dispatch is async, so back-to-back
+        # collectives pipeline on device and the host stops being the clock.
+        # Only a SAMPLED dispatch blocks, because an enqueue-time measurement
+        # would never see a slow collective — the sync IS the probe.
         out = fn(*cols)
-        if span is not None:
-            _partition_spans(span, out, mesh, t0)
-        out = jax.block_until_ready(out)
-        dur_ns = time.time_ns() - t0
-        if mem0 is not None:
-            mem1 = fast_device_bytes()
-            if mem1 is not None:
-                # max of the two in-use samples, NOT the runtime's
-                # peak_bytes_in_use counter — that one is process-lifetime
-                # monotonic, so after any big build every later dispatch
-                # would report the global high-water mark instead of its
-                # own footprint (same semantic as the model-span attr)
-                span.set_attrs(peak_device_bytes=max(mem0[0], mem1[0]),
-                               device_bytes_delta=mem1[0] - mem0[0])
+        if sampled:
+            if span is not None:
+                _partition_spans(span, out, mesh, t0)
+            out = jax.block_until_ready(out)  # graftlint: ok(sampled telemetry probe — the sync is the measurement)
+            dur_ns = time.time_ns() - t0
+            _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(dur_ns / 1e9)
+            if mem0 is not None:
+                mem1 = fast_device_bytes()
+                if mem1 is not None:
+                    # max of the two in-use samples, NOT the runtime's
+                    # peak_bytes_in_use counter — that one is process-lifetime
+                    # monotonic, so after any big build every later dispatch
+                    # would report the global high-water mark instead of its
+                    # own footprint (same semantic as the model-span attr)
+                    span.set_attrs(peak_device_bytes=max(mem0[0], mem1[0]),
+                                   device_bytes_delta=mem1[0] - mem0[0])
+        else:
+            # unmeasured: the timeline keeps one record per dispatch either
+            # way, but an async enqueue time must not pollute the duration
+            # series — dur_ns=0 is the ring's established "untimed event"
+            # marker; accurate durations live in the SAMPLED observations
+            dur_ns = 0
     _tl.TIMELINE.record("collective", name, dur_ns)
-    # dispatch count + partition (shard) count + duration distribution; the
+    # dispatch count + partition (shard) count always; the duration
     # histogram's min/max spread is the straggler signal (under SPMD all
     # shards run one program, so a straggler shows as dispatch max >> min)
     _tm.MR_DISPATCHES.labels(fn=name).inc()
     _tm.MR_PARTITIONS.inc(mesh.size)
-    _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(dur_ns / 1e9)
     return out
 
 
 def _partition_spans(span, out, mesh, t0: int) -> None:
-    """Per-partition sub-spans under a traced dispatch: block on each
-    device's output shard in device order and stamp when it became ready.
-    The max/argmax of those readiness times is the straggler attribution
-    (recorded as span attrs); the per-shard sync costs nothing the caller's
-    own block_until_ready would not pay. Best-effort: a trace must never
-    break a dispatch."""
+    """Per-partition sub-spans under a traced SAMPLED dispatch: block on
+    each device's output shard in device order and stamp when it became
+    ready. The max/argmax of those readiness times is the straggler
+    attribution (recorded as span attrs). Runs only on sampled dispatches /
+    under ``H2O3TPU_TRACE_PARTITIONS=1`` — the sequential shard blocking is
+    a real serialization, so it must never ride on every dispatch a traced
+    request touches. Best-effort: a trace must never break a dispatch."""
     try:
         from h2o3_tpu.utils import tracing as _tr
         leaves = jax.tree.leaves(out)
@@ -162,6 +194,7 @@ def _partition_spans(span, out, mesh, t0: int) -> None:
             for leaf in leaves:
                 sh = getattr(leaf, "addressable_shards", ())
                 if i < len(sh):
+                    # graftlint: ok(sampled straggler probe — per-shard readiness IS the measurement)
                     jax.block_until_ready(sh[i].data)
             ends.append(time.time_ns())
         durs = [e - t0 for e in ends]

@@ -1,0 +1,61 @@
+"""graftlint sync-discipline rules (SYN) — blocking device syncs in library code.
+
+- **SYN001** — ``jax.block_until_ready(...)`` / ``x.block_until_ready()``
+  outside the telemetry/tracing/timeline modules. JAX dispatch is async by
+  design: a blocking sync in library code serializes the device pipeline
+  and makes the host the clock (the exact pattern ISSUE 7 removed from the
+  ``map_reduce`` dispatch path). Measurement probes belong in the telemetry
+  modules — or, where the sync IS the measurement (a sampled duration
+  probe, a latency endpoint), carry an inline
+  ``# graftlint: ok(<reason>)`` suppression like every other rule family.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, call_name
+
+#: module basenames whose whole purpose is timing/observability — the sync
+#: there IS the product (matched on basename so fixture packages can opt a
+#: file into the exemption the same way the live package does)
+EXEMPT_BASENAMES = {"telemetry.py", "tracing.py", "timeline.py"}
+
+
+def _is_block_call(node: ast.Call) -> bool:
+    """Both spellings: ``jax.block_until_ready(x)`` and the method form
+    ``x.block_until_ready()``."""
+    name = call_name(node)
+    if name and name.split(".")[-1] == "block_until_ready":
+        return True
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready")
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        base = mod.path.rsplit("/", 1)[-1]
+        if base in EXEMPT_BASENAMES:
+            continue
+        # one walk per module: the rule is purely syntactic (no call-graph),
+        # so function scoping only matters for the `where` attribution.
+        # Walk outer functions first (lower lineno) so nested defs overwrite
+        # their parents' claim — the innermost enclosing function wins.
+        qual_of: dict[int, str] = {}
+        for fn in sorted((f for f in index.functions.values()
+                          if f.module is mod),
+                         key=lambda f: f.node.lineno):
+            for sub in ast.walk(fn.node):
+                qual_of[id(sub)] = fn.qualname
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_block_call(node):
+                findings.append(Finding(
+                    "SYN001", mod.path, node.lineno,
+                    qual_of.get(id(node), ""),
+                    "blocking `block_until_ready` in library code — JAX "
+                    "dispatch is async; a sync here serializes the device "
+                    "pipeline (move the probe into telemetry/tracing or "
+                    "suppress with a reason)",
+                    detail="block_until_ready"))
+    return findings

@@ -450,6 +450,16 @@ ITER_SECONDS = METRICS.histogram(
     "h2o3_iteration_seconds",
     "per-iteration wall time of host-driven convergence loops", ("loop",))
 
+# dispatch economy of the same loops: blocking host fetches per logical
+# iteration (1.0 = the classic sync-per-step driver; 1/K under K-step
+# megasteps). Set by models/model_base.publish_dispatch_audit at the end of
+# every fit; bench gates on it so a per-iteration fetch cannot silently
+# return to a hot path.
+DISPATCHES_PER_ITER = METRICS.gauge(
+    "h2o3_dispatches_per_iteration",
+    "blocking host syncs per logical iteration of a convergence loop "
+    "(1/K under K-step megasteps)", ("loop",))
+
 # fault injection (utils/timeline.py FaultInjector)
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))

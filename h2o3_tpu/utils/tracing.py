@@ -58,6 +58,17 @@ def enabled() -> bool:
     return os.environ.get("H2O3TPU_TRACE_OFF", "") != "1"
 
 
+def trace_partitions_enabled() -> bool:
+    """Full-fidelity partition tracing: when ``H2O3TPU_TRACE_PARTITIONS=1``,
+    EVERY traced ``map_reduce`` dispatch syncs and stamps per-partition
+    readiness sub-spans + straggler attrs. Off by default because the
+    per-shard sequential blocking serializes the data plane — the dispatch
+    path then keeps straggler attribution only on its SAMPLED dispatches
+    (see ``ops/map_reduce._SAMPLE_EVERY``). Read per call so tests and
+    operators can flip it at runtime without re-importing."""
+    return os.environ.get("H2O3TPU_TRACE_PARTITIONS", "") == "1"
+
+
 class SpanContext:
     """Immutable (trace_id, span_id) pair — what propagates."""
 

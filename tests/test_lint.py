@@ -388,6 +388,52 @@ def test_mem001_exempts_explicit_device_get(tmp_path):
     assert "MEM001" not in rules_of(run_lint(pkg))
 
 
+# -- sync discipline (SYN) ---------------------------------------------------
+
+def test_syn001_block_until_ready_flagged_both_forms(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def dispatch(x):
+            out = jnp.sum(x)
+            jax.block_until_ready(out)           # library-code sync
+            return out
+
+        def method_form(x):
+            return (x + 1).block_until_ready()   # and the method spelling
+    """})
+    findings = run_lint(pkg)
+    syn = [f for f in findings if f.rule == "SYN001"]
+    assert len(syn) == 2
+    assert {f.where for f in syn} == {"dispatch", "method_form"}
+    assert all(f.detail == "block_until_ready" for f in syn)
+
+
+def test_syn001_telemetry_modules_exempt_and_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"pkg/utils/telemetry.py": """
+        import jax
+
+        def probe(x):
+            jax.block_until_ready(x)     # the sync IS the measurement
+            return x
+    """, "pkg/utils/tracing.py": """
+        import jax
+
+        def partition_probe(x):
+            x.block_until_ready()
+            return x
+    """, "pkg/ops/dispatch.py": """
+        import jax
+
+        def sampled_probe(x):
+            # graftlint: ok(sampled telemetry probe)
+            jax.block_until_ready(x)
+            return x
+    """})
+    assert "SYN001" not in rules_of(run_lint(pkg))
+
+
 # -- suppression + baseline --------------------------------------------------
 
 def test_inline_suppression(tmp_path):

@@ -194,11 +194,15 @@ def test_chrome_export_schema_and_nesting():
 # -- map_reduce partition spans + straggler attribution ----------------------
 
 
-def test_dispatch_records_partition_spans_and_straggler_attrs(rng):
+def test_dispatch_records_partition_spans_and_straggler_attrs(rng, monkeypatch):
+    """Full-fidelity partition tracing rides behind H2O3TPU_TRACE_PARTITIONS=1
+    (ISSUE 7): with it set, every traced dispatch syncs and stamps shard
+    readiness sub-spans + straggler attrs."""
     import jax.numpy as jnp
 
     from h2o3_tpu.ops.map_reduce import map_reduce
 
+    monkeypatch.setenv("H2O3TPU_TRACE_PARTITIONS", "1")
     x = jnp.asarray(rng.normal(size=64).astype(np.float32))
 
     def total(shard):
@@ -219,6 +223,36 @@ def test_dispatch_records_partition_spans_and_straggler_attrs(rng):
         assert key in d["attrs"]
     assert all(p["parent_id"] == d["span_id"] for p in parts)
     assert len(parts) == d["attrs"]["partitions"]
+    assert d["attrs"]["sampled"] is True
+
+
+def test_unsampled_dispatch_skips_partition_spans(rng, monkeypatch):
+    """Without H2O3TPU_TRACE_PARTITIONS, an UNSAMPLED traced dispatch must
+    not serialize on per-shard readiness: the dispatch span records (the
+    tree stays connected) but no partition sub-spans, no straggler attrs,
+    and no blocking sync ride along."""
+    import sys
+
+    import jax.numpy as jnp
+
+    from h2o3_tpu.ops.map_reduce import map_reduce
+
+    mr = sys.modules["h2o3_tpu.ops.map_reduce"]
+    monkeypatch.delenv("H2O3TPU_TRACE_PARTITIONS", raising=False)
+    monkeypatch.setattr(mr, "_SAMPLE_EVERY", 10 ** 9)
+    next(mr._dispatch_seq)            # burn seq 0 — never the sampled slot
+    x = jnp.asarray(rng.normal(size=64).astype(np.float32))
+
+    with TRACER.span("mr_async_root", root=True) as root:
+        tid = root.trace_id
+        map_reduce(lambda s: s.sum(), x)
+    trace = TRACER.get_trace(tid)
+    dispatch = [s for s in trace["spans"] if s["kind"] == "dispatch"]
+    parts = [s for s in trace["spans"] if s["kind"] == "partition"]
+    assert len(dispatch) == 1 and parts == []
+    d = dispatch[0]
+    assert d["attrs"]["sampled"] is False
+    assert "straggler" not in d["attrs"]
 
 
 def test_straggler_attribution_names_the_slow_shard_not_the_last():
@@ -444,9 +478,13 @@ def test_traces_endpoints_and_client_accessors(server):
         client.trace("f" * 32)
 
 
-def test_rest_to_job_to_partition_trace_is_connected(server, tmp_path):
+def test_rest_to_job_to_partition_trace_is_connected(server, tmp_path,
+                                                     monkeypatch):
     """Tentpole: one connected span tree spanning REST → Job (worker
-    thread) → model fit → map_reduce dispatch → partition spans."""
+    thread) → model fit → map_reduce dispatch → partition spans (partition
+    sub-spans need H2O3TPU_TRACE_PARTITIONS=1 since the async-dispatch
+    refactor — sampled-only by default)."""
+    monkeypatch.setenv("H2O3TPU_TRACE_PARTITIONS", "1")
     client = H2OClient(server.url)
     rng = np.random.default_rng(7)
     x = rng.normal(size=200)
@@ -489,11 +527,12 @@ def _wait_trace(trace_id, timeout=10.0):
 
 
 @pytest.mark.slow
-def test_automl_trace_acceptance(server, tmp_path):
+def test_automl_trace_acceptance(server, tmp_path, monkeypatch):
     """Acceptance: a completed REST AutoML run yields ONE connected span
     tree spanning REST → leaderboard jobs → per-model map_reduce partition
     spans, with a non-empty critical path and at least one straggler
     attribution attr; its Perfetto export is valid Chrome trace JSON."""
+    monkeypatch.setenv("H2O3TPU_TRACE_PARTITIONS", "1")
     client = H2OClient(server.url)
     rng = np.random.default_rng(11)
     n = 150
