@@ -388,6 +388,127 @@ def _scoring_gate(sc: dict) -> None:
         sys.exit(3)
 
 
+def bench_chaos(ndev: int) -> dict:
+    """Completion-under-faults (ISSUE 8 acceptance): with ``drop_rate=0.02``
+    on the dispatch path, GLM and GBM builds must complete with results
+    within 1e-6 of the fault-free run — the retry/backoff layer absorbs the
+    injected faults. A dispatch storm under the same injector exercises the
+    retry path at volume, and the whole faulted phase runs under a WATCHDOG:
+    a deadlocked chaos run records ``completed: false`` (the gate refuses to
+    stamp) instead of hanging the bench."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.ops.map_reduce import map_reduce
+    from h2o3_tpu.utils.registry import DKV
+    from h2o3_tpu.utils.telemetry import DISPATCH_RETRIES
+    from h2o3_tpu.utils.timeline import inject_faults
+
+    n = 2_000 if SMOKE else 50_000
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    logit = X[:, :3] @ np.array([1.0, -0.7, 0.4], np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.where(rng.random(n) < 1.0 / (1.0 + np.exp(-logit)),
+                         "yes", "no")
+    fr = Frame.from_arrays(cols)
+
+    def builds():
+        glm = GLM(family="binomial", lambda_=1e-4, max_iterations=15,
+                  model_id="chaos_glm").train(y="y", training_frame=fr)
+        gbm = GBM(ntrees=8, max_depth=4, seed=11, trees_per_dispatch=2,
+                  model_id="chaos_gbm").train(y="y", training_frame=fr)
+        pg = np.asarray(jax.device_get(glm._score_raw(fr)))
+        pb = np.asarray(jax.device_get(gbm._score_raw(fr)))
+        for k in ("chaos_glm", "chaos_gbm"):
+            DKV.remove(k)
+        return pg, pb
+
+    t0 = time.perf_counter()
+    clean_glm, clean_gbm = builds()         # fault-free reference (+ warm-up)
+    clean_secs = time.perf_counter() - t0
+
+    def retried_total():
+        return sum(c.value for labels, c in DISPATCH_RETRIES.children()
+                   if labels["outcome"] == "retried")
+
+    storm = jnp.ones(256, jnp.float32)
+    result: dict = {}
+
+    def _storm_sum(s):
+        return s.sum()
+
+    def chaos_phase():
+        try:
+            # dispatch storm: enough dispatches that 2% drops MUST fire and
+            # be absorbed (P(zero faults) < 1e-4 at 500 draws); one stable
+            # map_fn so the compiled-program cache serves every call
+            for _ in range(20 if SMOKE else 500):
+                map_reduce(_storm_sum, storm)
+            result["glm"], result["gbm"] = builds()
+        except BaseException as e:   # noqa: BLE001 — the gate refuses on it
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    r0 = retried_total()
+    with inject_faults(drop_rate=0.02, delay_rate=0.02, delay_ms=1,
+                       seed=17) as inj:
+        worker = threading.Thread(target=chaos_phase, daemon=True)
+        tc0 = time.perf_counter()
+        worker.start()
+        # watchdog: generous multiple of the clean wall — a faulted run
+        # that exceeds it is treated as deadlocked and refused
+        worker.join(timeout=max(20.0, 10.0 * clean_secs + 60.0))
+        chaos_secs = time.perf_counter() - tc0
+        completed = not worker.is_alive()
+    faults = inj.dropped + inj.delayed
+    if completed and result.get("error"):
+        # the faulted run DIED rather than deadlocked — equally refusable
+        return {"error": f"faulted run failed: {result['error']}",
+                "faults_injected": faults}
+    out = dict(completed=completed,
+               faults_injected=faults,
+               faults_dropped=inj.dropped, faults_delayed=inj.delayed,
+               retries_absorbed=round(retried_total() - r0, 1),
+               drop_rate=0.02,
+               clean_seconds=round(clean_secs, 2),
+               chaos_seconds=round(chaos_secs, 2))
+    if completed:
+        out["glm_divergence"] = float(np.abs(result["glm"]
+                                             - clean_glm).max())
+        out["gbm_divergence"] = float(np.abs(result["gbm"]
+                                             - clean_gbm).max())
+    return out
+
+
+def _chaos_gate(ch: dict) -> None:
+    """Refuse to stamp an artifact whose chaos run deadlocked or diverged:
+    a faulted build that hangs means retry/backoff lost a failure (the
+    exact regression this layer exists to prevent), and divergence beyond
+    1e-6 means a retry re-ran a non-functional dispatch."""
+    if ch.get("error"):
+        print(f"# bench REFUSED: chaos section failed: {ch['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if not ch["completed"]:
+        print("# bench REFUSED: chaos run DEADLOCKED — faulted builds did "
+              "not complete within the watchdog budget", file=sys.stderr)
+        sys.exit(3)
+    if ch["glm_divergence"] > 1e-6 or ch["gbm_divergence"] > 1e-6:
+        print(f"# bench REFUSED: faulted builds diverged from the "
+              f"fault-free run (glm {ch['glm_divergence']}, gbm "
+              f"{ch['gbm_divergence']} > 1e-6)", file=sys.stderr)
+        sys.exit(3)
+    if not SMOKE and ch["faults_injected"] == 0:
+        print("# bench REFUSED: chaos phase injected zero faults — the "
+              "harness is hollow", file=sys.stderr)
+        sys.exit(3)
+
+
 def bench_tracing(ndev: int) -> dict:
     """Trace-store overhead + the slowest trace's critical path.
 
@@ -802,6 +923,14 @@ def main() -> None:
     out["extra"]["dispatch_audit"] = _dispatch_audit_section(
         out["extra"]["backend"])
     _dispatch_gate(out)
+    # chaos: completion-under-faults with retry absorption (ISSUE 8) —
+    # refuses to stamp when a faulted run deadlocks or diverges
+    try:
+        ch = bench_chaos(ndev)
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        ch = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["chaos"] = ch
+    _chaos_gate(ch)
     # serving path: score_qps through the compiled/batched /3/Score tier
     # vs the per-request predict path (ISSUE 6: the scoring tier gets the
     # same perf trajectory the training path has)

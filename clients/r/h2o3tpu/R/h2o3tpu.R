@@ -596,6 +596,14 @@ h2o.memory <- function(top = 10) {
   .http("GET", paste0("/3/Memory?top=", as.integer(top)))
 }
 
+h2o.job <- function(job_key) {
+  # one job's JobV3: status/progress plus the reliability surface —
+  # retries (dispatch retries absorbed), max_runtime_secs/deadline_exceeded
+  # (deadline budget), auto_recoverable/auto_recovery_dir (crash-resume
+  # snapshot state; docs/RELIABILITY.md)
+  .http("GET", paste0("/3/Jobs/", job_key))$jobs[[1]]
+}
+
 h2o.jstack <- function() {
   # all server thread stacks (reference: h2o-r h2o.killMinus3 analog reads)
   .http("GET", "/3/JStack")$traces

@@ -221,6 +221,14 @@ class H2OClient:
     def jobs(self) -> list[dict]:
         return self.request("GET", "/3/Jobs")["jobs"]
 
+    def job(self, job_key: str) -> dict:
+        """One job's JobV3 — status/progress plus the reliability surface:
+        ``retries`` (dispatch retries the build absorbed),
+        ``max_runtime_secs``/``deadline_exceeded`` (deadline budget), and
+        ``auto_recoverable``/``auto_recovery_dir`` (crash-resume snapshot
+        state; docs/RELIABILITY.md)."""
+        return self.request("GET", f"/3/Jobs/{job_key}")["jobs"][0]
+
     # -- observability (h2o-py: cluster().timeline / get_log; plus metrics) --
 
     def timeline(self) -> list[dict]:

@@ -426,8 +426,18 @@ def job_v3(job_id: str, job) -> dict:
          "progress": _clean(job.progress), "progress_msg": job.progress_msg,
          "msec": int(job.run_time * 1000),
          "description": getattr(job, "description", ""),
-         "auto_recoverable": False,  # these three are read unconditionally
-         "exception": None,          # by h2o-py's H2OJob init/poll loop
+         # reliability surface (docs/RELIABILITY.md): True when the build
+         # auto-checkpoints under auto_recovery_dir (hex/faulttolerance
+         # semantics — a crashed job restarts from its snapshot); h2o-py's
+         # H2OJob reads auto_recoverable/exception/warnings unconditionally
+         "auto_recoverable": bool(getattr(job, "auto_recovery_dir", None)),
+         "auto_recovery_dir": getattr(job, "auto_recovery_dir", None),
+         # dispatch retries this job's build absorbed + its deadline budget
+         "retries": int(getattr(job, "retries", 0) or 0),
+         "max_runtime_secs": _clean(float(
+             getattr(job, "max_runtime_secs", 0.0) or 0.0)),
+         "deadline_exceeded": bool(getattr(job, "deadline_exceeded", False)),
+         "exception": None,
          "warnings": None,
          # the trace the job's execution reports into (None when it was
          # created outside any trace) — pollers correlate via /3/Traces/{id}
@@ -436,6 +446,9 @@ def job_v3(job_id: str, job) -> dict:
     if job.status == "FAILED" and job.exception is not None:
         d["exception"] = str(job.exception)
         d["stacktrace"] = ""
+        if getattr(job, "retry_history", None):
+            # what the retry budget tried before giving up (DispatchFailed)
+            d["retry_history"] = job.retry_history
     return d
 
 

@@ -574,7 +574,14 @@ class _Handler(BaseHTTPRequestHandler):
             kwargs["base_models"] = [
                 DKV[str(_name(b)).strip('"')]
                 for b in (kwargs.get("base_models") or [])]
-        builder = cls(**kwargs)
+        try:
+            builder = cls(**kwargs)
+            builder.validate_request()
+        except ValueError as e:
+            # a request the build could NEVER satisfy (unknown params, an
+            # unsupported checkpoint combination) is a client error — a
+            # structured 400 now, not a FAILED job the poller unwraps later
+            return self._error(400, str(e))
         self._run_build_job(
             algo.lower(), builder, p.get("model_id"),
             lambda: builder.train(x=x, y=y, training_frame=frame,
@@ -592,8 +599,53 @@ class _Handler(BaseHTTPRequestHandler):
         builder.model_id = model_id or f"{algo}_{uuid.uuid4().hex[:10]}"
         job = Job(f"{algo} via REST", key=f"job_{uuid.uuid4().hex[:12]}")
         job.dest_key = builder.model_id
+        # mirror the builder's reliability contract onto the REST job so
+        # /3/Jobs pollers see the deadline/recovery surface from the first
+        # poll (the inner library Job enforces; this one reports)
+        params = getattr(builder, "params", {})
+        job.max_runtime_secs = float(params.get("max_runtime_secs") or 0.0)
+        # only advertised when the builder actually writes snapshots
+        # (supports_auto_recovery) — the inner job applies the same gate
+        job.auto_recovery_dir = (
+            params.get("auto_recovery_dir")
+            if getattr(builder, "supports_auto_recovery", lambda: False)()
+            else None)
+
+        # forward /3/Jobs/{id}/cancel into the INNER library job the build
+        # loops actually poll — without this a REST cancel only flips the
+        # outer job's flag and the build runs to completion anyway
+        _outer_cancel = job.cancel
+
+        def _cancel_both():
+            _outer_cancel()
+            # flag FIRST, then try the inner job: train() re-checks the flag
+            # right after creating its Job, so a cancel landing in the
+            # window before builder.job exists is still honored (the
+            # orderings make losing both impossible)
+            builder._cancel_requested_early = True
+            inner = getattr(builder, "job", None)
+            if inner is not None:
+                inner.cancel()
+        job.cancel = _cancel_both
 
         def driver(j: Job):
+            def mirror_inner_cancel():
+                # the build terminated on its deadline/cancel — the REST
+                # job must read CANCELLED (not DONE) and carry the deadline
+                # evidence, whether train() returned a partial model or
+                # raised JobCancelled (no-partial builders like GLM)
+                inner = getattr(builder, "job", None)
+                if inner is None or inner.status != Job.CANCELLED:
+                    return
+                j.keep_partial()
+                if inner.deadline_exceeded:
+                    # one locked transition: a poller must never observe
+                    # the flag without its progress_msg (Job invariant)
+                    with j._lock:
+                        j.deadline_exceeded = True
+                        j.progress_msg = inner.progress_msg
+                j.cancel()
+
             # one combined acquisition — two separate with-statements would
             # reintroduce the ABBA deadlock the global sort order prevents
             with LOCKS.locked(write=(builder.model_id,), read=frame_keys):
@@ -604,9 +656,13 @@ class _Handler(BaseHTTPRequestHandler):
                         raise KeyError(f"{fk} not found")
                 try:
                     m = train_fn()
+                except BaseException:
+                    mirror_inner_cancel()
+                    raise
                 finally:
                     if cleanup is not None:
                         cleanup()
+            mirror_inner_cancel()
             j.dest_key = m.key
             return m
 

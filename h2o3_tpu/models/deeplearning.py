@@ -39,8 +39,9 @@ from h2o3_tpu.frame.types import VecType
 from h2o3_tpu.frame.vec import Vec
 from h2o3_tpu.models.data_info import DataInfo, response_as_float
 from h2o3_tpu.models.job import Job
-from h2o3_tpu.models.model_base import (Model, ModelBuilder, make_model_key,
-                                        megastep_k, publish_dispatch_audit)
+from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
+                                        make_model_key, megastep_k,
+                                        publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 
@@ -342,6 +343,9 @@ class DeepLearning(ModelBuilder):
             bs.append(jnp.zeros(width, jnp.float32))
         return {"W": Ws, "b": bs}
 
+    def supports_auto_recovery(self) -> bool:
+        return True     # epoch-boundary snapshots in _fit
+
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DeepLearningModel:
         p = self.params
         act, act_dropout = _act_kind(p["activation"])
@@ -380,15 +384,21 @@ class DeepLearning(ModelBuilder):
         key = jax.random.PRNGKey(seed if seed >= 0 else 5318008)
         key, init_key = jax.random.split(key)
         cp = self._resolve_checkpoint()
+        done_ep = 0
+        samples0 = 0.0
         if cp is not None:
             # resume from the prior model's weights (reference:
             # DeepLearning.java:348 checkpoint path: continue training the
-            # same topology on more epochs)
+            # same topology on more epochs). An auto-recovery snapshot
+            # additionally carries epochs_done, so a crashed build resumes
+            # with only the REMAINING epochs instead of the full budget.
             if cp.output["sizes"] != sizes or cp.output["act"] != act:
                 raise ValueError("checkpoint topology/activation differs; "
                                  "hidden/activation are immutable across resume")
             params = cp.output["params"]
             key = jax.random.fold_in(key, 1 + int(cp.output["samples_trained"]))
+            done_ep = int(cp.output.get("epochs_done") or 0)
+            samples0 = float(cp.output.get("samples_trained") or 0.0)
         else:
             params = self._init_params(init_key, sizes, act)
 
@@ -420,22 +430,52 @@ class DeepLearning(ModelBuilder):
         epochs = float(p["epochs"])
         n_epochs = max(int(np.ceil(epochs)), 1)
 
-        samples = jnp.float32(0.0)
+        samples = jnp.float32(samples0)
         k_mega = megastep_k()
         epoch_losses = []        # [k] device arrays; fetched once post-loop
         ep = 0
+        n_epochs = max(n_epochs - done_ep, 0)   # remaining after auto-resume
         dispatches = 0
         import time as _time
+        from h2o3_tpu.models.job import JobCancelled
+        from h2o3_tpu.ops.map_reduce import retrying
+        from h2o3_tpu.persist.recovery import checkpoint_every
+        recovery = getattr(self, "_build_recovery", None)
+        ckpt_every = checkpoint_every()
+        last_snap = 0
+
+        def _snapshot(epochs_now: int) -> None:
+            pm = DeepLearningModel(
+                key=f"{self.model_id or self.algo}_autockpt",
+                params=ModelParameters(p), data_info=di,
+                response_column=None if autoenc else y,
+                response_domain=domain,
+                output=dict(params=params, act=act, sizes=sizes,
+                            score_history=[],
+                            samples_trained=float(jax.device_get(samples)),
+                            epochs_done=done_ep + epochs_now))
+            recovery.snapshot(pm, progress=done_ep + epochs_now,
+                              target=done_ep + n_epochs)
+
         while ep < n_epochs:
+            if job.should_stop:
+                # cooperative deadline/cancel between megasteps: trained
+                # epochs are kept (partial model, job CANCELLED)
+                job.keep_partial()
+                break
             # K epochs per compiled dispatch (trailing chunk compiles its own
             # smaller K once); shuffle + minibatching run inside the program,
             # so the host dispatches WORK, not steps
             kk = min(k_mega, n_epochs - ep)
             t0 = _time.time_ns()
+            _in = (params, opt, key, samples)
             with timed_event("iteration", "dl_epoch"):
-                params, opt, key, samples, losses_k = _train_epochs(
-                    params, opt, X, yy, w, key, samples,
-                    act, loss, nclasses, cfg, kk, nb, B, autoenc)
+                # retried on transient dispatch failure: the megastep is
+                # functional over its inputs, so a re-run is exact
+                params, opt, key, samples, losses_k = retrying(
+                    "dl_epochs", lambda: _train_epochs(
+                        *_in[:2], X, yy, w, *_in[2:],
+                        act, loss, nclasses, cfg, kk, nb, B, autoenc))
             # NO per-epoch fetch: the loss series stays on device and is
             # fetched in one batched transfer below, so megasteps pipeline
             epoch_losses.append(losses_k)
@@ -448,9 +488,18 @@ class DeepLearning(ModelBuilder):
             dt = (_time.time_ns() - t0) / 1e9
             for _ in range(kk):
                 _tm.ITER_SECONDS.labels(loop="dl_epoch").observe(dt / kk)
-            job.update(ep / n_epochs, f"epoch {ep}/{n_epochs}")
+            if recovery is not None and ep - last_snap >= ckpt_every:
+                _snapshot(ep)
+                last_snap = ep
+            try:
+                job.update(ep / max(n_epochs, 1), f"epoch {ep}/{n_epochs}")
+            except JobCancelled:
+                job.keep_partial()
+                break           # partial-result algorithm: keep the epochs
             if job.cancelled:
                 break
+        if recovery is not None and job.should_stop and ep > last_snap:
+            _snapshot(ep)       # CANCELLED builds stay resumable
         publish_dispatch_audit(self, "dl_epoch", iterations=max(ep, 1),
                                host_syncs=1, device_dispatches=dispatches)
         score_history = [
@@ -460,7 +509,6 @@ class DeepLearning(ModelBuilder):
                  for a in jax.device_get(epoch_losses)])
                 if epoch_losses else [])]
 
-        from h2o3_tpu.models.model_base import ModelParameters
         model = DeepLearningModel(
             key=make_model_key(self.algo, self.model_id),
             params=ModelParameters(p),

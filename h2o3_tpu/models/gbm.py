@@ -24,15 +24,15 @@ import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import _remap_codes
-from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.job import Job, JobCancelled
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, make_model_key,
                                         publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 from jax import lax
 
-from h2o3_tpu.models.tree import (Tree, _grow_tree_device, predict_binned,
-                                  predict_raw)
+from h2o3_tpu.models.tree import (Tree, _grow_tree_device, fold_binned,
+                                  predict_binned, predict_raw)
 from h2o3_tpu.ops.quantile import bin_features, compute_bin_edges
 
 
@@ -442,6 +442,10 @@ def _heap_to_host(heap):
 
 class SharedTreeModel(Model):
     def _tree_raw_sum(self, frame: Frame) -> jax.Array:
+        if not self.output["trees"]:
+            # a deadline-cancelled build may legitimately hold zero trees;
+            # it scores as the null model (f0 margin only)
+            return jnp.zeros(frame.plen, jnp.float32)
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
         return predict_raw(X, self.output["trees"],
                            cat_card=self.output.get("cat_card"),
@@ -528,6 +532,11 @@ class SharedTreeModel(Model):
 
     def _tree_raw_sum_per_class(self, frame: Frame) -> jax.Array:
         """[rows, K] per-class sums for multinomial (trees_multi[k] = class k)."""
+        if not any(self.output["trees_multi"]):
+            # zero-round deadline-cancelled partial: null model (f0 only),
+            # same contract as the single-class guard in _tree_raw_sum
+            return jnp.zeros((frame.plen, len(self.output["trees_multi"])),
+                             jnp.float32)
         X = tree_matrix(frame, self.output["x_cols"], self.output["feat_domains"])
         cc = self.output.get("cat_card")
         nb = int(self.output.get("cat_bins") or 0)
@@ -896,6 +905,18 @@ class GBM(SharedTreeBuilder):
 
     algo = "gbm"
 
+    def supports_auto_recovery(self) -> bool:
+        return True     # chunk-boundary snapshots in _grow_with_stopping
+
+    def _retag_model(self, m: GBMModel) -> GBMModel:
+        """Partial-model snapshots must carry the builder's model class so
+        a resume passes the checkpoint algo check (XGBoost re-classes its
+        models the same way at the end of ``_fit``)."""
+        if self.algo == "xgboost":
+            from h2o3_tpu.models.xgboost import XGBoostModel
+            m.__class__ = XGBoostModel
+        return m
+
     @classmethod
     def defaults(cls) -> dict:
         return dict(
@@ -1006,7 +1027,10 @@ class GBM(SharedTreeBuilder):
         trees: list[Tree] = []
         if cp is not None:
             trees = list(cp.output["trees"])
-            Fcur = Fcur + lr * predict_binned(binned, trees, int(p["nbins"]))
+            # fold (not sum-then-scale): the resumed margins must match the
+            # uninterrupted scan's accumulation order bit-for-bit, so the
+            # remaining trees come out identical (exact-resume contract)
+            Fcur = fold_binned(binned, trees, int(p["nbins"]), lr, Fcur)
         ntrees = int(p["ntrees"])
         done = len(trees)
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
@@ -1037,9 +1061,31 @@ class GBM(SharedTreeBuilder):
                 edges, 0, f0, lr, domains,
                 yvec.domain if yvec.is_categorical else None,
                 prior_trees=trees or None)
+
+        # auto-checkpoint constructor: a resumable partial ensemble in the
+        # exact shape checkpoint= resume consumes (distinct key — the final
+        # model must never be clobbered by its own snapshot)
+        self._partial_model_fn = None
+        if getattr(self, "_build_recovery", None) is not None:
+            def _partial(grown: list) -> GBMModel:
+                pm = GBMModel(
+                    key=f"{self.model_id or self.algo}_autockpt",
+                    params=self.params, data_info=None, response_column=y,
+                    response_domain=(yvec.domain if yvec.is_categorical
+                                     else None),
+                    output=dict(trees=trees + grown, edges=edges, f0=f0,
+                                learn_rate=lr, distribution=dist,
+                                x_cols=list(x), feat_domains=domains,
+                                ntrees=len(trees) + len(grown),
+                                **({"custom_link": custom_dist.link_name}
+                                   if custom_dist is not None else {}),
+                                **self._cat_output()))
+                return self._retag_model(pm)
+            self._partial_model_fn = _partial
         grown, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
                                                fmask_base, Fcur, keys, dist,
                                                0, kwargs, p, valid=valid)
+        self._partial_model_fn = None
         trees += grown
         job.update(0.9, f"{len(trees)} trees grown")
         # final margins double as training predictions (skips the re-score);
@@ -1202,7 +1248,24 @@ class GBM(SharedTreeBuilder):
         nbins = int(kwargs["n_bins"])
         best, since = np.inf, 0
         chunks = 0
+        # auto-checkpoint plumbing (docs/RELIABILITY.md): the fit installed
+        # a partial-model constructor when auto_recovery_dir is set; every
+        # ckpt_every grown trees the partial ensemble lands on disk through
+        # the SAME artifact format checkpoint= resume consumes
+        recovery = getattr(self, "_build_recovery", None)
+        partial_fn = getattr(self, "_partial_model_fn", None)
+        from h2o3_tpu.persist.recovery import checkpoint_every
+        ckpt_every = checkpoint_every()
+        last_snap = 0
+        deadline_stop = False
+        from h2o3_tpu.ops.map_reduce import retrying
         for s0 in range(0, M, per):
+            if job.should_stop:
+                # cooperative deadline/cancel between chunks: built trees
+                # are KEPT — the model returns partial, the job CANCELLED
+                deadline_stop = True
+                job.keep_partial()
+                break
             kchunk = keys[s0:s0 + per]
             take = kchunk.shape[0]
             if take < per and per <= M:
@@ -1214,17 +1277,29 @@ class GBM(SharedTreeBuilder):
                                        np.full(per - take, take - 1)])
                 kchunk = kchunk[reps]
             F_prev = Fcur
+
+            def _chunk():
+                Fc, heap, extras, Fv = _boost_scan(
+                    binned, edges, yc, w, fmask_base, F_prev, kchunk,
+                    track=metric, val=valid, **kwargs)
+                # ONE batched host transfer per chunk (tunnel round-trips
+                # are ~40ms each; per-leaf gets would pay a dozen of them);
+                # the fetch feeds the host-side early-stopping decision —
+                # and surfaces any async dispatch error INSIDE the retry
+                # scope
+                hh, eh = jax.device_get(  # graftlint: ok(batched chunk fetch)
+                    (heap, extras))
+                return Fc, hh, eh, Fv
+
             with timed_event("tree", f"{self.algo}:chunk",
                              observe=_tm.ITER_SECONDS.labels(
                                  loop=f"{self.algo}_chunk")):
-                Fcur, heap, extras, Fvend = _boost_scan(
-                    binned, edges, yc, w, fmask_base, Fcur, kchunk,
-                    track=metric, val=valid, **kwargs)
-                # ONE batched host transfer per chunk (tunnel round-trips are
-                # ~40ms each; per-leaf gets would pay a dozen of them); the
-                # fetch feeds the host-side early-stopping decision
-                heap_h, extras_h = jax.device_get(  # graftlint: ok(batched chunk fetch)
-                    (heap, extras))
+                # transient dispatch failures (injected drops, transient
+                # runtime errors) retry with backoff instead of killing the
+                # build; the chunk is functional over F_prev so a re-run is
+                # exact
+                Fcur, heap_h, extras_h, Fvend = retrying(
+                    f"{self.algo}_chunk", _chunk)
             chunks += 1
             heap_h = jax.tree.map(np.asarray, heap_h)
             new_trees = collect(heap_h, take)
@@ -1251,8 +1326,24 @@ class GBM(SharedTreeBuilder):
             if vs is not None:
                 vser.extend(vs[:keep])
             shown = -series[keep - 1] if metric == "AUC" else series[keep - 1]
-            job.update(0.1 + 0.8 * min(s0 + keep, M) / M,
-                       f"{len(out_trees)}/{M} trees, {metric} {shown:.5f}")
+            try:
+                job.update(0.1 + 0.8 * min(s0 + keep, M) / M,
+                           f"{len(out_trees)}/{M} trees, {metric} {shown:.5f}")
+            except JobCancelled:
+                # deadline/cancel tripped inside update: this algorithm
+                # keeps partial results, so swallow the cooperative raise
+                # and stop growing — the job still terminates CANCELLED
+                deadline_stop = True
+                job.keep_partial()
+            if recovery is not None and partial_fn is not None and \
+                    len(out_trees) - last_snap >= ckpt_every:
+                pm = partial_fn(list(out_trees))
+                # progress counts TOTAL ensemble trees (prior checkpoint
+                # included) against the params target, so a resume-of-a-
+                # resume keeps its arithmetic straight
+                recovery.snapshot(pm, progress=int(pm.output["ntrees"]),
+                                  target=int(p["ntrees"]))
+                last_snap = len(out_trees)
             if keep < kchunk.shape[0] and not kwargs.get("drf"):
                 # the scan's margins include discarded trees (mid-chunk stop
                 # or chunk padding) — replay to the kept prefix; one cheap
@@ -1264,8 +1355,15 @@ class GBM(SharedTreeBuilder):
                          for k in range(nclass)], axis=1)
                 else:
                     Fcur = F_prev + lr * predict_binned(binned, kept, nbins)
-            if stop_at is not None:
+            if stop_at is not None or deadline_stop:
                 break
+        if deadline_stop and recovery is not None and partial_fn is not None \
+                and len(out_trees) > last_snap:
+            # deadline-cancelled builds stay resumable from exactly where
+            # they stopped (train() keeps the snapshot on CANCELLED)
+            pm = partial_fn(list(out_trees))
+            recovery.snapshot(pm, progress=int(pm.output["ntrees"]),
+                              target=int(p["ntrees"]))
         self._score_series = (metric, tser, vser if vser else None)
         # dispatch economy: ONE host sync (the stopping/heap fetch) per
         # `trees_per_dispatch`-sized chunk, not per boosting round
@@ -1299,9 +1397,11 @@ class GBM(SharedTreeBuilder):
         if cp is not None:
             trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
             done = len(trees_multi[0])
-            Fcur = Fcur + lr * jnp.stack(
-                [predict_binned(binned, ts, int(p["nbins"]))
-                 for ts in trees_multi], axis=1)
+            # per-class sequential fold matches the scan's per-round
+            # accumulation order exactly (see the single-class path)
+            Fcur = jnp.stack(
+                [fold_binned(binned, ts, int(p["nbins"]), lr, Fcur[:, ki])
+                 for ki, ts in enumerate(trees_multi)], axis=1)
         ntrees = int(p["ntrees"])
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
         job.update(0.1, f"growing {(ntrees - done) * K} trees (one fused program)")
@@ -1326,10 +1426,28 @@ class GBM(SharedTreeBuilder):
             valid = self._valid_stop_data(
                 edges, K, f0, lr, domains, yvec.domain,
                 prior_trees=trees_multi if done else None)
+        self._partial_model_fn = None
+        if getattr(self, "_build_recovery", None) is not None:
+            def _partial(rounds_grown: list) -> GBMModel:
+                tm = [list(ts) for ts in trees_multi]
+                for per_class in rounds_grown:
+                    for k in range(K):
+                        tm[k].append(per_class[k])
+                pm = GBMModel(
+                    key=f"{self.model_id or self.algo}_autockpt",
+                    params=self.params, data_info=None, response_column=y,
+                    response_domain=yvec.domain,
+                    output=dict(trees_multi=tm, edges=edges, f0_multi=f0,
+                                learn_rate=lr, distribution="multinomial",
+                                x_cols=list(x), feat_domains=domains,
+                                ntrees=len(tm[0]), **self._cat_output()))
+                return self._retag_model(pm)
+            self._partial_model_fn = _partial
         rounds, Fend = self._grow_with_stopping(job, binned, edges, yc, w,
                                                 jnp.ones(binned.shape[1], bool),
                                                 Fcur, keys, "multinomial", K,
                                                 kwargs, p, valid=valid)
+        self._partial_model_fn = None
         for per_class in rounds:
             for k in range(K):
                 trees_multi[k].append(per_class[k])
@@ -1424,6 +1542,12 @@ class DRF(SharedTreeBuilder):
                 trees_multi = [list(ts) for ts in cp.output["trees_multi"]]
                 done = len(trees_multi[0])
             keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+            # deadline checkpoint: DRF grows the whole forest in ONE fused
+            # program, so the budget is only observable at dispatch
+            # boundaries — a deadline that already tripped cancels here,
+            # before the program launches (docs/RELIABILITY.md)
+            job.update(0.1, f"growing {(ntrees - done) * nclass} trees "
+                            "(one fused program)")
             _, heap, _, _ = _boost_scan(
                 binned, edges, yc, w, fmask,
                 jnp.zeros((binned.shape[0], nclass), jnp.float32), keys,
@@ -1439,6 +1563,12 @@ class DRF(SharedTreeBuilder):
             for m in range(ntrees - done):
                 for k in range(nclass):
                     trees_multi[k].append(_trees_from_stacked(heap, m, k))
+            try:
+                job.update(0.9, f"{ntrees * nclass} trees grown")
+            except JobCancelled:
+                # deadline tripped while the program ran: the forest is
+                # already complete — keep it (job still reads CANCELLED)
+                job.keep_partial()
             return DRFModel(
                 key=make_model_key(self.algo, self.model_id),
                 params=self.params, data_info=None, response_column=y,
@@ -1462,6 +1592,9 @@ class DRF(SharedTreeBuilder):
             trees = list(cp.output["trees"])
         done = len(trees)
         keys = jax.random.split(key, ntrees * 3).reshape(ntrees, 3, 2)[done:]
+        # deadline checkpoint at the dispatch boundary (see the multinomial
+        # branch above): cancel BEFORE the fused forest program launches
+        job.update(0.1, f"growing {ntrees - done} trees (one fused program)")
         _, heap, _, _ = _boost_scan(
             binned, edges, yc, w, fmask,
             jnp.zeros(binned.shape[0], jnp.float32), keys,
@@ -1474,6 +1607,12 @@ class DRF(SharedTreeBuilder):
             cat_feats=self._cat_feats)
         heap = _heap_to_host(heap)
         trees += [_trees_from_stacked(heap, m) for m in range(ntrees - done)]
+        try:
+            job.update(0.9, f"{len(trees)} trees grown")
+        except JobCancelled:
+            # forest is complete by the time the deadline is observable —
+            # keep it; the job still terminates CANCELLED
+            job.keep_partial()
 
         model = DRFModel(
             key=make_model_key(self.algo, self.model_id),

@@ -28,6 +28,7 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.models.distributions import get_family
 from h2o3_tpu.models.job import Job
+from h2o3_tpu.ops.map_reduce import retrying
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
                                         make_model_key, megastep_k,
                                         publish_dispatch_audit)
@@ -669,8 +670,9 @@ class GLM(ModelBuilder):
         megasteps = 0
         while it_total < max_it and not done:
             t0 = time.time_ns()
-            with timed_event("iteration", "glm_irls"):
-                beta, devs_d, ran_d, done_d = _irls_megastep(
+
+            def _megastep(beta=beta, it_total=it_total, dev_prev=dev_prev):
+                b, devs_d, ran_d, done_d = _irls_megastep(
                     family, tw, X, yy, w, beta, lam, k, it_total, max_it,
                     beta_eps, obj_eps, dev_prev, non_negative=nn, off=off,
                     lo=lo, hi=hi, has_bounds=bounds is not None)
@@ -679,6 +681,12 @@ class GLM(ModelBuilder):
                 # the convergence test
                 devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
                     np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+                return b, devs, ran, done
+
+            with timed_event("iteration", "glm_irls"):
+                # transient dispatch failures retry with backoff (the
+                # megastep is functional over beta — a re-run is exact)
+                beta, devs, ran, done = retrying("glm_megastep", _megastep)
             megasteps += 1
             n = int(ran.sum())
             steps = [float(d) for d in devs[:n]]
@@ -995,14 +1003,19 @@ class GLM(ModelBuilder):
         megasteps = 0
         while it_total < max_it and not done:
             t0 = time.time_ns()
-            with timed_event("iteration", "glm_multinomial"):
-                B, devs_d, ran_d, done_d = _multinomial_megastep(
+
+            def _megastep(B=B, it_total=it_total, dev_prev=dev_prev):
+                B2, devs_d, ran_d, done_d = _multinomial_megastep(
                     K, X, yoh, w, B, jnp.float32(lam), jnp.float32(lam1), k,
                     it_total, max_it, obj_eps, dev_prev, non_negative=nn)
                 # ONE blocking fetch per K-step megastep — the per-step
                 # deviance series IS the stopping test
                 devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
                     np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+                return B2, devs, ran, done
+
+            with timed_event("iteration", "glm_multinomial"):
+                B, devs, ran, done = retrying("glm_megastep", _megastep)
             megasteps += 1
             n = int(ran.sum())
             steps = [float(d) for d in devs[:n]]

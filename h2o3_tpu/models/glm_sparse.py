@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.frame.sparse import SparseFrame, SparseMatrix
+from h2o3_tpu.ops.map_reduce import retrying
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.timeline import timed_event
 
@@ -152,14 +153,21 @@ def fit_sparse_glm(builder, job, sf: SparseFrame, y: str, weights=None):
     megasteps = 0
     while it_total < mi and not done:
         t0 = time.time_ns()
-        with timed_event("iteration", "glm_sparse_irls"):
-            beta, devs_d, ran_d, done_d = _sparse_irls_megastep(
+
+        def _megastep(beta=beta, it_total=it_total, dev_prev=dev_prev):
+            b, devs_d, ran_d, done_d = _sparse_irls_megastep(
                 family, X.data, X.row, X.col, X.nrows, X.ncols, yy, w, beta,
                 lam, k, it_total, mi, beta_eps, dev_prev)
             # ONE blocking transfer per K-step megastep — the per-step
             # deviance series + executed count IS the convergence test
             devs, ran, done = map(  # graftlint: ok(one batched fetch per megastep)
                 np.asarray, jax.device_get((devs_d, ran_d, done_d)))
+            return b, devs, ran, done
+
+        with timed_event("iteration", "glm_sparse_irls"):
+            # transient dispatch failures retry with backoff (functional
+            # over beta — a re-run is exact)
+            beta, devs, ran, done = retrying("glm_megastep", _megastep)
         megasteps += 1
         n = int(ran.sum())
         steps = [float(d) for d in devs[:n]]

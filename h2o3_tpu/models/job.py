@@ -9,6 +9,7 @@ is bookkeeping: status, progress, timing, cancellation flag, exception capture.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import traceback
@@ -23,10 +24,32 @@ class JobCancelled(Exception):
     pass
 
 
+#: innermost-first stack of Jobs executing on this context — the REST path
+#: nests a library Job inside the REST Job, and dispatch-retry accounting
+#: must land on BOTH so /3/Jobs pollers see the retries the build absorbed
+_JOB_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "h2o3_job_stack", default=())
+
+
+def current_job() -> "Job | None":
+    """The innermost Job executing on this context, if any."""
+    stack = _JOB_STACK.get()
+    return stack[0] if stack else None
+
+
+def note_dispatch_retry(n: int = 1) -> None:
+    """Credit ``n`` dispatch retries to every Job on this context's stack
+    (called by :func:`h2o3_tpu.ops.map_reduce.retrying`)."""
+    for j in _JOB_STACK.get():
+        with j._lock:
+            j.retries += int(n)
+
+
 class Job:
     CREATED, RUNNING, DONE, FAILED, CANCELLED = "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED"
 
-    def __init__(self, description: str, key: str | None = None):
+    def __init__(self, description: str, key: str | None = None,
+                 max_runtime_secs: float = 0.0):
         self.key = key or f"job_{uuid.uuid4().hex[:12]}"
         self.description = description
         self.status = Job.CREATED
@@ -37,12 +60,21 @@ class Job:
         self.exception: BaseException | None = None
         self.traceback: str | None = None
         self.result: Any = None
+        # reliability surface (docs/RELIABILITY.md): a deadline enforced
+        # cooperatively in update()/should_stop, absorbed dispatch-retry
+        # counts, and the attempt history of an exhausted retry budget
+        self.max_runtime_secs = float(max_runtime_secs or 0.0)
+        self.deadline_exceeded = False
+        self.retries = 0
+        self.retry_history: list | None = None
+        self.auto_recovery_dir: str | None = None
         # guards every post-construction field mutation: the worker thread
         # writes status/progress/result while REST handler threads serialize
         # the job (schemas.job_v3 polls) — unlocked multi-field transitions
         # let a poller observe DONE with a stale result/progress
         self._lock = threading.Lock()
         self._cancel_requested = threading.Event()
+        self._partial_accepted = False
         self._done = threading.Event()
         # the creating request's span context rides into the worker thread
         # (contextvars do not cross threads) so REST polling and execution
@@ -69,6 +101,7 @@ class Job:
         # adopt the creating request's span context: the job's work appears
         # as a child span in that trace, and the retention taken at
         # construction is released when the job span (tree) ends
+        token = _JOB_STACK.set((self,) + _JOB_STACK.get())
         with _tracing.TRACER.adopt(self._span_ctx,
                                    f"job:{self.description}", kind="job",
                                    attrs={"job": self.key}) as jspan:
@@ -93,24 +126,57 @@ class Job:
                     jspan.set_status("cancelled")
             except BaseException as e:
                 # Job is the error carrier (REST/background polls read it);
-                # the synchronous caller re-raises from job.exception.
+                # the synchronous caller re-raises from job.exception. An
+                # exhausted dispatch-retry budget (DispatchFailed) lands its
+                # per-attempt history here so pollers see what was tried.
                 with self._lock:
                     self.status = Job.FAILED
                     self.exception = e
                     self.traceback = traceback.format_exc()
+                    self.retry_history = getattr(e, "history", None)
                 if jspan is not None:
                     jspan.set_status("error")
                     jspan.set_attrs(exception=f"{type(e).__name__}: {e}")
             finally:
+                _JOB_STACK.reset(token)
                 with self._lock:
                     self.end_time = time.time()
                 self._done.set()
 
+    def _check_deadline(self) -> bool:
+        """True once the job has outlived ``max_runtime_secs`` (reference:
+        ``Job.update`` throws when the work budget is spent). Trips the
+        cancellation flag so the normal-return path lands on CANCELLED —
+        builders that keep partial results (GBM's built trees) return them
+        and the job still reads as deadline-terminated."""
+        if self.max_runtime_secs <= 0 or self.start_time is None \
+                or self.deadline_exceeded:
+            return self.deadline_exceeded
+        if time.time() - self.start_time > self.max_runtime_secs:
+            from h2o3_tpu.utils.telemetry import JOB_DEADLINE_EXCEEDED
+            with self._lock:
+                self.deadline_exceeded = True
+                self.progress_msg = (f"max_runtime_secs="
+                                     f"{self.max_runtime_secs:g} exceeded")
+            self._cancel_requested.set()
+            JOB_DEADLINE_EXCEEDED.inc()
+        return self.deadline_exceeded
+
+    def keep_partial(self) -> None:
+        """A partial-result builder ACCEPTED the stop signal: it stopped
+        its loop and is finalizing what it built. Later ``update`` calls
+        must not re-raise, or finalization itself would be cancelled —
+        the job still terminates CANCELLED."""
+        with self._lock:
+            self._partial_accepted = True
+
     def update(self, progress: float, msg: str = "") -> None:
+        self._check_deadline()
         with self._lock:
             self.progress = float(progress)
-            self.progress_msg = msg
-        if self._cancel_requested.is_set():
+            if not self.deadline_exceeded:
+                self.progress_msg = msg
+        if self._cancel_requested.is_set() and not self._partial_accepted:
             raise JobCancelled(self.key)
 
     # -- client side ---------------------------------------------------------
@@ -118,6 +184,13 @@ class Job:
     @property
     def cancelled(self) -> bool:
         return self._cancel_requested.is_set()
+
+    @property
+    def should_stop(self) -> bool:
+        """Cooperative stop signal — explicit cancel OR deadline. Builders
+        that can keep partial results check this between megasteps/chunks
+        and break instead of letting ``update`` raise."""
+        return self._cancel_requested.is_set() or self._check_deadline()
 
     def cancel(self) -> None:
         self._cancel_requested.set()

@@ -268,11 +268,31 @@ class ModelBuilder:
             fold_column=None,           # explicit per-row fold ids
             weights_column=None,
             ignored_columns=None,
-            max_runtime_secs=0.0,
+            max_runtime_secs=0.0,   # job deadline, enforced in Job.update()
             keep_cross_validation_predictions=False,
             checkpoint=None,     # prior model (key or Model) to resume from
+            # auto-checkpoint dir for long builds (reference:
+            # -auto_recovery_dir): GBM/XGBoost/DL snapshot a partial model
+            # every H2O3TPU_CHECKPOINT_EVERY trees/epochs; a restarted
+            # train() with the same dir+params resumes from the snapshot
+            # through the checkpoint machinery (docs/RELIABILITY.md)
+            auto_recovery_dir=None,
             custom_metric_func=None,   # python callable (preds, y, w) -> value
         )
+
+    def validate_request(self) -> None:
+        """Fail-fast validation the REST layer runs BEFORE starting the
+        background job: raise ``ValueError`` for a request no build could
+        ever satisfy (the server maps it to a structured 400 instead of a
+        FAILED job the poller unwraps later). Subclasses extend."""
+
+    def supports_auto_recovery(self) -> bool:
+        """True when this builder actually WRITES auto-checkpoint snapshots
+        under ``auto_recovery_dir`` (GBM/XGBoost-gbtree chunk snapshots, DL
+        epoch snapshots). Base builders don't — advertising
+        ``auto_recoverable`` for them would promise a resume that silently
+        restarts from scratch."""
+        return False
 
     def _resolve_checkpoint(self) -> "Model | None":
         """Resolve the ``checkpoint`` param to a prior Model (reference:
@@ -358,7 +378,39 @@ class ModelBuilder:
         self._x_cols = x
         self._y_col = y
 
-        self.job = Job(f"{self.algo} on {frame.key or 'frame'}")
+        # auto-recovery: when a prior run with this dir+params left a
+        # partial-model snapshot, resume through the ordinary checkpoint
+        # machinery (seed-derived per-tree keys make the resumed GBM
+        # bit-identical to an uninterrupted run); an explicit checkpoint=
+        # from the caller wins over the snapshot
+        self._build_recovery = None
+        self._resume_snap_key = None
+        rdir = self.params.get("auto_recovery_dir")
+        if rdir and not self.supports_auto_recovery():
+            # no snapshot will ever be written: keep the job's
+            # auto_recoverable contract honest rather than advertising a
+            # resume that would restart from scratch
+            rdir = None
+        if rdir:
+            from h2o3_tpu.persist.recovery import BuildRecovery
+            self._build_recovery = BuildRecovery(str(rdir))
+            if not self.params.get("checkpoint"):
+                snap = self._build_recovery.load_snapshot(self.params)
+                if snap is not None:
+                    # load_model already re-registered it in the DKV (so
+                    # every checkpoint consumer — CV refits resolve by key —
+                    # can see it); remember the key to remove after the run
+                    self._resume_snap_key = snap.key
+                    self.params["checkpoint"] = snap
+
+        self.job = Job(f"{self.algo} on {frame.key or 'frame'}",
+                       max_runtime_secs=float(
+                           self.params.get("max_runtime_secs") or 0.0))
+        self.job.auto_recovery_dir = rdir
+        if getattr(self, "_cancel_requested_early", False):
+            # a REST cancel raced job creation (see server._run_build_job):
+            # honor it now, before the build starts
+            self.job.cancel()
         t0 = time.time()
 
         self._score_series = None   # per-train metric series (tree builders)
@@ -470,8 +522,25 @@ class ModelBuilder:
             return model
 
         self.model = self.job.run(driver)
+        if self._resume_snap_key:
+            # the transient resume-source model has served its purpose
+            DKV.remove(self._resume_snap_key)
         if self.job.status == Job.FAILED:
             raise self.job.exception
+        if self.job.status == Job.CANCELLED and self.job.result is None:
+            # the build stopped (explicit cancel or max_runtime_secs) before
+            # it could produce even a partial model — surfacing None would
+            # read as success; builders that keep partial results (GBM's
+            # built trees) return them with the job still marked CANCELLED
+            from h2o3_tpu.models.job import JobCancelled
+            raise JobCancelled(
+                f"{self.algo} build cancelled"
+                + (" (max_runtime_secs exceeded)"
+                   if self.job.deadline_exceeded else ""))
+        if self.job.status == Job.DONE and self._build_recovery is not None:
+            # only a COMPLETED build retires its snapshot: a deadline-
+            # cancelled partial keeps it so a rerun resumes where it stopped
+            self._build_recovery.complete()
         return self.job.result
 
     def train_segments(self, segments: list[str], y: str,

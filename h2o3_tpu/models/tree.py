@@ -516,6 +516,75 @@ def _predict_binned_impl(binned, feat_s, t_s, na_s, sp_s, leaf_s, n_bins: int):
     return acc
 
 
+def fold_binned(binned, trees: "list[Tree]", n_bins: int, lr, F0) -> jax.Array:
+    """Margins folded tree-by-tree: ``F = (((F0 + lr*l1) + lr*l2) + ...)``.
+
+    The boosting scan accumulates margins in exactly this float-addition
+    order, so a checkpoint resume seeding from here reproduces the
+    uninterrupted run's margins — and therefore its remaining trees —
+    BIT-IDENTICALLY. ``predict_binned`` (sum-then-scale) differs by ulps,
+    which is fine for scoring but breaks exact-resume guarantees
+    (docs/RELIABILITY.md)."""
+    if not trees:
+        # a zero-tree checkpoint (deadline tripped before the first chunk)
+        # legally resumes from the bare f0 margins
+        return jnp.asarray(F0, jnp.float32)
+    stack = lambda attr: jnp.stack([getattr(t, attr) for t in trees])
+    lr = jnp.float32(lr)
+    if trees[0].left_mask is not None:
+        return _fold_binned_masked(binned, stack("feat"), stack("left_mask"),
+                                   stack("na_left"), stack("is_split"),
+                                   stack("leaf"), lr, F0, n_bins)
+    return _fold_binned_impl(binned, stack("feat"), stack("thresh_bin"),
+                             stack("na_left"), stack("is_split"),
+                             stack("leaf"), lr, F0, n_bins)
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _fold_binned_impl(binned, feat_s, t_s, na_s, sp_s, leaf_s, lr, F0,
+                      n_bins: int):
+    rows = binned.shape[0]
+    depth = int(np.log2(feat_s.shape[1] + 1)) - 1
+
+    def one_tree(acc, tr):
+        feat, t, na_l, is_sp, leaf = tr
+        idx = jnp.zeros(rows, jnp.int32)
+        for _ in range(depth):
+            f = jnp.maximum(feat[idx], 0)
+            b = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+            left = jnp.where(b >= n_bins, na_l[idx], b < t[idx])
+            nxt = idx * 2 + jnp.where(left, 1, 2)
+            idx = jnp.where(is_sp[idx], nxt, idx)
+        return acc + lr * leaf[idx], None
+
+    acc, _ = lax.scan(one_tree, F0.astype(jnp.float32),
+                      (feat_s, t_s, na_s, sp_s, leaf_s))
+    return acc
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _fold_binned_masked(binned, feat_s, mask_s, na_s, sp_s, leaf_s, lr, F0,
+                        n_bins: int):
+    rows = binned.shape[0]
+    depth = int(np.log2(feat_s.shape[1] + 1)) - 1
+
+    def one_tree(acc, tr):
+        feat, mask, na_l, is_sp, leaf = tr
+        idx = jnp.zeros(rows, jnp.int32)
+        for _ in range(depth):
+            f = jnp.maximum(feat[idx], 0)
+            b = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+            left = jnp.where(b >= n_bins, na_l[idx],
+                             mask[idx, jnp.minimum(b, n_bins - 1)])
+            nxt = idx * 2 + jnp.where(left, 1, 2)
+            idx = jnp.where(is_sp[idx], nxt, idx)
+        return acc + lr * leaf[idx], None
+
+    acc, _ = lax.scan(one_tree, F0.astype(jnp.float32),
+                      (feat_s, mask_s, na_s, sp_s, leaf_s))
+    return acc
+
+
 @partial(jax.jit, static_argnames=("n_bins",))
 def _predict_binned_masked(binned, feat_s, mask_s, na_s, sp_s, leaf_s,
                            n_bins: int):

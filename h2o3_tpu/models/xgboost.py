@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.models.gbm import GBM, GBMModel, _grad_hess
-from h2o3_tpu.models.job import Job
+from h2o3_tpu.models.job import Job, JobCancelled
 from h2o3_tpu.models.model_base import make_model_key
 from h2o3_tpu.models.tree import TreeParams, grow_trees_batched
 
@@ -109,6 +109,23 @@ class XGBoost(GBM):
         # derived here so stored params keep the user's values
         return (float(self.params["col_sample_rate"])
                 * float(self.params.get("col_sample_by_node") or 1.0))
+
+    def supports_auto_recovery(self) -> bool:
+        # DART neither checkpoints nor resumes (renormalized prior-tree
+        # weights); gbtree shares GBM's chunk snapshots
+        return str(self.params.get("booster") or "gbtree").lower() != "dart"
+
+    def validate_request(self) -> None:
+        """REST fail-fast: DART cannot resume a checkpoint (per-round
+        renormalization rescales prior tree weights, so the ensemble the
+        checkpoint froze no longer exists) — the server turns this into a
+        structured 400 instead of a background FAILED job."""
+        super().validate_request()
+        if str(self.params.get("booster") or "").lower() == "dart" \
+                and self.params.get("checkpoint"):
+            raise ValueError("checkpoint resume is not supported with "
+                             "booster='dart' (prior-tree weights would have "
+                             "been renormalized away)")
 
     def _fit(self, job, frame, x, y, weights):
         booster = str(self.params.get("booster") or "gbtree").lower()
@@ -244,8 +261,15 @@ class XGBoost(GBM):
             trees.append(new[0])
             wts.append(w_new)
             preds.append(pred)
-            job.update(0.1 + 0.8 * (m + 1) / ntrees,
-                       f"DART tree {m + 1}/{ntrees} (dropped {k})")
+            try:
+                job.update(0.1 + 0.8 * (m + 1) / ntrees,
+                           f"DART tree {m + 1}/{ntrees} (dropped {k})")
+            except JobCancelled:
+                # deadline/cancel between rounds: DART keeps its grown
+                # trees like the other tree builders (partial model, job
+                # terminates CANCELLED)
+                job.keep_partial()
+                break
             if sr > 0:                  # ScoreKeeper early stopping
                 dev = self._stop_score(metric, dist, Fcur, yc, w, 0)
                 if dev < best - tol * abs(best) or not np.isfinite(best):

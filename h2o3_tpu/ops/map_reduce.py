@@ -92,6 +92,125 @@ _SAMPLE_EVERY = max(int(os.environ.get("H2O3TPU_DISPATCH_SAMPLE", "16") or 16), 
 _dispatch_seq = itertools.count()
 
 
+# ---------------------------------------------------------------------------
+# Dispatch retry — the UDP-drop tolerance of the reference (water/H2O.java
+# -random_udp_drop exercises an RPC retry path) mapped onto this runtime's
+# network events: device dispatches. A transient failure (an injected
+# FaultInjected drop, a transient XLA RuntimeError) is retried with
+# exponential backoff + jitter under a budget; only an exhausted budget
+# surfaces, as a structured DispatchFailed carrying the attempt history
+# (docs/RELIABILITY.md).
+
+class DispatchFailed(RuntimeError):
+    """A dispatch kept failing after its retry budget was exhausted.
+
+    ``fn`` names the call site; ``history`` is the per-attempt record
+    (error + backoff) that Job surfaces to pollers."""
+
+    def __init__(self, fn: str, history: "list[dict]"):
+        self.fn = fn
+        self.history = history
+        last = history[-1]["error"] if history else "unknown"
+        super().__init__(f"dispatch {fn!r} failed after {len(history)} "
+                         f"attempt(s); last error: {last}")
+
+
+def retry_budget() -> int:
+    """Retry attempts after the first try (``H2O3TPU_DISPATCH_RETRIES``,
+    default 3; 0 disables the retry machinery — failures pass through
+    unchanged)."""
+    try:
+        return max(int(os.environ.get("H2O3TPU_DISPATCH_RETRIES", "") or 3), 0)
+    except ValueError:
+        return 3
+
+
+def _backoff_ms(attempt: int) -> float:
+    """Exponential backoff with jitter: base * 2^attempt * U(0.5, 1.5)
+    (``H2O3TPU_DISPATCH_BACKOFF_MS``, default 25)."""
+    import random
+    try:
+        base = float(os.environ.get("H2O3TPU_DISPATCH_BACKOFF_MS", "") or 25.0)
+    except ValueError:
+        base = 25.0
+    return base * (2 ** attempt) * (0.5 + random.random())
+
+
+#: error-status tags that mark a RuntimeError DETERMINISTIC, not transient:
+#: re-dispatching an OOM or an invalid program burns device time on a
+#: failure that cannot change (XlaRuntimeError subclasses RuntimeError and
+#: carries the gRPC-style status name in its message)
+_NON_TRANSIENT = ("RESOURCE_EXHAUSTED", "INVALID_ARGUMENT",
+                  "FAILED_PRECONDITION", "UNIMPLEMENTED")
+
+
+def retrying(what: str, thunk: Callable, *, span=None,
+             retry_runtime_errors: bool = True):
+    """Run ``thunk`` under the dispatch retry budget.
+
+    Fault injection (``FAULTS.maybe_fault(what)``) fires before every
+    attempt, so chaos drops exercise this exact path. ``FaultInjected`` is
+    always retryable (it is raised before the dispatch); ``RuntimeError``
+    from the dispatch itself is retried only when ``retry_runtime_errors``
+    (donated buffers are consumed by a real dispatch attempt, so donating
+    call sites must not re-run it). Each retry increments
+    ``h2o3_dispatch_retries_total{fn,outcome="retried"}`` and notes itself
+    on the active Job; exhaustion increments ``outcome="exhausted"`` and
+    raises :class:`DispatchFailed` with the attempt history."""
+    from h2o3_tpu.utils import telemetry as _tm
+    from h2o3_tpu.utils import timeline as _tl
+    budget = retry_budget()
+    history: list[dict] = []
+    attempt = 0
+    while True:
+        try:
+            if _tl.FAULTS is not None:
+                _tl.FAULTS.maybe_fault(what)
+            out = thunk()
+        except (_tl.FaultInjected, RuntimeError) as e:
+            if isinstance(e, DispatchFailed):
+                raise          # a nested dispatch already exhausted its budget
+            if budget == 0:
+                raise          # retries disabled: pure pass-through, no
+                               # metrics — the machinery never ran
+            if not isinstance(e, _tl.FaultInjected) and (
+                    not retry_runtime_errors
+                    or any(tag in str(e) for tag in _NON_TRANSIENT)):
+                raise          # deterministic failure: surface immediately
+            history.append({"attempt": attempt,
+                            "error": f"{type(e).__name__}: {e}"})
+            if attempt >= budget:
+                _tm.DISPATCH_RETRIES.labels(fn=what,
+                                            outcome="exhausted").inc()
+                if span is not None:
+                    span.set_attrs(retries=attempt)
+                raise DispatchFailed(what, history) from e
+            delay = _backoff_ms(attempt)
+            history[-1]["backoff_ms"] = round(delay, 1)
+            _tm.DISPATCH_RETRIES.labels(fn=what, outcome="retried").inc()
+            from h2o3_tpu.models.job import note_dispatch_retry
+            note_dispatch_retry()
+            time.sleep(delay / 1000.0)
+            attempt += 1
+            continue
+        if attempt:
+            # absorbed faults still read in trace trees: the span carries
+            # how many retries the dispatch cost and a "retried" status
+            # (overriding the error mark the injected drop left). Builder
+            # call sites pass no span of their own — mark the ACTIVE span
+            # (their timed_event chunk/megastep span) instead.
+            if span is not None:
+                span.set_attrs(retries=attempt)
+                span.set_status("retried")
+            else:
+                from h2o3_tpu.utils import tracing as _trc
+                # force: the injected drop already marked this span "error";
+                # the absorbed outcome overrides it
+                _trc.TRACER.mark_active(status="retried", force=True,
+                                        retries=attempt)
+        return out
+
+
 def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     """Run ``map_fn`` on each device's row shard; psum-reduce the results.
 
@@ -123,49 +242,67 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     # fidelity under H2O3TPU_TRACE_PARTITIONS=1, else every Nth dispatch
     full = _tr.trace_partitions_enabled()
     sampled = full or (next(_dispatch_seq) % _SAMPLE_EVERY == 0)
+    dur_box = [0]
     with _tr.TRACER.span(f"map_reduce:{name}", kind="dispatch",
                          attrs={"fn": name,
                                 "partitions": mesh.size,
                                 "sampled": sampled}) as span:
-        if _tl.FAULTS is not None:
-            _tl.FAULTS.maybe_fault("map_reduce")
-        # device-byte attribution per TRACED SAMPLED dispatch — only through
-        # the runtime's memory_stats counters (~µs): the live-array fallback
-        # walks every resident buffer and has no place on this hot path,
-        # so backends without stats (CPU) skip it (fast probe returns None)
-        mem0 = None
-        if span is not None and sampled:
-            from h2o3_tpu.utils.memory import fast_device_bytes
-            mem0 = fast_device_bytes()
-        t0 = time.time_ns()
-        # NO unconditional sync: dispatch is async, so back-to-back
-        # collectives pipeline on device and the host stops being the clock.
-        # Only a SAMPLED dispatch blocks, because an enqueue-time measurement
-        # would never see a slow collective — the sync IS the probe.
-        out = fn(*cols)
-        if sampled:
-            if span is not None:
-                _partition_spans(span, out, mesh, t0)
-            out = jax.block_until_ready(out)  # graftlint: ok(sampled telemetry probe — the sync is the measurement)
-            dur_ns = time.time_ns() - t0
-            _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(dur_ns / 1e9)
-            if mem0 is not None:
-                mem1 = fast_device_bytes()
-                if mem1 is not None:
-                    # max of the two in-use samples, NOT the runtime's
-                    # peak_bytes_in_use counter — that one is process-lifetime
-                    # monotonic, so after any big build every later dispatch
-                    # would report the global high-water mark instead of its
-                    # own footprint (same semantic as the model-span attr)
-                    span.set_attrs(peak_device_bytes=max(mem0[0], mem1[0]),
-                                   device_bytes_delta=mem1[0] - mem0[0])
-        else:
-            # unmeasured: the timeline keeps one record per dispatch either
-            # way, but an async enqueue time must not pollute the duration
-            # series — dur_ns=0 is the ring's established "untimed event"
-            # marker; accurate durations live in the SAMPLED observations
-            dur_ns = 0
-    _tl.TIMELINE.record("collective", name, dur_ns)
+        def _attempt():
+            # device-byte attribution per TRACED SAMPLED dispatch — only
+            # through the runtime's memory_stats counters (~µs): the
+            # live-array fallback walks every resident buffer and has no
+            # place on this hot path, so backends without stats (CPU) skip
+            # it (fast probe returns None)
+            mem0 = None
+            if span is not None and sampled:
+                from h2o3_tpu.utils.memory import fast_device_bytes
+                mem0 = fast_device_bytes()
+            t0 = time.time_ns()
+            # NO unconditional sync: dispatch is async, so back-to-back
+            # collectives pipeline on device and the host stops being the
+            # clock. Only a SAMPLED dispatch blocks, because an enqueue-time
+            # measurement would never see a slow collective — the sync IS
+            # the probe.
+            out = fn(*cols)
+            if sampled:
+                # measure BEFORE the full sync (per-shard readiness IS the
+                # probe) but EMIT spans only after the attempt succeeds — a
+                # failed-then-retried attempt must not leave bogus partition
+                # spans in the trace tree
+                meas = (_measure_partitions(out, mesh, t0)
+                        if span is not None else None)
+                out = jax.block_until_ready(out)  # graftlint: ok(sampled telemetry probe — the sync is the measurement)
+                if meas is not None:
+                    _emit_partition_spans(span, meas, t0)
+                dur_box[0] = time.time_ns() - t0
+                _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(
+                    dur_box[0] / 1e9)
+                if mem0 is not None:
+                    mem1 = fast_device_bytes()
+                    if mem1 is not None:
+                        # max of the two in-use samples, NOT the runtime's
+                        # peak_bytes_in_use counter — that one is
+                        # process-lifetime monotonic, so after any big build
+                        # every later dispatch would report the global
+                        # high-water mark instead of its own footprint (same
+                        # semantic as the model-span attr)
+                        span.set_attrs(
+                            peak_device_bytes=max(mem0[0], mem1[0]),
+                            device_bytes_delta=mem1[0] - mem0[0])
+            # unmeasured dispatches keep dur_box at 0: the timeline keeps one
+            # record per dispatch either way, but an async enqueue time must
+            # not pollute the duration series — dur_ns=0 is the ring's
+            # established "untimed event" marker; accurate durations live in
+            # the SAMPLED observations
+            return out
+
+        # transient failures (injected drops, transient runtime errors) are
+        # retried with backoff instead of killing the Job; donated buffers
+        # are consumed by a real dispatch attempt, so donate=True only
+        # retries pre-dispatch FaultInjected
+        out = retrying("map_reduce", _attempt, span=span,
+                       retry_runtime_errors=not donate)
+    _tl.TIMELINE.record("collective", name, dur_box[0])
     # dispatch count + partition (shard) count always; the duration
     # histogram's min/max spread is the straggler signal (under SPMD all
     # shards run one program, so a straggler shows as dispatch max >> min)
@@ -174,21 +311,22 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     return out
 
 
-def _partition_spans(span, out, mesh, t0: int) -> None:
-    """Per-partition sub-spans under a traced SAMPLED dispatch: block on
-    each device's output shard in device order and stamp when it became
-    ready. The max/argmax of those readiness times is the straggler
-    attribution (recorded as span attrs). Runs only on sampled dispatches /
-    under ``H2O3TPU_TRACE_PARTITIONS=1`` — the sequential shard blocking is
-    a real serialization, so it must never ride on every dispatch a traced
-    request touches. Best-effort: a trace must never break a dispatch."""
+def _measure_partitions(out, mesh, t0: int):
+    """Per-partition readiness measurement under a traced SAMPLED dispatch:
+    block on each device's output shard in device order and stamp when it
+    became ready. Runs only on sampled dispatches / under
+    ``H2O3TPU_TRACE_PARTITIONS=1`` — the sequential shard blocking is a
+    real serialization, so it must never ride on every dispatch a traced
+    request touches. Returns ``(ends, devices)`` or None; SPAN EMISSION is
+    separate (:func:`_emit_partition_spans`) so a failed-then-retried
+    attempt's measurements are simply discarded. Best-effort: a trace must
+    never break a dispatch."""
     try:
-        from h2o3_tpu.utils import tracing as _tr
         leaves = jax.tree.leaves(out)
         shards0 = getattr(leaves[0], "addressable_shards", None) \
             if leaves else None
         if not shards0:
-            return
+            return None
         ends = []
         for i in range(len(shards0)):
             for leaf in leaves:
@@ -197,10 +335,21 @@ def _partition_spans(span, out, mesh, t0: int) -> None:
                     # graftlint: ok(sampled straggler probe — per-shard readiness IS the measurement)
                     jax.block_until_ready(sh[i].data)
             ends.append(time.time_ns())
+        return ends, [str(s.device) for s in shards0]
+    except Exception:   # noqa: BLE001 — tracing is best-effort by contract
+        return None
+
+
+def _emit_partition_spans(span, meas, t0: int) -> None:
+    """Turn a successful attempt's readiness measurement into partition
+    child spans + straggler attribution attrs (max/argmax of the
+    INCREMENTAL waits — see :func:`_shard_waits`)."""
+    try:
+        from h2o3_tpu.utils import tracing as _tr
+        ends, devices = meas
         durs = [e - t0 for e in ends]
         waits = _shard_waits(ends, t0)
         argmax = waits.index(max(waits))
-        devices = [str(s.device) for s in shards0]
         for i, end in enumerate(ends):
             _tr.TRACER.add_span(f"partition:{i}", "partition", span,
                                 start_ns=t0, end_ns=end,

@@ -1,9 +1,20 @@
-"""Job-level auto-recovery for long-running grid/AutoML searches.
+"""Job-level auto-recovery for long-running searches AND single builds.
 
 Reference: ``hex/faulttolerance/Recovery.java:21-50`` — before a long job
 starts, its params and training frame are written to ``-auto_recovery_dir``;
 every model built is appended; on restart the job reloads the snapshot and
 resumes where it stopped (already-built hyperparameter points are skipped).
+
+Two granularities live here:
+
+- :class:`Recovery` — grid/AutoML combo skipping (one file per built model).
+- :class:`BuildRecovery` — ONE long iterative build (GBM/XGBoost/DL) under
+  ``auto_recovery_dir``: the builder snapshots a partial model every K
+  trees/epochs (``H2O3TPU_CHECKPOINT_EVERY``) through the SAME artifact
+  format ``checkpoint=`` resume consumes, so a killed process restarts from
+  the last snapshot instead of tree 0 — and, because tree PRNG keys are
+  derived per-tree from the base seed, the resumed GBM's final trees are
+  bit-identical to an uninterrupted run (docs/RELIABILITY.md).
 """
 
 from __future__ import annotations
@@ -13,6 +24,114 @@ import os
 
 from h2o3_tpu.persist.frame_io import load_frame, save_frame
 from h2o3_tpu.persist.model_io import load_model, save_model
+
+
+def checkpoint_every(default: int = 10) -> int:
+    """Snapshot cadence in trees/epochs (``H2O3TPU_CHECKPOINT_EVERY``)."""
+    try:
+        k = int(os.environ.get("H2O3TPU_CHECKPOINT_EVERY", "") or default)
+    except ValueError:
+        k = default
+    return max(k, 1)
+
+
+def _params_fingerprint(params: dict) -> str:
+    """Canonical param identity for snapshot compatibility — transient keys
+    (the recovery dir itself, a resolved checkpoint handle, model_id) are
+    excluded so a resume with the same *training* configuration matches.
+    Callables (custom_metric_func lambdas) fingerprint by NAME, not repr:
+    ``str(fn)`` embeds a process-specific address, which would make every
+    restarted process silently fail the match and rebuild from tree 0."""
+    skip = {"auto_recovery_dir", "checkpoint", "model_id"}
+
+    def _stable(v):
+        if callable(v):
+            return f"<callable {getattr(v, '__qualname__', type(v).__name__)}>"
+        return v
+
+    return json.dumps({k: _stable(v) for k, v in params.items()
+                       if k not in skip}, sort_keys=True, default=str)
+
+
+class BuildRecovery:
+    """Auto-checkpoint directory for one resumable model build.
+
+    Lifecycle (driven by ``ModelBuilder.train`` when ``auto_recovery_dir``
+    is set)::
+
+        rec = BuildRecovery(dir)
+        snap = rec.load_snapshot(params)      # partial model or None
+        # ... build resumes via the ordinary checkpoint= machinery ...
+        rec.snapshot(partial_model, progress=K, target=ntrees)  # every K
+        rec.complete()                        # success: snapshot removed
+    """
+
+    STATE = "build_recovery.json"
+    MODEL = "model_snapshot.bin"
+
+    def __init__(self, recovery_dir: str):
+        self.dir = recovery_dir
+        os.makedirs(recovery_dir, exist_ok=True)
+        self._state_path = os.path.join(recovery_dir, self.STATE)
+        self._model_path = os.path.join(recovery_dir, self.MODEL)
+
+    def load_snapshot(self, params: dict):
+        """The last partial-model snapshot, or None when there is nothing
+        to resume: no snapshot, a finished build (progress >= target — a
+        checkpoint that cannot legally seed ``ntrees must exceed``
+        validation), or a snapshot taken under different training params
+        (resuming it would silently train a different model)."""
+        if not (os.path.exists(self._state_path)
+                and os.path.exists(self._model_path)):
+            return None
+        try:
+            with open(self._state_path) as fh:
+                state = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if state.get("fingerprint") != _params_fingerprint(params):
+            return None
+        if int(state.get("progress", 0)) >= int(state.get("target", 1 << 62)):
+            return None
+        return load_model(self._model_path)
+
+    def snapshot(self, model, progress: int, target: int) -> None:
+        """Atomically persist a partial model + its progress marker: the
+        model file lands via os.replace BEFORE the state file, so a crash
+        mid-snapshot leaves either the previous consistent pair or the new
+        model with the previous state (whose fingerprint still matches) —
+        never a state pointing at a torn model file."""
+        fingerprint = _params_fingerprint(model.params)
+        # callable params (custom_metric_func lambdas/closures) don't pickle;
+        # the snapshot drops them rather than failing a build that succeeds
+        # without auto_recovery_dir — resume validates against the LIVE
+        # builder's params, so the artifact never needs them
+        clean = {k: v for k, v in model.params.items() if not callable(v)}
+        orig_params = model.params
+        if len(clean) != len(orig_params):
+            model.params = clean
+        try:
+            tmp = self._model_path + ".tmp"
+            save_model(model, tmp)
+            os.replace(tmp, self._model_path)
+        finally:
+            model.params = orig_params
+        doc = {"fingerprint": fingerprint,
+               "progress": int(progress), "target": int(target),
+               "model_key": model.key}
+        tmp_s = self._state_path + ".tmp"
+        with open(tmp_s, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp_s, self._state_path)
+
+    def complete(self) -> None:
+        """Successful build: retire the snapshot so a fresh run with the
+        same dir trains from scratch instead of tripping resume checks."""
+        for p in (self._state_path, self._model_path):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
 
 def combo_key(combo: dict) -> str:

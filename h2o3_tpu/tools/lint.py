@@ -28,7 +28,7 @@ import json
 import sys
 from pathlib import Path
 
-from h2o3_tpu.tools import locks, mem, rest, sync, tracer
+from h2o3_tpu.tools import locks, mem, rest, retry, sync, tracer
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -39,7 +39,7 @@ def run_lint(root: Path) -> list[Finding]:
     (path, line, rule) order."""
     index = PackageIndex.scan(Path(root))
     findings = (tracer.check(index) + locks.check(index) + rest.check(index)
-                + mem.check(index) + sync.check(index))
+                + mem.check(index) + sync.check(index) + retry.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),
@@ -92,8 +92,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.tools.lint",
         description="graftlint: tracer-safety, lock-discipline, "
-                    "REST-surface, memory and sync-discipline analysis "
-                    "for h2o3_tpu")
+                    "REST-surface, memory, sync- and retry-discipline "
+                    "analysis for h2o3_tpu")
     ap.add_argument("root", nargs="?", default=None,
                     help="package root to scan (default: the installed "
                          "h2o3_tpu package)")

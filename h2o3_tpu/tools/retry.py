@@ -1,0 +1,113 @@
+"""graftlint retry-discipline rules (RTY) — retry loops done wrong.
+
+The reliability layer (docs/RELIABILITY.md) standardizes transient-failure
+handling on ``ops/map_reduce.retrying``: budgeted attempts, exponential
+backoff WITH jitter, structured ``DispatchFailed`` on exhaustion. These
+rules flag hand-rolled retry loops that regress on that contract:
+
+- **RTY001** — a retry loop (a ``for``/``while`` whose body contains a
+  ``try``/``except``) that sleeps a CONSTANT between attempts. A fixed
+  ``time.sleep(0.5)`` has no backoff and no jitter: under a correlated
+  failure every retrier re-fires in lockstep (the thundering-herd the
+  jittered exponential exists to prevent). Compute the delay from the
+  attempt number, or use ``retrying``.
+- **RTY002** — an ``except``/``except Exception``/``except BaseException``
+  inside a retry-loop body whose handler only ``pass``/``continue``s. A
+  swallow-everything handler turns a bounded retry into an unbounded spin
+  and erases the error the exhaustion report needs; record the failure
+  (history, metric, log) or narrow the exception type.
+
+Both are inline-suppressible with ``# graftlint: ok(<reason>)`` like every
+other rule family.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, call_name
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_sleep(node: ast.Call) -> bool:
+    name = call_name(node)
+    return bool(name) and name.split(".")[-1] == "sleep"
+
+
+def _const_sleep_arg(node: ast.Call) -> bool:
+    """True when every positional arg is a literal constant (no args counts
+    as non-constant — not a duration we can judge)."""
+    return bool(node.args) and all(isinstance(a, ast.Constant)
+                                   for a in node.args)
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    if h.type is None:                      # bare except:
+        return True
+    names = []
+    if isinstance(h.type, ast.Tuple):
+        names = [t for t in h.type.elts]
+    else:
+        names = [h.type]
+    for t in names:
+        tn = (t.id if isinstance(t, ast.Name)
+              else t.attr if isinstance(t, ast.Attribute) else None)
+        if tn in _BROAD:
+            return True
+    return False
+
+
+def _handler_swallows(h: ast.ExceptHandler) -> bool:
+    """Only ``pass``/``continue`` in the body — the failure vanishes."""
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in h.body)
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        # innermost-enclosing-function attribution, same scheme as SYN001
+        qual_of: dict[int, str] = {}
+        for fn in sorted((f for f in index.functions.values()
+                          if f.module is mod),
+                         key=lambda f: f.node.lineno):
+            for sub in ast.walk(fn.node):
+                qual_of[id(sub)] = fn.qualname
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = node.body + node.orelse
+            tries = [s for stmt in body for s in ast.walk(stmt)
+                     if isinstance(s, ast.Try)]
+            if not tries:
+                continue        # a sleep without except is polling, not retry
+            sleeps = [sub for stmt in body for sub in ast.walk(stmt)
+                      if isinstance(sub, ast.Call) and _is_sleep(sub)]
+            # RETRY loop discriminator: a `while` re-attempts the same
+            # operation; a `for` over a collection with except/continue is
+            # the skip-bad-items idiom (legitimate) UNLESS it also waits —
+            # iteration that sleeps between failures is retry in disguise
+            is_retry = isinstance(node, ast.While) or bool(sleeps)
+            if not is_retry:
+                continue
+            for sub in sleeps:
+                if _const_sleep_arg(sub):
+                    findings.append(Finding(
+                        "RTY001", mod.path, sub.lineno,
+                        qual_of.get(id(sub), ""),
+                        "retry loop sleeps a CONSTANT between attempts "
+                        "— no backoff, no jitter (compute the delay "
+                        "from the attempt number, or use "
+                        "ops.map_reduce.retrying)",
+                        detail="constant-sleep-retry"))
+            for t in tries:
+                for h in t.handlers:
+                    if _handler_is_broad(h) and _handler_swallows(h):
+                        findings.append(Finding(
+                            "RTY002", mod.path, h.lineno,
+                            qual_of.get(id(h), ""),
+                            "broad `except` swallowing inside a retry body "
+                            "— the failure vanishes and the retry spins "
+                            "blind (record it or narrow the type)",
+                            detail="swallowing-retry-except"))
+    return findings

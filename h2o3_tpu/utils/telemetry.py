@@ -464,6 +464,20 @@ DISPATCHES_PER_ITER = METRICS.gauge(
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))
 
+# dispatch reliability (ops/map_reduce.py retrying): one "retried" per
+# backoff-and-reattempt, one "exhausted" when the budget runs out and the
+# dispatch surfaces as DispatchFailed (docs/RELIABILITY.md)
+DISPATCH_RETRIES = METRICS.counter(
+    "h2o3_dispatch_retries",
+    "dispatch retry events by call site and outcome (retried/exhausted)",
+    ("fn", "outcome"))
+
+# job deadlines (models/job.py): builds that hit max_runtime_secs and were
+# cooperatively cancelled between megasteps/tree chunks
+JOB_DEADLINE_EXCEEDED = METRICS.counter(
+    "h2o3_job_deadline_exceeded",
+    "jobs terminated by their max_runtime_secs deadline")
+
 # scoring tier (serving/ — docs/SERVING.md). Batch-size buckets are row
 # counts (the micro-batcher's power-of-two buckets), not seconds.
 SCORE_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,

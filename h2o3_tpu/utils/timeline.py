@@ -176,20 +176,49 @@ def io_stats() -> dict:
 class FaultInjector:
     """Random fault injection for the communication substrate (reference:
     the ``-random_udp_drop`` flag ``water/H2O.java:446`` drops UDP packets to
-    exercise the RPC retry path; here faults hit ``map_reduce`` dispatches —
-    a random delay models a straggler shard, a raised ``FaultInjected``
-    models a lost reduction — exercising Job failure carrying and
-    grid/AutoML recovery)."""
+    exercise the RPC retry path; here faults hit dispatch call sites
+    (``map_reduce``, the builders' megastep/chunk dispatches) — a random
+    delay models a straggler shard, a raised ``FaultInjected`` models a lost
+    reduction (absorbed by the dispatch retry loop, docs/RELIABILITY.md),
+    and a ``crash`` is process-fatal (``os._exit``) so auto-recovery resume
+    paths can be exercised end to end.
+
+    ``site_rates`` overrides rates per call site::
+
+        FaultInjector(site_rates={"gbm_chunk": {"drop_rate": 1.0,
+                                                "after": 1}})
+
+    ``after`` skips the first N calls at that site — deterministic
+    "fail the second chunk" scenarios for checkpoint-resume tests.
+
+    Thread-safe: chaos runs under ``windowed_parallel`` hit this from
+    concurrent dispatch threads, so the RNG draw and the fault counters
+    mutate under one lock (unlocked, concurrent ``random.Random`` calls can
+    return duplicate draws and drop increments)."""
 
     def __init__(self, drop_rate: float = 0.0, delay_ms: float = 0.0,
-                 delay_rate: float = 0.0, seed: int = 17):
+                 delay_rate: float = 0.0, seed: int = 17,
+                 crash_rate: float = 0.0, crash_after: int = 0,
+                 site_rates: "dict[str, dict] | None" = None):
         import random
         self.drop_rate = drop_rate
         self.delay_ms = delay_ms
         self.delay_rate = delay_rate
+        self.crash_rate = crash_rate
+        # crash on the Nth faultable call overall (0 = disabled) — the
+        # deterministic kill for resume tests
+        self.crash_after = int(crash_after)
+        self.site_rates = dict(site_rates or {})
         self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._calls = 0
+        self._site_calls: dict[str, int] = {}
         self.dropped = 0
         self.delayed = 0
+        self.crashed = 0
+
+    def _site(self, what: str, key: str, default):
+        return self.site_rates.get(what, {}).get(key, default)
 
     def maybe_fault(self, what: str) -> None:
         # injected faults surface as metrics too, so fault-injection runs are
@@ -197,19 +226,54 @@ class FaultInjector:
         # active span (if a trace is open) is marked so fault-injection runs
         # are visible in trace trees
         from h2o3_tpu.utils.telemetry import FAULTS_INJECTED
-        r = self._rng.random()
-        if self.drop_rate > 0 and r < self.drop_rate:
-            self.dropped += 1
+        with self._lock:
+            self._calls += 1
+            calls = self._calls
+            site_calls = self._site_calls[what] = \
+                self._site_calls.get(what, 0) + 1
+            armed = site_calls > int(self._site(what, "after", 0))
+            drop_rate = self._site(what, "drop_rate", self.drop_rate)
+            delay_rate = self._site(what, "delay_rate", self.delay_rate)
+            delay_ms = self._site(what, "delay_ms", self.delay_ms)
+            crash_rate = self._site(what, "crash_rate", self.crash_rate)
+            # deterministic kills: Nth faultable call overall (crash_after)
+            # or Nth call at THIS site (site_rates[what]["crash_after"])
+            site_crash_after = int(self._site(what, "crash_after", 0))
+            r = self._rng.random()
+            r2 = self._rng.random()
+            crash = (
+                bool(self.crash_after and calls >= self.crash_after)
+                or bool(site_crash_after
+                        and site_calls >= site_crash_after)
+                or (armed and crash_rate > 0 and r < crash_rate))
+            drop = (not crash) and armed and drop_rate > 0 and r < drop_rate
+            delay = (not crash and not drop) and armed \
+                and delay_rate > 0 and r2 < delay_rate
+            if crash:
+                self.crashed += 1
+            elif drop:
+                self.dropped += 1
+        if crash:
+            # process-fatal (reference: a kill -9 mid-build, the scenario
+            # hex/faulttolerance/Recovery.java exists for). Recorded first so
+            # an inherited log/timeline snapshot shows the cause of death;
+            # os._exit skips atexit — nothing may "clean up" a crash test.
+            TIMELINE.record("fault", f"crash:{what}")
+            FAULTS_INJECTED.labels(kind="crash").inc()
+            import os as _os
+            _os._exit(86)
+        if drop:
             TIMELINE.record("fault", f"drop:{what}")
             FAULTS_INJECTED.labels(kind="drop").inc()
             _tracing.TRACER.mark_active(status="error",
                                         fault=f"drop:{what}")
             raise FaultInjected(what)
-        if self.delay_rate > 0 and self._rng.random() < self.delay_rate:
-            self.delayed += 1
+        if delay:
             t0 = time.time_ns()
-            time.sleep(self.delay_ms / 1000.0)
+            time.sleep(delay_ms / 1000.0)
             dur_ns = time.time_ns() - t0
+            with self._lock:
+                self.delayed += 1
             # the event carries the TRUE injected stall, not 0 — delay
             # faults are stragglers and must read as such in the timeline
             TIMELINE.record("fault", f"delay:{what}", dur_ns)

@@ -368,8 +368,14 @@ class Tracer:
                     merge(rec["attrs"])
                     return
 
-    def mark_active(self, status: str | None = None, **attrs) -> None:
-        """Annotate the innermost active span (fault injection hooks)."""
+    def mark_active(self, status: str | None = None, force: bool = False,
+                    **attrs) -> None:
+        """Annotate the innermost active span (fault injection hooks).
+
+        By default a status only lands on a still-"ok" span (the FIRST
+        fault wins); ``force=True`` overrides — the dispatch retry layer
+        uses it to flip an injected drop's "error" into "retried" once the
+        re-attempt succeeds (the fault was absorbed, not fatal)."""
         ctx = _CURRENT.get()
         if ctx is None:
             return
@@ -377,7 +383,7 @@ class Tracer:
             tr = self._active.get(ctx.trace_id)
             span = tr["open"].get(ctx.span_id) if tr else None
         if span is not None:
-            if status is not None and span.status == "ok":
+            if status is not None and (force or span.status == "ok"):
                 span.status = status
             span.attrs.update(attrs)
 

@@ -434,6 +434,109 @@ def test_syn001_telemetry_modules_exempt_and_suppressible(tmp_path):
     assert "SYN001" not in rules_of(run_lint(pkg))
 
 
+# -- retry discipline (RTY) --------------------------------------------------
+
+def test_rty001_constant_sleep_retry_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import time
+
+        def fetch(url):
+            for attempt in range(5):
+                try:
+                    return do_request(url)
+                except IOError:
+                    time.sleep(0.5)          # constant: no backoff/jitter
+
+        def fetch2(url):
+            while True:
+                try:
+                    return do_request(url)
+                except IOError:
+                    pass
+                time.sleep(2)                # same, while-loop spelling
+    """})
+    rty = [f for f in run_lint(pkg) if f.rule == "RTY001"]
+    assert len(rty) == 2
+    assert {f.where for f in rty} == {"fetch", "fetch2"}
+    assert all(f.detail == "constant-sleep-retry" for f in rty)
+
+
+def test_rty001_backoff_and_polling_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import random
+        import time
+
+        def fetch(url):
+            for attempt in range(5):
+                try:
+                    return do_request(url)
+                except IOError:
+                    # exponential backoff + jitter: computed, not constant
+                    time.sleep(0.05 * 2 ** attempt * (0.5 + random.random()))
+
+        def poll(job):
+            # polling (no except in the loop) is not a retry loop
+            while not job.done():
+                time.sleep(0.2)
+    """})
+    assert "RTY001" not in rules_of(run_lint(pkg))
+
+
+def test_rty002_swallowing_except_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import time
+
+        def spin(op):
+            while True:                      # retry loop
+                try:
+                    return op()
+                except Exception:            # the failure vanishes
+                    pass
+
+        def sleepy_for(items):
+            for it in items:
+                try:
+                    send(it)
+                except:                      # bare + waits = retry in disguise
+                    continue
+                time.sleep(1.0)
+    """})
+    rty = [f for f in run_lint(pkg) if f.rule == "RTY002"]
+    assert len(rty) == 2
+    assert {f.where for f in rty} == {"spin", "sleepy_for"}
+
+
+def test_rty002_recording_and_skip_patterns_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        def robust(op, log):
+            errs = []
+            while True:                      # records the failure: fine
+                try:
+                    return op()
+                except Exception as e:
+                    errs.append(e)
+                    if len(errs) > 3:
+                        raise
+
+        def skip_bad(items):
+            out = []
+            for it in items:                 # for + no sleep = skip-bad-items
+                try:
+                    out.append(parse(it))
+                except Exception:
+                    continue
+            return out
+
+        def narrow(op):
+            while True:
+                try:
+                    return op()
+                except KeyError:             # narrow type: fine
+                    pass
+    """})
+    assert "RTY002" not in rules_of(run_lint(pkg))
+
+
 # -- suppression + baseline --------------------------------------------------
 
 def test_inline_suppression(tmp_path):

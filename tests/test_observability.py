@@ -319,10 +319,15 @@ def test_profiler_excludes_its_own_thread(server):
     assert not any("r_profiler" in st for st in prof["stacktraces"])
 
 
-def test_fault_injection_counts_surface_as_metrics(server):
+def test_fault_injection_counts_surface_as_metrics(server, monkeypatch):
     import jax.numpy as jnp
     from h2o3_tpu.ops.map_reduce import map_reduce
     from h2o3_tpu.utils.timeline import FaultInjected, inject_faults
+
+    # retries disabled: the drop passes through unchanged and injects
+    # EXACTLY one fault (retry semantics have their own tests in
+    # tests/test_chaos.py)
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "0")
 
     def before():
         m = re.search(r'h2o3_faults_injected_total\{kind="drop"\} (\d+)',

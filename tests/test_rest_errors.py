@@ -79,6 +79,37 @@ def test_oversized_param_body_rejected(server):
         assert r.status == 200
 
 
+def test_dart_checkpoint_resume_is_structured_400(server, rng):
+    """Satellite (ISSUE 8): DART cannot resume a checkpoint (per-round
+    renormalization rescales prior tree weights) — the REST layer must
+    refuse the request UP FRONT with a structured 400, not hand back a
+    background job that fails on the poller."""
+    n = 200
+    X = rng.normal(size=(n, 3))
+    fr = Frame.from_arrays({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.where(X[:, 0] > 0, "p", "n")}, key="dart_fr")
+    DKV.put("dart_fr", fr)
+    with _post(server, "/3/ModelBuilders/xgboost",
+               {"training_frame": "dart_fr", "response_column": "y",
+                "ntrees": 2, "max_depth": 2,
+                "model_id": "dart_cp_model"}) as r:
+        job_key = json.loads(r.read())["job"]["key"]["name"]
+    for _ in range(300):
+        with urllib.request.urlopen(f"{server.url}/3/Jobs/{job_key}") as r:
+            if json.loads(r.read())["jobs"][0]["status"] in (
+                    "DONE", "FAILED", "CANCELLED"):
+                break
+        time.sleep(0.05)
+    code, body = _err(server, "/3/ModelBuilders/xgboost",
+                      {"training_frame": "dart_fr", "response_column": "y",
+                       "booster": "dart", "ntrees": 4,
+                       "checkpoint": "dart_cp_model"})
+    assert code == 400
+    assert "dart" in body["msg"].lower()
+    assert "checkpoint" in body["msg"].lower()
+
+
 def test_concurrent_job_cancellation(server, rng):
     n = 4000
     X = rng.normal(size=(n, 3))

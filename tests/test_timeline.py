@@ -51,9 +51,12 @@ def test_cpu_ticks_reads_proc():
     assert "cpu" in t and len(t["cpu"]) >= 4
 
 
-def test_fault_injection_drop(rng):
+def test_fault_injection_drop(rng, monkeypatch):
     import jax.numpy as jnp
     from h2o3_tpu.ops.map_reduce import map_reduce
+    # retries disabled: the drop must pass through as exactly ONE injected
+    # fault (retry absorption has its own coverage in tests/test_chaos.py)
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "0")
     x = jnp.asarray(rng.normal(size=64).astype(np.float32))
     with inject_faults(drop_rate=1.0) as inj:
         with pytest.raises(FaultInjected):
@@ -63,12 +66,16 @@ def test_fault_injection_drop(rng):
     map_reduce(lambda s: s.sum(), x)
 
 
-def test_fault_injection_job_carries_failure(rng):
+def test_fault_injection_job_carries_failure(rng, monkeypatch):
     """A dropped collective inside training surfaces as a failed Job, not a
-    crashed process (reference: UDP drops are retried; fatal errors carry)."""
+    crashed process: UDP drops ARE retried now, so a 100% drop rate
+    exhausts the budget into a structured DispatchFailed — which the Job
+    carries like any other build failure."""
     from h2o3_tpu.frame.frame import Frame
     from h2o3_tpu.models.glm import GLM
     from h2o3_tpu.models import Job
+    from h2o3_tpu.ops.map_reduce import DispatchFailed
+    monkeypatch.setenv("H2O3TPU_DISPATCH_BACKOFF_MS", "1")
     n = 128
     X = rng.normal(size=(n, 2)).astype(np.float32)
     y = np.where(X[:, 0] > 0, "a", "b")
@@ -79,7 +86,7 @@ def test_fault_injection_job_carries_failure(rng):
         try:
             builder.train(y="y", training_frame=fr)
             trained = True
-        except FaultInjected:
+        except (FaultInjected, DispatchFailed):
             trained = False
     # whether GLM's path used explicit map_reduce or implicit jnp reductions,
     # the process must survive; a clean retrain must then succeed

@@ -289,10 +289,15 @@ def test_effective_nobs_reflects_skip_rows(rng):
     assert m2.output["effective_nobs"] == n
 
 
-def test_fault_injection_marks_span_status(rng):
+def test_fault_injection_marks_span_status(rng, monkeypatch):
     """Satellite: injected drops/delays must surface on the active span —
     fault-injection runs are visible in trace trees."""
     import jax.numpy as jnp
+
+    # retries off: the drop must surface as FaultInjected and leave the
+    # span in error state (the retried/absorbed path is covered in
+    # tests/test_chaos.py)
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "0")
 
     from h2o3_tpu.ops.map_reduce import map_reduce
     from h2o3_tpu.utils.timeline import FaultInjected, inject_faults
