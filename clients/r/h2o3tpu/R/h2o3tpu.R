@@ -195,6 +195,14 @@ h2o.init <- function(ip = "localhost", port = 54321, url = NULL, ...) {
 
 h2o.clusterStatus <- function() .http("GET", "/3/Cloud")
 
+h2o.cloud <- function() .http("GET", "/3/Cloud")
+
+h2o.meshSlices <- function() {
+  # mesh-slice scheduler utilization (slice layout, busy seconds, builds,
+  # queue wait) — served inside /3/Cloud (docs/ORCHESTRATION.md)
+  .http("GET", "/3/Cloud")$mesh_slices
+}
+
 h2o.importFile <- function(path, destination_frame = NULL) {
   body <- list(path = path)
   if (!is.null(destination_frame)) body$destination_frame <- destination_frame

@@ -22,7 +22,8 @@ from h2o3_tpu.frame import Frame, Vec, VecType
 from h2o3_tpu.frame.parse import import_file, parse_raw, upload_file
 from h2o3_tpu.frame.utils import create_frame, interaction, rebalance, tf_idf
 from h2o3_tpu.frame.sql import import_sql_select, import_sql_table
-from h2o3_tpu.parallel.mesh import get_mesh, set_mesh, mesh_context, num_devices
+from h2o3_tpu.parallel.mesh import (bind_mesh, get_mesh, set_mesh,
+                                    mesh_context, num_devices, slice_meshes)
 from h2o3_tpu.persist import (export_file, load_frame, load_model, save_frame,
                               save_model)
 from h2o3_tpu.genmodel import import_mojo
@@ -57,8 +58,10 @@ __all__ = [
     "shap_summary",
     "get_mesh",
     "set_mesh",
+    "bind_mesh",
     "mesh_context",
     "num_devices",
+    "slice_meshes",
     "DKV",
     "init",
     "connect",

@@ -55,6 +55,16 @@ class H2OClient:
     def cloud_status(self) -> dict:
         return self.request("GET", "/3/Cloud")
 
+    def cloud(self) -> dict:
+        """Alias of :meth:`cloud_status` (h2o-py ``h2o.cluster()`` shape);
+        includes the ``mesh_slices`` utilization view."""
+        return self.cloud_status()
+
+    def mesh_slices(self) -> dict:
+        """Mesh-slice scheduler utilization: slice layout + per-slice busy
+        seconds / builds / queue wait (docs/ORCHESTRATION.md)."""
+        return self.cloud_status().get("mesh_slices", {})
+
     def import_file(self, path: str, destination_frame: str | None = None) -> str:
         d = {"path": path}
         if destination_frame:

@@ -57,7 +57,13 @@ def cloud_v3(version: str) -> dict:
     dkv_bytes, _by_kind, nkeys = MEMORY.dkv_totals()
     pojo = max(host["rss_bytes"] - MEMORY.dkv_host_bytes(), 0)
     pid = _os.getpid()
+    # mesh-slice scheduler utilization (orchestration/scheduler.py): slice
+    # layout + per-slice busy seconds / builds / queue wait — the
+    # cluster-utilization view ROADMAP item 5 asks for, on the endpoint
+    # every client already polls
+    from h2o3_tpu.orchestration.scheduler import SLICE_STATS
     return {**_meta("CloudV3"), "version": version, "cloud_name": "h2o3_tpu",
+            "mesh_slices": SLICE_STATS.snapshot(),
             "cloud_size": len(devs), "cloud_healthy": True, "bad_nodes": 0,
             "consensus": True, "locked": True, "is_client": False,
             "cloud_uptime_millis": 0, "internal_security_enabled": False,
@@ -182,9 +188,13 @@ def frames_list_v3(store) -> dict:
     from h2o3_tpu.frame.frame import Frame
     # raw_items: spilled frames list from their stubs (nrows/ncols carried)
     # instead of being re-inflated from disk just for a listing
+    # mesh-slice views (Frame.on_mesh) are internal device-layout copies —
+    # byte-accounted in /3/Memory, but not user frames for the listing
     frames = [{"frame_id": {"name": k}, "rows": v.nrows, "column_count": v.ncols}
               for k, v in store.raw_items()
-              if isinstance(v, Frame) or type(v).__name__ == "SwappedFrame"]
+              if (isinstance(v, Frame) or type(v).__name__ == "SwappedFrame")
+              and not getattr(v, "_is_mesh_view", False)
+              and "::mesh[" not in k]
     return {**_meta("FramesV3"), "frames": frames}
 
 

@@ -1753,7 +1753,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "cloud_uptime_millis", "internal_security_enabled",
                     "branch_name", "build_number", "build_age",
                     "build_too_old", "node_idx", "cloud_internal_timezone",
-                    "datafile_parser_timezone"],
+                    "datafile_parser_timezone", "mesh_slices"],
     }
 
     def r_metadata_schemas(self):

@@ -32,6 +32,11 @@ class Frame:
         self.names: list[str] = list(names)
         self.vecs: list[Vec] = list(vecs)
         self.key = key
+        # mesh-view bookkeeping (see on_mesh): structural mutations bump the
+        # epoch, which invalidates every cached resharded view of this frame
+        self._view_epoch: int = 0
+        self._mesh_views: dict[tuple, "Frame | str"] = {}
+        self._is_mesh_view: bool = False
 
     # -- construction -------------------------------------------------------
 
@@ -169,15 +174,122 @@ class Frame:
             raise ValueError(f"duplicate column name: {name!r}")
         self.names.append(name)
         self.vecs.append(vec)
+        self.invalidate_views()
         return self
 
     def remove(self, col: int | str) -> Vec:
         i = self._index(col)
         self.names.pop(i)
+        self.invalidate_views()
         return self.vecs.pop(i)
+
+    def replace_vec(self, col: int | str, vec: Vec) -> "Frame":
+        """Replace a column's Vec IN PLACE (impute, pipeline transforms).
+        Goes through here — not ``frame.vecs[i] = ...`` — so cached mesh
+        views are invalidated: a slice-bound build resharding this frame
+        must see the replacement, never the pre-mutation column."""
+        if vec.nrows != self.nrows and self.vecs:
+            raise ValueError("row count mismatch")
+        self.vecs[self._index(col)] = vec
+        self.invalidate_views()
+        return self
 
     def subframe(self, cols: Iterable[str]) -> "Frame":
         return self[list(cols)]
+
+    # -- mesh views (slice-bound builds; orchestration/scheduler.py) ---------
+
+    def invalidate_views(self) -> None:
+        """Drop every cached resharded view (called on structural mutation —
+        add/remove — so a slice-bound build can never train on a stale
+        column set). DKV-registered view keys are removed so their bytes
+        leave ``/3/Memory`` with them."""
+        self._view_epoch += 1
+        stale, self._mesh_views = self._mesh_views, {}
+        if any(isinstance(v, str) for v in stale.values()):
+            from h2o3_tpu.utils.registry import DKV
+            for v in stale.values():
+                if isinstance(v, str):
+                    DKV.remove(v)
+
+    def on_mesh(self, mesh) -> "Frame":
+        """This frame resharded onto ``mesh`` — ONE batched ``device_put``
+        of the stacked column matrix per dtype (the ``upload_columns``
+        pattern: per-column transfers cost a tunnel round-trip each).
+
+        Returns ``self`` when the frame is already laid out on ``mesh``'s
+        device set. Views are cached per (device set, mutation epoch) and
+        byte-accounted: a keyed frame's views register in the DKV under
+        ``{key}::mesh[...]`` so ``/3/Memory`` shows resharded bytes and the
+        Cleaner can evict them (an evicted view is simply rebuilt from the
+        source columns on next use)."""
+        from h2o3_tpu.parallel.mesh import mesh_device_ids
+        dev_idx = [i for i, v in enumerate(self.vecs) if v.data is not None]
+        if not dev_idx:
+            return self
+        target = mesh_device_ids(mesh)
+        cur = getattr(self.vecs[dev_idx[0]].data, "sharding", None)
+        cur_devs = tuple(sorted(d.id for d in getattr(cur, "device_set", ())
+                                )) if cur is not None else ()
+        if cur_devs == target:
+            return self
+        ck = (target, self._view_epoch)
+        cached = self._mesh_views.get(ck)
+        if cached is not None:
+            if isinstance(cached, Frame):
+                return cached
+            # DKV-registered view: rebuild if it was evicted/removed
+            from h2o3_tpu.utils.cleaner import CLEANER
+            from h2o3_tpu.utils.registry import DKV
+            with DKV._lock:
+                live = DKV._store.get(cached)
+            if type(live).__name__ == "Frame":
+                # keep hot views off the LRU chopping block (on_mesh reads
+                # the raw store, so DKV.get's access accounting never fires)
+                CLEANER.touch(cached)
+                return live
+        view = self._reshard(mesh)
+        view._is_mesh_view = True
+        if self.key:
+            from h2o3_tpu.utils.registry import DKV
+            vkey = f"{self.key}::mesh[{'-'.join(map(str, target))}]" \
+                   f"@{self._view_epoch}"
+            view.key = vkey
+            DKV.put(vkey, view)
+            self._mesh_views[ck] = vkey
+        else:
+            self._mesh_views[ck] = view
+        return view
+
+    def _reshard(self, mesh) -> "Frame":
+        """Copy every device column onto ``mesh`` in (at most) two batched
+        transfers — one [k, plen] float stack, one int stack for CAT codes —
+        then slice rows back out (each slice inherits the target row
+        sharding, exactly like ``upload_columns``)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from h2o3_tpu.parallel.mesh import ROWS
+        sharding = NamedSharding(mesh, P(None, ROWS))
+        groups: dict[str, list[int]] = {}
+        for i, v in enumerate(self.vecs):
+            if v.data is not None:
+                groups.setdefault(str(v.data.dtype), []).append(i)
+        moved: dict[int, jax.Array] = {}
+        for idxs in groups.values():
+            stacked = jnp.stack([self.vecs[i].data for i in idxs], axis=0)
+            dev = jax.device_put(stacked, sharding)
+            for j, i in enumerate(idxs):
+                moved[i] = dev[j]
+        vecs = []
+        for i, v in enumerate(self.vecs):
+            if i not in moved:
+                vecs.append(v)          # host-only columns share the payload
+                continue
+            nv = Vec(moved[i], v.type, v.nrows, domain=v.domain,
+                     host_values=v.host_values, time_offset=v.time_offset)
+            nv._rollups = v._rollups    # rollups are layout-independent
+            vecs.append(nv)
+        return Frame(list(self.names), vecs)
 
     # -- device views -------------------------------------------------------
 

@@ -16,6 +16,7 @@ excludes them from model training.
 
 from __future__ import annotations
 
+import math
 from typing import Sequence
 
 import jax
@@ -24,7 +25,8 @@ import numpy as np
 
 from h2o3_tpu.frame.types import CAT_NA, VecType
 from h2o3_tpu.frame.rollups import Rollups, cat_rollups, numeric_rollups
-from h2o3_tpu.parallel.mesh import num_devices, row_sharding
+from h2o3_tpu.parallel.mesh import (ROWS, bound_mesh, num_global_devices,
+                                    row_sharding)
 
 # Pad row counts to a multiple of (devices * _ROW_ALIGN) so every shard is
 # sublane-aligned for float32 tiles (8 x 128 min tile).
@@ -32,7 +34,18 @@ _ROW_ALIGN = 8
 
 
 def padded_len(nrows: int, ndev: int | None = None) -> int:
-    ndev = ndev or num_devices()
+    # against the GLOBAL device count, never just a bound slice: a frame's
+    # padded length is a process-wide invariant, and scheduler slices divide
+    # it (slice_meshes carves equal divisors), so arrays pad identically no
+    # matter which lease creates them. A bound mesh whose size does NOT
+    # divide the global unit (public mesh_context with an arbitrary submesh)
+    # widens the unit to the lcm so the same array shards cleanly on both
+    # the bound and the global mesh.
+    if ndev is None:
+        ndev = num_global_devices()
+        b = bound_mesh()
+        if b is not None and ROWS in b.shape:
+            ndev = math.lcm(ndev, b.shape[ROWS])
     unit = ndev * _ROW_ALIGN
     return max(unit, ((nrows + unit - 1) // unit) * unit)
 

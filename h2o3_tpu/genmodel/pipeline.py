@@ -235,7 +235,7 @@ def _set_col(frame: Frame, name: str, vec) -> None:
     """Add-or-replace: in-place transforms (output == an existing column)
     are a normal reference-pipeline shape."""
     if name in frame.names:
-        frame.vecs[frame.names.index(name)] = vec
+        frame.replace_vec(name, vec)
     else:
         frame.add(name, vec)
 

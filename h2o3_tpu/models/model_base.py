@@ -353,6 +353,29 @@ class ModelBuilder:
             raise ValueError("training_frame is required")
         if y is None and not getattr(self, "unsupervised", False):
             raise ValueError(f"{self.algo} is supervised: y is required")
+        # slice-bound build (orchestration/scheduler.py lease): reshard the
+        # inputs onto the bound mesh ONCE, up front — every downstream mesh
+        # (row_sharding, map_reduce, tree.hist_mesh from input shardings)
+        # then resolves inside the slice, so a build compiled on slice 0
+        # never embeds slice 1's devices and concurrent builds never share
+        # a collective rendezvous
+        from h2o3_tpu.parallel import mesh as _pmesh
+        bound = _pmesh.bound_mesh()
+        # user-facing name for Job/extension surfaces: the reshard below
+        # swaps in an internal `{key}::mesh[...]` view key that means
+        # nothing to the user (and may be evicted before they look)
+        user_frame_key = frame.key
+        if bound is not None:
+            frame = frame.on_mesh(bound)
+            if validation_frame is not None:
+                validation_frame = validation_frame.on_mesh(bound)
+            if weights is not None and isinstance(weights, jax.Array):
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+                weights = jax.device_put(
+                    weights, NamedSharding(bound, _P(_pmesh.ROWS)))
+            from h2o3_tpu.utils.tracing import TRACER as _trc
+            _trc.mark_active(mesh_devices=",".join(
+                str(i) for i in _pmesh.mesh_device_ids(bound)))
         ignored = set(self.params.get("ignored_columns") or [])
         if self.params.get("weights_column"):
             ignored.add(self.params["weights_column"])
@@ -403,7 +426,7 @@ class ModelBuilder:
                     self._resume_snap_key = snap.key
                     self.params["checkpoint"] = snap
 
-        self.job = Job(f"{self.algo} on {frame.key or 'frame'}",
+        self.job = Job(f"{self.algo} on {user_frame_key or 'frame'}",
                        max_runtime_secs=float(
                            self.params.get("max_runtime_secs") or 0.0))
         self.job.auto_recovery_dir = rdir
@@ -429,7 +452,7 @@ class ModelBuilder:
 
         def locked_driver(job: Job, _ext) -> Model:
             _ext.report("model_build_start", algo=self.algo, job=job.key,
-                        frame=frame.key)
+                        frame=user_frame_key)
             # build wall-time lands in the timeline ring (kind "model") and
             # in the metrics registry; scoring history carries it through
             # run_time_ms (reference: TwoDimTable duration column)
@@ -522,6 +545,16 @@ class ModelBuilder:
             return model
 
         self.model = self.job.run(driver)
+        if bound is not None and _pmesh.rehome_requested() \
+                and self.job.result is not None:
+            # the model's artifacts (coefficients, tree heaps, OOF
+            # predictions) are committed to the slice's devices; re-home
+            # them onto the scheduler's base mesh so downstream consumers
+            # (predict on base-mesh frames, stacked-ensemble level-one
+            # assembly across models built on DIFFERENT slices) never mix
+            # device sets in one program — XLA raises on incompatible
+            # devices
+            _pmesh.rehome(self.job.result, _pmesh.rehome_target())
         if self._resume_snap_key:
             # the transient resume-source model has served its purpose
             DKV.remove(self._resume_snap_key)

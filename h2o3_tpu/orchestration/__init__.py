@@ -7,11 +7,13 @@ Reference: ``hex/grid/``, ``hex/leaderboard/``, ``hex/ensemble/``,
 from h2o3_tpu.orchestration.automl import AutoML, EventLog
 from h2o3_tpu.orchestration.grid import Grid, GridSearch
 from h2o3_tpu.orchestration.leaderboard import Leaderboard
+from h2o3_tpu.orchestration.scheduler import MeshScheduler, SLICE_STATS
 from h2o3_tpu.orchestration.stacked_ensemble import StackedEnsemble, StackedEnsembleModel
 from h2o3_tpu.orchestration.segments import SegmentModels, train_segments
 
 __all__ = [
     "AutoML", "EventLog", "Grid", "GridSearch", "Leaderboard",
+    "MeshScheduler", "SLICE_STATS",
     "StackedEnsemble", "StackedEnsembleModel",
     "SegmentModels", "train_segments",
 ]

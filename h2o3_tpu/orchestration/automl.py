@@ -82,6 +82,7 @@ class AutoML:
         # see orchestration/parallel_build.py). 1 = strictly sequential.
         self.parallelism = max(1, int(parallelism))
         self.leaderboard: Leaderboard | None = None
+        self._scheduler = None      # MeshScheduler, created per train() run
         self.event_log = EventLog()
         self._t0 = 0.0
         self._n_built = 0
@@ -223,6 +224,12 @@ class AutoML:
         tree_algos = {"GBM", "XGBOOST", "DRF"}
 
         from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+        from h2o3_tpu.orchestration.scheduler import MeshScheduler
+
+        # one slice layout per run: overlapped builds lease DISJOINT device
+        # slices instead of racing collectives on the global mesh
+        # (orchestration/scheduler.py; H2O3TPU_MESH_SLICES overrides)
+        self._scheduler = MeshScheduler(slices=self.parallelism)
 
         def enabled_steps():
             for algo, cls, params in self._steps():
@@ -247,8 +254,11 @@ class AutoML:
                                                       training_frame=fr_s)
             return m, algo, time.time() - t
 
-        results, _ = windowed_parallel(enabled_steps(), self.parallelism,
-                                       can_submit, build_step)
+        results, _ = windowed_parallel(
+            enabled_steps(), self.parallelism, can_submit, build_step,
+            scheduler=self._scheduler,
+            job_meta=lambda step: dict(rows=training_frame.nrows,
+                                       algo=step[0]))
         # leaderboard membership follows PLAN order regardless of completion
         # interleaving — identical to the sequential leaderboard
         for step, res, exc in results:
@@ -280,6 +290,7 @@ class AutoML:
                                                  max_runtime_secs=max(remaining_secs, 0.0),
                                                  seed=gseed),
                             parallelism=self.parallelism,
+                            scheduler=self._scheduler,
                             **{**fixed, **common})
             # grids are tree families: same TE frame as the base tree steps
             grid = gs.train(x=tree_x, y=y, training_frame=tree_frame)

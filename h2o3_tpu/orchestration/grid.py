@@ -98,7 +98,7 @@ class GridSearch:
                  grid_id: str | None = None,
                  search_criteria: dict | None = None,
                  recovery_dir: str | None = None,
-                 parallelism: int = 1, **fixed_params):
+                 parallelism: int = 1, scheduler=None, **fixed_params):
         if isinstance(builder_cls, ModelBuilder):
             fixed_params = {**builder_cls.params, **fixed_params}
             builder_cls = type(builder_cls)
@@ -109,8 +109,11 @@ class GridSearch:
         self.grid_id = grid_id or f"{builder_cls.algo}_grid_{int(time.time())}"
         self.recovery_dir = recovery_dir
         # reference: GridSearch.startGridSearch(..., parallelism) — builds
-        # overlap on host threads (see orchestration/parallel_build.py)
+        # overlap on host threads (see orchestration/parallel_build.py),
+        # each leasing a disjoint device slice from the scheduler
+        # (orchestration/scheduler.py; AutoML shares its run's scheduler)
         self.parallelism = max(1, int(parallelism))
+        self.scheduler = scheduler
         self.grid: Grid | None = None
 
     def _combos(self):
@@ -170,7 +173,12 @@ class GridSearch:
                             "search_criteria": self.search_criteria})
 
         from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+        from h2o3_tpu.orchestration.scheduler import MeshScheduler
         from h2o3_tpu.persist.recovery import combo_key
+
+        scheduler = self.scheduler or MeshScheduler(slices=self.parallelism)
+        meta = dict(rows=training_frame.nrows if training_frame else None,
+                    algo=self.builder_cls.algo)
 
         def fresh_combos():
             for combo in self._combos():
@@ -213,7 +221,12 @@ class GridSearch:
                     exhausted = False
                     break
                 try:
-                    m = build_one(combo)
+                    # sequential builds lease too: with a forced slice layout
+                    # (H2O3TPU_MESH_SLICES) a par=1 run binds the same-sized
+                    # slice a par=N run would, so per-model results are
+                    # bit-identical across parallelism settings
+                    with scheduler.lease(**meta):
+                        m = build_one(combo)
                     models.append(m)
                     if recovery is not None:
                         recovery.model_built(combo, m)
@@ -221,7 +234,8 @@ class GridSearch:
                     failures.append((combo, f"{type(e).__name__}: {e}"))
         else:
             results, exhausted = windowed_parallel(
-                fresh_combos(), self.parallelism, can_submit, build_one)
+                fresh_combos(), self.parallelism, can_submit, build_one,
+                scheduler=scheduler, job_meta=lambda combo: meta)
             for combo, m, exc in results:
                 if exc is not None:
                     failures.append((combo, f"{type(exc).__name__}: {exc}"))

@@ -24,6 +24,8 @@ def windowed_parallel(
     par: int,
     can_submit: Callable[[int], bool],
     run_one: Callable[[Any], Any],
+    scheduler=None,
+    job_meta: Callable[[Any], dict] | None = None,
 ) -> tuple[list[tuple[Any, Any, Exception | None]], bool]:
     """Run ``run_one(item)`` over a LAZY item stream with at most ``par`` in
     flight.  ``can_submit(n_submitted)`` gates each submission (budget /
@@ -34,12 +36,27 @@ def windowed_parallel(
     a failed build releases its budget and the walker keeps going — the
     reference GridSearch semantics (failed params don't consume max_models).
 
+    When a ``scheduler`` (:class:`~h2o3_tpu.orchestration.scheduler.
+    MeshScheduler`) is given, every submission runs inside a slice lease:
+    the build binds a disjoint device slice (small work) or the whole mesh
+    (big work) per the scheduler's policy, so ``par`` overlapped builds
+    never race collectives on a shared device set. ``job_meta(item)``
+    supplies the sizing hints (``rows``/``algo``) the policy needs.
+
     Returns ``(results, stream_exhausted)`` where results are
     ``(item, result, exc)`` in SUBMISSION order — callers get deterministic
     model ordering regardless of completion interleaving — and
     ``stream_exhausted`` is False when a budget/deadline stop (not stream
     end) ended the run.
     """
+    if scheduler is not None:
+        inner = run_one
+
+        def run_one(item):   # noqa: F811 — leased wrapper shadows on purpose
+            meta = job_meta(item) if job_meta is not None else {}
+            with scheduler.lease(**meta):
+                return inner(item)
+
     it = iter(items)
     if par <= 1:
         out: list = []

@@ -1,0 +1,320 @@
+"""MeshScheduler — device-slice allocation for concurrent model builds.
+
+ROADMAP item 5 ("AutoML at fleet scale"): AutoML/grid "parallelism" used to
+be host threads interleaving builds on ONE global mesh, so two overlapped
+builds raced full-device collectives against each other — a documented
+correctness hazard (overlapping programs can wedge XLA's collective
+rendezvous; the PR 8 chaos AutoML test and the parallel-build tests pinned
+``parallelism=1`` because of it). The fix shape is MXNET-MPI's (PAPERS.md):
+partition workers into independent communicator groups and run jobs
+group-local. Here the group is a **mesh slice** (:func:`~h2o3_tpu.parallel.
+mesh.slice_meshes`) and the policy is TensorFlow-placement-shaped: small
+builds pack one-per-slice and run concurrently for real; big builds wait
+for, and take, the whole mesh.
+
+A lease binds its slice as the context mesh (``bind_mesh``), so everything
+the build resolves — ``row_sharding``, ``map_reduce``, frame reshards via
+``Frame.on_mesh`` — stays inside the slice's device set and two concurrent
+builds never share a collective.
+
+Utilization (busy seconds, builds, queue wait) is exported as the
+``h2o3_slice_*`` metrics and served inside ``GET /3/Cloud`` as
+``mesh_slices`` (docs/ORCHESTRATION.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from h2o3_tpu.parallel.mesh import (bind_mesh, get_mesh, mesh_device_ids,
+                                    slice_meshes)
+from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.tracing import TRACER
+
+#: builds at or above this many rows take the whole mesh (override with
+#: ``H2O3TPU_SLICE_ROWS_MAX``) — below it a build packs onto one slice
+DEFAULT_SMALL_ROWS = 1_000_000
+
+#: algo families that are slice-sized regardless of rows (the ISSUE's
+#: "GLM/DRF-class work": one Gram solve / one fused forest program — their
+#: collectives are tiny, so a slice loses nothing)
+SMALL_ALGOS = {"glm", "drf"}
+
+
+def small_rows_threshold() -> int:
+    try:
+        return int(os.environ.get("H2O3TPU_SLICE_ROWS_MAX", "")
+                   or DEFAULT_SMALL_ROWS)
+    except ValueError:
+        return DEFAULT_SMALL_ROWS
+
+
+def slices_from_env() -> int | None:
+    """Explicit slice count from ``H2O3TPU_MESH_SLICES`` (None = unset)."""
+    env = os.environ.get("H2O3TPU_MESH_SLICES", "").strip()
+    if not env:
+        return None
+    try:
+        return max(int(env), 1)
+    except ValueError:
+        return None
+
+
+class _SliceStats:
+    """Process-wide utilization rollup behind ``/3/Cloud``'s ``mesh_slices``
+    view (schedulers are per-run; the view must outlive them)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._layout: list[dict] = []
+        self._per: dict[str, dict] = {}
+        self._full_devices: list | None = None
+
+    def configure(self, meshes) -> int:
+        """Merge ``meshes``'s rows into the layout (keyed by slice label) and
+        return the slice count. MERGE, not replace: schedulers configure on
+        construction, and a later/concurrent run (say a par=1 grid while a
+        par=2 AutoML holds slice leases) must not erase the other's slices
+        from ``/3/Cloud``. A label re-carved with a different device set
+        takes the new row — same-label collisions across *different* layouts
+        are the documented pin-``H2O3TPU_MESH_SLICES`` limitation.
+
+        The whole-mesh ``"full"`` row is NOT a carving — it overlaps every
+        slice by definition — so it never enters the layout or the count: a
+        par=1 run next to a par=2 run reports 2 slices plus a separate
+        ``full`` utilization row, not 3 pseudo-slices."""
+        with self._lock:
+            for i, m in enumerate(meshes):
+                if len(meshes) == 1:
+                    self._full_devices = list(mesh_device_ids(m))
+                    continue
+                row = {"slice": str(i), "devices": list(mesh_device_ids(m))}
+                if row not in self._layout:
+                    self._layout = [r for r in self._layout
+                                    if r["slice"] != row["slice"]]
+                    self._layout.append(row)
+            if len(meshes) > 1:
+                # whole-mesh ("full") leases on this layout cover the union
+                # of its slices — keep the utilization row's device set real
+                self._full_devices = sorted(
+                    {d for r in self._layout for d in r["devices"]})
+            return self._count_locked()
+
+    def _count_locked(self) -> int:
+        return len(self._layout) or (1 if self._full_devices else 0)
+
+    def record(self, label: str, busy_s: float, wait_s: float) -> None:
+        with self._lock:
+            st = self._per.setdefault(label, {"builds": 0,
+                                              "busy_seconds": 0.0,
+                                              "queue_wait_seconds": 0.0})
+            st["builds"] += 1
+            st["busy_seconds"] = round(st["busy_seconds"] + busy_s, 6)
+            st["queue_wait_seconds"] = round(
+                st["queue_wait_seconds"] + wait_s, 6)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            slices = []
+            for row in self._layout:
+                st = self._per.get(row["slice"],
+                                   {"builds": 0, "busy_seconds": 0.0,
+                                    "queue_wait_seconds": 0.0})
+                slices.append({**row, **st})
+            full = self._per.get("full")
+            if full is not None or (self._full_devices and not slices):
+                slices.append({"slice": "full",
+                               "devices": list(self._full_devices or []),
+                               **(full or {"builds": 0, "busy_seconds": 0.0,
+                                           "queue_wait_seconds": 0.0})})
+            return {"count": self._count_locked(), "slices": slices}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._layout = []
+            self._per = {}
+            self._full_devices = None
+
+
+#: the process-wide utilization view (``GET /3/Cloud`` → ``mesh_slices``)
+SLICE_STATS = _SliceStats()
+
+
+class _LeaseState:
+    """Free-list + condvar for one slice layout, shared PROCESS-WIDE.
+
+    Schedulers are per-run (AutoML and its grids share one), but two
+    *independent* concurrent runs each construct their own — with
+    per-instance state both would grant "slice 0" at once and the two
+    builds' collectives would rendezvous on the same devices, the exact
+    wedge the scheduler exists to remove. Keying the lease state by the
+    slice layout (the device-id tuples) makes every scheduler carving the
+    same layout contend on ONE free list, so a slice is held by at most
+    one build in the process regardless of which run leased it.
+    Different layouts still overlap (documented limitation —
+    docs/ORCHESTRATION.md): pin ``H2O3TPU_MESH_SLICES`` so concurrent
+    runs carve identically.
+    """
+
+    _registry: dict[tuple, "_LeaseState"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self, n: int):
+        self.cv = threading.Condition()
+        self.free = list(range(n))
+        self.big_waiting = 0
+        self.n = n
+
+    @classmethod
+    def for_layout(cls, layout: tuple) -> "_LeaseState":
+        with cls._registry_lock:
+            st = cls._registry.get(layout)
+            if st is None:
+                st = cls._registry[layout] = cls(len(layout))
+            return st
+
+
+class SliceLease:
+    """What a build holds while it runs: the bound mesh + attribution."""
+
+    __slots__ = ("mesh", "index", "label", "devices", "queue_wait_s")
+
+    def __init__(self, mesh, index: int, label: str, devices, wait_s: float):
+        self.mesh = mesh
+        self.index = index          # -1 = whole mesh
+        self.label = label
+        self.devices = devices
+        self.queue_wait_s = wait_s
+
+
+class MeshScheduler:
+    """Allocates disjoint mesh slices to concurrent builds.
+
+    ``slices`` is a REQUEST: the effective count is the largest divisor of
+    the global device count that is <= the request (``slice_meshes``), and
+    ``H2O3TPU_MESH_SLICES`` overrides it outright. One slice (or one
+    device) degrades to exactly today's behavior: every build binds the
+    global mesh.
+    """
+
+    def __init__(self, slices: int | None = None):
+        n = slices_from_env()
+        if n is None:
+            n = max(int(slices or 1), 1)
+        # carve the CALLER'S active mesh (the user's mesh_context/bind_mesh
+        # binding when one is live, else the global mesh): a grid/AutoML run
+        # confined to a submesh must stay confined — leases sub-divide it,
+        # big builds take it, artifacts re-home onto it. Captured here, on
+        # the caller's thread, because pool workers don't inherit the
+        # caller's contextvars.
+        self.base = get_mesh()
+        self.meshes = slice_meshes(n, base=self.base)
+        self.n = len(self.meshes)
+        # lease state is shared process-wide per LAYOUT: two concurrent
+        # runs carving the same slices contend on one free list, so a
+        # slice is never granted to two builds at once (see _LeaseState)
+        self._state = _LeaseState.for_layout(
+            tuple(mesh_device_ids(m) for m in self.meshes))
+        # gauge follows the merged process-wide layout, not just this run
+        _tm.SLICE_COUNT.set(SLICE_STATS.configure(self.meshes))
+
+    # -- policy --------------------------------------------------------------
+
+    def is_small(self, rows: int | None = None,
+                 algo: str | None = None) -> bool:
+        """Small = packs onto one slice; big = takes the whole mesh."""
+        if self.n <= 1:
+            return False
+        if algo and str(algo).lower() in SMALL_ALGOS:
+            return True
+        return rows is not None and int(rows) < small_rows_threshold()
+
+    # -- leasing -------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def lease(self, rows: int | None = None, algo: str | None = None):
+        """Acquire a slice (small builds) or the whole mesh (big builds),
+        bind it as the context mesh, and release on exit. Blocks until
+        capacity frees up; a waiting big build gates new small leases so it
+        cannot starve."""
+        small = self.is_small(rows=rows, algo=algo)
+        t0 = time.monotonic()
+        if self.n <= 1:
+            # degenerate layout (1 slice / 1 device) = today's behavior:
+            # builds overlap freely on the one mesh (host-thread overlap
+            # hides compile/dispatch latency; there is no second rendezvous
+            # to race), so the lease must not serialize them
+            t1 = time.monotonic()
+            mesh = self.meshes[0]
+            try:
+                # the one mesh IS the global mesh — nothing to re-home
+                with bind_mesh(mesh, rehome_models=False):
+                    yield SliceLease(mesh, -1, "full",
+                                     mesh_device_ids(mesh), 0.0)
+            finally:
+                busy = time.monotonic() - t1
+                _tm.SLICE_BUSY.labels(slice="full").inc(busy)
+                _tm.SLICE_BUILDS.labels(slice="full").inc()
+                SLICE_STATS.record("full", busy, 0.0)
+            return
+        st = self._state
+        # acquisition happens INSIDE the try: ``idx`` flips from None the
+        # instant a slice (or the whole mesh) leaves the free list, so an
+        # exception (or KeyboardInterrupt) landing anywhere after that —
+        # even between acquisition and yield — still releases it in the
+        # finally (a leaked slice would wedge every later lease
+        # process-wide)
+        idx: int | None = None
+        t1 = t0
+        try:
+            if small:
+                with st.cv:
+                    while not st.free or st.big_waiting:
+                        st.cv.wait()
+                    idx = st.free.pop(0)
+            else:
+                with st.cv:
+                    st.big_waiting += 1
+                    try:
+                        while len(st.free) < self.n:
+                            st.cv.wait()
+                        st.free.clear()
+                        idx = -1
+                    finally:
+                        st.big_waiting -= 1
+                        st.cv.notify_all()
+            t1 = time.monotonic()
+            if idx >= 0:
+                mesh, label = self.meshes[idx], str(idx)
+            else:
+                mesh, label = self.base, "full"
+            wait_s = t1 - t0
+            devices = mesh_device_ids(mesh)
+            _tm.SLICE_QUEUE_WAIT.observe(wait_s)
+            # whole-mesh leases need no re-homing (artifacts are already
+            # on the base device set); slice leases re-home onto the base
+            with bind_mesh(mesh, rehome_models=idx >= 0,
+                           rehome_to=self.base):
+                with TRACER.span(f"mesh_slice:{label}", kind="orchestration",
+                                 attrs={"slice": label,
+                                        "devices": ",".join(map(str, devices)),
+                                        "n_devices": len(devices),
+                                        "queue_wait_ms":
+                                            round(wait_s * 1e3, 3)}):
+                    yield SliceLease(mesh, idx, label, devices, wait_s)
+        finally:
+            if idx is not None:
+                busy = time.monotonic() - t1
+                label = str(idx) if idx >= 0 else "full"
+                _tm.SLICE_BUSY.labels(slice=label).inc(busy)
+                _tm.SLICE_BUILDS.labels(slice=label).inc()
+                SLICE_STATS.record(label, busy, t1 - t0)
+                with st.cv:
+                    if idx >= 0:
+                        st.free.append(idx)
+                    else:
+                        st.free.extend(range(self.n))
+                    st.cv.notify_all()

@@ -8,20 +8,34 @@ driven by XLA collectives, and there is no user-level RPC to implement.
 
 from h2o3_tpu.parallel.mesh import (
     ROWS,
+    bind_mesh,
+    bound_mesh,
     get_mesh,
+    global_mesh,
     set_mesh,
     mesh_context,
+    mesh_device_ids,
     num_devices,
+    num_global_devices,
+    rehome,
     row_sharding,
     replicated_sharding,
+    slice_meshes,
 )
 
 __all__ = [
     "ROWS",
+    "bind_mesh",
+    "bound_mesh",
     "get_mesh",
+    "global_mesh",
     "set_mesh",
     "mesh_context",
+    "mesh_device_ids",
     "num_devices",
+    "num_global_devices",
+    "rehome",
     "row_sharding",
     "replicated_sharding",
+    "slice_meshes",
 ]

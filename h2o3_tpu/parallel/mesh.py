@@ -1,4 +1,4 @@
-"""Global device mesh — the TPU-native equivalent of H2O's "cloud".
+"""Device mesh resolution — the TPU-native equivalent of H2O's "cloud".
 
 In the reference, every node gossips heartbeats until all agree on the member
 list (``water/Paxos.java:27-124``) and the cloud is then locked — membership is
@@ -10,11 +10,25 @@ The default mesh is 1-D over all addressable devices with axis name ``"rows"``:
 frames are row-partitioned across it the way H2O chunks rows across nodes
 (ESPC layout, ``water/fvec/Vec.java:152``). Multi-dim meshes (e.g. rows × model
 for sharded Gram linear algebra) can be installed with :func:`set_mesh`.
+
+Mesh resolution is TWO-LEVEL (the MXNET-MPI communicator-group shape,
+PAPERS.md):
+
+- the **process-global** mesh (:func:`global_mesh`) covers the whole device
+  cloud and owns frame layout: padded lengths are computed against it so a
+  frame's shape never depends on which slice later computes over it;
+- a **context-bound** mesh (:func:`bind_mesh`) scopes :func:`get_mesh` to the
+  current thread/task via a contextvar. A model build bound to a slice from
+  :func:`slice_meshes` resolves every ``row_sharding``/``map_reduce`` against
+  its OWN device subset, so two concurrent builds compile independent XLA
+  programs and never share a collective rendezvous (the documented hazard
+  that forced ``parallelism=1`` pins before the mesh-slice scheduler).
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 
 import jax
@@ -28,14 +42,37 @@ ROWS = "rows"
 _lock = threading.Lock()
 _mesh: Mesh | None = None
 
+# Context-bound mesh: set by bind_mesh()/mesh_context(), read by get_mesh().
+# A contextvar (not a global) so concurrent builds on different threads each
+# see their own slice — the last-exit-clobbers race the old global-mutating
+# mesh_context had cannot happen.
+_bound: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "h2o3_tpu_bound_mesh", default=None)
+
+# Set (with the binding) by scheduler leases: the mesh a leased build's
+# artifacts are re-homed onto at train() exit so cross-slice consumers can
+# mix them — the scheduler's base mesh (the caller's mesh at scheduler
+# construction; usually the global mesh). A plain mesh_context/bind_mesh
+# does NOT request it (None) — its caller owns the device layout
+# (device-parity tests predict INSIDE the context).
+_rehome_to: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "h2o3_tpu_rehome_to", default=None)
+
 
 def _default_mesh() -> Mesh:
     devices = np.array(jax.devices())
     return Mesh(devices, axis_names=(ROWS,))
 
 
-def get_mesh() -> Mesh:
-    """Return the process-global mesh, creating the default 1-D mesh lazily."""
+def _check_rows_axis(mesh: Mesh) -> None:
+    if ROWS not in mesh.axis_names:
+        raise ValueError(f"mesh must have a {ROWS!r} axis, got {mesh.axis_names}")
+
+
+def global_mesh() -> Mesh:
+    """The process-global mesh over the whole device cloud (created lazily),
+    ignoring any context-bound slice. Frame layout (padding) and post-build
+    re-homing resolve against this one."""
     global _mesh
     with _lock:
         if _mesh is None:
@@ -43,42 +80,229 @@ def get_mesh() -> Mesh:
         return _mesh
 
 
+def get_mesh() -> Mesh:
+    """The mesh for the current context: the bound slice when one is active
+    (see :func:`bind_mesh`), else the process-global mesh."""
+    bound = _bound.get()
+    if bound is not None:
+        return bound
+    return global_mesh()
+
+
+def bound_mesh() -> Mesh | None:
+    """The context-bound slice mesh, or None outside any binding."""
+    return _bound.get()
+
+
 def set_mesh(mesh: Mesh | None) -> None:
-    """Install a mesh globally (``None`` resets to the lazy default).
+    """Install a mesh PROCESS-GLOBALLY (``None`` resets to the lazy default).
 
     The mesh must have a ``"rows"`` axis; extra axes are allowed and are used by
     model-parallel code paths (e.g. sharded Cholesky for wide GLM Gram matrices).
+    For a scoped install use :func:`bind_mesh` / :func:`mesh_context` instead.
     """
     global _mesh
-    if mesh is not None and ROWS not in mesh.axis_names:
-        raise ValueError(f"mesh must have a {ROWS!r} axis, got {mesh.axis_names}")
+    if mesh is not None:
+        _check_rows_axis(mesh)
     with _lock:
         _mesh = mesh
 
 
 @contextlib.contextmanager
-def mesh_context(mesh: Mesh):
-    """Temporarily install ``mesh`` as the global mesh."""
-    prev = _mesh
-    set_mesh(mesh)
+def bind_mesh(mesh: Mesh, rehome_models: bool = False,
+              rehome_to: Mesh | None = None):
+    """Bind ``mesh`` as this context's mesh: :func:`get_mesh` (and everything
+    built on it — ``row_sharding``, ``map_reduce``, ``num_devices``) resolves
+    to it inside the block, in THIS thread/task only. ``rehome_models=True``
+    (scheduler leases) additionally asks builders to move finished model
+    artifacts onto ``rehome_to`` (default: the global mesh) —
+    :func:`rehome_requested` / :func:`rehome_target`."""
+    _check_rows_axis(mesh)
+    token = _bound.set(mesh)
+    target = (rehome_to if rehome_to is not None else global_mesh()) \
+        if rehome_models else None
+    token_r = _rehome_to.set(target)
     try:
         yield mesh
     finally:
-        set_mesh(prev)
+        _rehome_to.reset(token_r)
+        _bound.reset(token)
+
+
+def rehome_requested() -> bool:
+    """True when the active binding came from a scheduler lease, i.e. the
+    finished model must be re-homed onto :func:`rehome_target` for
+    cross-slice consumers (predict on base-mesh frames, stacked-ensemble
+    assembly)."""
+    return _rehome_to.get() is not None
+
+
+def rehome_target() -> Mesh | None:
+    """The mesh a leased build's artifacts re-home onto (the scheduler's
+    base mesh), or None outside a rehoming binding."""
+    return _rehome_to.get()
+
+
+def mesh_context(mesh: Mesh):
+    """Temporarily use ``mesh`` as the active mesh.
+
+    Historical API kept for callers/tests; now an alias of :func:`bind_mesh`.
+    The old implementation swapped the process-global mesh and restored it on
+    exit — under concurrent use the last exit clobbered everyone else's mesh
+    (and a concurrent builder could resolve a foreign mesh mid-build). The
+    contextvar binding is per-thread/task, so interleaved contexts are
+    isolated by construction.
+    """
+    return bind_mesh(mesh)
 
 
 def num_devices() -> int:
-    """Number of devices along the row axis (H2O: ``H2O.CLOUD.size()``)."""
+    """Devices along the row axis of the ACTIVE mesh (H2O:
+    ``H2O.CLOUD.size()``) — the bound slice's size inside a binding."""
     mesh = get_mesh()
     return mesh.shape[ROWS]
 
 
+def num_global_devices() -> int:
+    """Devices along the row axis of the process-global mesh, ignoring any
+    bound slice. Frame padding uses this so a frame's padded length is one
+    process-wide invariant (every slice's device count divides it — see
+    :func:`slice_meshes`)."""
+    mesh = global_mesh()
+    return mesh.shape[ROWS]
+
+
+def slice_meshes(k: int, base: Mesh | None = None) -> list[Mesh]:
+    """Carve ``base`` (default: the global mesh) into ``k`` disjoint
+    ``rows`` submeshes.
+
+    Each slice is a contiguous block of the base row axis with its own
+    1-D ``rows`` mesh, so collectives compiled against one slice rendezvous
+    only among that slice's devices — concurrent builds on different slices
+    are independent XLA programs (MXNET-MPI communicator groups; FireCaffe
+    independent reduction trees). ``k`` is clamped to the largest divisor of
+    the base device count that is <= k, so every slice has the same size
+    and the padded length stays divisible by each slice's row count.
+    ``k <= 1`` (or a single-device base) returns ``[base]`` — the
+    degenerate layout IS today's behavior.
+    """
+    g = base if base is not None else global_mesh()
+    ndev = g.shape[ROWS]
+    k = max(int(k), 1)
+    while k > 1 and ndev % k:
+        k -= 1
+    if k <= 1 or ndev <= 1:
+        return [g]
+    if g.devices.ndim != 1:
+        # multi-axis meshes are carved along rows only when rows is the sole
+        # axis; otherwise degrade to the whole mesh (correct, just unsliced)
+        return [g]
+    per = ndev // k
+    devs = np.asarray(g.devices).reshape(-1)
+    return [Mesh(devs[i * per:(i + 1) * per], axis_names=(ROWS,))
+            for i in range(k)]
+
+
+def mesh_device_ids(mesh: Mesh) -> tuple[int, ...]:
+    """Stable identity of a mesh's device set (sorted jax device ids) —
+    cache keys for per-mesh resharded views and span attribution."""
+    return tuple(sorted(d.id for d in np.asarray(mesh.devices).reshape(-1)))
+
+
 def row_sharding(ndim: int = 1) -> NamedSharding:
-    """Sharding that partitions axis 0 (rows) and replicates the rest."""
+    """Sharding that partitions axis 0 (rows) and replicates the rest,
+    on the active (possibly bound) mesh."""
     spec = P(ROWS, *([None] * (ndim - 1)))
     return NamedSharding(get_mesh(), spec)
 
 
 def replicated_sharding() -> NamedSharding:
-    """Fully-replicated sharding on the global mesh."""
+    """Fully-replicated sharding on the active (possibly bound) mesh."""
     return NamedSharding(get_mesh(), P())
+
+
+def _spec_transfers(spec, shape, mesh: Mesh):
+    """``spec`` re-expressed on ``mesh`` when every partitioned axis exists
+    there and still divides the array's dimension — else None (replicate).
+    Preserves a slice-built array's layout across re-homing: row-sharded on
+    the slice stays row-sharded on the global mesh."""
+    for dim, part in enumerate(spec):
+        if part is None:
+            continue
+        names = part if isinstance(part, tuple) else (part,)
+        size = 1
+        for nm in names:
+            if nm not in mesh.shape:
+                return None
+            size *= mesh.shape[nm]
+        if dim >= len(shape) or (size and shape[dim] % size):
+            return None
+    return spec
+
+
+def rehome(obj, mesh: Mesh | None = None, _depth: int = 0,
+           _seen: dict | None = None):
+    """Move every jax array reachable through ``obj`` onto ``mesh`` (default:
+    the global mesh), IN PLACE where possible.
+
+    A model built inside a :func:`bind_mesh` slice holds artifacts committed
+    to that slice's devices; mixing them with global-mesh frames in a later
+    jit (predict, stacked-ensemble level-one assembly) raises XLA's
+    incompatible-devices error. Walking the object graph once at build exit
+    re-homes coefficients / tree heaps / OOF predictions. The decision comes
+    from each array's EXISTING sharding, not a shape guess: an array already
+    on the target device set is left exactly as the builder laid it out; a
+    slice-homed array keeps its partition spec where the spec still applies
+    on the target mesh (same axis names, sizes divide), else it is
+    replicated. Depth- and cycle-limited like
+    ``utils.memory.array_tree_bytes``; numpy arrays and scalars pass through.
+    Returns the (possibly replaced) object so callers can rebind immutables.
+    """
+    if mesh is None:
+        mesh = global_mesh()
+    if _depth > 8 or obj is None or isinstance(obj, (str, bytes, int, float,
+                                                     bool, type)):
+        return obj
+    if isinstance(obj, jax.Array):
+        target = {d.id for d in np.asarray(mesh.devices).reshape(-1)}
+        cur = getattr(obj, "sharding", None)
+        cur_ids = {d.id for d in getattr(cur, "device_set", ())}
+        if cur_ids == target:
+            return obj          # already homed — keep the builder's layout
+        spec = P()
+        if isinstance(cur, NamedSharding):
+            carried = _spec_transfers(cur.spec, obj.shape, mesh)
+            if carried is not None:
+                spec = carried
+        return jax.device_put(obj, NamedSharding(mesh, spec))
+    if isinstance(obj, np.ndarray):
+        return obj
+    if _seen is None:
+        _seen = {}
+    # memo maps id -> the RE-HOMED replacement (for in-place containers
+    # that is the container itself): a second reference to an aliased
+    # tuple must get the rebuilt copy, not the original whose arrays are
+    # still on the slice devices
+    if id(obj) in _seen:
+        return _seen[id(obj)]
+    _seen[id(obj)] = obj
+    if isinstance(obj, dict):
+        for k, v in list(obj.items()):
+            obj[k] = rehome(v, mesh, _depth + 1, _seen)
+        return obj
+    if isinstance(obj, list):
+        for i, v in enumerate(obj):
+            obj[i] = rehome(v, mesh, _depth + 1, _seen)
+        return obj
+    if isinstance(obj, tuple):
+        new = type(obj)(rehome(v, mesh, _depth + 1, _seen) for v in obj)
+        _seen[id(obj)] = new
+        return new
+    if hasattr(obj, "__dict__"):
+        for k, v in list(vars(obj).items()):
+            try:
+                setattr(obj, k, rehome(v, mesh, _depth + 1, _seen))
+            except AttributeError:   # read-only property/slots
+                pass
+        return obj
+    return obj

@@ -324,11 +324,11 @@ def merge(left: Frame, right: Frame, by: Sequence[str] | None = None,
             if lv.is_categorical:
                 vals = lv.labels()
                 vals[miss] = rv.labels()[miss]
-                lf.vecs[lf._index(c)] = Vec.from_numpy(vals, type=VecType.CAT)
+                lf.replace_vec(c, Vec.from_numpy(vals, type=VecType.CAT))
             else:
                 vals = lv.to_numpy().copy()
                 vals[miss] = rv.to_numpy()[miss]
-                lf.vecs[lf._index(c)] = Vec.from_numpy(vals, type=lv.type)
+                lf.replace_vec(c, Vec.from_numpy(vals, type=lv.type))
     if rf is not None:
         for c in right_rest:
             name = c if c not in lf.names else c + "_y"

@@ -248,8 +248,8 @@ def impute(frame: Frame, column: str, method: str = "mean",
             fill = jnp.argmax(counts).astype(jnp.int32)
         new = jnp.where(v.data < 0, fill, v.data).astype(jnp.int32)
         new = jnp.where(frame.row_mask(), new, -1)
-        frame.vecs[frame._index(column)] = Vec(new, VecType.CAT, v.nrows,
-                                               domain=v.domain)
+        frame.replace_vec(column, Vec(new, VecType.CAT, v.nrows,
+                                      domain=v.domain))
         return frame
 
     x = v.as_float()
@@ -270,8 +270,8 @@ def impute(frame: Frame, column: str, method: str = "mean",
     else:
         fill = {"mean": vmean, "median": vmedian, "min": vmin, "max": vmax}[method](v)
     out = jnp.where(jnp.isnan(x) & frame.row_mask(), fill, x)
-    frame.vecs[frame._index(column)] = Vec(out.astype(jnp.float32),
-                                           VecType.NUM, v.nrows)
+    frame.replace_vec(column, Vec(out.astype(jnp.float32),
+                                  VecType.NUM, v.nrows))
     return frame
 
 

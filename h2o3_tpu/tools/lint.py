@@ -28,7 +28,7 @@ import json
 import sys
 from pathlib import Path
 
-from h2o3_tpu.tools import locks, mem, rest, retry, sync, tracer
+from h2o3_tpu.tools import locks, mem, meshes, rest, retry, sync, tracer
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -39,7 +39,8 @@ def run_lint(root: Path) -> list[Finding]:
     (path, line, rule) order."""
     index = PackageIndex.scan(Path(root))
     findings = (tracer.check(index) + locks.check(index) + rest.check(index)
-                + mem.check(index) + sync.check(index) + retry.check(index))
+                + mem.check(index) + sync.check(index) + retry.check(index)
+                + meshes.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),

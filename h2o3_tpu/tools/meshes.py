@@ -1,0 +1,62 @@
+"""graftlint mesh-discipline rules (MSH) — stale-mesh hazards in builders.
+
+- **MSH001** — a direct ``get_mesh()`` call inside builder hot paths
+  (modules under ``models/``). Mesh resolution is two-level
+  (``parallel/mesh.py``): ``get_mesh()`` answers from the *context* — the
+  bound slice of the build that happens to be running — so a builder that
+  grabs it mid-build can (a) bake a mesh into a jit trace that the compile
+  cache later serves to a build bound to a DIFFERENT slice (the
+  ``tree.py:hist_mesh`` stale-mesh bug class: shard_map bakes its mesh in
+  at trace time), or (b) resolve a foreign thread's mesh when called from
+  a helper outside the lease. Builder code must take the mesh from its
+  INPUT sharding (the ``hist_mesh`` pattern — the data already knows where
+  it lives) or receive it as an explicit argument threaded from the
+  slice-bound frame. Intentional sites carry an inline
+  ``# graftlint: ok(<reason>)`` suppression like every other rule family.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, call_name
+
+#: package-relative directory whose modules are builder hot paths
+BUILDER_DIRS = ("models",)
+
+
+def _in_builder_dir(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return any(d in parts[:-1] for d in BUILDER_DIRS)
+
+
+def _is_get_mesh_call(node: ast.Call) -> bool:
+    """Both spellings: bare ``get_mesh()`` (from-import) and the attribute
+    form ``mesh.get_mesh()`` / ``parallel.mesh.get_mesh()``."""
+    name = call_name(node)
+    return bool(name) and name.split(".")[-1] == "get_mesh"
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        if not _in_builder_dir(mod.path):
+            continue
+        qual_of: dict[int, str] = {}
+        for fn in sorted((f for f in index.functions.values()
+                          if f.module is mod),
+                         key=lambda f: f.node.lineno):
+            for sub in ast.walk(fn.node):
+                qual_of[id(sub)] = fn.qualname
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_get_mesh_call(node):
+                findings.append(Finding(
+                    "MSH001", mod.path, node.lineno,
+                    qual_of.get(id(node), ""),
+                    "direct `get_mesh()` in a builder hot path — the mesh "
+                    "must come from the input arrays' sharding (the "
+                    "tree.py:hist_mesh pattern) or an explicit argument; a "
+                    "context lookup here can bake a stale/foreign slice "
+                    "into a compiled program",
+                    detail="get_mesh"))
+    return findings

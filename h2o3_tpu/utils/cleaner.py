@@ -115,9 +115,16 @@ class Cleaner:
                 break
             if k == protect:
                 continue
-            path = os.path.join(self.ice_root, k)
-            save_frame(fr, path)
-            DKV.put(k, SwappedFrame(k, path, fr.nrows, fr.ncols))
+            if getattr(fr, "_is_mesh_view", False):
+                # resharded mesh views (Frame.on_mesh) rebuild from their
+                # source columns on next use — spilling one would write a
+                # snapshot nobody ever reloads and leave a SwappedFrame
+                # stub posing as a user frame; just drop it
+                DKV.remove(k)
+            else:
+                path = os.path.join(self.ice_root, k)
+                save_frame(fr, path)
+                DKV.put(k, SwappedFrame(k, path, fr.nrows, fr.ncols))
             total -= self._frame_bytes(fr)
             spilled.append(k)
         return spilled

@@ -35,11 +35,19 @@ class KeyedStore:
         # store while holding its own lock.
         from h2o3_tpu.utils.memory import MEMORY
         with self._lock:
+            old = self._store.get(key)
             self._store[key] = value
             n = len(self._store)
             MEMORY.register(key, value)
         _tm.DKV_PUTS.inc()
         _tm.DKV_KEYS.set(n)
+        if old is not None and old is not value \
+                and type(old).__name__ in ("Frame", "SwappedFrame"):
+            # overwriting a keyed frame (re-put, spill to a stub, restore
+            # from one) strands the OLD frame's registered mesh views: the
+            # new value's lookup table starts empty, so they would hold
+            # full-size device buffers in /3/Memory forever
+            self._drop_mesh_views(key)
         if type(value).__name__ == "Frame":
             # Cleaner hook (reference: Cleaner LRU sweep on heap pressure);
             # no-op unless a budget is enabled
@@ -93,10 +101,33 @@ class KeyedStore:
             with contextlib.suppress(OSError):
                 os.remove(v.path)
             CLEANER.forget(key)
+            # a spilled source's views are just as unreachable as a live
+            # one's — the stub carries no view table, so cascade by key
+            self._drop_mesh_views(key)
             return None
         from h2o3_tpu.utils.cleaner import CLEANER
         CLEANER.forget(key)
+        if type(v).__name__ == "Frame":
+            # cascade to the frame's DKV-registered mesh views: after the
+            # source is gone they are unreachable (lookups only go through
+            # the source's _mesh_views) yet keep full-size device buffers
+            # resident and visible in /3/Memory. The key-prefix scan backs
+            # up the view table for frames whose table was lost (restored
+            # from a spill snapshot) or whose key was reassigned
+            for vk in list(getattr(v, "_mesh_views", {}).values()):
+                if isinstance(vk, str):
+                    self.remove(vk)
+            self._drop_mesh_views(key)
         return v
+
+    def _drop_mesh_views(self, key: str) -> None:
+        """Remove every DKV-registered mesh view of ``key`` (the
+        ``{key}::mesh[...]`` namespace — Frame.on_mesh)."""
+        prefix = f"{key}::mesh["
+        with self._lock:
+            stale = [k for k in self._store if k.startswith(prefix)]
+        for k in stale:
+            self.remove(k)
 
     def keys(self) -> list[str]:
         with self._lock:

@@ -460,6 +460,21 @@ DISPATCHES_PER_ITER = METRICS.gauge(
     "blocking host syncs per logical iteration of a convergence loop "
     "(1/K under K-step megasteps)", ("loop",))
 
+# mesh-slice scheduler (orchestration/scheduler.py): utilization of the
+# disjoint device slices concurrent builds run on (docs/ORCHESTRATION.md).
+# Slice labels are indices ("0".."k-1") or "full" for whole-mesh leases.
+SLICE_COUNT = METRICS.gauge(
+    "h2o3_slice_count",
+    "device slices the mesh scheduler currently carves the global mesh into")
+SLICE_BUSY = METRICS.counter(
+    "h2o3_slice_busy_seconds",
+    "cumulative seconds a slice spent running leased builds", ("slice",))
+SLICE_BUILDS = METRICS.counter(
+    "h2o3_slice_builds", "model builds leased onto a slice", ("slice",))
+SLICE_QUEUE_WAIT = METRICS.histogram(
+    "h2o3_slice_queue_wait_seconds",
+    "time a build waited for a free slice (or for the whole mesh)")
+
 # fault injection (utils/timeline.py FaultInjector)
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))

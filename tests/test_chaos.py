@@ -144,14 +144,15 @@ def test_glm_completes_exactly_under_drop_and_delay(rng):
 def test_automl_completes_under_fault_injection(rng):
     from h2o3_tpu.orchestration import AutoML
     fr = _binfr(rng, n=300)
-    # parallelism=1: this test gates FAULT ABSORPTION; overlapped builds
-    # racing 8-device collectives from two host threads can wedge the CPU
-    # backend's rendezvous regardless of faults (pre-existing hazard,
-    # tracked by ROADMAP item 1's mesh-sharded data plane)
-    clean = AutoML(max_models=2, nfolds=0, seed=7, parallelism=1)
+    # parallelism=2 (un-pinned): overlapped builds now lease DISJOINT mesh
+    # slices from the MeshScheduler, so the two builds' collectives
+    # rendezvous on separate device sets and can no longer wedge each
+    # other (the hazard that used to force parallelism=1 here). Parity
+    # stays exact: same-size slices run the same deterministic programs.
+    clean = AutoML(max_models=2, nfolds=0, seed=7, parallelism=2)
     clean.train(y="y", training_frame=fr)
     with inject_faults(drop_rate=0.05, delay_rate=0.1, delay_ms=1, seed=13):
-        chaotic = AutoML(max_models=2, nfolds=0, seed=7, parallelism=1)
+        chaotic = AutoML(max_models=2, nfolds=0, seed=7, parallelism=2)
         chaotic.train(y="y", training_frame=fr)
     assert len(chaotic.leaderboard) == len(clean.leaderboard)
     for mc, mf in zip(clean.leaderboard.models,

@@ -434,6 +434,49 @@ def test_syn001_telemetry_modules_exempt_and_suppressible(tmp_path):
     assert "SYN001" not in rules_of(run_lint(pkg))
 
 
+# -- mesh discipline (MSH) ---------------------------------------------------
+
+def test_msh001_get_mesh_in_builder_flagged_both_forms(tmp_path):
+    pkg = make_pkg(tmp_path, {"models/bad.py": """
+        from h2o3_tpu.parallel.mesh import get_mesh
+        from h2o3_tpu.parallel import mesh
+
+        def fit(x):
+            m = get_mesh()               # context lookup in a builder
+            return m
+
+        def fit_attr(x):
+            return mesh.get_mesh()       # attribute spelling
+    """})
+    msh = [f for f in run_lint(pkg) if f.rule == "MSH001"]
+    assert len(msh) == 2
+    assert {f.where for f in msh} == {"fit", "fit_attr"}
+    assert all(f.detail == "get_mesh" for f in msh)
+
+
+def test_msh001_input_sharding_pattern_and_non_builders_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"models/good.py": """
+        def hist_mesh(arr):
+            # the sanctioned pattern: the mesh comes from the DATA
+            sharding = getattr(arr, "sharding", None)
+            return getattr(sharding, "mesh", None)
+
+        def fit(x, mesh):
+            return mesh                  # threaded as an argument
+    """, "ops/dispatch.py": """
+        from h2o3_tpu.parallel.mesh import get_mesh
+
+        def map_reduce(fn):
+            return get_mesh()            # dispatch layer: context-aware
+    """, "models/suppressed.py": """
+        from h2o3_tpu.parallel.mesh import get_mesh
+
+        def fit(x):
+            return get_mesh()  # graftlint: ok(whole-frame op, no jit trace)
+    """})
+    assert "MSH001" not in rules_of(run_lint(pkg))
+
+
 # -- retry discipline (RTY) --------------------------------------------------
 
 def test_rty001_constant_sleep_retry_flagged(tmp_path):

@@ -1,0 +1,600 @@
+"""Mesh-slice scheduler: concurrent model builds on disjoint device slices
+(parallel/mesh.py contextvar binding + slice_meshes, Frame.on_mesh resharded
+views, orchestration/scheduler.py MeshScheduler; reference analog: MXNET-MPI
+communicator groups — PAPERS.md)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.parallel import mesh as M
+
+
+def _frame(rng, n=400, key=None):
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    return Frame.from_arrays({
+        "a": x[:, 0], "b": x[:, 1], "c": x[:, 2],
+        "y": np.where(x[:, 0] + x[:, 1] > 0, "t", "f")}, key=key)
+
+
+# -- slice carving ------------------------------------------------------------
+
+def test_slice_meshes_carves_disjoint_cover():
+    g = M.global_mesh()
+    ndev = g.shape[M.ROWS]
+    assert ndev == 8                      # conftest virtual cloud
+    slices = M.slice_meshes(2)
+    assert len(slices) == 2
+    ids = [set(M.mesh_device_ids(m)) for m in slices]
+    assert ids[0].isdisjoint(ids[1])
+    assert ids[0] | ids[1] == set(M.mesh_device_ids(g))
+    assert all(m.shape[M.ROWS] == 4 for m in slices)
+
+
+def test_slice_meshes_clamps_to_divisor_and_degrades():
+    # 3 does not divide 8 -> largest divisor <= 3 is 2
+    assert len(M.slice_meshes(3)) == 2
+    # k=1 (and k<=0) = the global mesh itself: today's behavior
+    assert M.slice_meshes(1) == [M.global_mesh()]
+    assert M.slice_meshes(0) == [M.global_mesh()]
+    # oversubscribed: clamped to one device per slice
+    assert len(M.slice_meshes(64)) == 8
+
+
+def test_get_mesh_prefers_bound_slice():
+    s0 = M.slice_meshes(2)[0]
+    assert M.get_mesh() is M.global_mesh()
+    with M.bind_mesh(s0):
+        assert M.get_mesh() is s0
+        assert M.num_devices() == 4
+        # frame padding stays a GLOBAL invariant inside a binding
+        from h2o3_tpu.frame.vec import padded_len
+        assert padded_len(100) % (8 * 8) == 0
+    assert M.get_mesh() is M.global_mesh()
+
+
+def test_mesh_context_concurrent_threads_no_clobber():
+    """The old mesh_context swapped the process-global mesh: interleaved
+    exits clobbered each other (last exit won). The contextvar delegate
+    isolates per thread — each sees its own mesh, the global never moves."""
+    s0, s1 = M.slice_meshes(2)
+    g = M.global_mesh()
+    inside = threading.Barrier(2, timeout=10)
+    seen = {}
+    errs = []
+
+    def worker(name, mesh):
+        try:
+            with M.mesh_context(mesh):
+                inside.wait()              # both bindings active at once
+                seen[name] = M.get_mesh()
+                inside.wait()              # interleave the exits
+        except Exception as e:   # noqa: BLE001
+            errs.append(e)
+
+    t0 = threading.Thread(target=worker, args=("a", s0))
+    t1 = threading.Thread(target=worker, args=("b", s1))
+    t0.start(); t1.start(); t0.join(); t1.join()
+    assert not errs
+    assert seen["a"] is s0 and seen["b"] is s1
+    # neither exit clobbered the process-global mesh
+    assert M.get_mesh() is g and M.global_mesh() is g
+
+
+def test_mesh_context_non_divisor_submesh_frame_creation():
+    """Public mesh_context with an arbitrary submesh whose size (3) does not
+    divide the global padded unit: padded_len widens to the lcm so frame
+    creation shards cleanly on the bound mesh AND the result stays divisible
+    by the global unit (pre-slice-scheduler behavior, kept working)."""
+    from jax.sharding import Mesh
+
+    from h2o3_tpu.frame.vec import padded_len
+    sub = Mesh(np.array(jax.devices()[:3]), axis_names=(M.ROWS,))
+    with M.mesh_context(sub):
+        plen = padded_len(100)
+        assert plen % (3 * 8) == 0 and plen % (8 * 8) == 0
+        fr = Frame.from_arrays({"a": np.arange(100, dtype=np.float32)})
+        assert {d.id for d in fr.vec("a").data.sharding.device_set} == \
+            {0, 1, 2}
+    np.testing.assert_array_equal(fr.vec("a").to_numpy(),
+                                  np.arange(100, dtype=np.float32))
+
+
+def test_rehome_decides_from_existing_sharding():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    g = M.global_mesh()
+    s0 = M.slice_meshes(2)[0]
+    # already on the target device set: untouched, even though the shape
+    # satisfies the old divisibility guess that would have re-sharded it
+    rep = jax.device_put(np.zeros((64, 2), np.float32), NamedSharding(g, P()))
+    assert M.rehome(rep, g) is rep
+    # slice-homed row-sharded array keeps its spec on the global mesh
+    rs = jax.device_put(np.zeros(64, np.float32),
+                        NamedSharding(s0, P(M.ROWS)))
+    out = M.rehome(rs, g)
+    assert {d.id for d in out.sharding.device_set} == \
+        set(M.mesh_device_ids(g))
+    assert out.sharding.spec == P(M.ROWS)
+    # slice-homed replicated array stays replicated (never force-sharded)
+    small = jax.device_put(np.zeros(3, np.float32), NamedSharding(s0, P()))
+    assert M.rehome(small, g).sharding.spec == P()
+    # a spec that no longer divides on the target mesh degrades to replicated
+    nd = jax.device_put(np.zeros(4, np.float32),
+                        NamedSharding(s0, P(M.ROWS)))
+    assert M.rehome(nd, g).sharding.spec == P()
+
+
+def test_rehome_aliased_tuple_gets_the_rebuilt_copy():
+    """A tuple referenced from two places is rebuilt ONCE and both
+    references get the re-homed copy — the second must not short-circuit
+    to the original whose arrays still live on the slice devices."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    g = M.global_mesh()
+    s0 = M.slice_meshes(2)[0]
+    arr = jax.device_put(np.zeros(64, np.float32),
+                         NamedSharding(s0, P(M.ROWS)))
+    pair = (arr, arr)
+    holder = {"a": pair, "b": pair}
+    out = M.rehome(holder, g)
+    assert out["a"] is out["b"]
+    for ref in (out["a"], out["b"]):
+        assert {d.id for d in ref[0].sharding.device_set} == \
+            set(M.mesh_device_ids(g))
+
+
+# -- Frame.on_mesh ------------------------------------------------------------
+
+def test_on_mesh_reshards_batched_and_caches(rng):
+    s0, s1 = M.slice_meshes(2)
+    fr = _frame(rng)
+    v0 = fr.on_mesh(s0)
+    assert v0 is not fr
+    devs = {d.id for d in v0.vec("a").data.sharding.device_set}
+    assert devs == set(M.mesh_device_ids(s0))
+    # cat column rides its own int stack; domain/type survive
+    assert v0.vec("y").domain == fr.vec("y").domain
+    assert v0.types == fr.types
+    np.testing.assert_array_equal(v0.vec("a").to_numpy(),
+                                  fr.vec("a").to_numpy())
+    # cached per (device set, epoch); already-on-mesh returns self
+    assert fr.on_mesh(s0) is v0
+    assert v0.on_mesh(s0) is v0
+    assert fr.on_mesh(M.global_mesh()) is fr
+    # a second slice gets its own independent view
+    v1 = fr.on_mesh(s1)
+    assert {d.id for d in v1.vec("a").data.sharding.device_set} == \
+        set(M.mesh_device_ids(s1))
+
+
+def test_on_mesh_view_invalidated_on_mutation(rng):
+    s0 = M.slice_meshes(2)[0]
+    fr = _frame(rng)
+    v0 = fr.on_mesh(s0)
+    from h2o3_tpu.frame.vec import Vec
+    fr.add("extra", Vec.from_numpy(np.arange(fr.nrows, dtype=np.float32)))
+    v1 = fr.on_mesh(s0)
+    assert v1 is not v0
+    assert "extra" in v1.names and "extra" not in v0.names
+    fr.remove("extra")
+    assert fr.on_mesh(s0) is not v1
+
+
+def test_on_mesh_view_invalidated_on_column_replacement(rng):
+    """In-place column replacement (impute / pipeline transforms) goes
+    through Frame.replace_vec, which bumps the view epoch — a slice-bound
+    build can never reshard a pre-mutation column."""
+    from h2o3_tpu.rapids import ops
+    s0 = M.slice_meshes(2)[0]
+    x = np.array([1.0, np.nan, 3.0, np.nan] * 100, dtype=np.float32)
+    fr = Frame.from_arrays({"a": x, "y": np.where(
+        np.arange(400) % 2, "t", "f")})
+    v0 = fr.on_mesh(s0)
+    assert np.isnan(v0.vec("a").to_numpy()).any()
+    ops.impute(fr, "a", method="mean")
+    v1 = fr.on_mesh(s0)
+    assert v1 is not v0
+    assert not np.isnan(v1.vec("a").to_numpy()).any()
+
+
+def test_on_mesh_views_byte_accounted_in_dkv(rng):
+    from h2o3_tpu.utils.memory import MEMORY
+    from h2o3_tpu.utils.registry import DKV
+    s0 = M.slice_meshes(2)[0]
+    fr = _frame(rng, key="slice_src")
+    DKV.put("slice_src", fr)
+    v0 = fr.on_mesh(s0)
+    assert v0._is_mesh_view
+    vkeys = [k for k in DKV.keys() if k.startswith("slice_src::mesh[")]
+    assert len(vkeys) == 1
+    # registered bytes equal the view's own accounting (visible in /3/Memory)
+    summary = MEMORY.summary(top_n=50)
+    row = next(r for r in summary["top_keys"] if r["key"] == vkeys[0])
+    assert row["kind"] == "frame" and row["bytes"] == v0.nbytes > 0
+    # …but the view is NOT a user frame in the /3/Frames listing
+    from h2o3_tpu.api import schemas
+    listed = {f["frame_id"]["name"]
+              for f in schemas.frames_list_v3(DKV)["frames"]}
+    assert "slice_src" in listed and vkeys[0] not in listed
+    # structural mutation drops the stale view (and its bytes) from the DKV
+    from h2o3_tpu.frame.vec import Vec
+    fr.add("extra", Vec.from_numpy(np.arange(fr.nrows, dtype=np.float32)))
+    assert vkeys[0] not in DKV
+    # an evicted/cleared view is rebuilt transparently on next use
+    v1 = fr.on_mesh(s0)
+    k1 = [k for k in DKV.keys() if k.startswith("slice_src::mesh[")][0]
+    DKV.remove(k1)
+    v2 = fr.on_mesh(s0)
+    assert v2 is not v1 or v2 is v1  # no crash; fresh view served
+    assert {d.id for d in v2.vec("a").data.sharding.device_set} == \
+        set(M.mesh_device_ids(s0))
+
+
+def test_frame_delete_cascades_to_mesh_views(rng):
+    """DELETE /3/Frames/{key} (any DKV.remove of a frame) removes its
+    registered mesh views too: after the source is gone they are
+    unreachable yet would keep full-size device buffers in /3/Memory."""
+    from h2o3_tpu.utils.memory import MEMORY
+    from h2o3_tpu.utils.registry import DKV
+    s0 = M.slice_meshes(2)[0]
+    fr = _frame(rng, key="del_src")
+    DKV.put("del_src", fr)
+    fr.on_mesh(s0)
+    vkey = next(k for k in DKV.keys() if k.startswith("del_src::mesh["))
+    DKV.remove("del_src")
+    assert vkey not in DKV
+    assert all(r["key"] != vkey
+               for r in MEMORY.summary(top_n=200)["top_keys"])
+
+
+def test_frame_overwrite_and_spilled_remove_drop_mesh_views(rng):
+    """Re-putting a key (replacement frame, spill stub, restore) and
+    removing a SPILLED source both orphan the old frame's registered views
+    — they must leave the DKV with it, not linger in /3/Memory."""
+    from h2o3_tpu.utils.registry import DKV
+    s0 = M.slice_meshes(2)[0]
+    fr = _frame(rng, key="ovw_src")
+    DKV.put("ovw_src", fr)
+    fr.on_mesh(s0)
+    vkey = next(k for k in DKV.keys() if k.startswith("ovw_src::mesh["))
+    DKV.put("ovw_src", _frame(rng, key="ovw_src"))   # replacement frame
+    assert vkey not in DKV
+    DKV.remove("ovw_src")
+    # spilled source: remove() sees the stub, not the Frame
+    class SwappedFrame:                      # shape of cleaner's spill stub
+        def __init__(self):
+            self.path = "/nonexistent/spill"
+    fr2 = _frame(rng, key="spill_src")
+    DKV.put("spill_src", fr2)
+    fr2.on_mesh(s0)
+    vkey2 = next(k for k in DKV.keys() if k.startswith("spill_src::mesh["))
+    with DKV._lock:                          # spill without put-cascade
+        DKV._store["spill_src"] = SwappedFrame()
+    assert vkey2 in DKV
+    DKV.remove("spill_src")
+    assert vkey2 not in DKV
+
+
+# -- scheduler ---------------------------------------------------------------
+
+def test_scheduler_packs_small_one_per_slice():
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    sched = MeshScheduler(slices=2)
+    assert sched.n == 2
+    got = {}
+    inside = threading.Barrier(2, timeout=10)
+
+    def worker(name):
+        with sched.lease(rows=100, algo="gbm") as lease:
+            inside.wait()                # both leases held at once
+            got[name] = set(lease.devices)
+            inside.wait()
+
+    ts = [threading.Thread(target=worker, args=(n,)) for n in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert got["a"].isdisjoint(got["b"])
+    assert got["a"] | got["b"] == set(M.mesh_device_ids(M.global_mesh()))
+
+
+def test_scheduler_big_build_takes_full_mesh():
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    sched = MeshScheduler(slices=2)
+    order = []
+    small_holding = threading.Event()
+    release_small = threading.Event()
+
+    def small():
+        with sched.lease(rows=100):
+            small_holding.set()
+            assert release_small.wait(10)
+            order.append("small_done")
+
+    def big():
+        with sched.lease(rows=10_000_000) as lease:   # >= threshold
+            order.append("big_ran")
+            assert lease.index == -1
+            assert set(lease.devices) == \
+                set(M.mesh_device_ids(M.global_mesh()))
+
+    ts = threading.Thread(target=small)
+    tb = threading.Thread(target=big)
+    ts.start()
+    assert small_holding.wait(10)
+    tb.start()
+    time.sleep(0.1)                      # big must be BLOCKED on the lease
+    assert order == []
+    release_small.set()
+    ts.join(); tb.join()
+    assert order == ["small_done", "big_ran"]
+
+
+def test_scheduler_degrades_to_overlap_on_one_slice():
+    """1 slice = today's behavior: concurrent leases do NOT serialize."""
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    sched = MeshScheduler(slices=1)
+    assert sched.n == 1
+    inside = threading.Barrier(3, timeout=10)
+
+    def worker():
+        with sched.lease(rows=100):
+            inside.wait()                # all three leases held at once
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()                         # barrier passed => no serialization
+
+
+def test_two_schedulers_same_layout_share_lease_state():
+    """Lease state is process-wide per layout: two INDEPENDENT runs (each
+    with its own MeshScheduler) can never both hold the same slice."""
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    s_a, s_b = MeshScheduler(slices=2), MeshScheduler(slices=2)
+    assert s_a._state is s_b._state
+    got = {}
+    inside = threading.Barrier(2, timeout=10)
+
+    def worker(name, sched):
+        with sched.lease(rows=100, algo="gbm") as lease:
+            inside.wait()                # both leases held at once
+            got[name] = set(lease.devices)
+            inside.wait()
+
+    ts = [threading.Thread(target=worker, args=("a", s_a)),
+          threading.Thread(target=worker, args=("b", s_b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert got["a"].isdisjoint(got["b"])
+
+
+def test_cleaner_drops_mesh_views_instead_of_spilling(rng, tmp_path):
+    """Under budget pressure a mesh view is REMOVED (it rebuilds from its
+    source columns) — never spilled to disk as a SwappedFrame stub that
+    would waste a snapshot write and pose as a user frame in /3/Frames."""
+    from h2o3_tpu.api import schemas
+    from h2o3_tpu.utils.cleaner import Cleaner
+    from h2o3_tpu.utils.registry import DKV
+    s0 = M.slice_meshes(2)[0]
+    fr = _frame(rng, key="spill_src")
+    DKV.put("spill_src", fr)
+    fr.on_mesh(s0)
+    vkey = next(k for k in DKV.keys() if k.startswith("spill_src::mesh["))
+    cl = Cleaner(budget_bytes=1, ice_root=str(tmp_path))  # force all out
+    cl.touch("spill_src")                        # view is LRU-first
+    spilled = cl.sweep(protect="spill_src")
+    assert vkey in spilled
+    assert vkey not in DKV                       # dropped, not stubbed
+    assert not list(tmp_path.iterdir())          # no orphan snapshot
+    listed = {f["frame_id"]["name"]
+              for f in schemas.frames_list_v3(DKV)["frames"]}
+    assert vkey not in listed
+    # the view transparently rebuilds on next use
+    v2 = fr.on_mesh(s0)
+    assert {d.id for d in v2.vec("a").data.sharding.device_set} == \
+        set(M.mesh_device_ids(s0))
+    DKV.remove("spill_src")
+
+
+def test_scheduler_env_override(monkeypatch):
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    monkeypatch.setenv("H2O3TPU_MESH_SLICES", "4")
+    sched = MeshScheduler(slices=1)      # env wins over the request
+    assert sched.n == 4
+
+
+# -- the regression the pins guarded against ---------------------------------
+
+def test_concurrent_slice_builds_never_share_a_collective(rng):
+    """Two builds at parallelism=2 run on DISJOINT device slices with
+    overlapping execution: the span tree shows concurrent mesh_slice spans
+    bound to non-intersecting device sets, so no collective of one build
+    can rendezvous with the other's."""
+    from h2o3_tpu.models.gbm import GBM
+    from h2o3_tpu.orchestration.parallel_build import windowed_parallel
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    from h2o3_tpu.utils.tracing import TRACER
+
+    fr = _frame(rng)
+    sched = MeshScheduler(slices=2)
+
+    def build(i):
+        return GBM(ntrees=3, max_depth=3, seed=7).train(
+            y="y", training_frame=fr)
+
+    with TRACER.span("slice_regression", root=True) as root:
+        results, _ = windowed_parallel(
+            [0, 1], 2, lambda n: True, build,
+            scheduler=sched, job_meta=lambda i: dict(rows=fr.nrows,
+                                                     algo="gbm"))
+    assert all(e is None for _, _, e in results)
+    m0, m1 = results[0][1], results[1][1]
+    # identical work on same-size slices -> bit-identical models
+    assert float(m0.training_metrics.auc) == float(m1.training_metrics.auc)
+
+    trace = TRACER.get_trace(root.trace_id)
+    leases = [s for s in trace["spans"] if s["name"].startswith("mesh_slice:")]
+    assert len(leases) == 2
+    devsets = [set(s["attrs"]["devices"].split(",")) for s in leases]
+    assert devsets[0].isdisjoint(devsets[1])
+    # the fit spans OVERLAP in time (they really ran concurrently)
+    (a0, a1), (b0, b1) = [(s["start_ns"], s["end_ns"]) for s in leases]
+    assert max(a0, b0) < min(a1, b1), "slice-bound builds did not overlap"
+    # each lease subtree carries that slice's devices on the build span
+    steps = [s for s in trace["spans"]
+             if s["attrs"].get("mesh_devices") is not None]
+    assert len(steps) >= 2
+    step_sets = {frozenset(s["attrs"]["mesh_devices"].split(","))
+                 for s in steps}
+    assert len(step_sets) == 2
+
+
+def test_job_surfaces_user_frame_key_not_view_key(rng):
+    """A slice-leased build's Job description and extension stream name the
+    USER'S frame key, not the internal ``{key}::mesh[...]`` view key the
+    entry reshard swaps in (which may even be evicted by the time the user
+    reads GET /3/Jobs)."""
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    from h2o3_tpu.utils.registry import DKV
+    fr = _frame(rng, key="user_fr")
+    DKV.put("user_fr", fr)
+    try:
+        est = GLM(family="binomial", lambda_=0.0)
+        sched = MeshScheduler(slices=2)
+        with sched.lease(rows=fr.nrows, algo="glm") as lease:
+            assert lease.index >= 0          # actually slice-bound
+            est.train(y="y", training_frame=fr)
+        assert "user_fr" in est.job.description
+        assert "::mesh[" not in est.job.description
+    finally:
+        DKV.remove("user_fr")
+
+
+def test_automl_parallel_bit_identical_to_sequential(rng, monkeypatch):
+    """Acceptance: at a FORCED slice layout, parallelism=2 AutoML produces
+    per-model results bit-identical to parallelism=1 (every build binds a
+    same-size slice either way), and models predict on global frames."""
+    from h2o3_tpu.orchestration import AutoML
+
+    monkeypatch.setenv("H2O3TPU_MESH_SLICES", "2")
+    fr = _frame(rng, n=300)
+    runs = []
+    for par in (1, 2):
+        aml = AutoML(max_models=2, nfolds=0, seed=7, parallelism=par,
+                     include_algos=["GLM", "GBM"])
+        aml.train(y="y", training_frame=fr)
+        runs.append(aml.leaderboard.models)
+    assert len(runs[0]) == len(runs[1]) >= 2
+    for m1, m2 in zip(*runs):
+        assert m1.algo == m2.algo
+        assert float(m1.training_metrics.auc) == \
+            float(m2.training_metrics.auc)
+    # slice-built models were re-homed: scoring a GLOBAL-mesh frame works
+    pred = runs[1][0].predict(fr)
+    assert pred.nrows == fr.nrows
+
+
+def test_cloud_v3_serves_mesh_slice_utilization(rng):
+    from h2o3_tpu.api import schemas
+    from h2o3_tpu.orchestration.scheduler import (MeshScheduler,
+                                                  SLICE_STATS)
+    SLICE_STATS.reset()
+    sched = MeshScheduler(slices=2)
+    with sched.lease(rows=10, algo="glm"):
+        pass
+    cloud = schemas.cloud_v3("0.0.0")
+    ms = cloud["mesh_slices"]
+    assert ms["count"] == 2
+    used = [s for s in ms["slices"] if s["builds"]]
+    assert used and used[0]["busy_seconds"] >= 0.0
+    assert "queue_wait_seconds" in used[0]
+    # telemetry rode along (h2o3_slice_* family)
+    from h2o3_tpu.utils.telemetry import METRICS
+    names = {r["name"] for r in METRICS.snapshot(include_buckets=False)}
+    assert "h2o3_slice_count" in names
+    assert "h2o3_slice_builds_total" in names
+
+
+def test_slice_stats_full_row_never_counts_as_a_slice():
+    """A whole-mesh (par=1) scheduler next to a 2-slice scheduler must not
+    inflate the carving count to 3 — ``full`` overlaps every slice, so it
+    reports as a separate utilization row, outside ``count``."""
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler, SLICE_STATS
+    SLICE_STATS.reset()
+    try:
+        sliced = MeshScheduler(slices=2)
+        full = MeshScheduler(slices=1)
+        with full.lease(rows=10_000_000):
+            pass
+        snap = SLICE_STATS.snapshot()
+        assert snap["count"] == 2
+        labels = [s["slice"] for s in snap["slices"]]
+        assert labels.count("full") == 1
+        full_row = next(s for s in snap["slices"] if s["slice"] == "full")
+        assert full_row["builds"] == 1 and full_row["devices"]
+        # carved rows keep their disjoint device sets
+        carved = [s for s in snap["slices"] if s["slice"] != "full"]
+        assert len(carved) == 2
+        assert not set(carved[0]["devices"]) & set(carved[1]["devices"])
+        # a full-only process still reports one "slice": the whole mesh
+        SLICE_STATS.reset()
+        assert SLICE_STATS.configure(full.meshes) == 1
+        assert SLICE_STATS.snapshot()["count"] == 1
+    finally:
+        SLICE_STATS.reset()
+
+
+def test_full_lease_on_sliced_layout_reports_real_devices():
+    """A big (whole-mesh) lease taken from a multi-slice scheduler reports
+    the union of the layout's devices, not an empty set."""
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler, SLICE_STATS
+    SLICE_STATS.reset()
+    try:
+        sched = MeshScheduler(slices=2)
+        with sched.lease(rows=10_000_000):
+            pass
+        full_row = next(s for s in SLICE_STATS.snapshot()["slices"]
+                        if s["slice"] == "full")
+        assert sorted(full_row["devices"]) == \
+            sorted(M.mesh_device_ids(M.global_mesh()))
+    finally:
+        SLICE_STATS.reset()
+
+
+def test_scheduler_respects_callers_mesh_context():
+    """A grid/AutoML run inside a user's ``mesh_context(submesh)`` stays
+    confined to it: the scheduler carves the CALLER'S mesh, big leases take
+    exactly it, and leases bind it even on pool threads (which don't
+    inherit the caller's contextvars)."""
+    from h2o3_tpu.orchestration.scheduler import MeshScheduler
+    sub = M.slice_meshes(2)[1]               # a 4-device submesh
+    sub_ids = set(M.mesh_device_ids(sub))
+    with M.mesh_context(sub):
+        sched = MeshScheduler(slices=2)
+    assert set(M.mesh_device_ids(sched.base)) == sub_ids
+    for m in sched.meshes:
+        assert set(M.mesh_device_ids(m)) <= sub_ids
+    assert len(sched.meshes) == 2
+    # leases resolve inside the submesh even from a foreign thread
+    seen = {}
+    def worker():
+        with sched.lease(rows=10):                   # small -> a sub-slice
+            seen["small"] = set(M.mesh_device_ids(M.get_mesh()))
+            # slice-built artifacts re-home onto the CALLER'S mesh
+            seen["rehome_to"] = set(M.mesh_device_ids(M.rehome_target()))
+        with sched.lease(rows=10_000_000):           # big -> the submesh
+            seen["big"] = set(M.mesh_device_ids(M.get_mesh()))
+    t = threading.Thread(target=worker)
+    t.start(); t.join(timeout=30)
+    assert seen["small"] < sub_ids
+    assert seen["rehome_to"] == sub_ids
+    assert seen["big"] == sub_ids

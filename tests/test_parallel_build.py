@@ -5,7 +5,6 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.orchestration.parallel_build import windowed_parallel
@@ -64,10 +63,16 @@ def test_failures_recorded_not_raised():
     assert isinstance(out[2][2], ValueError)
 
 
-def test_grid_parallel_same_models_as_sequential(rng):
+def test_grid_parallel_same_models_as_sequential(rng, monkeypatch):
+    """Formerly hazard-prone: par>1 builds raced collectives on ONE global
+    mesh (the documented rendezvous wedge). With the mesh-slice scheduler
+    the overlapped builds lease disjoint slices; forcing the same slice
+    layout on both runs makes per-model results BIT-identical across
+    parallelism (same-size slices run the same deterministic programs)."""
     from h2o3_tpu.models.gbm import GBM
     from h2o3_tpu.orchestration.grid import GridSearch
 
+    monkeypatch.setenv("H2O3TPU_MESH_SLICES", "2")
     n = 400
     x = rng.normal(size=(n, 3)).astype(np.float32)
     fr = Frame.from_arrays({
@@ -80,8 +85,10 @@ def test_grid_parallel_same_models_as_sequential(rng):
     g2 = GridSearch(GBM, hyper, grid_id="gpar", parallelism=3,
                     ntrees=3, seed=5).train(y="y", training_frame=fr)
     assert len(g1.models) == len(g2.models) == 4
-    # same combos in the same submission order, same fitted trees
+    # same combos in the same submission order, identical fitted trees:
+    # slice-bound builds are deterministic per slice SIZE, so assignment
+    # timing cannot perturb the models
     for m1, m2 in zip(g1.models, g2.models):
         assert m1.output["hyper_values"] == m2.output["hyper_values"]
         assert float(m1.training_metrics.auc) == \
-            pytest.approx(float(m2.training_metrics.auc), abs=1e-7)
+            float(m2.training_metrics.auc)
