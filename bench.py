@@ -53,6 +53,36 @@ ANCHOR_ROWS_PER_SEC = 1.0e6  # gpu_hist-class anchor (see module docstring)
 DL_REF_SAMPLES_PER_SEC = 294.0  # dlperf.Rmd:375 Rectifier on i7-5820k
 
 
+def _hardware_fingerprint() -> dict:
+    """``extra.hardware``: the exact silicon + software stack this artifact
+    was measured on, so cross-round comparisons are self-explaining (the
+    r03 no-TPU wobble took a VERDICT post-mortem to attribute; a stamped
+    fingerprint makes it one diff). Fields mirror what the compute
+    observatory keys its peak table on (utils/costs.py PEAK_TABLE)."""
+    import jax
+    try:
+        import jaxlib
+        jaxlib_ver = getattr(jaxlib, "__version__", None)
+    except ImportError:   # pragma: no cover — jaxlib ships with jax
+        jaxlib_ver = None
+    devs = jax.devices()
+    return {"backend": jax.default_backend(),
+            "device_kind": devs[0].device_kind if devs else None,
+            "devices": len(devs),
+            "jax": jax.__version__, "jaxlib": jaxlib_ver}
+
+
+def _steady_state_recompiles(scenario: str, sig0: int) -> dict:
+    """Post-warmup recompile probe for a warm steady-state scenario:
+    ``sig0`` is ``COSTS.signature_count()`` taken AFTER the scenario's
+    warm-up call — any growth by now means the timed, shape-identical
+    re-run compiled a fresh signature (the r04→r05 automl wobble class of
+    regression). The compute gate refuses to stamp on it."""
+    from h2o3_tpu.utils.costs import COSTS
+    return {"scenario": scenario,
+            "recompiles_steady_state": COSTS.signature_count() - sig0}
+
+
 def _higgs_frame(rows: int):
     from h2o3_tpu.frame.frame import Frame
     rng = np.random.default_rng(11)
@@ -73,15 +103,18 @@ def bench_gbm(fr, ndev: int) -> dict:
         return GBM(ntrees=NTREES, max_depth=DEPTH, nbins=NBINS,
                    learn_rate=0.1, seed=42).train(y="y", training_frame=fr)
 
+    from h2o3_tpu.utils.costs import COSTS
     train()  # warm-up: compile every level program
     jax.effects_barrier()
+    sig0 = COSTS.signature_count()
     t0 = time.perf_counter()
     model = train()
     jax.effects_barrier()
     dt = time.perf_counter() - t0
     rps = fr.nrows * NTREES / dt / ndev
     return dict(rows_per_sec_chip=round(rps, 1), seconds=round(dt, 2),
-                auc=round(float(model.training_metrics.auc), 4))
+                auc=round(float(model.training_metrics.auc), 4),
+                **_steady_state_recompiles("gbm_higgs_11m", sig0))
 
 
 def bench_xgboost(fr, ndev: int) -> dict:
@@ -96,15 +129,18 @@ def bench_xgboost(fr, ndev: int) -> dict:
         return XGBoost(ntrees=nt, max_depth=depth, max_bin=bins, eta=0.3,
                        seed=42).train(y="y", training_frame=fr)
 
+    from h2o3_tpu.utils.costs import COSTS
     train()
     jax.effects_barrier()
+    sig0 = COSTS.signature_count()
     t0 = time.perf_counter()
     model = train()
     jax.effects_barrier()
     dt = time.perf_counter() - t0
     rps = fr.nrows * nt / dt / ndev
     return dict(rows_per_sec_chip=round(rps, 1), seconds=round(dt, 2),
-                auc=round(float(model.training_metrics.auc), 4))
+                auc=round(float(model.training_metrics.auc), 4),
+                **_steady_state_recompiles("xgboost_hist_11m", sig0))
 
 
 def bench_glm(ndev: int) -> dict:
@@ -128,15 +164,18 @@ def bench_glm(ndev: int) -> dict:
         m = b.train(y="dep_delayed", training_frame=fr)
         return m, len(b._iter_devs)
 
+    from h2o3_tpu.utils.costs import COSTS
     train()   # warm-up compiles
     jax.effects_barrier()
+    sig0 = COSTS.signature_count()
     t0 = time.perf_counter()
     model, iters = train()
     jax.effects_barrier()
     dt = time.perf_counter() - t0
     return dict(rows_iters_per_sec_chip=round(n * iters / dt / ndev, 1),
                 iterations=iters, seconds=round(dt, 2),
-                auc=round(float(model.training_metrics.auc), 4))
+                auc=round(float(model.training_metrics.auc), 4),
+                **_steady_state_recompiles("glm_airlines_1m", sig0))
 
 
 def bench_dl(ndev: int) -> dict:
@@ -160,15 +199,18 @@ def bench_dl(ndev: int) -> dict:
                             epochs=epochs, mini_batch_size=128, seed=7).train(
             y="y", training_frame=fr)
 
+    from h2o3_tpu.utils.costs import COSTS
     train()
     jax.effects_barrier()
+    sig0 = COSTS.signature_count()
     t0 = time.perf_counter()
     train()
     jax.effects_barrier()
     dt = time.perf_counter() - t0
     sps = n * epochs / dt / ndev
     return dict(samples_per_sec_chip=round(sps, 1), seconds=round(dt, 2),
-                vs_reference_cpu=round(sps / DL_REF_SAMPLES_PER_SEC, 1))
+                vs_reference_cpu=round(sps / DL_REF_SAMPLES_PER_SEC, 1),
+                **_steady_state_recompiles("dl_mlp_mnist", sig0))
 
 
 def bench_automl(ndev: int) -> dict:
@@ -201,8 +243,17 @@ def bench_automl(ndev: int) -> dict:
         out[f"seconds_par{par}"] = round(time.perf_counter() - t0, 2)
         out["models"] = len(aml.leaderboard)
         c1 = compile_cache.stats()
+        # by_site deltas (CostMeter scope attribution): the r04→r05 wobble
+        # could only say "something recompiled" — this names WHICH loop
+        by_site = {
+            site: {k: st[k] - (c0["by_site"].get(site) or
+                               {"hits": 0, "misses": 0})[k]
+                   for k in ("hits", "misses")}
+            for site, st in c1["by_site"].items()}
         cc[f"par{par}"] = {"cache_hits": c1["hits"] - c0["hits"],
-                           "cache_misses": c1["misses"] - c0["misses"]}
+                           "cache_misses": c1["misses"] - c0["misses"],
+                           "by_site": {s: d for s, d in by_site.items()
+                                       if d["hits"] or d["misses"]}}
         # keyed per par level like compile_cache_per_run — utilization and
         # queue wait are only comparable across par levels if each level
         # keeps its own snapshot
@@ -822,6 +873,71 @@ def _resolve_vs_baseline(out: dict) -> None:
     pval = float(art["value"])
     out["vs_baseline"] = round(out["value"] / pval, 3)
     out["baseline_source"] = f"{fname} ({backend} prior artifact, {pval})"
+    # differing hardware fingerprints make the ratio a hardware diff, not a
+    # code diff — the artifact says so instead of leaving it to archaeology
+    mine = out["extra"].get("hardware") or {}
+    theirs = (art.get("extra") or {}).get("hardware")
+    if theirs is None:
+        out["baseline_hardware_mismatch"] = (
+            f"{fname} predates hardware fingerprints — comparability "
+            "unknown")
+        return
+    diffs = [f"{k}: {theirs.get(k)} -> {mine.get(k)}"
+             for k in sorted(set(mine) | set(theirs))
+             if mine.get(k) != theirs.get(k)]
+    if diffs:
+        out["baseline_hardware_mismatch"] = "; ".join(diffs)
+        print(f"# bench WARNING: comparing against {fname} across a "
+              f"hardware/software change ({'; '.join(diffs)}) — the "
+              "vs_baseline ratio mixes code and platform effects",
+              file=sys.stderr)
+
+
+def _compute_section(extra: dict) -> dict:
+    """``extra.compute`` — the observatory's view of the run the bench just
+    measured (utils/costs.py, ``GET /3/Compute``): per-loop achieved FLOP/s
+    and utilization (null off the peak table — every CPU round), per-site
+    compile counts/seconds, recompile totals, and the per-scenario
+    steady-state recompile probes collected above. The ROOFLINE.md
+    arithmetic, stamped automatically every round."""
+    from h2o3_tpu.utils.costs import COSTS, backend_peak
+    snap = COSTS.snapshot()
+    steady = {sec["scenario"]: sec["recompiles_steady_state"]
+              for sec in extra.values()
+              if isinstance(sec, dict) and "recompiles_steady_state" in sec}
+    return {
+        "peak": backend_peak(),
+        "loops": snap["loops"],
+        "sites": {s["site"]: {"compiles": s["compiles"],
+                              "compile_seconds": s["compile_seconds"],
+                              "flops": s["flops"], "bytes": s["bytes"],
+                              "signatures": len(s["signatures"]),
+                              "recompile_events": len(s["recompile_events"])}
+                  for s in snap["sites"]},
+        "recompile_events": snap["recompile_events"],
+        "steady_state_recompiles": steady,
+    }
+
+
+def _compute_gate(out: dict) -> None:
+    """Refuse to stamp when a warm steady-state scenario recompiled after
+    its warm-up phase: the timed re-run is shape-identical by construction,
+    so signature growth there means executables are churning — the exact
+    recompile class behind the r04→r05 automl wobble, now caught at stamp
+    time instead of in the next round's VERDICT."""
+    if SMOKE:
+        return
+    steady = out["extra"]["compute"]["steady_state_recompiles"]
+    churned = {k: v for k, v in steady.items() if v > 0}
+    if churned:
+        for scenario, n in churned.items():
+            print(f"# steady-state recompile: {scenario} compiled {n} new "
+                  "signature(s) during its shape-identical timed run",
+                  file=sys.stderr)
+        print(f"# bench REFUSED: {len(churned)} warm scenario(s) recompiled "
+              "after warm-up — executables churn in steady state",
+              file=sys.stderr)
+        sys.exit(3)
 
 
 def _dispatch_audit_section(backend: str) -> dict:
@@ -950,7 +1066,7 @@ def main() -> None:
         "vs_baseline": round(gbm["rows_per_sec_chip"] / ANCHOR_ROWS_PER_SEC, 3),
         "extra": {"gbm_higgs_11m": gbm, **extra,
                   "backend": jax.default_backend(), "devices": ndev,
-                  "rows": fr.nrows},
+                  "rows": fr.nrows, "hardware": _hardware_fingerprint()},
     }
     if CPU_FALLBACK:
         out["extra"]["backend_fallback"] = (
@@ -983,6 +1099,11 @@ def main() -> None:
         sc = {"error": f"{type(e).__name__}: {e}"}
     out["extra"]["scoring"] = sc
     _scoring_gate(sc)
+    # compute observatory: achieved FLOP/s + utilization-or-null per loop,
+    # compile/recompile accounting, and the steady-state recompile gate —
+    # a warm scenario that recompiled after its warm-up refuses to stamp
+    out["extra"]["compute"] = _compute_section(out["extra"])
+    _compute_gate(out)
     MEMORY.refresh()
     MEMORY.leak_sweep()
     # compile-cache effectiveness this round (satellite of ROADMAP item 5:

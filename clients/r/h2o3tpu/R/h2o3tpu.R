@@ -617,6 +617,31 @@ h2o.jstack <- function() {
   .http("GET", "/3/JStack")$traces
 }
 
+# -- compute observatory (server /3/Compute, /3/Profiler/capture;
+#    docs/OBSERVABILITY.md "Compute") ----------------------------------------
+
+h2o.compute <- function() {
+  # XLA cost accounting: per-site compiled signatures, compile seconds,
+  # cost_analysis FLOPs/bytes, recompile events with signature diffs, and
+  # per-loop achieved FLOP/s + utilization (NULL on backends outside the
+  # peak table, e.g. CPU)
+  .http("GET", "/3/Compute")
+}
+
+h2o.profilerCapture <- function(duration_ms = 500) {
+  # bounded jax.profiler.trace window with span-derived annotations;
+  # returns the capture record — fetch the Perfetto artifact via
+  # GET /3/Profiler/captures/{capture_id}/download (a plain curl works).
+  # A concurrent capture gets a structured 409.
+  .http("POST", paste0("/3/Profiler/capture?duration_ms=",
+                       as.integer(duration_ms)))
+}
+
+h2o.profilerCaptures <- function() {
+  # registry of recent captures, oldest first
+  .http("GET", "/3/Profiler/captures")$captures
+}
+
 h2o.profiler <- function(depth = 5) {
   # sampled stack profile, hottest-first (reference ProfilerHandler)
   .http("GET", paste0("/3/Profiler?depth=", as.integer(depth)))

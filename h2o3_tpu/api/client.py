@@ -272,6 +272,36 @@ class H2OClient:
         ordered hottest-first (``GET /3/Profiler?depth=N``)."""
         return self.request("GET", f"/3/Profiler?depth={int(depth)}")
 
+    def compute(self) -> dict:
+        """The compute observatory (``GET /3/Compute``): per-site compiled
+        signatures, compile seconds, cost_analysis FLOPs/bytes, recompile
+        events with signature diffs, and per-loop achieved FLOP/s +
+        utilization-or-null (docs/OBSERVABILITY.md "Compute")."""
+        return self.request("GET", "/3/Compute")
+
+    def profiler_capture(self, duration_ms: int = 500) -> dict:
+        """Open a bounded device-profiler window
+        (``POST /3/Profiler/capture``) and return the capture record;
+        fetch the Perfetto artifact with :meth:`profiler_download`. A
+        concurrent capture raises (the server replies a structured 409)."""
+        return self.request("POST",
+                            f"/3/Profiler/capture?duration_ms="
+                            f"{int(duration_ms)}")
+
+    def profiler_captures(self) -> list[dict]:
+        """Capture registry (``GET /3/Profiler/captures``)."""
+        return self.request("GET", "/3/Profiler/captures")["captures"]
+
+    def profiler_download(self, capture_id: str, path: str) -> str:
+        """Save a capture's gzip Chrome-trace artifact to ``path`` and
+        return it — gunzip and load at https://ui.perfetto.dev."""
+        url = f"{self.url}/3/Profiler/captures/{capture_id}/download"
+        with urllib.request.urlopen(url) as resp:
+            data = resp.read()
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
     def metrics_text(self) -> str:
         """Raw Prometheus/OpenMetrics exposition (``GET /metrics``)."""
         with urllib.request.urlopen(self.url + "/metrics") as resp:

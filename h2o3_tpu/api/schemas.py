@@ -92,6 +92,17 @@ def memory_v3(summary: dict) -> dict:
     return {**_meta("MemoryV3"), **_clean(summary)}
 
 
+def compute_v3(snapshot: dict) -> dict:
+    """``GET /3/Compute`` — the compute observatory (utils/costs.py): per
+    logical compile site the compiled signatures (shapes/dtypes/statics),
+    compile wall seconds, ``cost_analysis()`` FLOPs/bytes, and recompile
+    events with signature diffs; per loop the achieved FLOP/s / bytes/s,
+    arithmetic intensity, and utilization against the backend's peak row
+    (utilization and roofline are null on backends outside the peak table
+    — this CPU container included). ``docs/OBSERVABILITY.md`` "Compute"."""
+    return {**_meta("ComputeV3"), **_clean(snapshot)}
+
+
 def _column_histogram(vec, r, nbins: int = 20) -> dict:
     """ColV3 histogram fields (reference ``FrameV3.ColV3``: Flow's frame
     inspector renders these as sparklines): fixed-stride bins over

@@ -301,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
     #: an explicit request to record the call in the caller's trace
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
-        r"Logs(?:/.*)?|Memory|Metrics|Score|Timeline|JStack|"
+        r"Logs(?:/.*)?|Memory|Metrics|Compute|Score|Timeline|JStack|"
         r"WaterMeter[^/]*(?:/\d+)?|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
 
@@ -1140,6 +1140,54 @@ class _Handler(BaseHTTPRequestHandler):
                            f"{p.get('top')!r}") from None
         self._reply(schemas.memory_v3(MEMORY.summary(top_n=top)))
 
+    def r_compute(self):
+        """``GET /3/Compute`` — the compute observatory: per-site compiled
+        signatures / compile seconds / cost_analysis FLOPs + bytes,
+        recompile events with signature diffs, and per-loop achieved
+        throughput + utilization against the backend peak table (null on
+        unknown backends; docs/OBSERVABILITY.md "Compute")."""
+        from h2o3_tpu.utils.costs import COSTS
+        self._reply(schemas.compute_v3(COSTS.snapshot()))
+
+    def r_profiler_capture(self):
+        """``POST /3/Profiler/capture[?duration_ms=N]`` — bounded
+        ``jax.profiler.trace`` window with span-derived TraceAnnotations;
+        returns the capture record (download the Perfetto artifact via
+        ``/3/Profiler/captures/{id}/download``). A concurrent capture gets
+        a structured 409 — the profiler runtime is process-global."""
+        from h2o3_tpu.utils.profiling import PROFILER, CaptureBusy
+        p = self._params()
+        try:
+            duration_ms = int(p.get("duration_ms", 500))
+        except ValueError:
+            raise KeyError(f"duration_ms must be an integer, got "
+                           f"{p.get('duration_ms')!r}") from None
+        try:
+            rec = PROFILER.capture(duration_ms=duration_ms)
+        except CaptureBusy as e:
+            self._error(409, str(e), headers={"Retry-After": "1"})
+            return
+        self._reply({"__meta": {"schema_type": "ProfilerCaptureV3"}, **rec})
+
+    def r_profiler_captures(self):
+        """Capture registry: the last few capture records, oldest first."""
+        from h2o3_tpu.utils.profiling import PROFILER
+        self._reply({"__meta": {"schema_type": "ProfilerCapturesV3"},
+                     "captures": PROFILER.list_captures()})
+
+    def r_profiler_capture_download(self, capture_id):
+        """The capture's Perfetto-loadable artifact (gzip Chrome trace
+        JSON) — save and open at https://ui.perfetto.dev."""
+        from h2o3_tpu.utils.profiling import PROFILER
+        body, fname = PROFILER.artifact_bytes(capture_id)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/gzip")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{fname}"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def r_metrics_json(self):
         """JSON metrics snapshot — flat {name, type, labels, value} rows
         (TwoDimTable-friendly; the Python client's ``client.metrics()``)."""
@@ -1829,6 +1877,11 @@ _ROUTES = [
     (r"/3/Logs", "GET", _Handler.r_logs),
     (r"/3/Logs/nodes/(-?\d+)/files/([^/]+)", "GET", _Handler.r_logs_file),
     (r"/3/Memory", "GET", _Handler.r_memory),
+    (r"/3/Compute", "GET", _Handler.r_compute),
+    (r"/3/Profiler/capture", "POST", _Handler.r_profiler_capture),
+    (r"/3/Profiler/captures", "GET", _Handler.r_profiler_captures),
+    (r"/3/Profiler/captures/([^/]+)/download", "GET",
+     _Handler.r_profiler_capture_download),
     (r"/3/Metrics", "GET", _Handler.r_metrics_json),
     (r"/metrics", "GET", _Handler.r_metrics_text),
     (r"/3/Traces", "GET", _Handler.r_traces),

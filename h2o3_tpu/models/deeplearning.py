@@ -43,6 +43,7 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
                                         make_model_key, megastep_k,
                                         publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import accounted_jit
 from h2o3_tpu.utils.timeline import timed_event
 
 
@@ -193,8 +194,9 @@ def _epoch_steps(params, opt, Xb, yb, wb, key, samples0,
     return params, opt, key, samples, losses.mean()
 
 
-@partial(jax.jit, static_argnames=("act", "loss", "nclasses", "cfg", "k",
-                                   "nb", "B", "autoenc"))
+@accounted_jit("dl:train_epochs", loop="dl_epoch",
+               static_argnames=("act", "loss", "nclasses", "cfg", "k",
+                                "nb", "B", "autoenc"))
 def _train_epochs(params, opt, X, yy, w, key, samples0,
                   act: str, loss: str, nclasses: int, cfg: tuple, k: int,
                   nb: int, B: int, autoenc: bool):

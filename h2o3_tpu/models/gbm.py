@@ -28,6 +28,7 @@ from h2o3_tpu.models.job import Job, JobCancelled
 from h2o3_tpu.models.model_base import (Model, ModelBuilder, make_model_key,
                                         publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import accounted_jit
 from h2o3_tpu.utils.timeline import timed_event
 from jax import lax
 
@@ -294,11 +295,16 @@ def _boost_scan(binned, edges, yc, w, fmask_base, Fcur0, keys, *,
         custom_link=custom_link, mesh=hist_mesh(binned))
 
 
-@partial(jax.jit, static_argnames=("dist", "depth", "n_bins", "bootstrap",
-                                   "drf", "nclass", "do_row_sample",
-                                   "do_tree_col_sample", "do_col_sample",
-                                   "track", "ntrees_prior", "custom_id",
-                                   "custom_link", "mesh"))
+# the boosting chunk's host-dispatched program — registered with the
+# compute observatory (utils/costs.py): each (rows, K, depth, mesh)
+# signature's compile wall time and cost_analysis FLOPs/bytes show in
+# /3/Compute, and a shape-changed rebuild records a recompile event
+@accounted_jit("gbm:boost_scan", loop="gbm_chunk",
+               static_argnames=("dist", "depth", "n_bins", "bootstrap",
+                                "drf", "nclass", "do_row_sample",
+                                "do_tree_col_sample", "do_col_sample",
+                                "track", "ntrees_prior", "custom_id",
+                                "custom_link", "mesh"))
 def _boost_scan_jit(binned, edges, yc, w, fmask_base, Fcur0, keys, hp, *,
                     dist: str, depth: int, n_bins: int, bootstrap: bool,
                     drf: bool, nclass: int, do_row_sample: bool,

@@ -33,6 +33,7 @@ from h2o3_tpu.models.model_base import (Model, ModelBuilder, ModelParameters,
                                         make_model_key, megastep_k,
                                         publish_dispatch_audit)
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import accounted_jit
 from h2o3_tpu.utils.timeline import timed_event
 
 
@@ -128,8 +129,13 @@ def _irls_step(family: str, tweedie_p: float, X, y, w, beta, l2,
     return new_beta, dev, jnp.max(jnp.abs(new_beta - beta))
 
 
-@partial(jax.jit, static_argnames=("family", "tweedie_p", "non_negative",
-                                   "k", "has_bounds"))
+# the host-dispatched IRLS program — registered with the compute
+# observatory (utils/costs.py): per-signature compile time + cost_analysis
+# FLOPs/bytes land in /3/Compute, and a shape-changed rebuild records a
+# recompile event naming the changed dimension
+@accounted_jit("glm:irls_megastep", loop="glm_irls",
+               static_argnames=("family", "tweedie_p", "non_negative",
+                                "k", "has_bounds"))
 def _irls_megastep(family: str, tweedie_p: float, X, y, w, beta, l2, k: int,
                    it0, max_it, beta_eps, obj_eps, dev_prev0,
                    non_negative: bool = False, off=0.0, lo=None, hi=None,
@@ -278,7 +284,8 @@ def _multinomial_step(nclasses: int, X, yoh, w, B, l2, l1, non_negative: bool = 
     return B, dev
 
 
-@partial(jax.jit, static_argnames=("nclasses", "non_negative", "k"))
+@accounted_jit("glm:multinomial_megastep", loop="glm_multinomial",
+               static_argnames=("nclasses", "non_negative", "k"))
 def _multinomial_megastep(nclasses: int, X, yoh, w, B, l2, l1, k: int,
                           it0, max_it, obj_eps, dev_prev0,
                           non_negative: bool = False):

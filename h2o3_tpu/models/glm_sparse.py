@@ -26,6 +26,7 @@ import numpy as np
 from h2o3_tpu.frame.sparse import SparseFrame, SparseMatrix
 from h2o3_tpu.ops.map_reduce import retrying
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import accounted_jit
 from h2o3_tpu.utils.timeline import timed_event
 
 
@@ -75,7 +76,8 @@ def _sparse_irls_step(family: str, data, row, col, nrows: int, ncols: int,
     return beta_new, dev
 
 
-@partial(jax.jit, static_argnames=("family", "k", "nrows", "ncols"))
+@accounted_jit("glm:sparse_irls_megastep", loop="glm_sparse_irls",
+               static_argnames=("family", "k", "nrows", "ncols"))
 def _sparse_irls_megastep(family: str, data, row, col, nrows: int, ncols: int,
                          y, w, beta, lam, k: int, it0, max_it, beta_eps,
                          dev_prev0):

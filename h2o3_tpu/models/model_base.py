@@ -41,6 +41,7 @@ from h2o3_tpu.models.metrics import (
 )
 from h2o3_tpu.ops.map_reduce import map_reduce
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import COSTS
 from h2o3_tpu.utils.registry import DKV, LOCKS
 from h2o3_tpu.utils.timeline import timed_event
 
@@ -456,7 +457,12 @@ class ModelBuilder:
             # build wall-time lands in the timeline ring (kind "model") and
             # in the metrics registry; scoring history carries it through
             # run_time_ms (reference: TwoDimTable duration column)
-            with timed_event("model", f"{self.algo}:fit"):
+            # the fit runs under a CostMeter site scope so persistent
+            # compile-cache hits/misses during the build credit this algo
+            # (utils/compile_cache.py by_site — docs/OBSERVABILITY.md
+            # "Compute")
+            with timed_event("model", f"{self.algo}:fit"), \
+                    COSTS.scope(f"fit:{self.algo}"):
                 model = self._fit(job, frame, x, y, base_w)
                 # effective-rows rollup through the EXPLICIT MRTask path
                 # (reference: every build's GLMIterationTask-style row

@@ -40,6 +40,8 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from h2o3_tpu.utils.costs import accounted_jit
+
 
 @dataclasses.dataclass
 class TreeParams:
@@ -417,9 +419,13 @@ def _grow_tree_device(binned, binned_T, edges, g, h, w, feat_mask, key,
     return out + (row_leaf,)
 
 
-@partial(jax.jit, static_argnames=("depth", "n_bins", "col_rate", "min_rows",
-                                   "reg_lambda", "reg_alpha", "gamma",
-                                   "min_split_improvement", "mesh"))
+# the boosting round's host-dispatched program — registered with the
+# compute observatory (utils/costs.py) so each (shape, K, depth, mesh)
+# signature's compile time and cost_analysis FLOPs are attributable
+@accounted_jit("gbm:grow_batched", loop="gbm_chunk",
+               static_argnames=("depth", "n_bins", "col_rate", "min_rows",
+                                "reg_lambda", "reg_alpha", "gamma",
+                                "min_split_improvement", "mesh"))
 def _grow_batched(binned, edges, g, h, w, feat_mask, keys,
                   depth: int, n_bins: int, min_rows, reg_lambda, reg_alpha,
                   gamma, min_split_improvement, col_rate: float,

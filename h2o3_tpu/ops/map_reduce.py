@@ -221,6 +221,7 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
     """
     mesh = get_mesh()
     ndims = tuple(c.ndim for c in cols)
+    name = getattr(map_fn, "__name__", "map_reduce")
     key = _cache_key("mr", map_fn, (mesh, ndims, donate))
     fn = _cache_get(key)
     if fn is None:
@@ -229,13 +230,22 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
         def shard_body(*shards):
             return jax.tree.map(lambda p: lax.psum(p, ROWS), map_fn(*shards))
 
-        fn = jax.jit(_shard_map(shard_body, mesh=mesh, in_specs=in_specs, out_specs=P()),
-                     donate_argnums=tuple(range(len(cols))) if donate else ())
+        # accounted AOT compile (utils/costs.py): every collective's
+        # signature / compile time / cost_analysis FLOPs land in /3/Compute.
+        # sample=False — this module's OWN sampled probe below measures the
+        # synced duration and feeds COSTS.observe, so the wrapper must not
+        # add a second sync of its own
+        from h2o3_tpu.utils.costs import accounted_jit
+        fn = accounted_jit(
+            f"map_reduce:{name}",
+            _shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                       out_specs=P()),
+            donate_argnums=tuple(range(len(cols))) if donate else (),
+            sample=False)
         _cache_put(key, fn)
     from h2o3_tpu.utils import telemetry as _tm
     from h2o3_tpu.utils import timeline as _tl
     from h2o3_tpu.utils import tracing as _tr
-    name = getattr(map_fn, "__name__", "map_reduce")
     # child span per dispatch (no-op outside an active trace); faults
     # injected below mark THIS span, so fault runs read in trace trees
     # sampled telemetry sync (see the note at _SAMPLE_EVERY): full partition
@@ -277,6 +287,14 @@ def map_reduce(map_fn: Callable, *cols: jax.Array, donate: bool = False):
                 dur_box[0] = time.time_ns() - t0
                 _tm.MR_DISPATCH_SECONDS.labels(fn=name).observe(
                     dur_box[0] / 1e9)
+                # the synced duration is exactly what the compute
+                # observatory needs: achieved FLOP/s of this collective
+                # against the cost of the signature that actually ran
+                # (utils/costs.py; fn is the AccountedJit built above)
+                from h2o3_tpu.utils.costs import COSTS
+                cflops, cbytes = fn.last_cost()
+                COSTS.observe(f"map_reduce:{name}", dur_box[0] / 1e9,
+                              flops=cflops, nbytes=cbytes)
                 if mem0 is not None:
                     mem1 = fast_device_bytes()
                     if mem1 is not None:
@@ -383,7 +401,10 @@ def map_cols(fn: Callable, *cols: jax.Array) -> jax.Array:
     key = _cache_key("mc", fn, ())
     jfn = _cache_get(key)
     if jfn is None:
-        jfn = jax.jit(fn)
+        from h2o3_tpu.utils.costs import accounted_jit
+        jfn = accounted_jit(
+            f"map_cols:{getattr(fn, '__name__', 'map_cols')}", fn,
+            sample=False)
         _cache_put(key, jfn)
     return jfn(*cols)
 

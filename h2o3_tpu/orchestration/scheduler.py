@@ -25,6 +25,7 @@ Utilization (busy seconds, builds, queue wait) is exported as the
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 import threading
 import time
@@ -33,6 +34,16 @@ from h2o3_tpu.parallel.mesh import (bind_mesh, get_mesh, mesh_device_ids,
                                     slice_meshes)
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.tracing import TRACER
+
+#: slice label the current thread's build is leased onto — the compute
+#: observatory (utils/costs.py) reads it at observation time to fold
+#: achieved FLOPs into the per-slice ``mesh_slices`` view
+_ACTIVE_SLICE: contextvars.ContextVar["str | None"] = \
+    contextvars.ContextVar("h2o3_active_slice", default=None)
+
+
+def active_slice_label() -> str | None:
+    return _ACTIVE_SLICE.get()
 
 #: builds at or above this many rows take the whole mesh (override with
 #: ``H2O3TPU_SLICE_ROWS_MAX``) — below it a build packs onto one slice
@@ -115,6 +126,19 @@ class _SliceStats:
             st["busy_seconds"] = round(st["busy_seconds"] + busy_s, 6)
             st["queue_wait_seconds"] = round(
                 st["queue_wait_seconds"] + wait_s, 6)
+
+    def add_flops(self, label: str, flops: float) -> None:
+        """Fold a sampled dispatch's cost_analysis FLOPs into the slice's
+        utilization row (utils/costs.py calls this under an active lease) —
+        ``achieved_flops`` is the per-slice share of the observatory's
+        compute accounting, so ``/3/Cloud``'s ``mesh_slices`` view shows
+        WHERE the arithmetic ran, not just how long slices were busy."""
+        with self._lock:
+            st = self._per.setdefault(label, {"builds": 0,
+                                              "busy_seconds": 0.0,
+                                              "queue_wait_seconds": 0.0})
+            st["achieved_flops"] = st.get("achieved_flops", 0.0) \
+                + float(flops)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -296,15 +320,21 @@ class MeshScheduler:
             _tm.SLICE_QUEUE_WAIT.observe(wait_s)
             # whole-mesh leases need no re-homing (artifacts are already
             # on the base device set); slice leases re-home onto the base
-            with bind_mesh(mesh, rehome_models=idx >= 0,
-                           rehome_to=self.base):
-                with TRACER.span(f"mesh_slice:{label}", kind="orchestration",
-                                 attrs={"slice": label,
-                                        "devices": ",".join(map(str, devices)),
-                                        "n_devices": len(devices),
-                                        "queue_wait_ms":
-                                            round(wait_s * 1e3, 3)}):
-                    yield SliceLease(mesh, idx, label, devices, wait_s)
+            slice_token = _ACTIVE_SLICE.set(label)
+            try:
+                with bind_mesh(mesh, rehome_models=idx >= 0,
+                               rehome_to=self.base):
+                    with TRACER.span(f"mesh_slice:{label}",
+                                     kind="orchestration",
+                                     attrs={"slice": label,
+                                            "devices":
+                                                ",".join(map(str, devices)),
+                                            "n_devices": len(devices),
+                                            "queue_wait_ms":
+                                                round(wait_s * 1e3, 3)}):
+                        yield SliceLease(mesh, idx, label, devices, wait_s)
+            finally:
+                _ACTIVE_SLICE.reset(slice_token)
         finally:
             if idx is not None:
                 busy = time.monotonic() - t1

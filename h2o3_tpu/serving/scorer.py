@@ -22,12 +22,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import jax
 import numpy as np
 
 from h2o3_tpu.serving.schema import ServingSchema
 from h2o3_tpu.utils import telemetry as _tm
+from h2o3_tpu.utils.costs import COSTS, cost_of
 
 #: requests larger than the max bucket are scored in max-bucket slices
 MAX_BUCKET = int(os.environ.get("H2O3TPU_SCORE_MAX_BUCKET", "4096"))
@@ -49,10 +51,13 @@ class CompiledScorer:
     """One signature's executable: ``score(num, cat)`` over padded host
     arrays returns host predictions ([bucket] or [bucket, K])."""
 
-    __slots__ = ("bucket", "mode", "_fn")
+    __slots__ = ("bucket", "mode", "_fn", "site", "_ncalls", "_flops",
+                 "_bytes")
 
     def __init__(self, model, schema: ServingSchema, bucket: int):
         self.bucket = bucket
+        self._ncalls = 0
+        self._flops = self._bytes = None
 
         def raw_fn(num, cat):
             frame = schema.build_frame(num, cat, bucket)
@@ -62,15 +67,52 @@ class CompiledScorer:
                                         np.float32)
         cat_spec = jax.ShapeDtypeStruct((bucket, len(schema.cat_cols)),
                                         np.int32)
+        # compile under the cost-observatory site scope: serving compile
+        # time / FLOPs / recompile events show in /3/Compute next to the
+        # training loops, and compile-cache hits credit the scoring tier.
+        # H2O3TPU_COSTS_OFF=1 keeps the full-bypass contract: the scorer
+        # still compiles, but nothing is recorded (utils/costs.py).
+        from h2o3_tpu.utils.costs import enabled as _costs_on
+        site = self.site = f"score:{getattr(model, 'algo', 'model')}"
         try:
-            self._fn = jax.jit(raw_fn).lower(num_spec, cat_spec).compile()
+            with COSTS.scope(site):
+                t0 = time.perf_counter()
+                self._fn = jax.jit(raw_fn).lower(num_spec, cat_spec).compile()
+                dt = time.perf_counter() - t0
             self.mode = "compiled"
+            flops, nbytes = self._flops, self._bytes = cost_of(self._fn)
+            if _costs_on():
+                COSTS.record_compile(
+                    site,
+                    {"args": [{"shape": list(num_spec.shape),
+                               "dtype": "float32"},
+                              {"shape": list(cat_spec.shape),
+                               "dtype": "int32"}],
+                     "statics": {"model": str(getattr(model, "key", None)),
+                                 "bucket": str(bucket)}},
+                    dt, flops, nbytes, loop="scoring")
         except Exception:   # noqa: BLE001 — host-side branches in _score_raw
             self._fn = raw_fn
             self.mode = "eager"
+            if _costs_on():
+                COSTS.record_eager_fallback(site, loop="scoring")
 
     def score(self, num: np.ndarray, cat: np.ndarray) -> np.ndarray:
-        return np.asarray(jax.device_get(self._fn(num, cat)))
+        # the device_get below is already a sync, so timing a sampled call
+        # costs nothing extra — achieved FLOP/s of the scoring loop rides
+        # into /3/Compute next to the training loops
+        from h2o3_tpu.utils import costs as _costs
+        n, self._ncalls = self._ncalls, self._ncalls + 1
+        sampled = (self.mode == "compiled" and _costs.enabled()
+                   and n % _costs.sample_every() == 0)
+        t0 = time.perf_counter() if sampled else 0.0
+        out = np.asarray(jax.device_get(self._fn(num, cat)))
+        if sampled:
+            # this executable's OWN cost, not the site's latest — several
+            # buckets/models share the score:<algo> site
+            COSTS.observe(self.site, time.perf_counter() - t0,
+                          flops=self._flops, nbytes=self._bytes)
+        return out
 
 
 class ScorerCache:

@@ -1,0 +1,83 @@
+"""graftlint profiling-attribution rules (PRF) — executables must be
+nameable.
+
+- **PRF001** — anonymous ``jax.jit``: ``jit``/``pjit`` called on an
+  expression (a ``lambda``, a transform like ``jax.grad(f)``, a
+  ``partial(...)``) instead of a named function reference. The resulting
+  executable renders as ``<lambda>`` / ``<unnamed function>`` in device
+  profiler captures (``POST /3/Profiler/capture``) and cannot be credited
+  to a site in the cost registry (``utils/costs.py``) — dead weight in
+  exactly the views built to attribute compile time and FLOPs. Fix: jit a
+  named ``def`` (decorator or direct form both keep ``__name__``), or
+  route the site through ``accounted_jit(site, fn)``, which registers the
+  executable under an explicit stable site name.
+
+Decorator forms (``@jax.jit``, ``@partial(jax.jit, ...)``) are never
+flagged: the decorated ``def`` carries its own stable name. Calls on a
+plain ``Name``/``Attribute`` reference (``jax.jit(step)``,
+``jax.jit(jnp.matmul)``) keep the referent's name and pass too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, call_name
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+
+
+def _jit_decorator_calls(fn_node: ast.AST) -> set[int]:
+    """ids of Call nodes that ARE decorator expressions (or live inside
+    one) — ``@partial(jax.jit, ...)`` contains a Call on ``partial`` and
+    must not be mistaken for an anonymous jit of ``partial(...)``."""
+    out: set[int] = set()
+    for dec in getattr(fn_node, "decorator_list", ()):
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Call):
+                out.add(id(sub))
+    return out
+
+
+def _describe(arg: ast.AST) -> str:
+    if isinstance(arg, ast.Lambda):
+        return "a lambda"
+    if isinstance(arg, ast.Call):
+        nm = call_name(arg)
+        return f"`{nm}(...)`" if nm else "a call expression"
+    return "an expression"
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        decorator_calls: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decorator_calls |= _jit_decorator_calls(node)
+        # enclosing qualname per call node, for finding attribution
+        owner: dict[int, str] = {}
+        for key, info in index.functions.items():
+            if info.module is not mod:
+                continue
+            for sub in ast.walk(info.node):
+                if isinstance(sub, ast.Call):
+                    owner.setdefault(id(sub), info.qualname)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or id(node) in decorator_calls:
+                continue
+            if call_name(node) not in _JIT_NAMES:
+                continue
+            if not node.args:
+                continue   # jit(**only_kwargs) — not a compile site
+            arg = node.args[0]
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                continue   # named reference: executable keeps its __name__
+            findings.append(Finding(
+                "PRF001", mod.path, node.lineno, owner.get(id(node), ""),
+                f"`{call_name(node)}` over {_describe(arg)} — the "
+                "executable has no stable name, so profiler captures and "
+                "the cost registry cannot attribute it; jit a named def "
+                "or use accounted_jit(site, fn)",
+                detail=_describe(arg)))
+    return findings

@@ -29,7 +29,7 @@ import threading
 
 _lock = threading.Lock()
 _state = {"enabled": False, "dir": None, "hits": 0, "misses": 0,
-          "listener": False}
+          "listener": False, "by_site": {}}
 
 
 def _default_dir() -> str:
@@ -42,11 +42,21 @@ def _on_event(event: str, **_kw) -> None:
     # cache_misses arrives as a duration event on some jax versions and a
     # plain event on others; both funnel here
     if event == "/jax/compilation_cache/cache_hits":
-        with _lock:
-            _state["hits"] += 1
+        kind = "hits"
     elif event == "/jax/compilation_cache/cache_misses":
-        with _lock:
-            _state["misses"] += 1
+        kind = "misses"
+    else:
+        return
+    # per-site attribution: the CostMeter site scope active at compile time
+    # (an AccountedJit AOT compile, a builder's fit scope) names which loop
+    # hit/missed the persistent cache — the bench's compile_cache_per_run
+    # can then say WHICH loop recompiled, not just that one did
+    from h2o3_tpu.utils.costs import COSTS
+    site = COSTS.active_site() or "(unattributed)"
+    with _lock:
+        _state[kind] += 1
+        per = _state["by_site"].setdefault(site, {"hits": 0, "misses": 0})
+        per[kind] += 1
 
 
 def enable(cache_dir: str | None = None, *, default_on: bool = False,
@@ -86,11 +96,15 @@ def enable(cache_dir: str | None = None, *, default_on: bool = False,
 
 
 def stats() -> dict:
-    """{enabled, dir, entries, hits, misses} — ``entries`` counts on-disk
-    cache files (an absolute view; hits/misses are this process only)."""
+    """{enabled, dir, entries, hits, misses, by_site} — ``entries`` counts
+    on-disk cache files (an absolute view; hits/misses are this process
+    only, ``by_site`` splits them by the CostMeter site active at compile
+    time)."""
     with _lock:
         out = {"enabled": _state["enabled"], "dir": _state["dir"],
-               "hits": _state["hits"], "misses": _state["misses"]}
+               "hits": _state["hits"], "misses": _state["misses"],
+               "by_site": {k: dict(v)
+                           for k, v in _state["by_site"].items()}}
     entries = 0
     if out["dir"]:
         try:

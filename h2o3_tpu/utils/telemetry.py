@@ -475,6 +475,36 @@ SLICE_QUEUE_WAIT = METRICS.histogram(
     "h2o3_slice_queue_wait_seconds",
     "time a build waited for a free slice (or for the whole mesh)")
 
+# compute observatory (utils/costs.py CostMeter — docs/OBSERVABILITY.md
+# "Compute"). Site labels are code-defined logical compile sites
+# (glm:irls_megastep, gbm:grow_batched, map_reduce:<fn>, score:<algo>);
+# loop labels match the h2o3_iteration_seconds loops plus "scoring".
+COMPILES = METRICS.counter(
+    "h2o3_compiles", "XLA compiles observed by the cost observatory",
+    ("site",))
+COMPILE_SECONDS = METRICS.counter(
+    "h2o3_compile_seconds", "compile wall seconds per logical site",
+    ("site",))
+RECOMPILES = METRICS.counter(
+    "h2o3_recompiles",
+    "signature changes (a site compiling a 2nd+ distinct signature)",
+    ("site",))
+ACHIEVED_FLOPS = METRICS.gauge(
+    "h2o3_achieved_flops_per_sec",
+    "achieved FLOP/s of a loop's compiled program (cost_analysis FLOPs / "
+    "sampled synced wall time)", ("loop",))
+ACHIEVED_BYTES = METRICS.gauge(
+    "h2o3_achieved_bytes_per_sec",
+    "achieved bytes/s of a loop's compiled program", ("loop",))
+ARITH_INTENSITY = METRICS.gauge(
+    "h2o3_arithmetic_intensity",
+    "FLOPs per byte accessed of a loop's compiled program", ("loop",))
+COMPUTE_UTILIZATION = METRICS.gauge(
+    "h2o3_compute_utilization",
+    "achieved FLOP/s over the backend's peak (MFU); only published on "
+    "backends in the peak table — unknown backends report null via "
+    "/3/Compute instead of a bogus 0", ("loop",))
+
 # fault injection (utils/timeline.py FaultInjector)
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))

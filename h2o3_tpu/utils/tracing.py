@@ -53,6 +53,13 @@ _TRACEPARENT = re.compile(
 _CURRENT: contextvars.ContextVar["SpanContext | None"] = \
     contextvars.ContextVar("h2o3_span", default=None)
 
+#: set by utils/profiling.py while a device-profiler capture is open: every
+#: span entered during the window additionally opens a
+#: ``jax.profiler.TraceAnnotation`` named after the span, so the Perfetto
+#: capture carries span-derived names. None (the default) costs one
+#: is-not-None check per span — the always-on tracer budget is untouched.
+SPAN_HOOK = None
+
 
 def enabled() -> bool:
     return os.environ.get("H2O3TPU_TRACE_OFF", "") != "1"
@@ -141,20 +148,29 @@ class _SpanScope:
     """Context manager activating a span (or a no-op when tracing yields
     no span — off, or no active trace to parent under)."""
 
-    __slots__ = ("_tracer", "_span", "_token")
+    __slots__ = ("_tracer", "_span", "_token", "_ann")
 
     def __init__(self, tracer: "Tracer", span: Span | None):
         self._tracer = tracer
         self._span = span
         self._token = None
+        self._ann = None
 
     def __enter__(self) -> Span | None:
         if self._span is not None:
             self._token = _CURRENT.set(self._span.context)
+            if SPAN_HOOK is not None:    # device-profiler capture open
+                self._ann = SPAN_HOOK(self._span.name)
         return self._span
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         if self._span is not None:
+            if self._ann is not None:
+                try:
+                    self._ann.__exit__(None, None, None)
+                except Exception:   # noqa: BLE001 — annotation best-effort
+                    pass
+                self._ann = None
             if self._token is not None:
                 _CURRENT.reset(self._token)
             if exc_type is not None and self._span.status == "ok":

@@ -41,6 +41,10 @@ def _clear_jax_caches():
     ~500th compile). Shapes rarely repeat across modules, so the recompile
     cost is negligible."""
     yield
+    # AccountedJit wrappers (utils/costs.py) hold AOT executables the global
+    # cache clear cannot see — drop them too, same segfault guard
+    from h2o3_tpu.utils.costs import COSTS
+    COSTS.clear_executables()
     jax.clear_caches()
 
 

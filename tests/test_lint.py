@@ -580,6 +580,63 @@ def test_rty002_recording_and_skip_patterns_clean(tmp_path):
     assert "RTY002" not in rules_of(run_lint(pkg))
 
 
+# -- profiling attribution (PRF) ---------------------------------------------
+
+def test_prf001_anonymous_jit_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        from functools import partial
+
+        def loss(b):
+            return (b * b).sum()
+
+        def fit(b):
+            g = jax.jit(jax.grad(loss))            # transform: unnamed
+            s = jax.jit(lambda x: x + 1)           # lambda: unnamed
+            p = jax.jit(partial(loss))             # partial: unnamed
+            return g(b) + s(b) + p(b)
+    """})
+    findings = [f for f in run_lint(pkg) if f.rule == "PRF001"]
+    assert len(findings) == 3
+    assert all(f.where == "fit" for f in findings)
+    assert "stable name" in findings[0].message
+
+
+def test_prf001_named_forms_clean(tmp_path):
+    # decorators (incl. @partial(jax.jit, ...)) and calls on named
+    # references all keep a stable __name__ — zero findings
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        @partial(jax.jit, static_argnames=("k",))
+        def megastep(x, k):
+            return x * k
+
+        def fit(x):
+            f = jax.jit(step)                  # named def reference
+            m = jax.jit(jnp.matmul)            # named attribute reference
+            return f(x) + m(x, x)
+    """})
+    assert [f.rule for f in run_lint(pkg) if f.rule == "PRF001"] == []
+
+
+def test_prf001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import jax
+
+        def fit(b):
+            g = jax.jit(lambda x: x + 1)  # graftlint: ok(throwaway probe)
+            return g(b)
+    """})
+    assert [f.rule for f in run_lint(pkg) if f.rule == "PRF001"] == []
+
+
 # -- suppression + baseline --------------------------------------------------
 
 def test_inline_suppression(tmp_path):
@@ -706,6 +763,15 @@ def test_package_has_no_new_findings(live_findings):
     new, _old = split_findings(live_findings, load_baseline(DEFAULT_BASELINE))
     assert new == [], "new graftlint findings:\n" + "\n".join(
         f.render() for f in new)
+
+
+def test_package_has_no_prf001_findings(live_findings):
+    """Every executable in the live package is attributable: zero PRF001
+    findings, baselined or not — the compute observatory (ISSUE 10) relies
+    on stable names to credit compiles, FLOPs, and profiler events to
+    sites, so anonymous jits don't get grandfathered into the baseline."""
+    hits = [f for f in live_findings if f.rule == "PRF001"]
+    assert hits == [], "\n".join(f.render() for f in hits)
 
 
 def test_package_fix_targets_stay_clean(live_findings):

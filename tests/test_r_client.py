@@ -142,7 +142,8 @@ def test_r_package_sources_complete():
     code = open(os.path.join(pkg, "R", "h2o3tpu.R")).read()
     for fn in ("h2o.init", "h2o.connect", "h2o.importFile", "h2o.gbm",
                "h2o.glm", "h2o.predict", "h2o.performance", "h2o.splitFrame",
-               "h2o.auc", "h2o.removeAll"):
+               "h2o.auc", "h2o.removeAll", "h2o.compute",
+               "h2o.profilerCapture", "h2o.profilerCaptures"):
         assert f"export({fn})" in ns, fn
         assert f"{fn} <- function" in code, fn
 
@@ -345,3 +346,18 @@ def test_r_wire_contract_round5(server, tmp_path, rng):
                          "lambda_": "0.0"})
     assert glm
     st, _ = _raw_http(server, "DELETE", "/3/DKV")
+
+
+def test_r_wire_contract_compute(server):
+    """ISSUE 10 R verbs: h2o.compute (GET /3/Compute), h2o.profilerCapture
+    (POST /3/Profiler/capture?duration_ms=N) and h2o.profilerCaptures —
+    exact byte sequences the R package emits."""
+    st, snap = _raw_http(server, "GET", "/3/Compute")
+    assert st == 200
+    assert snap["__meta"]["schema_type"] == "ComputeV3"
+    assert "sites" in snap and "loops" in snap
+    st, rec = _raw_http(server, "POST", "/3/Profiler/capture?duration_ms=60")
+    assert st == 200 and rec["capture_id"].startswith("cap_")
+    st, caps = _raw_http(server, "GET", "/3/Profiler/captures")
+    assert st == 200
+    assert any(c["capture_id"] == rec["capture_id"] for c in caps["captures"])
