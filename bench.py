@@ -600,6 +600,179 @@ def _chaos_gate(ch: dict) -> None:
         sys.exit(3)
 
 
+def bench_elastic(ndev: int) -> dict:
+    """Elastic local-SGD under a mid-epoch worker kill (ISSUE 12 / ROADMAP
+    item 3 acceptance): a k-worker elastic DL run where one worker is
+    stalled dead mid-run must COMPLETE with exactly one ejection, the dead
+    worker's shard reassigned to survivors, and the kill costing less than
+    the dead worker's throughput share (slowdown < 1/k vs the uninterrupted
+    k-worker run — enforced on real hardware; CPU-fallback rounds enforce
+    completion + bounded wall only, the same policy as the slices gate)."""
+    import threading
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.parallel import elastic as _el
+    from h2o3_tpu.utils.registry import DKV
+    from h2o3_tpu.utils.timeline import inject_faults
+
+    k = 4 if ndev % 4 == 0 else (2 if ndev % 2 == 0 else max(ndev, 2))
+    n = 2_000 if SMOKE else 60_000
+    epochs, local_steps = (2, 1) if SMOKE else (8, 1)
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    logit = X[:, :3] @ np.array([1.0, -0.7, 0.4], np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(8)}
+    cols["y"] = np.where(rng.random(n) < 1.0 / (1.0 + np.exp(-logit)),
+                         "yes", "no")
+    fr = Frame.from_arrays(cols)
+
+    def run(model_id, eps=None):
+        b = DeepLearning(hidden=[16], epochs=eps or epochs, elastic=k,
+                         local_steps=local_steps, mini_batch_size=64,
+                         seed=9, model_id=model_id)
+        t0 = time.perf_counter()
+        m = b.train(y="y", training_frame=fr)
+        return m, b.job, time.perf_counter() - t0
+
+    # warm-up pass: compiles every per-slice signature so BOTH timed runs
+    # below are warm — without it the clean run carries the one-time
+    # compile cost and the slowdown ratio under-reads
+    warm_model, _, _ = run("elastic_warm", eps=1)
+    spw = warm_model.output["elastic"]["shards_per_worker"]
+    # uninterrupted k-worker reference
+    clean_model, _, clean_secs = run("elastic_clean")
+    clean_rounds = clean_model.output["elastic"]["rounds"]
+    round_wall = clean_secs / max(clean_rounds, 1)
+
+    # tight-but-safe membership knobs derived from the measured cadence:
+    # the stall outlives the whole run (a dead worker, not a hiccup); the
+    # deadline ejects it within <2 rounds BUT must clear the post-ejection
+    # round wall — survivors carry ceil(spw·k/(k-1))/spw ≈ 1.33x compute
+    # per round after the kill, and a deadline below that would
+    # mass-suspect the survivors themselves. The kill lands MID-RUN
+    # (worker 1's first sub-shard of round ~mid): `after` counts that
+    # worker's own dl_epochs calls, spw per round
+    stall_s = max(10.0 * clean_secs, 60.0)
+    deadline_s = max(1.75 * round_wall, 1.0)
+    kill_round = max(clean_rounds // 2, 1)
+    env_save = {kk: os.environ.get(kk) for kk in
+                ("H2O3TPU_ELASTIC_ROUND_DEADLINE_SECS",
+                 "H2O3TPU_ELASTIC_LEASE_SECS")}
+    os.environ["H2O3TPU_ELASTIC_ROUND_DEADLINE_SECS"] = str(deadline_s)
+    os.environ["H2O3TPU_ELASTIC_LEASE_SECS"] = str(max(deadline_s / 2, 0.5))
+    result: dict = {}
+
+    def killed_phase():
+        try:
+            result["model"], result["job"], result["secs"] = \
+                run("elastic_killed")
+        except BaseException as e:   # noqa: BLE001 — the gate refuses on it
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    try:
+        with inject_faults(worker_rates={1: {"stall_rate": 1.0,
+                                             "stall_ms": stall_s * 1e3,
+                                             "after": kill_round * spw}}
+                           ) as inj:
+            worker = threading.Thread(target=killed_phase, daemon=True)
+            worker.start()
+            # watchdog: a wedged elastic run is the exact regression this
+            # layer exists to prevent — refuse instead of hanging the bench
+            worker.join(timeout=max(30.0, 5.0 * clean_secs + stall_s / 2))
+            completed = not worker.is_alive()
+    finally:
+        _el.drain(60.0)
+        for kk, v in env_save.items():
+            if v is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = v
+
+    for key in ("elastic_warm", "elastic_clean", "elastic_killed"):
+        DKV.remove(key)
+    if result.get("error"):
+        return {"error": f"killed run failed: {result['error']}",
+                "stalls_injected": inj.stalled}
+    out = dict(workers=k, rounds=clean_rounds, local_steps=local_steps,
+               shards_per_worker=spw, kill_round=kill_round,
+               completed=completed, stalls_injected=inj.stalled,
+               clean_seconds=round(clean_secs, 2))
+    if completed:
+        el = result["model"].output["elastic"]
+        killed_secs = result["secs"]
+        slowdown = (killed_secs - clean_secs) / max(clean_secs, 1e-9)
+        out.update(
+            killed_status=result["job"].status,
+            killed_seconds=round(killed_secs, 2),
+            # what the kill actually cost, vs the dead worker's share
+            slowdown_frac=round(slowdown, 4),
+            dead_worker_share=round(1.0 / k, 4),
+            recovery_latency_s=round(max(killed_secs - clean_secs, 0.0), 2),
+            workers_ejected=int(result["job"].workers_ejected),
+            ejections_by_reason=el["ejections_by_reason"],
+            rounds_killed_run=el["rounds"],
+            # per-worker throughput: averaging rounds carried / busy wall
+            per_worker={w: {"rounds_done": v["rounds_done"],
+                            "busy_seconds": v["busy_seconds"],
+                            "rounds_per_sec": round(
+                                v["rounds_done"]
+                                / max(v["busy_seconds"], 1e-9), 3),
+                            "state": v["state"]}
+                        for w, v in el["per_worker"].items()},
+            final_loss_clean=clean_model.output["score_history"][-1]
+            ["train_loss"] if clean_model.output["score_history"] else None,
+            final_loss_killed=result["model"].output["score_history"][-1]
+            ["train_loss"] if result["model"].output["score_history"]
+            else None)
+    return out
+
+
+def _elastic_gate(el: dict, backend: str) -> None:
+    """Refuse to stamp when the elastic chaos scenario wedged, ejected the
+    wrong number of workers, or (on real hardware) the kill cost more than
+    the dead worker's throughput share — ROADMAP item 3's acceptance bar."""
+    if el.get("skipped"):
+        return
+    if el.get("error"):
+        print(f"# bench REFUSED: elastic section failed: {el['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if not el["completed"]:
+        print("# bench REFUSED: elastic killed-worker run WEDGED — the "
+              "dead worker stalled the cloud", file=sys.stderr)
+        sys.exit(3)
+    if el.get("workers_ejected") != 1:
+        print(f"# bench REFUSED: elastic kill ejected "
+              f"{el.get('workers_ejected')} workers (expected exactly 1) — "
+              "the harness is hollow or membership over-reacted",
+              file=sys.stderr)
+        sys.exit(3)
+    if el.get("stalls_injected", 0) < 1:
+        print("# bench REFUSED: elastic scenario injected zero stalls",
+              file=sys.stderr)
+        sys.exit(3)
+    if el.get("killed_status") != "DONE":
+        # a quorum-cancelled partial would otherwise read as a pass with a
+        # trivially-negative slowdown (it trained fewer epochs)
+        print(f"# bench REFUSED: killed run ended {el.get('killed_status')} "
+              "— survivors did not finish the build", file=sys.stderr)
+        sys.exit(3)
+    if el.get("rounds_killed_run") != el.get("rounds"):
+        print(f"# bench REFUSED: killed run carried "
+              f"{el.get('rounds_killed_run')} rounds vs the clean run's "
+              f"{el.get('rounds')} — membership over-reacted (mass-suspect "
+              "or early exit), the epochs were not all trained",
+              file=sys.stderr)
+        sys.exit(3)
+    real = backend not in ("cpu",) and not CPU_FALLBACK
+    if real and el["slowdown_frac"] >= el["dead_worker_share"]:
+        print(f"# bench REFUSED: killing 1/{el['workers']} workers cost "
+              f"{el['slowdown_frac']:.1%} of throughput (>= its "
+              f"{el['dead_worker_share']:.1%} share)", file=sys.stderr)
+        sys.exit(3)
+
+
 def bench_tracing(ndev: int) -> dict:
     """Trace-store overhead + the slowest trace's critical path.
 
@@ -1090,6 +1263,18 @@ def main() -> None:
         ch = {"error": f"{type(e).__name__}: {e}"}
     out["extra"]["chaos"] = ch
     _chaos_gate(ch)
+    # elastic local-SGD: kill 1 of k workers mid-epoch — must complete with
+    # exactly one ejection, and on real hardware the kill must cost less
+    # than the dead worker's throughput share (ROADMAP item 3)
+    if SMOKE:
+        el: dict = {"skipped": "smoke"}
+    else:
+        try:
+            el = bench_elastic(ndev)
+        except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+            el = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["elastic"] = el
+    _elastic_gate(el, out["extra"]["backend"])
     # serving path: score_qps through the compiled/batched /3/Score tier
     # vs the per-request predict path (ISSUE 6: the scoring tier gets the
     # same perf trajectory the training path has)

@@ -203,6 +203,12 @@ h2o.meshSlices <- function() {
   .http("GET", "/3/Cloud")$mesh_slices
 }
 
+h2o.workers <- function() {
+  # elastic local-SGD membership: per-worker state / round / last-heartbeat
+  # rows of recent elastic groups (docs/RELIABILITY.md "Elastic training")
+  .http("GET", "/3/Cloud")$workers
+}
+
 h2o.importFile <- function(path, destination_frame = NULL) {
   body <- list(path = path)
   if (!is.null(destination_frame)) body$destination_frame <- destination_frame

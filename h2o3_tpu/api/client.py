@@ -65,6 +65,12 @@ class H2OClient:
         seconds / builds / queue wait (docs/ORCHESTRATION.md)."""
         return self.cloud_status().get("mesh_slices", {})
 
+    def workers(self) -> list:
+        """Elastic local-SGD membership: per-worker state / round /
+        last-heartbeat rows of recent elastic groups, served inside
+        ``GET /3/Cloud`` (docs/RELIABILITY.md "Elastic training")."""
+        return self.cloud_status().get("workers", [])
+
     def import_file(self, path: str, destination_frame: str | None = None) -> str:
         d = {"path": path}
         if destination_frame:

@@ -62,8 +62,14 @@ def cloud_v3(version: str) -> dict:
     # cluster-utilization view ROADMAP item 5 asks for, on the endpoint
     # every client already polls
     from h2o3_tpu.orchestration.scheduler import SLICE_STATS
+    # elastic local-SGD membership (parallel/elastic.py): per-worker
+    # state/round/last-heartbeat rows of recent elastic groups — the
+    # reference's cloud-member heartbeat view, on the endpoint every
+    # client already polls (docs/RELIABILITY.md "Elastic training")
+    from h2o3_tpu.parallel.elastic import ELASTIC_STATS
     return {**_meta("CloudV3"), "version": version, "cloud_name": "h2o3_tpu",
             "mesh_slices": SLICE_STATS.snapshot(),
+            "workers": _clean(ELASTIC_STATS.rows()),
             "cloud_size": len(devs), "cloud_healthy": True, "bad_nodes": 0,
             "consensus": True, "locked": True, "is_client": False,
             "cloud_uptime_millis": 0, "internal_security_enabled": False,
@@ -458,6 +464,10 @@ def job_v3(job_id: str, job) -> dict:
          "max_runtime_secs": _clean(float(
              getattr(job, "max_runtime_secs", 0.0) or 0.0)),
          "deadline_exceeded": bool(getattr(job, "deadline_exceeded", False)),
+         # elastic membership decay: workers ejected from this build's
+         # local-SGD group (parallel/elastic.py; /3/Cloud serves the live
+         # per-worker view)
+         "workers_ejected": int(getattr(job, "workers_ejected", 0) or 0),
          "exception": None,
          "warnings": None,
          # the trace the job's execution reports into (None when it was

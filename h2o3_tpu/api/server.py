@@ -629,6 +629,16 @@ class _Handler(BaseHTTPRequestHandler):
         job.cancel = _cancel_both
 
         def driver(j: Job):
+            def mirror_inner_elastic():
+                # elastic membership decay lives on the inner library job;
+                # REST pollers read the outer one (live per-worker state is
+                # on /3/Cloud's workers view throughout the build)
+                inner = getattr(builder, "job", None)
+                ejected = int(getattr(inner, "workers_ejected", 0) or 0)
+                if ejected:
+                    with j._lock:
+                        j.workers_ejected = ejected
+
             def mirror_inner_cancel():
                 # the build terminated on its deadline/cancel — the REST
                 # job must read CANCELLED (not DONE) and carry the deadline
@@ -658,11 +668,13 @@ class _Handler(BaseHTTPRequestHandler):
                     m = train_fn()
                 except BaseException:
                     mirror_inner_cancel()
+                    mirror_inner_elastic()
                     raise
                 finally:
                     if cleanup is not None:
                         cleanup()
             mirror_inner_cancel()
+            mirror_inner_elastic()
             j.dest_key = m.key
             return m
 
@@ -1801,7 +1813,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "cloud_uptime_millis", "internal_security_enabled",
                     "branch_name", "build_number", "build_age",
                     "build_too_old", "node_idx", "cloud_internal_timezone",
-                    "datafile_parser_timezone", "mesh_slices"],
+                    "datafile_parser_timezone", "mesh_slices", "workers"],
     }
 
     def r_metadata_schemas(self):

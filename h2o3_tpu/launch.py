@@ -136,7 +136,8 @@ def main(args=None) -> int:
         # (multi-host training uses script mode, where every process runs
         # the same SPMD program).
         import threading
-        threading.Event().wait()     # serve forever
+        # graftlint: ok(serve forever — blocking IS this process's job)
+        threading.Event().wait()
     return 0
 
 

@@ -313,6 +313,13 @@ class DeepLearning(ModelBuilder):
             initial_weight_scale=1.0,
             autoencoder=False,
             score_each_iteration=False,
+            # elastic local-SGD (docs/RELIABILITY.md "Elastic training"):
+            # elastic = number of requested workers (0 = off; clamped to
+            # the mesh-slice layout), local_steps = local epochs each
+            # worker runs between parameter-averaging rounds (0 coerces
+            # to 1 — average every epoch)
+            elastic=0,
+            local_steps=1,
         )
 
     unsupervised = False
@@ -346,7 +353,19 @@ class DeepLearning(ModelBuilder):
         return {"W": Ws, "b": bs}
 
     def supports_auto_recovery(self) -> bool:
-        return True     # epoch-boundary snapshots in _fit
+        # elastic builds survive faults through MEMBERSHIP (ejection +
+        # shard reassignment), not snapshots — advertising auto_recovery
+        # there would promise a resume path the round engine doesn't write
+        return not int(self.params.get("elastic") or 0)
+
+    def validate_request(self) -> None:
+        super().validate_request()
+        el = self.params.get("elastic")
+        if el is not None and int(el) < 0:
+            raise ValueError("elastic must be >= 0 (worker count; 0 = off)")
+        ls = self.params.get("local_steps")
+        if ls is not None and int(ls) < 0:
+            raise ValueError("local_steps must be >= 0")
 
     def _fit(self, job: Job, frame: Frame, x, y, weights) -> DeepLearningModel:
         p = self.params
@@ -424,6 +443,15 @@ class DeepLearning(ModelBuilder):
                float(p["l1"]), float(p["l2"]), float(p["max_w2"]),
                float(p["input_dropout_ratio"]), tuple(float(d) for d in hid_drops),
                1.0)
+
+        if int(p.get("elastic") or 0):
+            # elastic local-SGD: k slice-leased workers train K local
+            # epochs per round on their own shard and average parameters
+            # at round boundaries, under elastic membership
+            # (parallel/elastic.py; docs/RELIABILITY.md)
+            return self._fit_elastic(job, frame, y, di, X, yy, w, act,
+                                     loss, nclasses, domain, cfg, autoenc,
+                                     params, key, sizes, done_ep, samples0)
 
         plen = X.shape[0]
         B = min(max(int(p["mini_batch_size"]), 1), plen)
@@ -520,6 +548,235 @@ class DeepLearning(ModelBuilder):
             output=dict(params=params, act=act, sizes=sizes,
                         score_history=score_history,
                         samples_trained=float(jax.device_get(samples))),
+        )
+        return model
+
+    # -- elastic local-SGD (docs/RELIABILITY.md "Elastic training") ----------
+
+    def _fit_elastic(self, job: Job, frame: Frame, y, di, X, yy, w,
+                     act: str, loss: str, nclasses: int, domain, cfg: tuple,
+                     autoenc: bool, params0, key, sizes, done_ep: int,
+                     samples0: float) -> DeepLearningModel:
+        """Local-SGD rounds over an elastic worker group.
+
+        Workers are mesh slices leased for the group's lifetime; each runs
+        ``local_steps`` whole epochs (the ``_train_epochs`` megastep) on its
+        own contiguous data shard per round, then live workers' parameters
+        are weighted-averaged (weights = shard weight-sums, renormalized
+        over whoever reported) and re-broadcast. A worker that faults,
+        exhausts its dispatch-retry budget, blows the round deadline, or
+        stops heartbeating is EJECTED: its shards are reassigned to
+        survivors at the next boundary; a (re)joining worker catches up by
+        cloning the latest average (every round thunk starts from the
+        broadcast). Below the quorum the build cancels with partial results
+        (``Job.keep_partial``). Fixed membership + fixed seeds is
+        reproducibility-identical across reruns: shard assignment, worker
+        PRNG streams (``fold_in(key, wid)``), and the wid-ordered host-side
+        float64 average are all deterministic — ejection changes the
+        averaging sequence, so parity holds only at fixed membership."""
+        from h2o3_tpu.models.job import JobCancelled
+        from h2o3_tpu.ops.map_reduce import retrying
+        from h2o3_tpu.orchestration.scheduler import MeshScheduler
+        from h2o3_tpu.parallel.elastic import (ElasticGroup,
+                                               min_workers_from_env)
+        from h2o3_tpu.parallel.mesh import (ROWS, num_devices,
+                                            replicated_sharding,
+                                            row_sharding)
+
+        p = self.params
+        k_req = max(int(p["elastic"]), 1)
+        local_k = max(int(p.get("local_steps") or 0), 1)
+        scheduler = MeshScheduler(slices=k_req)
+        if scheduler.n > 1:
+            k = scheduler.n
+        else:
+            # degenerate layout: on a single-device mesh threads overlap
+            # safely (no collectives to rendezvous); on a multi-device mesh
+            # one slice means ONE worker — overlapped same-mesh collectives
+            # are the documented XLA wedge the slice layout exists to avoid
+            k = k_req if num_devices() <= 1 else 1
+        slice_ndev = scheduler.meshes[0].shape[ROWS]
+
+        # host-side data: shard the REAL rows contiguously into k*spw equal
+        # SUB-shards (several per worker), each padded (zero-weight rows)
+        # to a multiple of the slice device count. Identical shapes mean a
+        # reassigned shard reuses the survivor's compiled program, and
+        # finer granularity means an ejected worker's load spreads ~evenly
+        # over the k-1 survivors (one whole-worker shard handed to one
+        # survivor would DOUBLE its round wall — the post-ejection
+        # throughput floor is k/(k-1), reachable only with sub-shards);
+        # workers also heartbeat between sub-shards, so slow and dead
+        # separate faster. spw shrinks until each sub-shard still holds a
+        # full minibatch.
+        n = frame.nrows
+        Xh = np.asarray(jax.device_get(X))[:n]
+        yh = np.asarray(jax.device_get(yy))[:n]
+        wh = np.asarray(jax.device_get(w))[:n]
+        B_req = max(int(p["mini_batch_size"]), 1)
+        spw = 6 if k > 1 else 1
+        while spw > 1 and n // (k * spw) < B_req:
+            spw -= 1
+        n_shards = k * spw
+        base = -(-n // n_shards)                # ceil
+        shard_n = -(-base // slice_ndev) * slice_ndev
+        host_shards = []
+        for i in range(n_shards):
+            lo, hi = i * base, min(n, (i + 1) * base)
+            m = max(hi - lo, 0)
+            Xs = np.zeros((shard_n, Xh.shape[1]), np.float32)
+            ys = np.zeros(shard_n, np.float32)
+            ws = np.zeros(shard_n, np.float32)
+            if m:
+                Xs[:m], ys[:m], ws[:m] = Xh[lo:hi], yh[lo:hi], wh[lo:hi]
+            host_shards.append({"X": Xs, "y": ys, "w": ws,
+                                "wsum": float(ws.sum()), "rows": m})
+        B = min(B_req, shard_n)
+        nb = shard_n // B
+        n_epochs = max(max(int(np.ceil(float(p["epochs"]))), 1) - done_ep, 0)
+
+        key_h = np.asarray(jax.device_get(key))
+        avg_h = jax.device_get(params0)         # host pytree of np arrays
+        wstate = {wid: {"opt": None, "key": None, "data": {},
+                        "samples": float(samples0)}
+                  for wid in range(k)}
+
+        group = ElasticGroup(k, scheduler=scheduler, job=job,
+                             group_id=job.key,
+                             shards={wid: list(range(wid * spw,
+                                                     (wid + 1) * spw))
+                                     for wid in range(k)})
+        group.start()
+
+        def make_step(wid: int, owned: list, kk: int, avg):
+            def step():
+                st = wstate[wid]
+                for sid in [s for s in st["data"] if s not in owned]:
+                    st["data"].pop(sid)
+                for sid in owned:
+                    if sid not in st["data"]:
+                        hs = host_shards[sid]
+                        st["data"][sid] = (
+                            jax.device_put(hs["X"], row_sharding(2)),
+                            jax.device_put(hs["y"], row_sharding(1)),
+                            jax.device_put(hs["w"], row_sharding(1)))
+                rs = replicated_sharding()
+                pd = jax.device_put(avg, rs)
+                if st["opt"] is None:
+                    zeros = jax.tree.map(jnp.zeros_like, pd)
+                    st["opt"] = {
+                        "Eg": zeros,
+                        "Edx": jax.tree.map(jnp.zeros_like, pd),
+                        "v": jax.tree.map(jnp.zeros_like, pd)}
+                    st["key"] = jax.device_put(
+                        jax.random.fold_in(jnp.asarray(key_h), wid), rs)
+                opt, kw = st["opt"], st["key"]
+                samples_d = jnp.float32(st["samples"])
+                shard_losses = []
+                for sid in owned:
+                    Xd, yd, wd = st["data"][sid]
+                    _in = (pd, opt, kw, samples_d)
+                    with timed_event("iteration", "dl_epoch"):
+                        pd, opt, kw, samples_d, losses_k = retrying(
+                            "dl_epochs", lambda: _train_epochs(
+                                _in[0], _in[1], Xd, yd, wd, _in[2], _in[3],
+                                act, loss, nclasses, cfg, kk, nb, B,
+                                autoenc))
+                    group.heartbeat(wid)
+                    shard_losses.append(losses_k)
+                # ONE batched fetch per worker-round (the megastep fetch
+                # contract): params + the loss series + the sample counter
+                ph, lh, sh = jax.device_get((pd, shard_losses, samples_d))
+                st["opt"], st["key"] = opt, kw
+                st["samples"] = float(sh)
+                wsum = sum(host_shards[sid]["wsum"] for sid in owned)
+                la = np.zeros(kk)
+                for sid, lk in zip(owned, lh):
+                    la += (np.atleast_1d(np.asarray(lk))
+                           * (host_shards[sid]["wsum"] / max(wsum, 1e-8)))
+                return {"params": ph, "losses": la, "wsum": wsum}
+            return step
+
+        quorum = min_workers_from_env()
+        epoch_losses: list[float] = []
+        ep_done = 0
+        rnd = 0
+        try:
+            while ep_done < n_epochs:
+                if job.should_stop:
+                    job.keep_partial()
+                    break
+                live = group.live_workers()
+                if len(live) < quorum:
+                    # quorum lost: cancel with partial results — the last
+                    # average IS the partial model (PR 8 contract)
+                    job.cancel()
+                    job.keep_partial()
+                    break
+                kk = min(local_k, n_epochs - ep_done)
+                rnd += 1
+                thunks = {wid: make_step(wid, owned, kk, avg_h)
+                          for wid in live
+                          if (owned := group.owned_shards(wid))}
+                if not thunks:
+                    break
+                reports = group.run_round(rnd, thunks)
+                if not reports:
+                    # everyone missed the boundary — membership was swept;
+                    # the quorum check above decides whether to go on
+                    continue
+                # every host-side reduction iterates wid-SORTED reports:
+                # dict order is thread-arrival order, and float sums in
+                # arrival order would break rerun bit-reproducibility
+                ordered = [reports[w] for w in sorted(reports)]
+                tot = sum(r["wsum"] for r in ordered)
+                if tot > 0:
+                    # wid-ordered float64 weighted average — deterministic,
+                    # and renormalized over exactly the reporting workers
+                    avg_h = jax.tree.map(
+                        lambda *leaves: sum(
+                            (r["wsum"] / tot) * np.asarray(lv, np.float64)
+                            for r, lv in zip(ordered, leaves))
+                        .astype(np.float32),
+                        *[r["params"] for r in ordered])
+                    for e in range(kk):
+                        epoch_losses.append(float(sum(
+                            (r["wsum"] / tot) * r["losses"][e]
+                            for r in ordered)))
+                ep_done += kk
+                try:
+                    job.update(ep_done / max(n_epochs, 1),
+                               f"round {rnd}: epoch {ep_done}/{n_epochs} "
+                               f"({len(reports)}/{k} workers)")
+                except JobCancelled:
+                    job.keep_partial()
+                    break
+                if job.cancelled:
+                    break
+        finally:
+            group.shutdown()
+
+        publish_dispatch_audit(self, "dl_elastic",
+                               iterations=max(ep_done, 1),
+                               host_syncs=max(rnd, 1),
+                               device_dispatches=max(rnd, 1))
+        score_history = [{"epoch": i + 1, "train_loss": v}
+                         for i, v in enumerate(epoch_losses)]
+        params_final = jax.device_put(avg_h, replicated_sharding())
+        # every worker starts its schedule counter at samples0 (checkpoint
+        # resume position); the TRAINED total is the sum of deltas
+        samples_trained = float(samples0 + sum(
+            st["samples"] - samples0 for st in wstate.values()))
+        model = DeepLearningModel(
+            key=make_model_key(self.algo, self.model_id),
+            params=ModelParameters(p),
+            data_info=di,
+            response_column=None if autoenc else y,
+            response_domain=domain,
+            output=dict(params=params_final, act=act, sizes=sizes,
+                        score_history=score_history,
+                        samples_trained=samples_trained,
+                        elastic={**group.summary(),
+                                 "shards_per_worker": spw}),
         )
         return model
 

@@ -68,6 +68,10 @@ class Job:
         self.retries = 0
         self.retry_history: list | None = None
         self.auto_recovery_dir: str | None = None
+        # elastic local-SGD membership decay (parallel/elastic.py): workers
+        # ejected from the build's elastic group, served by JobV3 so
+        # pollers watch throughput degrade instead of the job stalling
+        self.workers_ejected = 0
         # guards every post-construction field mutation: the worker thread
         # writes status/progress/result while REST handler threads serialize
         # the job (schemas.job_v3 polls) — unlocked multi-field transitions

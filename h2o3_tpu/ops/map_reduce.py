@@ -115,6 +115,31 @@ class DispatchFailed(RuntimeError):
                          f"attempt(s); last error: {last}")
 
 
+#: elastic-membership ejection hook (parallel/elastic.py): a worker thread
+#: running under ``ejection_scope(cb)`` turns an exhausted retry budget into
+#: a MEMBERSHIP event instead of a build failure — ``retrying`` invokes the
+#: hook with the call-site name and attempt history right before raising
+#: DispatchFailed, so the elastic group records the ejection cause while the
+#: exception unwinds only the worker's round (the build goes on without it).
+import contextlib as _contextlib
+import contextvars as _contextvars
+
+_EJECT_HOOK: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "h2o3_eject_hook", default=None)
+
+
+@_contextlib.contextmanager
+def ejection_scope(hook: "Callable[[str, list], None]"):
+    """Route retry exhaustion in this context to ``hook(fn, history)``
+    (called before :class:`DispatchFailed` is raised). Elastic worker
+    threads bind this so a dead dispatch ejects the WORKER, not the job."""
+    token = _EJECT_HOOK.set(hook)
+    try:
+        yield
+    finally:
+        _EJECT_HOOK.reset(token)
+
+
 def retry_budget() -> int:
     """Retry attempts after the first try (``H2O3TPU_DISPATCH_RETRIES``,
     default 3; 0 disables the retry machinery — failures pass through
@@ -184,6 +209,17 @@ def retrying(what: str, thunk: Callable, *, span=None,
                                             outcome="exhausted").inc()
                 if span is not None:
                     span.set_attrs(retries=attempt)
+                hook = _EJECT_HOOK.get()
+                if hook is not None:
+                    # elastic worker context: the exhausted budget is an
+                    # ejection cause, recorded before the exception unwinds
+                    # this worker's round (best-effort — a hook error must
+                    # not mask the DispatchFailed it annotates)
+                    try:
+                        hook(what, history)
+                    except Exception as he:   # noqa: BLE001
+                        _tl.TIMELINE.record("elastic",
+                                            f"eject_hook_error:{he}")
                 raise DispatchFailed(what, history) from e
             delay = _backoff_ms(attempt)
             history[-1]["backoff_ms"] = round(delay, 1)

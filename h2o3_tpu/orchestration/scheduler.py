@@ -259,12 +259,19 @@ class MeshScheduler:
     # -- leasing -------------------------------------------------------------
 
     @contextlib.contextmanager
-    def lease(self, rows: int | None = None, algo: str | None = None):
+    def lease(self, rows: int | None = None, algo: str | None = None,
+              small: bool | None = None):
         """Acquire a slice (small builds) or the whole mesh (big builds),
         bind it as the context mesh, and release on exit. Blocks until
         capacity frees up; a waiting big build gates new small leases so it
-        cannot starve."""
-        small = self.is_small(rows=rows, algo=algo)
+        cannot starve. ``small=True`` forces the one-slice policy outright —
+        elastic local-SGD workers (parallel/elastic.py) lease one slice each
+        for the LIFETIME of the training group, whatever the row count, so
+        membership maps 1:1 onto disjoint device slices."""
+        if small is None:
+            small = self.is_small(rows=rows, algo=algo)
+        elif small:
+            small = self.n > 1     # 1 slice has no sub-slice to pack onto
         t0 = time.monotonic()
         if self.n <= 1:
             # degenerate layout (1 slice / 1 device) = today's behavior:
@@ -294,17 +301,21 @@ class MeshScheduler:
         idx: int | None = None
         t1 = t0
         try:
+            # waits are BOUNDED (timeout + predicate recheck): a notify lost
+            # to a dying/stalled holder re-checks within a second instead of
+            # parking this thread forever — the deadlock class a dead
+            # elastic worker turns fatal (graftlint WTX001)
             if small:
                 with st.cv:
                     while not st.free or st.big_waiting:
-                        st.cv.wait()
+                        st.cv.wait(timeout=1.0)
                     idx = st.free.pop(0)
             else:
                 with st.cv:
                     st.big_waiting += 1
                     try:
                         while len(st.free) < self.n:
-                            st.cv.wait()
+                            st.cv.wait(timeout=1.0)
                         st.free.clear()
                         idx = -1
                     finally:

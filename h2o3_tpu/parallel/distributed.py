@@ -21,6 +21,11 @@ import jax
 import numpy as np
 
 _initialized = False
+#: the coordinator args the live runtime was initialized with — a SECOND
+#: init_distributed with different args used to silently no-op (the caller
+#: believed it had joined cloud B while still wired to cloud A); now it
+#: raises (see init_distributed)
+_init_args: tuple | None = None
 
 
 def init_distributed(coordinator_address: str | None = None,
@@ -35,25 +40,45 @@ def init_distributed(coordinator_address: str | None = None,
     mesh over every device in the cloud.
 
     On a single process (all args None) this is a no-op beyond mesh setup.
+    Re-initializing with the SAME coordinator args is idempotent (the cloud
+    is already formed); different args raise — JAX's distributed runtime
+    cannot re-home a live process onto another coordinator, and silently
+    keeping the old cloud is the worst possible answer.
     """
-    global _initialized
-    if coordinator_address is not None and not _initialized:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids)
-        _initialized = True
+    global _initialized, _init_args
+    if coordinator_address is not None:
+        args = (coordinator_address, num_processes, process_id,
+                tuple(local_device_ids)
+                if local_device_ids is not None else None)
+        if _initialized:
+            if args != _init_args:
+                raise RuntimeError(
+                    "init_distributed called twice with different "
+                    f"coordinator args: already joined {_init_args!r}, "
+                    f"requested {args!r}. A process cannot leave one cloud "
+                    "for another; call shutdown_distributed() first (and "
+                    "note live arrays from the old cloud do not survive).")
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids)
+            _initialized = True
+            _init_args = args
     # (re)install the default mesh over the now-global device set
     from h2o3_tpu.parallel.mesh import set_mesh
     set_mesh(None)
 
 
 def shutdown_distributed() -> None:
-    global _initialized
+    """Leave the cloud. Idempotent: a second call (or a call on a process
+    that never initialized) is a no-op."""
+    global _initialized, _init_args
     if _initialized:
         jax.distributed.shutdown()
         _initialized = False
+        _init_args = None
 
 
 def process_count() -> int:
@@ -76,8 +101,31 @@ def fetch(arr: jax.Array) -> np.ndarray:
     equivalent is a ``TaskGetKey`` fetch of remote chunks to the caller)."""
     if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
         return np.asarray(jax.device_get(arr))
+    return _allgather(arr)
+
+
+def _allgather(arr) -> np.ndarray:
+    """Cross-host gather of non-addressable shards, under the dispatch
+    retry budget: this is the one cross-host dispatch outside the
+    ``map_reduce`` sites, and a transient DCN hiccup here used to be the
+    only unretried failure path in the stack (docs/RELIABILITY.md).
+
+    Collective caveat: a retry re-enters the allgather rendezvous on THIS
+    process only, so absorption is sound for failures every participant
+    observes (XLA collectives fail collectively — a timed-out rendezvous
+    raises on all hosts, and all retry together) and for pre-dispatch
+    faults local to this host (the injected-chaos case). A failure mode
+    where one host errors while its peers return would desynchronize
+    regardless of retry policy; that class is fail-fast by nature and
+    surfaces as the eventual rendezvous timeout."""
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    from h2o3_tpu.ops.map_reduce import retrying
+
+    def _attempt():
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    return retrying("allgather", _attempt)
 
 
 def barrier(name: str = "sync") -> None:

@@ -23,6 +23,12 @@ PAPERS.md):
   its OWN device subset, so two concurrent builds compile independent XLA
   programs and never share a collective rendezvous (the documented hazard
   that forced ``parallelism=1`` pins before the mesh-slice scheduler).
+
+Slices are also the unit of ELASTIC membership (``parallel/elastic.py``,
+docs/RELIABILITY.md "Elastic training"): an elastic local-SGD worker is one
+slice held under a lifetime scheduler lease, so a worker that dies takes
+down only its own slice's collectives — the surviving slices' programs
+share no rendezvous with it and keep training.
 """
 
 from __future__ import annotations
@@ -182,9 +188,11 @@ def slice_meshes(k: int, base: Mesh | None = None) -> list[Mesh]:
     are independent XLA programs (MXNET-MPI communicator groups; FireCaffe
     independent reduction trees). ``k`` is clamped to the largest divisor of
     the base device count that is <= k, so every slice has the same size
-    and the padded length stays divisible by each slice's row count.
-    ``k <= 1`` (or a single-device base) returns ``[base]`` — the
-    degenerate layout IS today's behavior.
+    and the padded length stays divisible by each slice's row count — and
+    elastic data shards padded to one slice's row count fit EVERY slice,
+    which is what lets a dead worker's shard move to a survivor without a
+    recompile (parallel/elastic.py). ``k <= 1`` (or a single-device base)
+    returns ``[base]`` — the degenerate layout IS today's behavior.
     """
     g = base if base is not None else global_mesh()
     ndev = g.shape[ROWS]

@@ -159,8 +159,11 @@ class ModelBatcher:
         (early-out on a full max bucket), then drain the queue."""
         with self._cond:
             while True:
+                # bounded wait + predicate recheck (graftlint WTX001): a
+                # lost wakeup re-polls within a second instead of parking
+                # the worker thread forever
                 while not self._queue and not self._stopped:
-                    self._cond.wait()
+                    self._cond.wait(timeout=1.0)
                 if self._stopped:
                     return None
                 deadline = time.monotonic() + self._window

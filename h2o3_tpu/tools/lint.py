@@ -29,7 +29,7 @@ import sys
 from pathlib import Path
 
 from h2o3_tpu.tools import (locks, mem, meshes, profiles, rest, retry, sync,
-                            tracer)
+                            tracer, waits)
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -41,7 +41,8 @@ def run_lint(root: Path) -> list[Finding]:
     index = PackageIndex.scan(Path(root))
     findings = (tracer.check(index) + locks.check(index) + rest.check(index)
                 + mem.check(index) + sync.check(index) + retry.check(index)
-                + meshes.check(index) + profiles.check(index))
+                + meshes.check(index) + profiles.check(index)
+                + waits.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),

@@ -1,0 +1,91 @@
+"""graftlint wait-discipline rule (WTX) — unbounded blocking waits.
+
+Thread-coordination waits with no timeout are the deadlock class a dead
+participant turns fatal: a worker that crashes (or is ejected by the
+elastic membership layer, docs/RELIABILITY.md) between taking a resource
+and notifying its condition leaves every `Condition.wait()` /
+`Event.wait()` / `Queue.get()` parked FOREVER — no recheck, no recovery,
+a wedged process. The fix shape is a bounded wait in a predicate-recheck
+loop: ``while not pred: cv.wait(timeout=1.0)`` costs one spurious wakeup
+a second and can never park past a lost notify.
+
+- **WTX001** — a ``.wait()`` call with no positional argument and no
+  ``timeout=`` keyword (``Condition``/``Event`` style), or a ``.get()``
+  call with no arguments and no ``timeout=``/``block=False`` on a
+  queue-named receiver (the name contains ``queue``/``inbox`` or is
+  ``q``). ``ContextVar.get()``/``dict.get(key)`` are not flagged: the
+  former's receiver is never queue-named, the latter always has an
+  argument. Deliberate forever-waits (a serve-forever main) carry an
+  inline ``# graftlint: ok(<reason>)`` suppression like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from h2o3_tpu.tools.core import Finding, PackageIndex
+
+#: receiver names that mark a zero-arg ``.get()`` as a blocking queue read
+_QUEUEISH = re.compile(r"(^|_)(q|queue|inbox|mailbox|work_?items?)$",
+                       re.IGNORECASE)
+
+
+def _recv_name(func: ast.Attribute) -> str:
+    """Rightmost name of the receiver expression (``self._cond.wait`` →
+    ``_cond``; ``q.get`` → ``q``)."""
+    v = func.value
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Call):
+        # constructed-inline receiver: threading.Event().wait()
+        f = v.func
+        return (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else "")
+    return ""
+
+
+def _has_kw(node: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in node.keywords)
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        qual_of: dict[int, str] = {}
+        for fn in sorted((f for f in index.functions.values()
+                          if f.module is mod),
+                         key=lambda f: f.node.lineno):
+            for sub in ast.walk(fn.node):
+                qual_of[id(sub)] = fn.qualname
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            if meth == "wait":
+                if node.args or _has_kw(node, "timeout"):
+                    continue
+                findings.append(Finding(
+                    "WTX001", mod.path, node.lineno,
+                    qual_of.get(id(node), ""),
+                    "unbounded `.wait()` — a dead notifier parks this "
+                    "thread forever; wait with a timeout inside a "
+                    "predicate-recheck loop "
+                    "(`while not pred: cv.wait(timeout=...)`)",
+                    detail="unbounded-wait"))
+            elif meth == "get":
+                if node.args or _has_kw(node, "timeout", "block"):
+                    continue
+                if not _QUEUEISH.search(_recv_name(node.func)):
+                    continue
+                findings.append(Finding(
+                    "WTX001", mod.path, node.lineno,
+                    qual_of.get(id(node), ""),
+                    "unbounded `Queue.get()` — a dead producer parks this "
+                    "thread forever; poll with `get(timeout=...)` and "
+                    "recheck the stop condition",
+                    detail="unbounded-queue-get"))
+    return findings

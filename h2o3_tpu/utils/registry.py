@@ -217,16 +217,19 @@ class KeyLocks:
         with self._cond:
             for k, is_write in plan:
                 st = self._entry(k)
+                # bounded waits + predicate recheck (graftlint WTX001): a
+                # notify lost to a dying holder re-polls within a second
+                # instead of wedging every later locker process-wide
                 if is_write:
                     while (st[1] is not None and st[1] != me) or \
                             (st[1] is None and st[0] > 0):
-                        self._cond.wait()
+                        self._cond.wait(timeout=1.0)
                         st = self._entry(k)
                     st[1] = me
                     st[2] += 1
                 else:
                     while st[1] is not None and st[1] != me:
-                        self._cond.wait()
+                        self._cond.wait(timeout=1.0)
                         st = self._entry(k)
                     st[0] += 1
         try:

@@ -509,6 +509,19 @@ COMPUTE_UTILIZATION = METRICS.gauge(
 FAULTS_INJECTED = METRICS.counter(
     "h2o3_faults_injected", "faults injected into dispatches", ("kind",))
 
+# elastic local-SGD membership (parallel/elastic.py — docs/RELIABILITY.md
+# "Elastic training"): averaging rounds completed, workers ejected by cause,
+# and the live-worker gauge the /3/Cloud workers view mirrors
+ELASTIC_ROUNDS = METRICS.counter(
+    "h2o3_elastic_rounds", "elastic local-SGD averaging rounds completed")
+ELASTIC_EJECTIONS = METRICS.counter(
+    "h2o3_elastic_ejections",
+    "elastic workers ejected, by cause "
+    "(heartbeat/deadline/retry_exhausted/fault)", ("reason",))
+ELASTIC_WORKERS = METRICS.gauge(
+    "h2o3_elastic_workers",
+    "live (ACTIVE) workers in the most recent elastic group")
+
 # dispatch reliability (ops/map_reduce.py retrying): one "retried" per
 # backoff-and-reattempt, one "exhausted" when the budget runs out and the
 # dispatch surfaces as DispatchFailed (docs/RELIABILITY.md)

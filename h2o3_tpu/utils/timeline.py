@@ -17,6 +17,8 @@ delegate to ``jax.profiler`` traces (the XLA-native tool); CPU/IO meters read
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import sys
 import threading
 import time
@@ -173,6 +175,30 @@ def io_stats() -> dict:
     return out
 
 
+#: elastic-worker identity for fault scoping: the elastic group's worker
+#: threads run their round work under :func:`worker_scope`, and the injector
+#: consults it so a chaos scenario can make EXACTLY ONE worker straggle or
+#: die (``FaultInjector(worker_rates={1: {...}})``) while its peers run clean
+_WORKER_ID: contextvars.ContextVar["int | str | None"] = \
+    contextvars.ContextVar("h2o3_fault_worker_id", default=None)
+
+
+def current_worker_id() -> "int | str | None":
+    """The elastic worker id bound to this context, or None outside one."""
+    return _WORKER_ID.get()
+
+
+@contextlib.contextmanager
+def worker_scope(worker_id: "int | str"):
+    """Bind an elastic worker id to this thread/task for fault scoping and
+    membership attribution (parallel/elastic.py worker threads)."""
+    token = _WORKER_ID.set(worker_id)
+    try:
+        yield
+    finally:
+        _WORKER_ID.reset(token)
+
+
 class FaultInjector:
     """Random fault injection for the communication substrate (reference:
     the ``-random_udp_drop`` flag ``water/H2O.java:446`` drops UDP packets to
@@ -180,8 +206,10 @@ class FaultInjector:
     (``map_reduce``, the builders' megastep/chunk dispatches) — a random
     delay models a straggler shard, a raised ``FaultInjected`` models a lost
     reduction (absorbed by the dispatch retry loop, docs/RELIABILITY.md),
-    and a ``crash`` is process-fatal (``os._exit``) so auto-recovery resume
-    paths can be exercised end to end.
+    a ``stall`` is a BOUNDED hold on a gate that :meth:`release_stalls` (or
+    the bound) releases — a hung worker, as distinct from ``delay``'s fixed
+    sleep — and a ``crash`` is process-fatal (``os._exit``) so auto-recovery
+    resume paths can be exercised end to end.
 
     ``site_rates`` overrides rates per call site::
 
@@ -191,6 +219,16 @@ class FaultInjector:
     ``after`` skips the first N calls at that site — deterministic
     "fail the second chunk" scenarios for checkpoint-resume tests.
 
+    ``worker_rates`` scopes overrides to ONE elastic worker (keyed by the
+    :func:`worker_scope` id the elastic group binds around its round work)::
+
+        FaultInjector(worker_rates={1: {"stall_rate": 1.0,
+                                        "stall_ms": 30_000, "after": 2}})
+
+    Worker overrides take precedence over site overrides, which take
+    precedence over the global rates; the per-worker ``after``/
+    ``crash_after`` thresholds count that worker's own faultable calls.
+
     Thread-safe: chaos runs under ``windowed_parallel`` hit this from
     concurrent dispatch threads, so the RNG draw and the fault counters
     mutate under one lock (unlocked, concurrent ``random.Random`` calls can
@@ -199,26 +237,50 @@ class FaultInjector:
     def __init__(self, drop_rate: float = 0.0, delay_ms: float = 0.0,
                  delay_rate: float = 0.0, seed: int = 17,
                  crash_rate: float = 0.0, crash_after: int = 0,
-                 site_rates: "dict[str, dict] | None" = None):
+                 stall_ms: float = 0.0, stall_rate: float = 0.0,
+                 site_rates: "dict[str, dict] | None" = None,
+                 worker_rates: "dict | None" = None):
         import random
         self.drop_rate = drop_rate
         self.delay_ms = delay_ms
         self.delay_rate = delay_rate
         self.crash_rate = crash_rate
+        self.stall_ms = stall_ms
+        self.stall_rate = stall_rate
         # crash on the Nth faultable call overall (0 = disabled) — the
         # deterministic kill for resume tests
         self.crash_after = int(crash_after)
         self.site_rates = dict(site_rates or {})
+        self.worker_rates = dict(worker_rates or {})
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        # stall gate: held stalls block on this event up to their bound;
+        # release_stalls() wakes every held worker early (bounded hold that
+        # RELEASES — a stall can never wedge a test past its bound)
+        self._stall_gate = threading.Event()
         self._calls = 0
         self._site_calls: dict[str, int] = {}
+        self._worker_calls: dict = {}
         self.dropped = 0
         self.delayed = 0
         self.crashed = 0
+        self.stalled = 0
 
     def _site(self, what: str, key: str, default):
+        # precedence: worker override > site override > global rate. A
+        # worker block only overrides the keys it names — scoping a fault
+        # to one worker means giving ONLY that worker a nonzero rate (the
+        # globals stay 0, so its peers run clean).
+        wid = current_worker_id()
+        if wid is not None and wid in self.worker_rates:
+            w = self.worker_rates[wid]
+            if key in w:
+                return w[key]
         return self.site_rates.get(what, {}).get(key, default)
+
+    def release_stalls(self) -> None:
+        """Release every held ``stall`` fault immediately (tests/teardown)."""
+        self._stall_gate.set()
 
     def maybe_fault(self, what: str) -> None:
         # injected faults surface as metrics too, so fault-injection runs are
@@ -226,16 +288,26 @@ class FaultInjector:
         # active span (if a trace is open) is marked so fault-injection runs
         # are visible in trace trees
         from h2o3_tpu.utils.telemetry import FAULTS_INJECTED
+        wid = current_worker_id()
         with self._lock:
             self._calls += 1
             calls = self._calls
             site_calls = self._site_calls[what] = \
                 self._site_calls.get(what, 0) + 1
-            armed = site_calls > int(self._site(what, "after", 0))
+            # a worker-scoped `after`/`crash_after` counts THAT worker's own
+            # faultable calls, not the site's (its peers advance the site
+            # counter too, which would make "fail my 2nd call" racy)
+            armed_calls = site_calls
+            if wid is not None and wid in self.worker_rates:
+                armed_calls = self._worker_calls[wid] = \
+                    self._worker_calls.get(wid, 0) + 1
+            armed = armed_calls > int(self._site(what, "after", 0))
             drop_rate = self._site(what, "drop_rate", self.drop_rate)
             delay_rate = self._site(what, "delay_rate", self.delay_rate)
             delay_ms = self._site(what, "delay_ms", self.delay_ms)
             crash_rate = self._site(what, "crash_rate", self.crash_rate)
+            stall_rate = self._site(what, "stall_rate", self.stall_rate)
+            stall_ms = self._site(what, "stall_ms", self.stall_ms)
             # deterministic kills: Nth faultable call overall (crash_after)
             # or Nth call at THIS site (site_rates[what]["crash_after"])
             site_crash_after = int(self._site(what, "crash_after", 0))
@@ -244,15 +316,19 @@ class FaultInjector:
             crash = (
                 bool(self.crash_after and calls >= self.crash_after)
                 or bool(site_crash_after
-                        and site_calls >= site_crash_after)
+                        and armed_calls >= site_crash_after)
                 or (armed and crash_rate > 0 and r < crash_rate))
             drop = (not crash) and armed and drop_rate > 0 and r < drop_rate
-            delay = (not crash and not drop) and armed \
+            stall = (not crash and not drop) and armed \
+                and stall_rate > 0 and r < stall_rate
+            delay = (not crash and not drop and not stall) and armed \
                 and delay_rate > 0 and r2 < delay_rate
             if crash:
                 self.crashed += 1
             elif drop:
                 self.dropped += 1
+            elif stall:
+                self.stalled += 1
         if crash:
             # process-fatal (reference: a kill -9 mid-build, the scenario
             # hex/faulttolerance/Recovery.java exists for). Recorded first so
@@ -268,6 +344,21 @@ class FaultInjector:
             _tracing.TRACER.mark_active(status="error",
                                         fault=f"drop:{what}")
             raise FaultInjected(what)
+        if stall:
+            # bounded hold: the caller hangs on the gate until
+            # release_stalls() fires or the bound elapses — a hung worker
+            # the elastic membership layer must eject, not a fixed sleep
+            # (the gate makes the hold interruptible; the bound makes it
+            # impossible to wedge a run forever)
+            t0 = time.time_ns()
+            self._stall_gate.wait(timeout=stall_ms / 1000.0)
+            dur_ns = time.time_ns() - t0
+            TIMELINE.record("fault", f"stall:{what}", dur_ns)
+            FAULTS_INJECTED.labels(kind="stall").inc()
+            _tracing.TRACER.mark_active(status="stalled",
+                                        fault=f"stall:{what}",
+                                        stall_ns=dur_ns)
+            return
         if delay:
             t0 = time.time_ns()
             time.sleep(delay_ms / 1000.0)
@@ -304,6 +395,9 @@ class inject_faults:
     def __exit__(self, *exc):
         global FAULTS
         FAULTS = None
+        # unstick any worker still held on the stall gate — a finished
+        # chaos scenario must never leave a thread parked on its injector
+        self.injector.release_stalls()
         return False
 
 

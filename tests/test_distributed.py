@@ -1,0 +1,162 @@
+"""parallel/distributed.py single-process fallback paths (ISSUE 12
+satellite): fetch on fully-addressable arrays, process identity, idempotent
+shutdown, the double-init guard, and the retry-wrapped allgather — the
+paths only exercised incidentally by tests/scripts/multiproc_train.py
+before. (The real N-process cloud is covered by test_multiprocess.py.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.parallel import distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_DISPATCH_BACKOFF_MS", "1")
+
+
+@pytest.fixture
+def _reset_init_state(monkeypatch):
+    """Simulate the coordinator-init lifecycle without touching the real
+    jax.distributed runtime (initializing it would wedge the test
+    process waiting for peers)."""
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(dist, "_init_args", None)
+    yield calls
+    dist._initialized = False
+    dist._init_args = None
+
+
+# -- single-process fallbacks -------------------------------------------------
+
+def test_process_identity_single_process():
+    assert dist.process_count() == 1
+    assert dist.process_index() == 0
+    assert not dist.is_multiprocess()
+
+
+def test_fetch_fully_addressable_device_array():
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = dist.fetch(x)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, np.arange(16, dtype=np.float32))
+
+
+def test_fetch_row_sharded_array_single_process():
+    from h2o3_tpu.parallel.mesh import row_sharding
+    x = jax.device_put(np.arange(32, dtype=np.float32), row_sharding())
+    np.testing.assert_array_equal(dist.fetch(x),
+                                  np.arange(32, dtype=np.float32))
+
+
+def test_fetch_non_jax_values_pass_through():
+    np.testing.assert_array_equal(dist.fetch(np.array([1.0, 2.0])),
+                                  [1.0, 2.0])
+    np.testing.assert_array_equal(dist.fetch([3, 4]), [3, 4])
+
+
+def test_barrier_is_noop_single_process():
+    dist.barrier("test")     # must not require a multihost runtime
+
+
+def test_shutdown_idempotent():
+    # never initialized: both calls are no-ops, no raise
+    dist.shutdown_distributed()
+    dist.shutdown_distributed()
+
+
+def test_init_single_process_installs_mesh_only():
+    # all-None args: no coordinator, just (re)install the default mesh
+    dist.init_distributed()
+    from h2o3_tpu.parallel.mesh import global_mesh
+    assert global_mesh().shape["rows"] == len(jax.devices())
+
+
+# -- double-init guard --------------------------------------------------------
+
+def test_reinit_same_coordinator_args_is_idempotent(_reset_init_state):
+    calls = _reset_init_state
+    dist.init_distributed("10.0.0.1:1234", num_processes=2, process_id=0)
+    assert len(calls) == 1 and dist._initialized
+    dist.init_distributed("10.0.0.1:1234", num_processes=2, process_id=0)
+    assert len(calls) == 1               # no second initialize
+
+
+def test_reinit_different_coordinator_args_raises(_reset_init_state):
+    dist.init_distributed("10.0.0.1:1234", num_processes=2, process_id=0)
+    with pytest.raises(RuntimeError, match="different\\s+coordinator"):
+        dist.init_distributed("10.0.0.2:9999", num_processes=4,
+                              process_id=1)
+    # different local device bindings are a different configuration too
+    with pytest.raises(RuntimeError, match="different\\s+coordinator"):
+        dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                              process_id=0, local_device_ids=[2, 3])
+    # the live cloud is untouched by the rejected re-init
+    assert dist._init_args == ("10.0.0.1:1234", 2, 0, None)
+    dist.shutdown_distributed()
+    assert not dist._initialized and dist._init_args is None
+
+
+# -- retry-wrapped allgather --------------------------------------------------
+
+def test_allgather_retries_transient_failures(monkeypatch):
+    """fetch()'s cross-host gather runs under the PR 8 dispatch-retry
+    budget: a transient failure is absorbed, not surfaced (it was the one
+    cross-host dispatch with no retry path)."""
+    from jax.experimental import multihost_utils
+    attempts = []
+
+    def flaky(arr, tiled=True):
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("transient DCN hiccup")
+        return np.asarray(arr)
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", flaky)
+    out = dist._allgather(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_array_equal(out, [1.0, 2.0])
+    assert len(attempts) == 2            # failed once, retried, succeeded
+
+
+def test_allgather_exhaustion_raises_structured(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    from h2o3_tpu.ops.map_reduce import DispatchFailed
+
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "1")
+
+    def dead(arr, tiled=True):
+        raise RuntimeError("link down")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", dead)
+    with pytest.raises(DispatchFailed) as ei:
+        dist._allgather(np.array([1.0], np.float32))
+    assert ei.value.fn == "allgather"
+    assert len(ei.value.history) == 2    # first try + 1 retry
+
+
+def test_allgather_faults_injectable(monkeypatch):
+    """The chaos harness reaches the allgather site like every other
+    dispatch site (site name: 'allgather'): injected drops ride the retry
+    loop and an all-drops run exhausts into DispatchFailed with the
+    FaultInjected attempt history."""
+    from jax.experimental import multihost_utils
+
+    from h2o3_tpu.ops.map_reduce import DispatchFailed
+    from h2o3_tpu.utils.timeline import inject_faults
+
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "2")
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda arr, tiled=True: np.asarray(arr))
+    with inject_faults(site_rates={"allgather": {"drop_rate": 1.0}}) as inj:
+        with pytest.raises(DispatchFailed) as ei:
+            dist._allgather(np.array([7.0], np.float32))
+    assert inj.dropped == 3              # first try + 2 retries, all dropped
+    assert all("FaultInjected" in h["error"] for h in ei.value.history)

@@ -1,0 +1,415 @@
+"""Elastic local-SGD training (ISSUE 12): membership state machine,
+straggler/fault ejection, catch-up joins, quorum, determinism, and the
+REST/telemetry surface (docs/RELIABILITY.md "Elastic training").
+
+The chaos scenarios run at toy scale on the 8-virtual-device cloud; every
+DL config shares one shape (n=512, hidden=[8], B=64, local_steps=1, k=2 slices) so the
+`_train_epochs` megastep compiles once per device slice for the whole
+module."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.deeplearning import DeepLearning
+from h2o3_tpu.models.job import Job
+from h2o3_tpu.parallel import elastic
+from h2o3_tpu.parallel.elastic import (ACTIVE, EJECTED, JOINING, SUSPECT,
+                                       ELASTIC_STATS, ElasticGroup)
+from h2o3_tpu.utils.timeline import (FaultInjected, FaultInjector,
+                                     inject_faults, worker_scope)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_DISPATCH_BACKOFF_MS", "1")
+
+
+@pytest.fixture(autouse=True)
+def _drain_workers():
+    yield
+    # a stall-released worker may still be finishing a discarded dispatch;
+    # never let it bleed into the next test (or interpreter exit)
+    elastic.drain(60.0)
+
+
+def _frame(rng, n=512, key=None):
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    logit = X[:, :2] @ np.array([1.5, -1.0], np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = np.where(rng.random(n) < 1.0 / (1.0 + np.exp(-logit)),
+                         "yes", "no")
+    fr = Frame.from_arrays(cols, key=key)
+    return fr
+
+
+def _train(fr, *, elastic_k, epochs=2, local_steps=1, seed=5, **kw):
+    b = DeepLearning(hidden=[8], epochs=epochs, elastic=elastic_k,
+                     local_steps=local_steps, mini_batch_size=64,
+                     seed=seed, **kw)
+    model = b.train(y="y", training_frame=fr)
+    return model, b
+
+
+def _logloss(model, fr):
+    raw = np.asarray(jax.device_get(model._score_raw(fr)))[: fr.nrows]
+    y = np.asarray(jax.device_get(fr.vec("y").data))[: fr.nrows]
+    p = np.clip(raw[np.arange(len(y)), y.astype(int)], 1e-7, 1.0)
+    return float(-np.log(p).mean())
+
+
+# -- determinism (acceptance: fixed membership reproducibility) --------------
+
+def test_fixed_membership_determinism(rng):
+    fr = _frame(rng)
+    m1, b1 = _train(fr, elastic_k=2)
+    m2, b2 = _train(fr, elastic_k=2)
+    for a, b in zip(jax.tree.leaves(m1.output["params"]),
+                    jax.tree.leaves(m2.output["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the loss series is averaged in wid order too — bit-equal, not close
+    assert m1.output["score_history"] == m2.output["score_history"]
+    el = m1.output["elastic"]
+    assert el["rounds"] == 2 and el["ejections"] == []
+    assert b1.job.workers_ejected == 0
+    assert b1.job.status == Job.DONE
+    # elastic differs from the single-program path by construction (local
+    # SGD averages, SPMD averages per step) — the contract is determinism
+    # at fixed membership, not parity with elastic=0
+
+
+def test_elastic_metrics_and_workers_view(rng):
+    fr = _frame(rng)
+    m, b = _train(fr, elastic_k=2)
+    rows = [r for r in ELASTIC_STATS.rows() if r["group"] == b.job.key]
+    assert {r["worker"] for r in rows} == {0, 1}
+    for r in rows:
+        assert r["state"] == ACTIVE
+        assert r["round"] == m.output["elastic"]["rounds"]
+        assert r["last_heartbeat_ago_ms"] >= 0
+        assert r["devices"] and r["shards"]
+    from h2o3_tpu.utils.telemetry import METRICS
+    names = {m_["name"]: m_ for m_ in METRICS.snapshot()}
+    assert names["h2o3_elastic_rounds_total"]["value"] >= 2
+    assert names["h2o3_elastic_workers"]["value"] >= 0
+
+
+# -- chaos: kill 1 of k mid-epoch (ISSUE acceptance) -------------------------
+
+def test_kill_one_worker_completes_with_ejection(rng, monkeypatch):
+    """Stalling worker 1 dead mid-run must finish the build with
+    workers_ejected=1 (reason: heartbeat), the dead worker's shard
+    reassigned to the survivor, final quality within tolerance of the
+    uninterrupted (k-1)-worker run, and the wall bounded far below the
+    stall — the dead worker degrades throughput instead of stalling the
+    cloud. (The strict slowdown < 1/k gate runs in bench `extra.elastic`
+    on real hardware, where wall clocks mean something.)"""
+    monkeypatch.setenv("H2O3TPU_ELASTIC_ROUND_DEADLINE_SECS", "2.0")
+    monkeypatch.setenv("H2O3TPU_ELASTIC_LEASE_SECS", "1.0")
+    fr = _frame(rng)
+    # uninterrupted k-1 = 1 worker reference
+    ref, _ = _train(fr, elastic_k=1, epochs=3)
+    t0 = time.monotonic()
+    # after=4 = one full round of sub-shard dispatches (n=512, k=2, B=64
+    # → 4 sub-shards/worker): worker 1 stalls on its FIRST round-2
+    # dispatch — round 1 carries the compile-grace deadline by design, so
+    # deadline-clocked kills target round 2+
+    with inject_faults(worker_rates={1: {"stall_rate": 1.0,
+                                         "stall_ms": 60_000,
+                                         "after": 4}}) as inj:
+        m, b = _train(fr, elastic_k=2, epochs=3)
+    wall = time.monotonic() - t0
+    assert inj.stalled == 1
+    assert b.job.status == Job.DONE
+    assert b.job.workers_ejected == 1
+    el = m.output["elastic"]
+    assert el["shards_per_worker"] == 4
+    assert el["ejections_by_reason"] == {"heartbeat": 1}
+    assert el["per_worker"][1]["state"] == EJECTED
+    # shard reassignment: the survivor picked up the dead worker's
+    # sub-shards — full data coverage survives the ejection
+    assert sorted(el["per_worker"][0]["shards"]) == list(range(8))
+    # completed while the stalled worker was still held — killing 1 of k
+    # cost bounded time, nowhere near the 60s stall
+    assert wall < 45.0, f"kill cost {wall:.0f}s — the dead worker stalled us"
+    # quality within tolerance of the uninterrupted k-1-worker run
+    ll_killed, ll_ref = _logloss(m, fr), _logloss(ref, fr)
+    assert ll_killed < max(1.5 * ll_ref, ll_ref + 0.1), \
+        f"killed-run logloss {ll_killed:.3f} vs k-1 ref {ll_ref:.3f}"
+    # the JobV3 surface carries the membership decay
+    from h2o3_tpu.api import schemas
+    jv = schemas.job_v3(b.job.key, b.job)
+    assert jv["workers_ejected"] == 1
+
+
+def test_retry_exhaustion_ejects_worker_not_build(rng, monkeypatch):
+    """An exhausted dispatch-retry budget inside a worker's round is a
+    MEMBERSHIP event (ops/map_reduce.ejection_scope): the worker ejects
+    with reason retry_exhausted and the build completes on the survivor —
+    not a FAILED job (the pre-elastic behavior)."""
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "1")
+    fr = _frame(rng)
+    with inject_faults(worker_rates={0: {"drop_rate": 1.0, "after": 1}}):
+        m, b = _train(fr, elastic_k=2, epochs=3)
+    assert b.job.status == Job.DONE
+    assert b.job.workers_ejected == 1
+    el = m.output["elastic"]
+    assert el["ejections_by_reason"] == {"retry_exhausted": 1}
+    assert el["ejections"][0]["worker"] == 0
+    assert "DispatchFailed" in el["ejections"][0]["error"]
+    # the map_reduce ejection hook recorded WHICH dispatch site burned
+    # the budget — known at the site even if the exception gets wrapped
+    assert el["ejections"][0]["site"] == "dl_epochs"
+    assert sorted(el["per_worker"][1]["shards"]) == list(range(8))
+
+
+def test_quorum_loss_cancels_with_partial(rng, monkeypatch):
+    """Live workers below H2O3TPU_ELASTIC_MIN_WORKERS cancel the build
+    through the Job.keep_partial path: the job reads CANCELLED and the
+    last averaged model IS the partial result."""
+    monkeypatch.setenv("H2O3TPU_ELASTIC_MIN_WORKERS", "2")
+    monkeypatch.setenv("H2O3TPU_DISPATCH_RETRIES", "1")
+    fr = _frame(rng)
+    with inject_faults(worker_rates={0: {"drop_rate": 1.0, "after": 1}}):
+        m, b = _train(fr, elastic_k=2, epochs=3)
+    assert b.job.status == Job.CANCELLED
+    assert b.job.workers_ejected == 1
+    assert m is not None and m.output["elastic"]["rounds"] >= 1
+    assert m.predict(fr).nrows == fr.nrows     # the partial model scores
+
+
+# -- group-level state machine ----------------------------------------------
+
+def _quick_group(k=3, **kw):
+    kw.setdefault("round_deadline_secs", 0.5)
+    kw.setdefault("lease_secs", 10.0)
+    g = ElasticGroup(k, scheduler=None, **kw).start()
+    # round 1 carries the compile-grace deadline by design; deadline
+    # behavior under test starts at round 2
+    g.run_round(1, {w: (lambda w=w: w) for w in g.live_workers()})
+    return g
+
+
+def test_straggler_suspect_then_catch_up_join():
+    """A worker that blows the round deadline but keeps heartbeating goes
+    SUSPECT; its late result is DISCARDED and it re-enters as a catch-up
+    join, ACTIVE again at the next boundary."""
+    g = _quick_group()
+    try:
+        slow_release = threading.Event()
+
+        def slow():
+            # straggle past the deadline, heartbeating all the way
+            for _ in range(40):
+                if slow_release.wait(timeout=0.05):
+                    break
+                g.heartbeat(2)
+            return "late"
+
+        r2 = g.run_round(2, {0: lambda: "a", 1: lambda: "b", 2: slow})
+        assert set(r2) == {0, 1}               # slow missed the boundary
+        assert g.membership()[2] == SUSPECT
+        slow_release.set()
+        # the late post lands, flips it to JOINING (result discarded)
+        deadline = time.monotonic() + 5.0
+        while g.membership()[2] != JOINING and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert g.membership()[2] == JOINING
+        r3 = g.run_round(3, {0: lambda: "a", 1: lambda: "b"})
+        assert set(r3) == {0, 1}
+        assert g.membership()[2] == ACTIVE     # admitted at the boundary
+        r4 = g.run_round(4, {w: (lambda w=w: w) for w in g.live_workers()})
+        assert set(r4) == {0, 1, 2}
+    finally:
+        g.shutdown()
+
+
+def test_oscillating_straggler_ejected_on_second_strike():
+    """A worker slow enough to miss deadlines but fast enough to post late
+    each time (miss → late-post → rejoin → miss) must not cycle forever:
+    the strike counter survives the catch-up join, and the second
+    consecutive deadline miss ejects it (docs: blows the deadline twice)."""
+    g = _quick_group()
+    try:
+        def slow_once(release):
+            def thunk():
+                release.wait(timeout=1.2)      # ~2.4x the 0.5s deadline
+                return "late"
+            return thunk
+
+        r2_gate = threading.Event()
+        g.run_round(2, {0: lambda: "a", 1: slow_once(r2_gate),
+                        2: lambda: "c"})
+        assert g.membership()[1] == SUSPECT    # strike 1
+        deadline = time.monotonic() + 5.0
+        while g.membership()[1] != JOINING and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert g.membership()[1] == JOINING    # late post, catch-up join
+        g.run_round(3, {0: lambda: "a", 2: lambda: "c"})
+        assert g.membership()[1] == ACTIVE     # admitted — but on notice
+        r4_gate = threading.Event()
+        g.run_round(4, {0: lambda: "a", 1: slow_once(r4_gate),
+                        2: lambda: "c"})
+        # second consecutive miss: ejected outright, no oscillation
+        assert g.membership()[1] == EJECTED
+        assert g.ejections[0]["reason"] == "deadline"
+    finally:
+        g.shutdown()
+
+
+def test_chronic_straggler_ejected_on_second_boundary():
+    """SUSPECT + still missing at the NEXT boundary (lease fresh) ejects
+    with reason `deadline` — one grace round, then membership moves on."""
+    g = _quick_group()
+    try:
+        hold = threading.Event()
+
+        def stuck():
+            while not hold.wait(timeout=0.05):
+                g.heartbeat(1)                  # alive, just way too slow
+            return "way late"
+
+        g.run_round(2, {0: lambda: "a", 1: stuck, 2: lambda: "c"})
+        assert g.membership()[1] == SUSPECT
+        g.run_round(3, {0: lambda: "a", 2: lambda: "c"})
+        assert g.membership()[1] == EJECTED
+        assert g.ejections[0]["reason"] == "deadline"
+        # its shard was reassigned to a survivor
+        owned = [s for w in (0, 2) for s in g.owned_shards(w)]
+        assert sorted(owned) == [0, 1, 2]
+    finally:
+        hold.set()
+        g.shutdown()
+
+
+def test_dead_worker_ejected_by_heartbeat_lease():
+    g = _quick_group(lease_secs=0.2)
+    try:
+        hold = threading.Event()
+        g.run_round(2, {0: lambda: "a",
+                        1: lambda: hold.wait(timeout=30) or "dead",
+                        2: lambda: "c"})
+        # silent past the 0.2s lease at a 0.5s deadline: gone immediately
+        assert g.membership()[1] == EJECTED
+        assert g.ejections[0]["reason"] == "heartbeat"
+    finally:
+        hold.set()
+        g.shutdown()
+
+
+def test_explicit_leave_and_rejoin_gets_shard_back():
+    """eject() models a worker LEAVING; request_join() re-admits it at the
+    next boundary with a shard stolen back from the most-loaded survivor
+    (the catch-up clone is by construction: every round starts from the
+    broadcast average)."""
+    g = _quick_group()
+    try:
+        g.eject(2, reason="left")
+        assert g.membership()[2] == EJECTED
+        g.run_round(2, {w: (lambda w=w: w) for w in g.live_workers()})
+        assert sorted(s for w in (0, 1) for s in g.owned_shards(w)) \
+            == [0, 1, 2]
+        g.request_join(2)
+        assert g.membership()[2] == JOINING
+        g.run_round(3, {w: (lambda w=w: w) for w in g.live_workers()})
+        assert g.membership()[2] == ACTIVE
+        assert len(g.owned_shards(2)) == 1     # stolen back from a donor
+        assert sorted(s for w in (0, 1, 2) for s in g.owned_shards(w)) \
+            == [0, 1, 2]
+    finally:
+        g.shutdown()
+
+
+def test_summary_and_stats_rows_shape():
+    g = _quick_group(k=2)
+    try:
+        g.run_round(2, {0: lambda: 1, 1: lambda: 2})
+        s = g.summary()
+        assert s["workers"] == 2 and s["live"] == 2 and s["rounds"] == 2
+        rows = [r for r in ELASTIC_STATS.rows() if r["group"] == g.group_id]
+        assert {r["worker"] for r in rows} == {0, 1}
+        assert all(r["state"] == ACTIVE for r in rows)
+    finally:
+        g.shutdown()
+
+
+# -- chaos harness satellites ------------------------------------------------
+
+def test_stall_fault_is_bounded_and_releasable():
+    inj = FaultInjector(stall_rate=1.0, stall_ms=30_000)
+    done = threading.Event()
+
+    def victim():
+        inj.maybe_fault("site")
+        done.set()
+
+    t = threading.Thread(target=victim, daemon=True)
+    t0 = time.monotonic()
+    t.start()
+    time.sleep(0.1)
+    assert not done.is_set()                   # held on the gate
+    inj.release_stalls()                       # bounded hold that RELEASES
+    assert done.wait(timeout=5.0)
+    assert time.monotonic() - t0 < 5.0
+    assert inj.stalled == 1 and inj.delayed == 0
+
+
+def test_worker_scoped_faults_hit_exactly_one_worker():
+    inj = FaultInjector(worker_rates={1: {"drop_rate": 1.0}})
+    with worker_scope(0):
+        inj.maybe_fault("dl_epochs")           # peer runs clean
+    with worker_scope(1):
+        with pytest.raises(FaultInjected):
+            inj.maybe_fault("dl_epochs")
+    inj.maybe_fault("dl_epochs")               # unscoped context runs clean
+    assert inj.dropped == 1
+
+
+def test_worker_scoped_after_counts_that_workers_calls():
+    inj = FaultInjector(worker_rates={1: {"drop_rate": 1.0, "after": 2}})
+    with worker_scope(0):
+        for _ in range(5):
+            inj.maybe_fault("dl_epochs")       # advances only site counter
+    with worker_scope(1):
+        inj.maybe_fault("dl_epochs")           # worker call 1: armed=False
+        inj.maybe_fault("dl_epochs")           # worker call 2: armed=False
+        with pytest.raises(FaultInjected):
+            inj.maybe_fault("dl_epochs")       # worker call 3: fires
+
+
+# -- REST / clients ----------------------------------------------------------
+
+def test_rest_elastic_build_and_workers_view(rng):
+    from h2o3_tpu.api.client import H2OClient
+    from h2o3_tpu.api.server import H2OServer
+    from h2o3_tpu.utils.registry import DKV
+
+    fr = _frame(rng, key="elastic_rest_fr")
+    DKV.put(fr.key, fr)
+    s = H2OServer(port=0).start()
+    try:
+        c = H2OClient(s.url)
+        model = c.train("deeplearning", "elastic_rest_fr", y="y",
+                        hidden=[8], epochs=2, elastic=2, local_steps=1,
+                        mini_batch_size=64, seed=5)
+        assert model["algo"] == "deeplearning"
+        # /3/Cloud workers membership view round-trips through the client
+        rows = c.workers()
+        assert rows and {"worker", "group", "state", "round",
+                         "last_heartbeat_ago_ms"} <= set(rows[0])
+        assert any(r["state"] == ACTIVE for r in rows)
+        # JobV3 carries workers_ejected (0 on a clean run)
+        jobs = c.jobs()
+        dl = [j for j in jobs if "deeplearning" in j["description"]]
+        assert all(j["workers_ejected"] == 0 for j in dl)
+        # the elastic metrics are live on /metrics
+        text = c.metrics_text()
+        assert "h2o3_elastic_rounds_total" in text
+        assert "h2o3_elastic_workers" in text
+    finally:
+        s.stop()

@@ -580,6 +580,83 @@ def test_rty002_recording_and_skip_patterns_clean(tmp_path):
     assert "RTY002" not in rules_of(run_lint(pkg))
 
 
+# -- wait discipline ---------------------------------------------------------
+
+def test_wtx001_unbounded_waits_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._queue = queue.Queue()
+                self.free = []
+
+            def take(self):
+                with self._cond:
+                    while not self.free:
+                        self._cond.wait()          # unbounded: dead notifier
+                    return self.free.pop()
+
+            def drain(self):
+                return self._queue.get()           # unbounded queue read
+
+            def park(self):
+                threading.Event().wait()           # unbounded event wait
+    """})
+    wtx = [f for f in run_lint(pkg) if f.rule == "WTX001"]
+    assert len(wtx) == 3
+    assert {f.detail for f in wtx} == {"unbounded-wait",
+                                       "unbounded-queue-get"}
+
+
+def test_wtx001_bounded_and_nonqueue_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import contextvars
+        import queue
+        import threading
+
+        _CV = contextvars.ContextVar("x", default=None)
+
+        class Pool:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._inbox = queue.Queue()
+                self.free = []
+
+            def take(self):
+                with self._cond:
+                    # bounded wait + predicate recheck: the fixed shape
+                    while not self.free:
+                        self._cond.wait(timeout=1.0)
+                    return self.free.pop()
+
+            def drain(self):
+                return self._inbox.get(timeout=0.25)
+
+            def peek(self, d):
+                # dict.get has an argument; ContextVar.get is not a queue
+                return d.get("k"), _CV.get()
+
+            def join_worker(self, t):
+                t.join()          # join() is not wait()/get()
+    """})
+    assert "WTX001" not in rules_of(run_lint(pkg))
+
+
+def test_wtx001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        def serve_forever():
+            # graftlint: ok(serve forever - blocking IS the job)
+            threading.Event().wait()
+    """})
+    assert "WTX001" not in rules_of(run_lint(pkg))
+
+
+
 # -- profiling attribution (PRF) ---------------------------------------------
 
 def test_prf001_anonymous_jit_flagged(tmp_path):
@@ -771,6 +848,24 @@ def test_package_has_no_prf001_findings(live_findings):
     on stable names to credit compiles, FLOPs, and profiler events to
     sites, so anonymous jits don't get grandfathered into the baseline."""
     hits = [f for f in live_findings if f.rule == "PRF001"]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_package_has_no_wtx001_findings(live_findings):
+    """Every thread-coordination wait in the live package is bounded: zero
+    WTX001 findings, baselined or not — the elastic membership layer
+    (ISSUE 12) makes dead workers an EXPECTED event, so an unbounded wait
+    anywhere is a deadlock waiting for one; the five pre-existing sites
+    were fixed with timeout+recheck loops, not grandfathered."""
+    hits = [f for f in live_findings if f.rule == "WTX001"]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_elastic_module_scans_clean(live_findings):
+    """The new membership layer ships lint-clean across every rule family
+    (ISSUE 12 acceptance: graftlint scans the new module clean)."""
+    hits = [f for f in live_findings
+            if f.path in ("parallel/elastic.py", "tools/waits.py")]
     assert hits == [], "\n".join(f.render() for f in hits)
 
 

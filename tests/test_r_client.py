@@ -143,7 +143,8 @@ def test_r_package_sources_complete():
     for fn in ("h2o.init", "h2o.connect", "h2o.importFile", "h2o.gbm",
                "h2o.glm", "h2o.predict", "h2o.performance", "h2o.splitFrame",
                "h2o.auc", "h2o.removeAll", "h2o.compute",
-               "h2o.profilerCapture", "h2o.profilerCaptures"):
+               "h2o.profilerCapture", "h2o.profilerCaptures",
+               "h2o.workers"):
         assert f"export({fn})" in ns, fn
         assert f"{fn} <- function" in code, fn
 
