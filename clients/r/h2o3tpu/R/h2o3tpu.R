@@ -545,11 +545,15 @@ h2o.predict <- function(object, newdata) {
   as.character(x)
 }
 
-h2o.score <- function(object, rows, columns = NULL) {
+h2o.score <- function(object, rows, columns = NULL, priority = NULL,
+                      slo_ms = NULL) {
   # request-sized scoring through the compiled, batched serving tier:
   # `rows` is a data.frame or a list of named lists; no DKV frame
-  # round-trip. Returns the ScoreV3 payload (predictions column lists +
-  # the batch shape the request rode in).
+  # round-trip. `priority` (0-9, default 5) orders shedding under
+  # overload (low priority is turned away first with 503+Retry-After);
+  # `slo_ms` overrides the model's latency target at admit. Returns the
+  # ScoreV3 payload (predictions column lists + the batch shape the
+  # request rode in + the serving replica when a pool is routing).
   model_id <- if (is.list(object) && !is.null(object$model_id)) object$model_id else object
   if (is.data.frame(rows)) {
     columns <- names(rows)
@@ -560,11 +564,16 @@ h2o.score <- function(object, rows, columns = NULL) {
   }
   body <- list(rows = .json_write(rows))
   if (!is.null(columns)) body$columns <- .json_write(as.character(columns))
+  if (!is.null(priority)) body$priority <- as.integer(priority)
+  if (!is.null(slo_ms)) body$slo_ms <- as.numeric(slo_ms)
   .http("POST", paste0("/3/Score/", model_id), body)
 }
 
 h2o.serving <- function() {
-  # scoring-tier residency + compiled-scorer cache counters (GET /3/Score)
+  # scoring-tier state (GET /3/Score): residency + compiled-scorer cache
+  # counters, per-model SLO controller state (target/window/p50/p99),
+  # shed accounting by reason/priority, and the replica-pool view
+  # (slice leases, per-replica busy/queue-wait, scale events)
   .http("GET", "/3/Score")
 }
 

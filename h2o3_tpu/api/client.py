@@ -142,20 +142,30 @@ class H2OClient:
                            f"/3/Predictions/models/{model_key}/frames/{frame_key}")
         return out["predictions_frame"]["name"]
 
-    def score(self, model_key: str, rows: list, columns: list | None = None) -> dict:
+    def score(self, model_key: str, rows: list, columns: list | None = None,
+              priority: int | None = None,
+              slo_ms: float | None = None) -> dict:
         """Request-sized scoring through the batched serving tier
         (``POST /3/Score/{model}``): ``rows`` is a list of dicts (column-
-        keyed) or a list of lists ordered by ``columns``. Returns the
-        ScoreV3 payload — ``predictions`` column lists plus the batch
-        shape this request rode in (docs/SERVING.md)."""
+        keyed) or a list of lists ordered by ``columns``. ``priority``
+        (0-9, default 5) orders shedding under overload — low priority is
+        turned away first with 503+Retry-After; ``slo_ms`` overrides the
+        model's latency target at admit. Returns the ScoreV3 payload —
+        ``predictions`` column lists plus the batch shape this request
+        rode in (docs/SERVING.md)."""
         d: dict = {"rows": rows}
         if columns:
             d["columns"] = list(columns)
+        if priority is not None:
+            d["priority"] = int(priority)
+        if slo_ms is not None:
+            d["slo_ms"] = float(slo_ms)
         return self.request("POST", f"/3/Score/{model_key}", d)
 
     def serving(self) -> dict:
-        """Scoring-tier residency + compiled-scorer cache counters
-        (``GET /3/Score``)."""
+        """Scoring-tier state (``GET /3/Score``): residency +
+        compiled-scorer cache counters, per-model SLO controller state,
+        shed accounting by reason/priority, and the replica-pool view."""
         return self.request("GET", "/3/Score")
 
     def serving_evict(self, model_key: str) -> bool:

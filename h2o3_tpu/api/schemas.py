@@ -487,14 +487,19 @@ def score_v3(payload: dict) -> dict:
     """``POST /3/Score/{model}`` — batched request-sized predictions:
     ``predictions`` maps output columns (``predict``, ``p{level}``) to
     value lists; ``batch_rows``/``batch_requests`` report how the
-    micro-batcher fused this request (docs/SERVING.md)."""
+    micro-batcher fused this request; ``priority`` echoes the request's
+    shedding class and ``replica`` names the serving replica when a pool
+    is routing (docs/SERVING.md)."""
     return {**_meta("ScoreV3"), **_clean(payload)}
 
 
 def serving_v3(stats: dict) -> dict:
     """``GET /3/Score`` — scoring-tier state: resident models with
-    artifact bytes + request counts, residency budget, eviction count,
-    compiled-signature cache hit/miss counters, memory watermarks."""
+    artifact bytes + request counts + per-model ``slo`` controller state
+    (target/window/p50/p99), residency budget, eviction count,
+    compiled-signature cache hit/miss counters, ``shed`` accounting by
+    reason/priority, the ``replicas`` pool view (slice leases, busy and
+    queue-wait seconds, scale events), memory watermarks."""
     return {**_meta("ServingV3"), **_clean(stats)}
 
 

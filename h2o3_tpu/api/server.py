@@ -785,7 +785,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"rows is not valid JSON: {e}")
             return
         try:
-            out = SCORING.score(model_key, rows, columns)
+            # SLO layer (docs/SERVING.md "SLO & replicas"): priority
+            # orders shedding under overload; slo_ms overrides the
+            # model's latency target at admit — their coercion errors
+            # must name the FIELD, not blame the rows payload
+            priority = p.get("priority")
+            if priority is not None:
+                priority = int(priority)
+            slo_ms = p.get("slo_ms")
+            if slo_ms is not None:
+                slo_ms = float(slo_ms)
+        except (ValueError, TypeError) as e:
+            self._error(400, f"priority/slo_ms is not numeric: {e}")
+            return
+        try:
+            out = SCORING.score(model_key, rows, columns,
+                                priority=priority, slo_ms=slo_ms)
         except ServiceUnavailable as e:
             retry_s = max(1, int(round(e.retry_after_ms / 1000.0)))
             self._error(503, str(e), headers={
@@ -799,8 +814,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def r_score_stats(self):
         """``GET /3/Score`` — scoring-tier residency and cache counters:
-        resident models (bytes/requests/idle), budget, evictions, compiled-
-        signature hit/miss counts, memory watermarks."""
+        resident models (bytes/requests/idle + per-model SLO controller
+        state), budget, evictions, compiled-signature hit/miss counts,
+        shed accounting by reason/priority, the replica-pool view
+        (slice leases, per-replica busy/queue-wait, scale events), and
+        memory watermarks."""
         from h2o3_tpu.serving import SCORING
         self._reply(schemas.serving_v3(SCORING.stats()))
 

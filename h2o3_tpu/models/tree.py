@@ -133,16 +133,18 @@ def _level_histograms_fused(binned, node_local, g, h, w, n_nodes: int,
 
 def _histograms(binned, binned_T, node_local, g, h, w, n_nodes: int,
                 n_bins_tot: int, mesh=None):
-    """Dispatch: Pallas MXU kernel on TPU (≈4× the XLA scatter path inside the
-    fused tree program), one fused-collective shard_map reduction on a
-    multi-device mesh, segment_sum elsewhere / beyond the kernel's VMEM
-    envelope."""
+    """Dispatch: one fused-collective shard_map reduction on a multi-device
+    mesh FIRST — the Pallas kernel is single-device and running it over the
+    global array would skip the per-level ``psum`` entirely (each shard's
+    partial histogram would be treated as the total) — then the Pallas MXU
+    kernel on TPU (≈4× the XLA scatter path inside the fused tree program),
+    then segment_sum elsewhere / beyond the kernel's VMEM envelope."""
     from h2o3_tpu.ops.pallas_hist import hist_pallas, pallas_available
-    if pallas_available(n_nodes, binned.shape[1], n_bins_tot):
-        return hist_pallas(binned_T, node_local, g, h, w, n_nodes, n_bins_tot)
     if mesh is not None:
         return _level_histograms_fused(binned, node_local, g, h, w, n_nodes,
                                        n_bins_tot, mesh)
+    if pallas_available(n_nodes, binned.shape[1], n_bins_tot):
+        return hist_pallas(binned_T, node_local, g, h, w, n_nodes, n_bins_tot)
     return _level_histograms(binned, node_local, g, h, w, n_nodes, n_bins_tot)
 
 

@@ -256,6 +256,17 @@ class MeshScheduler:
             return True
         return rows is not None and int(rows) < small_rows_threshold()
 
+    def free_count(self) -> int:
+        """Slices currently unleased on this scheduler's layout. The
+        degenerate (<=1 slice) layout never leases, so it is always
+        "fully free". Introspection only — serving-replica tests pin that
+        lifetime leases (serving/replicas.py, parallel/elastic.py) release
+        cleanly on stop/shutdown instead of leaking slices."""
+        if self.n <= 1:
+            return self.n
+        with self._state.cv:
+            return len(self._state.free)
+
     # -- leasing -------------------------------------------------------------
 
     @contextlib.contextmanager

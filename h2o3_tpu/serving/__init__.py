@@ -8,10 +8,13 @@ is byte-accounted with LRU eviction under a budget.
 """
 
 from h2o3_tpu.serving.batcher import ModelBatcher
+from h2o3_tpu.serving.replicas import ReplicaPool, ScoringReplica
 from h2o3_tpu.serving.schema import NotServable, ServingSchema, serving_schema
 from h2o3_tpu.serving.scorer import CompiledScorer, ScorerCache, bucket_for
 from h2o3_tpu.serving.service import SCORING, ScoringService, ServiceUnavailable
+from h2o3_tpu.serving.slo import Shed, SLOController, clamp_priority
 
 __all__ = ["SCORING", "ScoringService", "ServiceUnavailable", "ScorerCache",
            "CompiledScorer", "ModelBatcher", "ServingSchema", "NotServable",
-           "serving_schema", "bucket_for"]
+           "serving_schema", "bucket_for", "SLOController", "Shed",
+           "clamp_priority", "ReplicaPool", "ScoringReplica"]

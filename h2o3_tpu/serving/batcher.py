@@ -8,10 +8,23 @@ slice. Dispatch overhead (host→device transfer, executable launch, the
 of once per request — p50 moves by at most the window, throughput
 multiplies under load.
 
-The window is env-tunable (``H2O3TPU_SCORE_WINDOW_MS``, default 1 ms) and
-closes EARLY when the queued rows fill the largest batch bucket — a full
-bucket gains nothing by waiting. One daemon worker thread per resident
-model owns its queue; eviction stops the thread.
+The window: with no SLO configured it is the fixed
+``H2O3TPU_SCORE_WINDOW_MS`` (default 1 ms) — resolved at batcher
+CONSTRUCTION, not at module import, so late env changes and test
+monkeypatching take effect (the graftlint ENV001 bug class). With an SLO
+target set (``H2O3TPU_SCORE_SLO_MS`` / per-request ``slo_ms``) each
+batch's window comes from the model's :class:`~h2o3_tpu.serving.slo.
+SLOController` feedback loop instead — widened when queue depth grows,
+narrowed when p99 headroom exists (docs/SERVING.md "SLO & replicas").
+Either way the window closes EARLY when the queued rows fill the largest
+batch bucket — a full bucket gains nothing by waiting. One daemon worker
+thread per (model, replica) seat owns its queue; eviction stops the
+thread.
+
+Admission shedding rides here too: ``submit()`` asks the controller to
+:meth:`~h2o3_tpu.serving.slo.SLOController.admit` BEFORE enqueueing, so
+overload turns into an early 503 (``Shed``) instead of a timeout burned
+inside the queue.
 
 Tracing: the batch leader's request context is captured at enqueue, and
 the worker adopts it — ``score:batch`` (rows/requests/bucket attrs) →
@@ -30,15 +43,12 @@ import time
 import numpy as np
 
 from h2o3_tpu.serving.scorer import MAX_BUCKET, bucket_for
+from h2o3_tpu.serving.slo import window_s_from_env
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils import tracing as _tr
 
-#: accumulation window (seconds) — how long the first request of a batch
-#: waits for company before dispatching
-WINDOW_S = float(os.environ.get("H2O3TPU_SCORE_WINDOW_MS", "1.0")) / 1e3
-
 #: a caller never blocks longer than this on its slice (seconds)
-SCORE_TIMEOUT_S = float(os.environ.get("H2O3TPU_SCORE_TIMEOUT_S", "30"))
+SCORE_TIMEOUT_S = float(os.environ.get("H2O3TPU_SCORE_TIMEOUT_S", "30"))  # graftlint: ok(ENV001 - tests monkeypatch this module attr; construction-time resolution would strand them)
 
 
 class Evicted(RuntimeError):
@@ -51,9 +61,11 @@ class _Pending:
     """One request's seat in the batch: inputs, completion event, slice."""
 
     __slots__ = ("num", "cat", "n", "event", "result", "error", "ctx",
-                 "batch_rows", "batch_requests")
+                 "batch_rows", "batch_requests", "priority", "t_enq",
+                 "queue_wait_s")
 
-    def __init__(self, num: np.ndarray, cat: np.ndarray, n: int, ctx):
+    def __init__(self, num: np.ndarray, cat: np.ndarray, n: int, ctx,
+                 priority: int = 5):
         self.num = num
         self.cat = cat
         self.n = n
@@ -63,34 +75,61 @@ class _Pending:
         self.ctx = ctx               # leader's captured trace context (or None)
         self.batch_rows = 0
         self.batch_requests = 0
+        self.priority = priority
+        self.t_enq = time.monotonic()
+        self.queue_wait_s: float | None = None
 
 
 class ModelBatcher:
-    """Per-model request queue + dispatch worker."""
+    """Per-(model, replica) request queue + dispatch worker.
 
-    def __init__(self, entry, window_s: float = WINDOW_S):
+    ``cache`` defaults to the entry's shared :class:`ScorerCache`; a
+    replica seat passes its own so compiled executables live with the
+    replica's slice lease. ``replica`` (a
+    :class:`~h2o3_tpu.serving.replicas.ScoringReplica`) makes dispatches
+    bind the replica's mesh and feeds its utilization accounting.
+    """
+
+    def __init__(self, entry, window_s: float | None = None, cache=None,
+                 replica=None):
         self._entry = entry          # serving/service.py _Resident
-        self._window = window_s
+        # resolved at CONSTRUCTION (not import): late env changes and
+        # monkeypatch.setenv are honored — and the SLO controller derives
+        # its base window through the same seam
+        self._window = float(window_s) if window_s is not None \
+            else window_s_from_env()
+        self._cache = cache if cache is not None else entry.cache
+        self._replica = replica
+        label = f"score-{entry.key}" if replica is None \
+            else f"score-{entry.key}@{replica.label}"
         self._cond = threading.Condition()
         self._queue: list[_Pending] = []
         self._stopped = False
         self._dispatching = False    # a drained batch is on the device
-        self._thread = threading.Thread(
-            target=self._run, name=f"score-{entry.key}", daemon=True)
+        self._thread = threading.Thread(target=self._run, name=label,
+                                        daemon=True)
         self._thread.start()
 
     # -- caller side ---------------------------------------------------------
 
-    def submit(self, num: np.ndarray, cat: np.ndarray, n: int) -> _Pending:
+    def submit(self, num: np.ndarray, cat: np.ndarray, n: int,
+               priority: int = 5) -> _Pending:
         """Enqueue ``n`` rows; blocks until the batch containing them has
-        dispatched and this request's slice is ready (or raises)."""
+        dispatched and this request's slice is ready (or raises). With an
+        SLO set, overload sheds HERE (:class:`~h2o3_tpu.serving.slo.Shed`)
+        — before the rows ever enter the queue."""
+        slo = getattr(self._entry, "slo", None)
         with self._cond:
             if self._stopped:
                 raise Evicted(f"model {self._entry.key!r} was evicted")
+            if slo is not None:
+                # sheds by raising — the queue is untouched, the caller
+                # gets a 503 + Retry-After in microseconds, not a timeout
+                slo.admit(priority, sum(p.n for p in self._queue), n)
             # the request opening a fresh batch is its leader: capture the
             # REST root context so the batch/dispatch spans land in a trace
             ctx = _tr.TRACER.capture() if not self._queue else None
-            p = _Pending(num, cat, n, ctx)
+            p = _Pending(num, cat, n, ctx, priority=priority)
             self._queue.append(p)
             self._cond.notify_all()
         if not p.event.wait(SCORE_TIMEOUT_S):
@@ -107,6 +146,11 @@ class ModelBatcher:
                     _tr.TRACER.release(p.ctx)
                     p.ctx = None
                 self._cond.notify_all()    # let the worker re-arm now
+            # an eviction may have raced the timeout: stop() already failed
+            # this pending with Evicted — surface THAT (a retryable
+            # residency loss), not a timeout blamed on the device
+            if p.error is not None and isinstance(p.error, Evicted):
+                raise p.error
             raise TimeoutError(
                 f"scoring {self._entry.key!r} timed out after "
                 f"{SCORE_TIMEOUT_S:.0f}s "
@@ -154,6 +198,15 @@ class ModelBatcher:
                 with self._cond:
                     self._dispatching = False
 
+    def _collect_window_s(self, queued_rows: int) -> float:
+        """This batch's accumulation window: the SLO controller's when a
+        target is set (one control-law step per batch), else the fixed
+        construction-time window — bit-identical PR 6 behavior."""
+        slo = getattr(self._entry, "slo", None)
+        if slo is not None and slo.active:
+            return slo.window_s(queued_rows)
+        return self._window
+
     def _collect(self) -> "list[_Pending] | None":
         """Block for the first request, then hold the accumulation window
         (early-out on a full max bucket), then drain the queue."""
@@ -166,7 +219,8 @@ class ModelBatcher:
                     self._cond.wait(timeout=1.0)
                 if self._stopped:
                     return None
-                deadline = time.monotonic() + self._window
+                window = self._collect_window_s(sum(p.n for p in self._queue))
+                deadline = time.monotonic() + window
                 while self._queue:
                     rows = sum(p.n for p in self._queue)
                     left = deadline - time.monotonic()
@@ -183,6 +237,9 @@ class ModelBatcher:
     def _dispatch(self, batch: list[_Pending]) -> None:
         entry = self._entry
         total = sum(p.n for p in batch)
+        t_start = time.monotonic()
+        for p in batch:
+            p.queue_wait_s = max(t_start - p.t_enq, 0.0)
         leader_ctx = next((p.ctx for p in batch if p.ctx is not None), None)
         try:
             with _tr.TRACER.adopt(leader_ctx, "score:batch", kind="serving",
@@ -196,6 +253,13 @@ class ModelBatcher:
                     p.ctx = None     # adopt() released the retention already
                 self._fail(p, e)
             return
+        wall = time.monotonic() - t_start
+        slo = getattr(entry, "slo", None)
+        if slo is not None:
+            slo.record_dispatch(wall, total)
+        if self._replica is not None:
+            self._replica.record_dispatch(
+                wall, total, max(p.queue_wait_s or 0.0 for p in batch))
         _tm.SCORE_BATCH_SIZE.observe(total)
         _tm.SCORE_BATCH_REQUESTS.observe(len(batch))
         for p, res in zip(batch, results):
@@ -208,31 +272,41 @@ class ModelBatcher:
     def _score_slices(self, batch: list[_Pending], total: int,
                       bspan) -> list[np.ndarray]:
         """Fuse the batch into bucket-padded arrays, dispatch (slicing into
-        max-bucket chunks when oversized), hand each request its rows."""
+        max-bucket chunks when oversized), hand each request its rows. A
+        replica seat binds its slice mesh around compile + dispatch so the
+        executables live (and rendezvous) on the replica's devices."""
+        import contextlib
+
         entry = self._entry
         num = np.concatenate([p.num for p in batch], axis=0) \
             if len(batch) > 1 else batch[0].num
         cat = np.concatenate([p.cat for p in batch], axis=0) \
             if len(batch) > 1 else batch[0].cat
+        if self._replica is not None and self._replica.mesh is not None:
+            from h2o3_tpu.parallel.mesh import bind_mesh
+            mesh_cm = bind_mesh(self._replica.mesh, rehome_models=False)
+        else:
+            mesh_cm = contextlib.nullcontext()
         outs: list[np.ndarray] = []
         start = 0
-        while start < total:
-            n = min(total - start, MAX_BUCKET)
-            bucket = bucket_for(n)
-            pnum = np.zeros((bucket, num.shape[1]), dtype=np.float32)
-            pcat = np.full((bucket, cat.shape[1]), -1, dtype=np.int32)
-            pnum[:n] = num[start:start + n]
-            pcat[:n] = cat[start:start + n]
-            scorer = entry.cache.get(entry.model, entry.schema, bucket)
-            if bspan is not None:
-                with _tr.TRACER.span("score:dispatch", kind="dispatch",
-                                     attrs={"bucket": bucket, "rows": n,
-                                            "mode": scorer.mode}):
+        with mesh_cm:
+            while start < total:
+                n = min(total - start, MAX_BUCKET)
+                bucket = bucket_for(n)
+                pnum = np.zeros((bucket, num.shape[1]), dtype=np.float32)
+                pcat = np.full((bucket, cat.shape[1]), -1, dtype=np.int32)
+                pnum[:n] = num[start:start + n]
+                pcat[:n] = cat[start:start + n]
+                scorer = self._cache.get(entry.model, entry.schema, bucket)
+                if bspan is not None:
+                    with _tr.TRACER.span("score:dispatch", kind="dispatch",
+                                         attrs={"bucket": bucket, "rows": n,
+                                                "mode": scorer.mode}):
+                        raw = scorer.score(pnum, pcat)
+                else:
                     raw = scorer.score(pnum, pcat)
-            else:
-                raw = scorer.score(pnum, pcat)
-            outs.append(raw[:n])
-            start += n
+                outs.append(raw[:n])
+                start += n
         full = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
         results, off = [], 0
         for p in batch:

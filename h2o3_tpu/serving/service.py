@@ -6,18 +6,28 @@ share the device, cold ones are evicted by bytes, and an over-budget
 request degrades to a retryable error instead of an OOM.
 
 A model scored through ``/3/Score`` becomes *resident*: its serving schema
-is derived once, a :class:`ModelBatcher` worker owns its request queue,
-and its compiled signatures accumulate in the shared
-:class:`ScorerCache`. Residency is byte-accounted with the same measure
-``/3/Memory`` reports per DKV key (``value_kind_bytes`` — the PR-5
-MemoryMeter's artifact-size walk): admission of a cold model under a
-configured budget (``H2O3TPU_SERVE_BUDGET_BYTES``) LRU-evicts idle
-resident models first, and when nothing evictable remains the request
-gets :class:`ServiceUnavailable` — the REST layer maps it to
+is derived once, each of its batcher seats owns a request queue, and its
+compiled signatures accumulate in a :class:`ScorerCache`. Residency is
+byte-accounted with the same measure ``/3/Memory`` reports per DKV key
+(``value_kind_bytes`` — the PR-5 MemoryMeter's artifact-size walk):
+admission of a cold model under a configured budget
+(``H2O3TPU_SERVE_BUDGET_BYTES``) LRU-evicts idle resident models first,
+and when nothing evictable remains the request gets
+:class:`ServiceUnavailable` — the REST layer maps it to
 ``503 + Retry-After`` rather than letting the device OOM. Models with
 in-flight batches are never evicted. Eviction drops the scorer-cache
-signatures and the worker thread; the DKV copy is untouched (that *is*
+signatures and the worker thread(s); the DKV copy is untouched (that *is*
 the cold tier — the next request re-admits it).
+
+SLO layer (docs/SERVING.md "SLO & replicas"): every resident model owns
+an :class:`~h2o3_tpu.serving.slo.SLOController` — with a target set
+(``H2O3TPU_SCORE_SLO_MS`` or per-request ``slo_ms``) the collect window
+adapts and overloaded admissions shed by priority with
+``503 + Retry-After`` (``h2o3_score_shed_total{reason,priority}``); with
+no target the tier is bit-identical to the PR 6 fixed-window path. With
+``H2O3TPU_SCORE_REPLICAS`` > 0 (or :meth:`configure_replicas`) requests
+route least-loaded across a :class:`~h2o3_tpu.serving.replicas.
+ReplicaPool` of slice-leased replicas instead of one in-process seat.
 """
 
 from __future__ import annotations
@@ -29,9 +39,14 @@ import time
 from h2o3_tpu.serving.batcher import Evicted, ModelBatcher
 from h2o3_tpu.serving.schema import NotServable, serving_schema
 from h2o3_tpu.serving.scorer import ScorerCache
+from h2o3_tpu.serving.slo import SLOController, Shed, clamp_priority
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.memory import MEMORY, value_kind_bytes
 from h2o3_tpu.utils.registry import DKV
+
+#: requests between opportunistic replica scale checks (cheap, but not
+#: free — snapshot reads under locks)
+_SCALE_CHECK_EVERY = 32
 
 
 class ServiceUnavailable(RuntimeError):
@@ -43,13 +58,16 @@ class ServiceUnavailable(RuntimeError):
 
 
 class _Resident:
-    """One resident model: schema + batcher + byte accounting."""
+    """One resident model: schema + SLO controller + batcher seat(s) +
+    byte accounting. With a replica pool the seats live on the replicas
+    (one per replica that served this model); without one, ``batcher`` is
+    the single in-process seat — exactly the PR 6 layout."""
 
     __slots__ = ("key", "model", "schema", "cache", "batcher", "nbytes",
-                 "last_used", "requests")
+                 "last_used", "requests", "slo", "pool", "stopped")
 
     def __init__(self, key: str, model, schema, cache: ScorerCache,
-                 nbytes: int):
+                 nbytes: int, pool=None):
         self.key = key
         self.model = model
         self.schema = schema
@@ -57,7 +75,35 @@ class _Resident:
         self.nbytes = nbytes     # computed once by the admitting caller
         self.last_used = time.monotonic()
         self.requests = 0
-        self.batcher = ModelBatcher(self)
+        self.slo = SLOController()
+        self.pool = pool
+        self.stopped = False     # set by eviction; replica seats check it
+        self.batcher = ModelBatcher(self) if pool is None else None
+
+    def submit(self, num, cat, n: int, priority: int):
+        """Route to the least-loaded replica seat (pool) or the local
+        batcher; returns ``(pending, replica_label)``."""
+        pool = self.pool
+        if pool is None:
+            return self.batcher.submit(num, cat, n, priority=priority), None
+        rep = pool.route()
+        return rep.batcher_for(self).submit(num, cat, n,
+                                            priority=priority), rep.label
+
+    def busy(self) -> bool:
+        if self.pool is None:
+            return self.batcher.busy()
+        return self.pool.model_busy(self.key)
+
+    def stop(self) -> None:
+        # the flag FIRST: a score() racing this eviction between _admit
+        # and submit must find a dead entry (batcher_for refuses to
+        # resurrect a seat for it), not re-create what we just dropped
+        self.stopped = True
+        if self.batcher is not None:
+            self.batcher.stop()
+        if self.pool is not None:
+            self.pool.drop_model(self.key, self.model)
 
 
 class ScoringService:
@@ -72,18 +118,91 @@ class ScoringService:
         self._resident: dict[str, _Resident] = {}
         self.cache = ScorerCache()
         self.evictions = 0
+        #: replica pool — created lazily on first admission (constructing
+        #: it eagerly would touch jax devices at module import) or
+        #: explicitly via :meth:`configure_replicas`
+        self.pool = None
+        self._pool_checked = False
+        self._shed: dict[tuple, int] = {}      # (reason, priority) -> count
+
+    # -- replica pool ---------------------------------------------------------
+
+    def configure_replicas(self, n: int, scheduler=None) -> None:
+        """Install a replica pool of ``n`` slice-leased replicas (``0``
+        tears any pool down). ``scheduler`` defaults to a fresh
+        ``MeshScheduler(slices=n)`` so each replica leases a disjoint
+        slice when the device count allows. Pool CONSTRUCTION (lease
+        waits, up to 30s per replica on a contended layout) runs OUTSIDE
+        the service lock — warm-path scorers of other models must not
+        stall behind it."""
+        from h2o3_tpu.serving.replicas import ReplicaPool
+        new_pool = None
+        if n and int(n) > 0:
+            if scheduler is None:
+                from h2o3_tpu.orchestration.scheduler import MeshScheduler
+                scheduler = MeshScheduler(slices=int(n))
+            new_pool = ReplicaPool(int(n), scheduler=scheduler)
+        with self._lock:
+            old, self.pool = self.pool, new_pool
+            self._pool_checked = True
+            # existing residents re-point at the NEW pool — or, on
+            # teardown (n=0), back at a local seat: an entry left holding
+            # the shut-down pool would 500 on every request
+            for entry in self._resident.values():
+                if new_pool is None:
+                    # the local seat must exist BEFORE pool goes None: a
+                    # concurrent submit() reads pool first, batcher second
+                    # — the reverse order would hand it a None batcher
+                    if entry.batcher is None or entry.batcher._stopped:
+                        entry.batcher = ModelBatcher(entry)
+                    entry.pool = None
+                else:
+                    entry.pool = new_pool
+                    if entry.batcher is not None:
+                        entry.batcher.stop()    # seats live on replicas now
+                        entry.batcher = None
+                    for rep in new_pool.replicas:
+                        rep.precompile(entry)
+        if old is not None:
+            old.shutdown()
+
+    def _ensure_pool(self):
+        """Resolve ``H2O3TPU_SCORE_REPLICAS`` once per service lifetime
+        (reset() re-arms it) — lazily, so importing the serving package
+        never constructs meshes. A construction failure (the slice
+        layout contended by other runs past the lease ceiling) re-arms
+        the check and surfaces RETRYABLE 503, never a 500."""
+        with self._lock:
+            if self._pool_checked:
+                return self.pool
+            self._pool_checked = True
+        from h2o3_tpu.serving.replicas import replicas_from_env
+        n = replicas_from_env()
+        if n > 0:
+            try:
+                self.configure_replicas(n)
+            except RuntimeError as e:
+                with self._lock:
+                    self._pool_checked = False   # next admission retries
+                raise ServiceUnavailable(
+                    f"scoring replica pool unavailable: {e}") from None
+        return self.pool
 
     # -- scoring -------------------------------------------------------------
 
-    def score(self, model_key: str, rows, columns=None) -> dict:
+    def score(self, model_key: str, rows, columns=None, priority=None,
+              slo_ms=None) -> dict:
         """Score JSON ``rows`` against ``model_key`` through the batched
-        path; returns the ``/3/Score`` payload dict."""
+        path; returns the ``/3/Score`` payload dict. ``priority`` (0-9,
+        default 5) orders shedding under overload; ``slo_ms`` overrides
+        the model's latency target at admit."""
         t0 = time.perf_counter()
         if not isinstance(rows, (list, tuple)) or not rows:
             # reject before admission: an invalid request must not be able
             # to churn residency (evicting warm models under a budget) for
             # rows that could never score
             raise ValueError("rows must be a non-empty JSON array")
+        pr = clamp_priority(priority)
         try:
             entry = self._admit(model_key)
         except Exception:
@@ -92,7 +211,10 @@ class ScoringService:
             # algo is unknown before admission — one bounded label value
             _tm.SCORE_REQUESTS.labels(algo="unknown", status="error").inc()
             raise
+        if slo_ms is not None:
+            entry.slo.set_target(slo_ms)
         algo = getattr(entry.model, "algo", "model")
+        replica = None
         try:
             # an eviction can race the window between _admit releasing the
             # service lock and submit() enqueueing (budgeted admit of
@@ -101,14 +223,22 @@ class ScoringService:
             for attempt in (0, 1):
                 num, cat = entry.schema.adapt_rows(rows, columns)
                 try:
-                    pending = entry.batcher.submit(num, cat, len(rows))
+                    pending, replica = entry.submit(num, cat, len(rows), pr)
                     break
+                except Shed as e:
+                    # the admission estimator turned this request away
+                    # before it entered the queue: accounted, retryable
+                    self._count_shed(e.reason, pr)
+                    raise ServiceUnavailable(
+                        str(e), retry_after_ms=e.retry_after_ms) from None
                 except TimeoutError as e:
                     # a queue that never drained within the wait ceiling is
                     # a load condition: retryable 503, not a server fault
+                    self._count_shed("timeout", pr)
                     raise ServiceUnavailable(str(e)) from None
                 except Evicted:
                     if attempt:
+                        self._count_shed("evicted", pr)
                         raise ServiceUnavailable(
                             f"{model_key!r} keeps losing residency under "
                             "the budget; retry shortly")
@@ -123,16 +253,41 @@ class ScoringService:
         except Exception:
             _tm.SCORE_REQUESTS.labels(algo=algo, status="error").inc()
             raise
+        latency = time.perf_counter() - t0
+        entry.slo.record_latency(latency)
+        # per MODEL: two resident models of one algo have independent
+        # controllers; an algo label would flap between their windows
+        _tm.SCORE_WINDOW_MS.labels(model=model_key).set(
+            entry.slo.current_window_s() * 1e3)
+        if pending.queue_wait_s is not None:
+            _tm.SCORE_QUEUE_WAIT.observe(pending.queue_wait_s)
+            if entry.pool is not None:
+                entry.pool.observe_wait(pending.queue_wait_s)
+        if entry.pool is not None and entry.requests % _SCALE_CHECK_EVERY == 0:
+            with self._lock:
+                residents = list(self._resident.values())
+            entry.pool.maybe_scale(entry.slo.slo_ms,
+                                   resident_entries=residents)
         out.update(model=model_key, rows=len(rows),
                    batch_rows=pending.batch_rows,
-                   batch_requests=pending.batch_requests)
+                   batch_requests=pending.batch_requests,
+                   priority=pr)
+        if replica is not None:
+            out["replica"] = replica
         _tm.SCORE_REQUESTS.labels(algo=algo, status="ok").inc()
-        _tm.SCORE_SECONDS.labels(algo=algo).observe(time.perf_counter() - t0)
+        _tm.SCORE_SECONDS.labels(algo=algo).observe(latency)
         return out
+
+    def _count_shed(self, reason: str, priority: int) -> None:
+        _tm.SCORE_SHED.labels(reason=reason, priority=str(priority)).inc()
+        with self._lock:
+            k = (reason, priority)
+            self._shed[k] = self._shed.get(k, 0) + 1
 
     # -- residency / admission ----------------------------------------------
 
     def _admit(self, model_key: str) -> _Resident:
+        self._ensure_pool()
         with self._lock:
             entry = self._resident.get(model_key)
             if entry is not None and entry.model is DKV.get(model_key):
@@ -158,11 +313,24 @@ class ScoringService:
             if entry is not None:      # key re-put: stale resident copy
                 self._evict_locked(entry)
             self._make_room_locked(incoming, model_key)
-            entry = _Resident(model_key, model, schema, self.cache, incoming)
+            # pool re-read UNDER the lock: an admission that lost the
+            # _ensure_pool race must not pin its model to a local seat
+            # (global-mesh dispatches, the contention the pool removes)
+            # for the resident's whole lifetime
+            pool = self.pool
+            entry = _Resident(model_key, model, schema, self.cache, incoming,
+                              pool=pool)
             self._resident[model_key] = entry
             entry.requests += 1
             self._export_locked()
-            return entry
+        if pool is not None:
+            # speculative bucket pre-compile at admission: every replica
+            # warms the power-of-two buckets in the background (fed by the
+            # persistent compile cache), so wherever routing lands this
+            # model next, the executable is already there
+            for rep in pool.replicas:
+                rep.precompile(entry)
+        return entry
 
     def _make_room_locked(self, incoming: int, for_key: str) -> None:
         if self.budget_bytes is None:
@@ -184,7 +352,7 @@ class ScoringService:
         # make an infeasible admission also destroy working residents.
         victims = [v for v in sorted(self._resident.values(),
                                      key=lambda e: e.last_used)
-                   if v.key != for_key and not v.batcher.busy()]
+                   if v.key != for_key and not v.busy()]
         evictable = sum(v.nbytes for v in victims)
         if resident_bytes() - evictable + incoming > self.budget_bytes:
             raise ServiceUnavailable(
@@ -199,7 +367,7 @@ class ScoringService:
 
     def _evict_locked(self, entry: _Resident) -> None:
         self._resident.pop(entry.key, None)       # graftlint: ok(caller holds self._lock — _locked suffix contract)
-        entry.batcher.stop()
+        entry.stop()
         self.cache.drop_model(entry.model)
         self.evictions += 1                        # graftlint: ok(caller holds self._lock — _locked suffix contract)
         self._export_locked()
@@ -215,7 +383,7 @@ class ScoringService:
             entry = self._resident.get(model_key)
             if entry is None:
                 return False
-            if entry.batcher.busy():
+            if entry.busy():
                 raise ServiceUnavailable(
                     f"{model_key!r} has in-flight batches; retry")
             self._evict_locked(entry)
@@ -224,35 +392,50 @@ class ScoringService:
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> dict:
-        """The ``GET /3/Score`` payload: residency + cache counters; the
-        device/host watermarks ride along so admission decisions can be
-        read against the same numbers ``/3/Memory`` serves."""
+        """The ``GET /3/Score`` payload: residency + cache counters + the
+        SLO/shed/replica view; the device/host watermarks ride along so
+        admission decisions can be read against the same numbers
+        ``/3/Memory`` serves."""
         with self._lock:
             resident = [{"model": e.key,
                          "algo": getattr(e.model, "algo", "model"),
                          "bytes": e.nbytes, "requests": e.requests,
-                         "idle_secs": round(time.monotonic() - e.last_used, 3)}
+                         "idle_secs": round(time.monotonic() - e.last_used, 3),
+                         "slo": e.slo.snapshot()}
                         for e in sorted(self._resident.values(),
                                         key=lambda e: -e.last_used)]
             budget = self.budget_bytes
             evictions = self.evictions
+            shed = [{"reason": r, "priority": p, "count": c}
+                    for (r, p), c in sorted(self._shed.items())]
+            pool = self.pool
         return {"resident": resident,
                 "resident_bytes": sum(r["bytes"] for r in resident),
                 "budget_bytes": budget, "evictions": evictions,
                 "cache": self.cache.stats(),
+                "shed": shed,
+                "shed_total": sum(s["count"] for s in shed),
+                "replicas": pool.snapshot() if pool is not None else None,
                 "watermarks": MEMORY.watermarks}
 
     def reset(self) -> None:
         """Evict everything and zero counters (tests + shutdown). The
         cache clears wholesale — no per-model drops, which would inflate
-        the ``evict`` telemetry counter with non-budget evictions."""
+        the ``evict`` telemetry counter with non-budget evictions. The
+        replica pool shuts down (leases released) and the env knob is
+        re-armed for the next admission."""
         with self._lock:
             for entry in list(self._resident.values()):
-                entry.batcher.stop()
+                entry.stop()
             self._resident.clear()
             self.cache.clear()
             self.evictions = 0
+            self._shed.clear()
+            pool, self.pool = self.pool, None
+            self._pool_checked = False
             self._export_locked()
+        if pool is not None:
+            pool.shutdown()
 
 
 def _finalize(model, raw, n: int) -> dict:

@@ -563,3 +563,30 @@ SCORE_RESIDENT_BYTES = METRICS.gauge(
     "artifact bytes of models resident in the scoring tier")
 SCORE_RESIDENT_MODELS = METRICS.gauge(
     "h2o3_score_resident_models", "models resident in the scoring tier")
+
+# SLO-adaptive serving (serving/slo.py + serving/replicas.py —
+# docs/SERVING.md "SLO & replicas"). Shed reasons: overload (admission
+# estimator), timeout (in-queue wait ceiling), evicted (persistent
+# residency loss); priority is the request's 0-9 class.
+SCORE_SHED = METRICS.counter(
+    "h2o3_score_shed",
+    "scoring requests shed with 503+Retry-After instead of served",
+    ("reason", "priority"))
+SCORE_QUEUE_WAIT = METRICS.histogram(
+    "h2o3_score_queue_wait_seconds",
+    "scoring request wait from enqueue to dispatch start (the SLO "
+    "controller's scale signal)")
+SCORE_WINDOW_MS = METRICS.gauge(
+    "h2o3_score_window_ms",
+    "current adaptive collect window per model (fixed window when no SLO); "
+    "cardinality is bounded by residency, like the per-model /3/Score rows",
+    ("model",))
+SCORE_REPLICAS = METRICS.gauge(
+    "h2o3_score_replicas", "live scoring replicas holding slice leases")
+SCORE_SCALE_EVENTS = METRICS.counter(
+    "h2o3_score_scale_events",
+    "replica pool scale decisions by direction (up/down)", ("direction",))
+SCORE_PRECOMPILE = METRICS.counter(
+    "h2o3_score_precompile",
+    "speculative bucket pre-compiles on replica admission "
+    "(scheduled/compiled/failed)", ("event",))

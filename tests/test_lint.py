@@ -714,6 +714,63 @@ def test_prf001_suppressible(tmp_path):
     assert [f.rule for f in run_lint(pkg) if f.rule == "PRF001"] == []
 
 
+# -- env-discipline (ENV) -----------------------------------------------------
+
+def test_env001_import_time_reads_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import os
+        from os import environ
+
+        WINDOW_S = float(os.environ.get("H2O3TPU_SCORE_WINDOW_MS", "1")) / 1e3
+        TIMEOUT = os.getenv("H2O3TPU_SCORE_TIMEOUT_S", "30")
+        BUDGET = environ["H2O3TPU_SERVE_BUDGET_BYTES"]
+
+        class Config:
+            slices = int(os.environ.get("H2O3TPU_MESH_SLICES", "1"))
+
+        def serve(window=os.environ.get("H2O3TPU_SCORE_WINDOW_MS")):
+            # the DEFAULT evaluates at def time -> import-time capture too
+            return window
+    """})
+    env = [f for f in run_lint(pkg) if f.rule == "ENV001"]
+    assert len(env) == 5
+    assert {f.detail for f in env} == {
+        "import-time-env:H2O3TPU_SCORE_WINDOW_MS",
+        "import-time-env:H2O3TPU_SCORE_TIMEOUT_S",
+        "import-time-env:H2O3TPU_SERVE_BUDGET_BYTES",
+        "import-time-env:H2O3TPU_MESH_SLICES"}
+
+
+def test_env001_runtime_reads_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import os
+
+        HOME = os.environ.get("HOME")       # not an H2O3TPU_* tunable
+
+        def window_s_from_env():
+            # the fix shape: resolved per call, late env changes land
+            return float(os.environ.get("H2O3TPU_SCORE_WINDOW_MS", "1")) / 1e3
+
+        class Batcher:
+            def __init__(self):
+                self.window = window_s_from_env()
+                self.budget = os.getenv("H2O3TPU_SERVE_BUDGET_BYTES")
+
+        probe = lambda: os.environ.get("H2O3TPU_SCORE_SLO_MS")
+    """})
+    assert "ENV001" not in rules_of(run_lint(pkg))
+
+
+def test_env001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import os
+
+        # graftlint: ok(deliberate one-shot capture - documented)
+        FROZEN = os.environ.get("H2O3TPU_SCORE_MAX_BUCKET", "4096")
+    """})
+    assert "ENV001" not in rules_of(run_lint(pkg))
+
+
 # -- suppression + baseline --------------------------------------------------
 
 def test_inline_suppression(tmp_path):
@@ -866,6 +923,18 @@ def test_elastic_module_scans_clean(live_findings):
     (ISSUE 12 acceptance: graftlint scans the new module clean)."""
     hits = [f for f in live_findings
             if f.path in ("parallel/elastic.py", "tools/waits.py")]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_slo_serving_modules_scan_clean(live_findings):
+    """The SLO serving layer (ISSUE 13) ships lint-clean across every
+    rule family — including ENV001, whose bug class (import-time env
+    capture) is exactly what serving/slo.py's *_from_env() helpers and
+    the batcher's construction-time window exist to avoid."""
+    hits = [f for f in live_findings
+            if f.path in ("serving/slo.py", "serving/replicas.py",
+                          "serving/batcher.py", "serving/service.py",
+                          "tools/envs.py")]
     assert hits == [], "\n".join(f.render() for f in hits)
 
 

@@ -159,7 +159,7 @@ class TestBitIdentical:
             with entry.batcher._cond:
                 assert entry.batcher._queue == []
         finally:
-            entry.batcher._window = bm.WINDOW_S
+            entry.batcher._window = bm.window_s_from_env()
         monkeypatch.setattr(bm, "SCORE_TIMEOUT_S", 30.0)
         assert SCORING.score(gbm.key, _rows(frame, 2))["rows"] == 2
 

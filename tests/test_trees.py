@@ -278,3 +278,35 @@ def test_gbm_early_stopping(rng):
     # without stopping all trees grow
     m2 = GBM(ntrees=12, max_depth=3, seed=1).train(y="y", training_frame=fr)
     assert m2.output["ntrees"] == 12
+
+
+def test_histogram_dispatch_mesh_fused_beats_pallas(monkeypatch):
+    """ISSUE 13 satellite: on a multi-device mesh the fused shard_map+psum
+    path must win the _histograms dispatch even when the Pallas kernel is
+    available — hist_pallas is single-device, and running it over the
+    global array would SKIP the per-level psum reduction (each shard's
+    partial histogram would be mistaken for the total)."""
+    from h2o3_tpu.models import tree as tree_mod
+    from h2o3_tpu.ops import pallas_hist as ph
+
+    calls = []
+    monkeypatch.setattr(ph, "pallas_available",
+                        lambda *a, **k: True)        # TPU-like container
+    monkeypatch.setattr(ph, "hist_pallas",
+                        lambda *a, **k: calls.append("pallas") or "pallas")
+    monkeypatch.setattr(tree_mod, "_level_histograms_fused",
+                        lambda *a, **k: calls.append("fused") or "fused")
+    monkeypatch.setattr(tree_mod, "_level_histograms",
+                        lambda *a, **k: calls.append("segsum") or "segsum")
+
+    binned = np.zeros((8, 2), np.int32)
+    args = (binned, binned.T, np.zeros(8, np.int32), np.zeros(8, np.float32),
+            np.zeros(8, np.float32), np.ones(8, np.float32))
+    # mesh present: the fused collective path MUST take precedence
+    assert tree_mod._histograms(*args, 4, 17, mesh=object()) == "fused"
+    # no mesh: the Pallas kernel is the fast single-device path
+    assert tree_mod._histograms(*args, 4, 17, mesh=None) == "pallas"
+    # no mesh, no pallas: segment_sum fallback
+    monkeypatch.setattr(ph, "pallas_available", lambda *a, **k: False)
+    assert tree_mod._histograms(*args, 4, 17) == "segsum"
+    assert calls == ["fused", "pallas", "segsum"]
