@@ -1103,6 +1103,173 @@ def bench_tracing(ndev: int) -> dict:
     return out
 
 
+def bench_ingest(ndev: int) -> dict:
+    """Out-of-core ingest proof (ROADMAP item 4, docs/INGEST.md): generate
+    a gzip CSV whose UNCOMPRESSED size exceeds a capped host budget, parse
+    it through the streaming pipeline (compressed chunks, lazy device
+    views), train a GLM on the result, and cycle a spill/fault-in.
+
+    ``extra.ingest`` embeds: peak host RSS growth vs the cap
+    (`H2O3TPU_INGEST_RAM_BUDGET` overrides the default of ~60% of the
+    dataset's text size), the achieved compression ratio, spill/fault-in
+    counters, and a bit-identity check of streamed-vs-eager predictions.
+    The gate refuses to stamp a real-run artifact whose ingest RSS growth
+    exceeded the cap or whose predictions diverged."""
+    import gzip
+    import tempfile
+    import threading
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.ingest import stream_import
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.utils import memory as _mem
+    from h2o3_tpu.utils.cleaner import (CLEANER, disable_cleaner,
+                                        enable_cleaner)
+    from h2o3_tpu.utils.registry import DKV
+
+    rows = 30_000 if SMOKE else (1_500_000 if CPU_FALLBACK else 8_000_000)
+    bytes_per_row = 25            # "123,45,67,0.123456,yes" ≈ 25B
+    cap = int(os.environ.get("H2O3TPU_INGEST_RAM_BUDGET",
+                             str(int(rows * bytes_per_row * 0.6))))
+    rng = np.random.default_rng(17)
+    tmp = tempfile.mkdtemp(prefix="h2o3_ingest_bench_")
+    big = os.path.join(tmp, "big.csv.gz")
+    # generate in bounded chunks — the GENERATOR must not hold O(file) either
+    text_bytes = 0
+    with gzip.open(big, "wt", compresslevel=1) as f:
+        f.write("a,b,c,x,y\n")
+        left = rows
+        while left:
+            n = min(left, 100_000)
+            a = rng.integers(0, 100, size=n)
+            b = rng.integers(-30, 30, size=n)
+            c = rng.integers(0, 7, size=n)
+            x = rng.normal(size=n)
+            ylab = np.where(rng.random(n) < 1 / (1 + np.exp(
+                -(0.02 * a - 0.05 * b + 0.3 * x))), "yes", "no")
+            block = "\n".join(
+                f"{ai},{bi},{ci},{xi:.6f},{yi}"
+                for ai, bi, ci, xi, yi in zip(a, b, c, x, ylab)) + "\n"
+            text_bytes += len(block)
+            f.write(block)
+            left -= n
+
+    # RSS sampler: VmHWM is process-lifetime, so sample the live RSS at
+    # 50ms cadence across parse+train to get THIS scenario's peak delta
+    rss0 = _mem.host_stats()["rss_bytes"]
+    peak = {"rss": rss0}
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            peak["rss"] = max(peak["rss"], _mem.host_stats()["rss_bytes"])
+            stop.wait(timeout=0.05)
+
+    smp = threading.Thread(target=sampler, daemon=True)
+    smp.start()
+    out: dict = {"rows": rows, "text_bytes": text_bytes,
+                 "gz_bytes": os.path.getsize(big), "cap_bytes": cap,
+                 "dataset_exceeds_cap": text_bytes > cap}
+    try:
+        t0 = time.perf_counter()
+        fr = stream_import(big, key="bench_ingest.hex")
+        dt = time.perf_counter() - t0
+        out["parse_seconds"] = round(dt, 2)
+        out["parse_rows_per_sec"] = round(rows / max(dt, 1e-9), 1)
+        st = fr._ingest_stats
+        out["compression_ratio"] = st["compression_ratio"]
+        out["chunks"] = st["chunks"]
+        out["inflight_peak_bytes"] = st["inflight_peak_bytes"]
+        out["restarts"] = st["restarts"]
+        t0 = time.perf_counter()
+        model = GLM(family="binomial", lambda_=1e-4, max_iterations=10,
+                    seed=5).train(y="y", training_frame=fr)
+        out["train_seconds"] = round(time.perf_counter() - t0, 2)
+        out["auc"] = round(float(model.training_metrics.auc), 4)
+        # the RSS cap covers PARSE+TRAIN — stop sampling before the forced
+        # spill cycle below: tier-3 save_frame decodes every column into
+        # one npz write (a documented O(file) limitation of the snapshot
+        # format, ROADMAP item 4), which would trip the gate on a spike
+        # that is not an ingest regression
+        stop.set()
+        smp.join(timeout=5.0)
+        # spill/fault-in cycle: a budget well under even the COMPRESSED
+        # payload forces a disk spill (view drops alone can't satisfy it);
+        # the re-get faults the frame back in
+        sp0 = CLEANER.stats()
+        enable_cleaner(max(fr.nbytes // 16, 1), ice_root=os.path.join(
+            tmp, "ice"))
+        try:
+            DKV.put("bench_ingest_hot.hex",
+                    Frame.from_arrays({"z": np.zeros(1024, np.float32)},
+                                      key="bench_ingest_hot.hex"))
+            _ = DKV["bench_ingest.hex"]     # transparent fault-in
+        finally:
+            disable_cleaner()
+        sp1 = CLEANER.stats()
+        out["spills"] = sp1["spill_count"] - sp0["spill_count"]
+        out["fault_ins"] = sp1["restore_count"] - sp0["restore_count"]
+        out["view_drops"] = sp1["view_drops"] - sp0["view_drops"]
+    finally:
+        stop.set()
+        smp.join(timeout=5.0)
+    out["rss_peak_delta_bytes"] = max(peak["rss"] - rss0, 0)
+    out["under_cap"] = out["rss_peak_delta_bytes"] <= cap
+
+    # bit-identity: streamed+compressed vs eager resident on a subset file
+    sub = os.path.join(tmp, "sub.csv")
+    with gzip.open(big, "rt") as fin, open(sub, "w") as fout:
+        for i, line in enumerate(fin):
+            if i > 50_000:
+                break
+            fout.write(line)
+    fs = stream_import(sub, key="bench_ingest_s.hex", chunk_rows=8192)
+    fe = import_file(sub, key="bench_ingest_e.hex")
+    kw = dict(family="binomial", lambda_=1e-4, max_iterations=8, seed=5)
+    ps = GLM(**kw).train(y="y", training_frame=fs).predict(fs) \
+        .vec("pyes").to_numpy()
+    pe = GLM(**kw).train(y="y", training_frame=fe).predict(fe) \
+        .vec("pyes").to_numpy()
+    out["bit_identical"] = bool(np.array_equal(ps, pe))
+    for k in ("bench_ingest.hex", "bench_ingest_hot.hex",
+              "bench_ingest_s.hex", "bench_ingest_e.hex"):
+        DKV.remove(k)
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _ingest_gate(ing: dict) -> None:
+    """Refuse to stamp when the out-of-core contract broke: streamed/
+    compressed predictions diverging from the eager path is a correctness
+    regression on ANY backend; a real run whose ingest RSS growth exceeded
+    the configured cap lost the O(chunk)+compressed memory story the
+    subsystem exists for (CPU fallback annotates only — device arrays live
+    in RSS there, so the cap is not meaningful)."""
+    if ing.get("error"):
+        print(f"# bench REFUSED: ingest section failed: {ing['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if not ing.get("bit_identical"):
+        print("# bench REFUSED: streamed/compressed GLM predictions "
+              "diverge from the eager resident path", file=sys.stderr)
+        sys.exit(3)
+    if SMOKE or CPU_FALLBACK:
+        return
+    if not ing.get("dataset_exceeds_cap"):
+        print("# bench REFUSED: ingest dataset no longer exceeds the RAM "
+              "cap — the out-of-core scenario proves nothing",
+              file=sys.stderr)
+        sys.exit(3)
+    if not ing.get("under_cap"):
+        print(f"# bench REFUSED: ingest host RSS growth "
+              f"{ing['rss_peak_delta_bytes']} exceeds the "
+              f"H2O3TPU_INGEST_RAM_BUDGET cap {ing['cap_bytes']}",
+              file=sys.stderr)
+        sys.exit(3)
+
+
 def bench_memory() -> dict:
     """Memory accounting for the artifact: host/device watermarks over the
     whole bench run, DKV byte totals by kind, and a leak-detector pass over
@@ -1559,6 +1726,16 @@ def main() -> None:
     # a warm scenario that recompiled after its warm-up refuses to stamp
     out["extra"]["compute"] = _compute_section(out["extra"])
     _compute_gate(out)
+    # out-of-core ingest: streaming-parse + GLM-train a dataset larger than
+    # the capped host budget, with a spill/fault-in cycle and a streamed-
+    # vs-eager bit-identity check (ISSUE 14; docs/INGEST.md) — the gate
+    # refuses divergence anywhere and a blown cap on real runs
+    try:
+        ing = bench_ingest(ndev)
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        ing = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["ingest"] = ing
+    _ingest_gate(ing)
     MEMORY.refresh()
     MEMORY.leak_sweep()
     # compile-cache effectiveness this round (satellite of ROADMAP item 5:

@@ -212,7 +212,14 @@ h2o.workers <- function() {
 h2o.importFile <- function(path, destination_frame = NULL) {
   body <- list(path = path)
   if (!is.null(destination_frame)) body$destination_frame <- destination_frame
+  # a nonexistent/unreadable server path is a structured 400 whose msg
+  # .http() raises via stop() — never a 500 traceback; per-file fails from
+  # ImportFilesMulti-shaped replies surface the same way
   out <- .http("POST", "/3/ImportFiles", body)
+  if (length(out$fails) > 0)
+    stop("importFile failed: ", paste(unlist(out$fails), collapse = "; "))
+  if (length(out$destination_frames) == 0)
+    stop("importFile: server imported no frames for ", path)
   key <- out$destination_frames[[1]]
   structure(list(frame_id = key), class = "H2OFrame")
 }

@@ -72,10 +72,25 @@ class H2OClient:
         return self.cloud_status().get("workers", [])
 
     def import_file(self, path: str, destination_frame: str | None = None) -> str:
+        """Server-side import+parse. A nonexistent/unreadable SERVER path
+        surfaces as :class:`FileNotFoundError` carrying the structured 400
+        the server replies (never a 500 traceback)."""
         d = {"path": path}
         if destination_frame:
             d["destination_frame"] = destination_frame
-        out = self.request("POST", "/3/ImportFiles", d)
+        try:
+            out = self.request("POST", "/3/ImportFiles", d)
+        except RuntimeError as e:
+            # only PATH errors map to FileNotFoundError — a 400 can also be
+            # a parse failure on a file that exists (ValueError server-side).
+            # Anchor on the server's _check_readable message shape so a
+            # parse error merely MENTIONING a path phrase never matches.
+            msg = str(e)
+            if "→ 400:" in msg and "import_file:" in msg \
+                    and ("no such file" in msg or "not readable" in msg
+                         or "is a directory" in msg):
+                raise FileNotFoundError(msg) from None
+            raise
         return out["destination_frames"][0]
 
     def upload_file(self, path: str, destination_frame: str | None = None) -> str:
@@ -274,8 +289,10 @@ class H2OClient:
 
     def memory(self, top: int = 10) -> dict:
         """Device/host byte accounting: host RSS, per-device HBM stats,
-        DKV bytes by kind + top-N keys, watermarks, and the leak report
-        (``GET /3/Memory``)."""
+        DKV bytes by kind + top-N keys (spilled stubs report their on-disk
+        bytes under the ``spilled`` kind), watermarks, the leak report,
+        and the Cleaner spill view — spill/fault-in/view-drop counters +
+        ice_root contents (``GET /3/Memory``; docs/INGEST.md)."""
         return self.request("GET", f"/3/Memory?top={int(top)}")
 
     def jstack(self) -> list[dict]:

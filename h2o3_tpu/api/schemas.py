@@ -93,8 +93,11 @@ def cloud_v3(version: str) -> dict:
 def memory_v3(summary: dict) -> dict:
     """``GET /3/Memory`` — the three-level byte accounting: host RSS +
     machine totals, per-device HBM (``memory_stats`` or live-array
-    fallback), DKV totals by kind with the top-N keys, monotonic
-    watermarks, and the leak-detector report (utils/memory.py)."""
+    fallback), DKV totals by kind with the top-N keys (spilled stubs keep
+    their on-disk bytes under the ``spilled`` kind), monotonic watermarks,
+    the leak-detector report (utils/memory.py), and the Cleaner's spill
+    view — budget, spill/fault-in/view-drop counters, ice_root contents
+    (utils/cleaner.py; docs/INGEST.md)."""
     return {**_meta("MemoryV3"), **_clean(summary)}
 
 

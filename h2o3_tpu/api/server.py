@@ -385,7 +385,15 @@ class _Handler(BaseHTTPRequestHandler):
     def r_import(self):
         p = self._params()
         from h2o3_tpu.frame.parse import import_file
-        fr = import_file(p["path"], key=p.get("destination_frame"))
+        try:
+            fr = import_file(p["path"], key=p.get("destination_frame"))
+        except (FileNotFoundError, PermissionError, IsADirectoryError,
+                ValueError) as e:
+            # a bad path is CLIENT error, not a server fault: a structured
+            # 400 whose msg carries the reason (the reference reports these
+            # as ImportFiles `fails`, never a 500 traceback)
+            self._error(400, str(e))
+            return
         self._reply({"__meta": {"schema_type": "ImportFilesV3"},
                      "destination_frames": [fr.key], "fails": []})
 

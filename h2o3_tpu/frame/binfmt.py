@@ -22,6 +22,21 @@ import zlib
 
 import numpy as np
 
+#: RFC 1952 gzip member header magic
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def is_gzipped(path: str) -> bool:
+    """Magic-byte gzip sniff (reference: the parser's ``ZipUtil`` codec
+    detection reads bytes, never trusts extensions) — the streaming ingest
+    router and pipeline share this so a gzipped file without a ``.gz``
+    suffix still decompresses incrementally."""
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(2) == _GZIP_MAGIC
+    except OSError:
+        return False
+
 
 # ---------------------------------------------------------------------------
 # Avro object container

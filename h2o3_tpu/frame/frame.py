@@ -140,6 +140,13 @@ class Frame:
     def types(self) -> dict[str, str]:
         return {n: str(v.type) for n, v in zip(self.names, self.vecs)}
 
+    def drop_device_views(self) -> int:
+        """Release every column's derived (decompress-on-access) device
+        array — the Cleaner's cheapest eviction tier for frames built by
+        the streaming ingest path. Returns freed device bytes; columns
+        without a compressed host payload are untouched."""
+        return sum(v.drop_device() for v in self.vecs)
+
     # -- column access ------------------------------------------------------
 
     def vec(self, col: int | str) -> Vec:

@@ -41,6 +41,49 @@ def _note_parse(frame, path: str | None = None, nbytes: int | None = None):
     return frame
 
 
+#: extensions the streaming pipeline can parse (CSV-shaped text, plus gzip)
+_STREAMABLE_EXTS = ("csv", "txt", "data", "gz")
+
+
+def _stream_mode(path: str, ext: str) -> bool:
+    """Should this import ride the streaming chunked pipeline
+    (``ingest/pipeline.py``)? Gated by ``H2O3TPU_INGEST_STREAMING``:
+    ``0``/unset = never (the eager path is the parity-proven default),
+    ``1`` = every streamable file, ``auto`` = gzip-compressed files and
+    files over the ``H2O3TPU_INGEST_STREAM_MIN_BYTES`` floor (64MB) —
+    the ones whose eager parse would materialize O(file) host columns."""
+    mode = os.environ.get("H2O3TPU_INGEST_STREAMING", "0").strip().lower()
+    if mode in ("", "0", "off", "false") or ext not in _STREAMABLE_EXTS:
+        return False
+    if mode in ("1", "on", "true", "force"):
+        return True
+    if mode != "auto":
+        return False
+    from h2o3_tpu.frame.binfmt import is_gzipped
+    if ext == "gz" or is_gzipped(path):
+        return True
+    floor = int(os.environ.get("H2O3TPU_INGEST_STREAM_MIN_BYTES",
+                               str(64 << 20)))
+    try:
+        return os.path.getsize(path) >= floor
+    except OSError:
+        return False
+
+
+def _check_readable(path: str) -> None:
+    """Surface bad paths as the structured errors the REST layer maps to a
+    400 (reference: ImportFiles ``fails`` entries) — never a 500 traceback
+    from deep inside a reader."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"import_file: no such file or directory: "
+                                f"{path!r}")
+    if os.path.isdir(path):
+        raise IsADirectoryError(f"import_file: {path!r} is a directory, "
+                                "not a data file")
+    if not os.access(path, os.R_OK):
+        raise PermissionError(f"import_file: {path!r} is not readable")
+
+
 def import_file(path: str, key: str | None = None, header: int | None = 0,
                 col_types: dict | None = None, na_strings: list[str] | None = None,
                 sep: str | None = None) -> Frame:
@@ -66,7 +109,30 @@ def import_file(path: str, key: str | None = None, header: int | None = 0,
         if scheme == "file":
             path = path.split("://", 1)[1]
 
+    _check_readable(path)
     ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if _stream_mode(path, ext):
+        # streaming chunked parse: overlapped read→decompress→tokenize→
+        # device stages, compressed host columns, O(chunk) peak transient
+        # memory, and a real Job with row/byte progress (docs/INGEST.md)
+        from h2o3_tpu.ingest.pipeline import stream_import
+        from h2o3_tpu.models.job import Job
+        job = Job(f"Parse {os.path.basename(path)}")
+
+        def _run(j):
+            return stream_import(path, key=key or _key_from_path(path),
+                                 header=header, col_types=col_types,
+                                 na_strings=na_strings, sep=sep, job=j)
+        job.run(_run, background=False)
+        if job.exception is not None:
+            raise job.exception
+        if job.result is None:
+            # cancelled mid-parse (Job swallows JobCancelled into status):
+            # surface a structured client error, never return None into
+            # handlers that dereference .key (→ 500)
+            raise ValueError(f"parse of {path!r} was cancelled "
+                             f"(job {job.key})")
+        return _note_parse(job.result, path)
     if ext in ("parquet", "pq"):
         df = pd.read_parquet(path)
     elif ext == "orc":

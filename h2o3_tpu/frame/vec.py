@@ -98,8 +98,13 @@ class Vec:
         domain: tuple[str, ...] | None = None,
         host_values: np.ndarray | None = None,
         time_offset: float = 0.0,
+        compressed=None,
     ):
-        self.data = data                  # padded, row-sharded device array (or None for STR/UUID)
+        self._data = data                 # padded, row-sharded device array (or None for STR/UUID)
+        # compressed host payload (ingest/encode.CompressedChunk): when set,
+        # the device array is a DERIVED view — ``data`` materializes it on
+        # first access and the Cleaner may drop it again (drop_device)
+        self._compressed = compressed
         self.type = type
         self.nrows = nrows
         self.domain = domain              # categorical level names, sorted (parser semantics)
@@ -148,11 +153,63 @@ class Vec:
         """Wrap an existing padded, row-sharded device array."""
         return Vec(data, type, nrows, domain=domain)
 
+    @staticmethod
+    def from_compressed(chunk, type: VecType, nrows: int,
+                        domain: tuple[str, ...] | None = None) -> "Vec":
+        """Wrap a compressed host payload (ingest/encode.CompressedChunk);
+        the device array materializes lazily on first ``data`` access."""
+        return Vec(None, type, nrows, domain=domain, compressed=chunk)
+
     # -- properties ---------------------------------------------------------
 
     @property
+    def data(self) -> jax.Array | None:
+        """The padded, row-sharded device column. For compressed vecs this
+        is a DERIVED view: first access decodes the host payload and
+        uploads (``Chunk.atd`` decompress-on-access, amortized per column);
+        :meth:`drop_device` releases it again."""
+        arr = self._data    # local: a concurrent Cleaner drop_device between
+        # materialization and return must not turn this access into None
+        if arr is None and self._compressed is not None:
+            from h2o3_tpu.utils import telemetry as _tm2
+            decoded = self._compressed.decode()
+            fill = CAT_NA if self.type is VecType.CAT else np.nan
+            arr = _upload(decoded, self.nrows, fill)
+            self._data = arr
+            _tm2.CHUNK_DECOMPRESS.inc()
+            _tm2.CHUNK_DECOMPRESS_BYTES.inc(int(decoded.nbytes))
+        return arr
+
+    @data.setter
+    def data(self, value) -> None:
+        self._data = value
+
+    @property
+    def compressed(self):
+        """The compressed host payload, if this Vec carries one."""
+        return self._compressed
+
+    @property
+    def device_resident(self) -> bool:
+        """True when a device array is materialized RIGHT NOW — the
+        accounting view (never triggers decompress, unlike ``data``)."""
+        return self._data is not None
+
+    def drop_device(self) -> int:
+        """Release the derived device array of a compressed Vec (the
+        Cleaner's cheapest eviction: the host payload rebuilds it on next
+        access). Returns the freed device bytes; 0 when there is nothing
+        safely droppable."""
+        if self._compressed is None or self._data is None:
+            return 0
+        freed = int(self._data.nbytes)
+        self._data = None
+        return freed
+
+    @property
     def plen(self) -> int:
-        return self.data.shape[0] if self.data is not None else padded_len(self.nrows)
+        return self._data.shape[0] if self._data is not None \
+            else padded_len(self.nrows)
 
     @property
     def nbytes(self) -> int:
@@ -208,6 +265,13 @@ class Vec:
             return self.host_values
         if self.type is VecType.TIME and self.host_values is not None:
             return self.host_values[: self.nrows]
+        if self._data is None and self._compressed is not None:
+            # host read of an unmaterialized compressed column: decode
+            # directly — no reason to round-trip through the device. COPY:
+            # the identity codec decodes to the payload itself, and the
+            # eager path's fetch() always returns a fresh array callers
+            # may mutate — never alias the host source of truth
+            return self._compressed.decode()[: self.nrows].copy()
         from h2o3_tpu.parallel.distributed import fetch
         return fetch(self.data)[: self.nrows]
 

@@ -1,0 +1,19 @@
+"""Streaming ingest + out-of-core frames (docs/INGEST.md).
+
+The data plane that survives datasets bigger than host RAM, mirroring the
+reference substrate's three legs (PAPER.md L1/L2): a streaming chunked
+parse whose host peak is O(chunk) (:mod:`h2o3_tpu.ingest.pipeline`),
+compressed column encodings with lazy decompress-on-access
+(:mod:`h2o3_tpu.ingest.encode` + the ``Vec`` seam), and Cleaner-driven
+spill of cold DKV values to persist (:mod:`h2o3_tpu.utils.cleaner`).
+
+``frame.parse.import_file`` routes large/compressed files here behind
+``H2O3TPU_INGEST_STREAMING`` (``auto`` streams gzip and files over the
+``H2O3TPU_INGEST_STREAM_MIN_BYTES`` floor; ``1`` forces, ``0`` disables).
+"""
+
+from h2o3_tpu.ingest.encode import CompressedChunk, encode_column
+from h2o3_tpu.ingest.pipeline import IngestStats, ParsePromoted, stream_import
+
+__all__ = ["CompressedChunk", "IngestStats", "ParsePromoted",
+           "encode_column", "stream_import"]

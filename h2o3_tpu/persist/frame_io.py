@@ -22,7 +22,9 @@ from h2o3_tpu.utils import telemetry as _tm
 _MAGIC = "h2o3_tpu-frame-v1"
 
 
-def _snapshot_bytes(path: str) -> int:
+def snapshot_bytes(path: str) -> int:
+    """On-disk size of a frame snapshot — what the Cleaner registers under
+    the ``spilled`` kind so `/3/Memory` reconciles across a sweep."""
     total = 0
     for name in ("columns.npz", "frame.json"):
         try:
@@ -30,6 +32,9 @@ def _snapshot_bytes(path: str) -> int:
         except OSError:
             pass
     return total
+
+
+_snapshot_bytes = snapshot_bytes
 
 
 def save_frame(frame: Frame, path: str) -> str:

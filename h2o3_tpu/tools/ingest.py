@@ -1,0 +1,80 @@
+"""graftlint ingest-discipline rule (ING) — unbounded reads in stage bodies.
+
+The streaming ingest pipeline (``ingest/pipeline.py``, docs/INGEST.md)
+exists so host peak memory is O(chunk), not O(file). One careless
+``fh.read()`` inside a stage body silently reverts the whole subsystem to
+the all-at-once parse it replaced — the pipeline still *looks* streamed
+(stages, queues, progress), but the first stage materializes the file and
+every memory claim downstream is fiction. The same applies to
+``readlines()`` (every line at once) and ``np.loadtxt`` (whole-file
+loader).
+
+- **ING001** — inside any function defined under the ``ingest/`` package:
+  a zero-argument ``.read()`` call (no size bound), any ``.readlines()``
+  call, or a call to ``loadtxt``/``genfromtxt``/``read_file`` whole-file
+  loaders. Bounded reads (``fh.read(1 << 20)``) and chunk-sized parsing
+  are the fix shape; a deliberate whole-file read (a tiny sidecar header,
+  say) carries an inline ``# graftlint: ok(<reason>)`` suppression like
+  every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, call_name
+
+#: callables that materialize an entire file regardless of its size
+_WHOLE_FILE_LOADERS = {"loadtxt", "genfromtxt", "read_file"}
+
+
+def _in_ingest(path: str) -> bool:
+    return path.startswith("ingest/") or "/ingest/" in path
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        if not _in_ingest(mod.path):
+            continue
+        # map AST nodes to their enclosing stage/function qualname
+        qual_of: dict[int, str] = {}
+        for fn in sorted((f for f in index.functions.values()
+                          if f.module is mod),
+                         key=lambda f: f.node.lineno):
+            for sub in ast.walk(fn.node):
+                qual_of[id(sub)] = fn.qualname
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            where = qual_of.get(id(node), "")
+            if not where:
+                continue          # module scope: not a stage body
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth == "read" and not node.args and not node.keywords:
+                    findings.append(Finding(
+                        "ING001", mod.path, node.lineno, where,
+                        "unbounded `.read()` in an ingest stage body — "
+                        "this materializes the whole file and reverts the "
+                        "pipeline's O(chunk) memory contract; read bounded "
+                        "blocks (`fh.read(1 << 20)`) instead",
+                        detail="unbounded-read"))
+                    continue
+                if meth == "readlines":
+                    findings.append(Finding(
+                        "ING001", mod.path, node.lineno, where,
+                        "`.readlines()` in an ingest stage body loads "
+                        "every line at once; iterate bounded blocks and "
+                        "re-assemble lines incrementally",
+                        detail="readlines"))
+                    continue
+            name = call_name(node)
+            if name and name.split(".")[-1] in _WHOLE_FILE_LOADERS:
+                findings.append(Finding(
+                    "ING001", mod.path, node.lineno, where,
+                    f"whole-file loader `{name}` in an ingest stage body "
+                    "— O(file) host memory by construction; parse "
+                    "fixed-row chunks through the staged pipeline instead",
+                    detail="whole-file-loader"))
+    return findings

@@ -1,4 +1,4 @@
-"""Cleaner — automatic LRU spill of cold frames under HBM pressure.
+"""Cleaner — LRU/idle-streak spill of cold DKV values under memory pressure.
 
 Reference: ``water/Cleaner.java:10-12`` — a background sweeper that writes
 the least-recently-used DKV byte[] values to the ice_root when the heap
@@ -6,37 +6,86 @@ crosses a watermark, transparently reloading them on next access
 (``water/Value.java`` spill state); ``water/MemoryManager.java`` tracks the
 budget.
 
-TPU-native: HBM is the scarce memory. The Cleaner tracks the device bytes
-of every DKV-resident Frame, and past a configurable budget swaps the
-least-recently-USED frames to the spill directory via the frame persist
-format. A swapped key holds a :class:`SwappedFrame` stub; ``DKV.get``
-resolves stubs by reloading (and sweeps again, possibly evicting something
-else). Enable with ``enable_cleaner(budget_bytes)`` or the
-``H2O3TPU_HBM_BUDGET`` env var (bytes; off by default — a single-chip v5e
-holds 16GB and most jobs never need spill).
+TPU-native, three eviction tiers (cheapest first):
+
+1. **Derived-view drop** — a compressed Vec's materialized device array is
+   a VIEW of its host payload (``ingest/encode``): dropping it frees device
+   bytes at zero I/O cost, and the next access decompresses it back (the
+   PR 9 ``{key}::mesh[...]`` view-cascade template applied to chunks).
+2. **Mesh-view removal** — DKV-registered resharded views rebuild from
+   their source columns; spilling one would snapshot data nobody reloads.
+3. **Per-value spill** — cold DKV values (frames AND raw upload payloads)
+   go to the ice_root; the key holds a :class:`SwappedFrame` /
+   :class:`SwappedValue` stub whose on-disk bytes stay registered under
+   the ``spilled`` kind so ``/3/Memory`` reconciles across a sweep.
+   ``DKV.get`` resolves stubs by reloading (fault-in) and sweeps again.
+
+Victims are chosen by the PR 5 accounting: per-key registered bytes order
+what's worth spilling, and the leak detector's **idle streaks** (sweeps
+with no DKV access) rank coldness ahead of the LRU clock — a key idle for
+four sweeps is colder than anything last-touch ordering alone can prove.
+
+Enable with ``enable_cleaner(budget_bytes)`` or ``H2O3TPU_HBM_BUDGET``
+(bytes; off by default — a single-chip v5e holds 16GB and most jobs never
+need spill).
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import shutil
 import tempfile
 import threading
 import time
+import uuid
 
+from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV
+
+
+def discard_snapshot(path: str) -> None:
+    """Delete a spill artifact: frame snapshots are DIRECTORIES
+    (columns.npz + frame.json), raw spills are files — a bare os.remove
+    on the former raises and silently leaks the ice_root forever. Taken
+    under the Cleaner IO lock so a discard can never tear a snapshot out
+    from under a concurrent fault-in load (reentrant from sweep/resolve)."""
+    with CLEANER._io_lock:
+        with contextlib.suppress(OSError):
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.remove(path)
 
 
 class SwappedFrame:
     """DKV stub for a spilled frame (reference: Value on-disk state)."""
 
-    def __init__(self, key: str, path: str, nrows: int, ncols: int):
+    def __init__(self, key: str, path: str, nrows: int, ncols: int,
+                 disk_bytes: int = 0):
         self.key = key
         self.path = path
         self.nrows = nrows
         self.ncols = ncols
+        self.disk_bytes = int(disk_bytes)
 
     def __repr__(self) -> str:
         return f"SwappedFrame({self.key} @ {self.path})"
+
+
+class SwappedValue:
+    """DKV stub for a spilled non-frame value (today: RawFile payloads)."""
+
+    def __init__(self, key: str, path: str, value_kind: str,
+                 disk_bytes: int, meta: dict | None = None):
+        self.key = key
+        self.path = path
+        self.value_kind = value_kind
+        self.disk_bytes = int(disk_bytes)
+        self.meta = meta or {}
+
+    def __repr__(self) -> str:
+        return f"SwappedValue({self.key} [{self.value_kind}] @ {self.path})"
 
 
 class Cleaner:
@@ -52,16 +101,35 @@ class Cleaner:
         # forget, never reach into ``_touch`` (graftlint LCK003)
         self._lock = threading.Lock()
         self._touch: dict[str, float] = {}
+        # serializes spill-side disk I/O against fault-in: a sweep rewriting
+        # a key's snapshot while a concurrent ``resolve`` reads it is a torn
+        # read (half-written frame.json). Reentrant because a fault-in's own
+        # DKV.put re-enters sweep on the same thread.
+        self._io_lock = threading.RLock()
+        # spill/restore accounting (served in /3/Memory's ``spill`` view)
+        self._spills = 0
+        self._spill_bytes = 0
+        self._restores = 0
+        self._restore_bytes = 0
+        self._view_drops = 0
+        self._view_drop_bytes = 0
 
     # -- bookkeeping ---------------------------------------------------------
 
     @staticmethod
     def _frame_bytes(fr) -> int:
-        total = 0
-        for v in getattr(fr, "vecs", []):
-            if v.data is not None:
-                total += v.data.size * v.data.dtype.itemsize
-        return total
+        """Resident bytes of a frame WITHOUT forcing lazy materialization
+        (``Frame.nbytes`` → ``vec_nbytes`` reads the raw device slot)."""
+        return int(getattr(fr, "nbytes", 0) or 0)
+
+    @staticmethod
+    def _value_bytes(v) -> int:
+        tname = type(v).__name__
+        if tname == "Frame":
+            return Cleaner._frame_bytes(v)
+        if tname == "RawFile":
+            return len(getattr(v, "data", b"") or b"")
+        return 0
 
     def touch(self, key: str) -> None:
         with self._lock:
@@ -84,17 +152,60 @@ class Cleaner:
     def resident_frames(self):
         from h2o3_tpu.frame.frame import Frame
         out = []
-        with DKV._lock:   # RAW store: DKV.get would re-inflate swapped stubs
-            items = list(DKV._store.items())
-        for k, v in items:
+        for k, v in DKV.raw_items():   # raw: get would re-inflate stubs
             if isinstance(v, Frame):
                 out.append((k, v))
         return out
 
+    def _spillable_values(self):
+        """(key, value) for every DKV value the sweeper may evict: frames
+        and raw upload payloads, never jobs/models/stubs."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.parse import RawFile
+        out = []
+        for k, v in DKV.raw_items():
+            if isinstance(v, (Frame, RawFile)):
+                out.append((k, v))
+        return out
+
+    def stats(self) -> dict:
+        """The ``/3/Memory`` spill view: budget, live counters, and what is
+        currently sitting on disk."""
+        spilled = []
+        for k, v in DKV.raw_items():
+            if isinstance(v, (SwappedFrame, SwappedValue)):
+                spilled.append({"key": k, "disk_bytes": v.disk_bytes,
+                                "kind": getattr(v, "value_kind", "frame")})
+        with self._lock:
+            return {"budget_bytes": self.budget, "ice_root": self.ice_root,
+                    "spill_count": self._spills,
+                    "spill_bytes": self._spill_bytes,
+                    "restore_count": self._restores,
+                    "restore_bytes": self._restore_bytes,
+                    "view_drops": self._view_drops,
+                    "view_drop_bytes": self._view_drop_bytes,
+                    "spilled_keys": sorted(spilled,
+                                           key=lambda r: -r["disk_bytes"]),
+                    "spilled_disk_bytes": sum(r["disk_bytes"]
+                                              for r in spilled)}
+
     # -- sweep ---------------------------------------------------------------
 
+    def _cold_order(self, items):
+        """Victim order: longest idle streak first (per-key accounting +
+        idle-streak detector, utils/memory.py), LRU clock as tiebreak."""
+        from h2o3_tpu.utils.memory import MEMORY
+        idle = MEMORY.idle_streaks()
+        return sorted(items,
+                      key=lambda kv: (-idle.get(kv[0], 0),
+                                      self.last_touched(kv[0])))
+
     def sweep(self, protect: str | None = None) -> list[str]:
-        """Spill LRU frames until under budget; returns spilled keys."""
+        """Evict cold values until under budget; returns the spilled keys.
+
+        Tier 1 drops derived device views of compressed columns (free);
+        tier 2 removes rebuildable mesh views; tier 3 spills whole values
+        to the ice_root behind stubs."""
         if self.budget is None:
             return []
         # every budgeted sweep advances one leak-detector generation: the
@@ -102,48 +213,171 @@ class Cleaner:
         # grow or sit untouched for N of them (utils/memory.py)
         from h2o3_tpu.utils.memory import MEMORY
         MEMORY.leak_sweep()
-        frames = self.resident_frames()
-        total = sum(self._frame_bytes(f) for _, f in frames)
+        values = self._spillable_values()
+        total = sum(self._value_bytes(v) for _, v in values)
         if total <= self.budget:
             return []
-        os.makedirs(self.ice_root, exist_ok=True)
-        order = sorted(frames, key=lambda kv: self.last_touched(kv[0]))
-        spilled = []
-        from h2o3_tpu.persist.frame_io import save_frame
-        for k, fr in order:
-            if total <= self.budget:
-                break
-            if k == protect:
+        # -- tier 1: drop decompress-on-access device views ------------------
+        for k, v in self._cold_order(values):
+            if total <= self.budget or k == protect:
                 continue
-            if getattr(fr, "_is_mesh_view", False):
-                # resharded mesh views (Frame.on_mesh) rebuild from their
-                # source columns on next use — spilling one would write a
-                # snapshot nobody ever reloads and leave a SwappedFrame
-                # stub posing as a user frame; just drop it
-                DKV.remove(k)
-            else:
-                path = os.path.join(self.ice_root, k)
-                save_frame(fr, path)
-                DKV.put(k, SwappedFrame(k, path, fr.nrows, fr.ncols))
-            total -= self._frame_bytes(fr)
-            spilled.append(k)
+            drop = getattr(v, "drop_device_views", None)
+            if drop is None:
+                continue
+            freed = drop()
+            if freed:
+                total -= freed
+                with self._lock:
+                    self._view_drops += 1
+                    self._view_drop_bytes += freed
+                _tm.CHUNK_VIEW_DROPS.inc()
+                _tm.CHUNK_VIEW_DROP_BYTES.inc(freed)
+                MEMORY.register(k, v)   # re-account the slimmer frame
+        if total <= self.budget:
+            return []
+        # -- tiers 2+3: remove mesh views / spill whole values ---------------
+        os.makedirs(self.ice_root, exist_ok=True)
+        spilled = []
+        from h2o3_tpu.persist.frame_io import save_frame, snapshot_bytes
+        with self._io_lock:    # never rewrite a snapshot a fault-in is reading
+            for k, v in self._cold_order(values):
+                if total <= self.budget:
+                    break
+                if k == protect:
+                    continue
+                with DKV._lock:    # raw read: is this value still current?
+                    if DKV._store.get(k) is not v:
+                        continue   # re-put/removed/restored since snapshot
+                nbytes = self._value_bytes(v)
+                if getattr(v, "_is_mesh_view", False):
+                    # resharded mesh views (Frame.on_mesh) rebuild from
+                    # their source columns on next use — spilling one would
+                    # write a snapshot nobody ever reloads and leave a stub
+                    # posing as a user frame; just drop it (identity-checked
+                    # so a concurrently re-put key is never collateral)
+                    with DKV._lock:
+                        if DKV._store.get(k) is v:
+                            DKV.remove(k)
+                elif type(v).__name__ == "RawFile":
+                    # unique path per spill: a restored key's snapshot is
+                    # discarded AFTER install, and a re-spill racing that
+                    # discard must never share the deleted path
+                    path = os.path.join(
+                        self.ice_root, f"{k}.{uuid.uuid4().hex[:8]}.raw")
+                    with open(path, "wb") as fh:
+                        fh.write(v.data)
+                    stub = SwappedValue(k, path, "raw", len(v.data),
+                                        meta={"name": v.name})
+                    if not self._cas_stub(k, v, stub):
+                        continue     # key changed during the write: no spill
+                    self._note_spill("raw", len(v.data))
+                else:
+                    path = os.path.join(
+                        self.ice_root, f"{k}.{uuid.uuid4().hex[:8]}")
+                    save_frame(v, path)
+                    stub = SwappedFrame(k, path, v.nrows, v.ncols,
+                                        disk_bytes=snapshot_bytes(path))
+                    if not self._cas_stub(k, v, stub):
+                        continue
+                    self._note_spill("frame", nbytes)
+                total -= nbytes
+                spilled.append(k)
         return spilled
 
+    def _cas_stub(self, key: str, expected, stub) -> bool:
+        """Install a spill stub ONLY while the store still holds the value
+        the snapshot was taken from. The snapshot write happens outside the
+        store lock (it's slow), so a concurrent put of a NEW value under
+        the same key must win — otherwise the stub would resurrect stale
+        data on the next fault-in (lost update)."""
+        if not DKV.replace_if(key, expected, stub):
+            discard_snapshot(stub.path)
+            return False
+        return True
+
+    def _note_spill(self, kind: str, nbytes: int) -> None:
+        with self._lock:
+            self._spills += 1
+            self._spill_bytes += nbytes
+        _tm.SPILLS.labels(kind=kind).inc()
+        _tm.SPILL_BYTES.labels(kind=kind).inc(nbytes)
+
+    def _note_restore(self, kind: str, nbytes: int) -> None:
+        with self._lock:
+            self._restores += 1
+            self._restore_bytes += nbytes
+        _tm.SPILL_RESTORES.labels(kind=kind).inc()
+        _tm.SPILL_RESTORE_BYTES.labels(kind=kind).inc(nbytes)
+
+    def _resolve_loop(self, key: str, stub, live_type, load, kind: str):
+        """Shared fault-in driver: load the snapshot under the IO lock,
+        CAS the restored value in, and on a lost race ADOPT whatever
+        superseded our stub — a live value wins outright, a NEWER stub
+        (the key was re-put and re-spilled mid-restore) is resolved in its
+        place (never hand back the stale load), and a concurrent remove is
+        honored (the load is returned but never resurrected). Bounded —
+        under pathological thrash the latest load is still correct data
+        for the caller."""
+        value = None
+        for _ in range(8):
+            with self._io_lock:
+                with DKV._lock:
+                    cur = DKV._store.get(key)
+                if isinstance(cur, live_type):
+                    return cur            # racing restore/user-put won
+                if type(cur).__name__ in ("SwappedFrame", "SwappedValue") \
+                        and cur is not stub:
+                    stub = cur            # newer spill superseded ours
+                elif cur is None and value is not None:
+                    return value          # removed mid-restore: honor it
+                try:
+                    value = load(stub)
+                except OSError:
+                    # the key was removed AND its snapshot discarded before
+                    # we got the IO lock — the key is simply gone
+                    return value
+            if DKV.replace_if(key, stub, value):
+                self._note_restore(kind, self._value_bytes(value)
+                                   or getattr(stub, "disk_bytes", 0))
+                discard_snapshot(stub.path)   # store owns the data again
+                self.touch(key)
+                self.sweep(protect=key)
+                return value
+        return value
+
     def resolve(self, key: str, stub: SwappedFrame):
-        """Reload a spilled frame (sweeping others to stay under budget)."""
+        """Fault a spilled frame back in (sweeping others to stay under
+        budget). Serialized against sweeps via the IO lock, and installed
+        by compare-and-swap: a racing restore/user-put wins — never hand
+        back a torn load, never resurrect stale data."""
+        from h2o3_tpu.frame.frame import Frame
         from h2o3_tpu.persist.frame_io import load_frame
-        fr = load_frame(stub.path, key=key)
-        DKV.put(key, fr)
-        self.touch(key)
-        self.sweep(protect=key)
-        return fr
+
+        def load(st):
+            fr = load_frame(st.path)
+            fr.key = key
+            return fr
+
+        return self._resolve_loop(key, stub, Frame, load, "frame")
+
+    def resolve_value(self, key: str, stub: SwappedValue):
+        """Fault a spilled non-frame value back in."""
+        if stub.value_kind != "raw":
+            raise ValueError(f"unknown spilled value kind {stub.value_kind!r}")
+        from h2o3_tpu.frame.parse import RawFile
+
+        def load(st):
+            with open(st.path, "rb") as fh:
+                return RawFile(fh.read(), name=st.meta.get("name", "upload"))
+
+        return self._resolve_loop(key, stub, RawFile, load, "raw")
 
 
 CLEANER = Cleaner()
 
 
 def enable_cleaner(budget_bytes: int, ice_root: str | None = None) -> Cleaner:
-    """Turn on automatic spill with the given HBM budget (bytes)."""
+    """Turn on automatic spill with the given resident-byte budget."""
     CLEANER.budget = int(budget_bytes)
     if ice_root:
         CLEANER.ice_root = ice_root

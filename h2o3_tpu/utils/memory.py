@@ -99,8 +99,11 @@ def value_kind_bytes(value) -> tuple[str, int]:
         # of a frame's resident bytes, so /3/Memory's per-key view can
         # never drift from what the frame reports about itself
         return "frame", int(value.nbytes)
-    if tname == "SwappedFrame":
-        return "swapped", 0          # spilled to disk — zero resident bytes
+    if tname in ("SwappedFrame", "SwappedValue"):
+        # spilled to persist — zero RESIDENT bytes, but the on-disk size is
+        # registered under its own kind so the /3/Memory view reconciles
+        # across a sweep (bytes move frame→spilled instead of vanishing)
+        return "spilled", int(getattr(value, "disk_bytes", 0) or 0)
     if tname == "RawFile":
         return "raw", len(getattr(value, "data", b"") or b"")
     if tname == "Job":
@@ -133,6 +136,9 @@ def value_host_bytes(value) -> int:
                     total += int(host.nbytes)
                 except (TypeError, AttributeError):
                     pass
+            comp = getattr(v, "compressed", None)
+            if comp is not None:   # compressed column payloads live in RSS
+                total += int(comp.nbytes)
         return total
     kind, nbytes = value_kind_bytes(value)
     if kind == "raw":
@@ -145,15 +151,22 @@ def value_host_bytes(value) -> int:
 
 
 def vec_nbytes(vec) -> int:
-    """One column's resident bytes: the padded device chunk plus any
+    """One column's resident bytes: the padded device chunk (when it is
+    materialized — NEVER forced: accounting must not trigger the compressed
+    seam's decompress-on-access), any compressed host payload, plus any
     host-side payload (STR/UUID object arrays, exact TIME ms)."""
     total = 0
-    data = getattr(vec, "data", None)
+    # ``_data`` is the raw slot behind the lazily-materializing ``data``
+    # property; plain attribute-carriers without it fall back to ``data``
+    data = vec._data if hasattr(vec, "_data") else getattr(vec, "data", None)
     if data is not None:
         try:
             total += int(data.nbytes)
         except (TypeError, AttributeError):
             pass
+    comp = getattr(vec, "compressed", None)
+    if comp is not None:
+        total += int(comp.nbytes)
     host = getattr(vec, "host_values", None)
     if host is not None:
         try:
@@ -487,6 +500,13 @@ class MemoryMeter:
             self._accessed.clear()
             self.detector.observe(keyed, accessed)
 
+    def idle_streaks(self) -> dict[str, int]:
+        """Per-key consecutive no-access sweep counts from the leak
+        detector — the Cleaner's spill-victim signal (a key idle for many
+        sweeps is colder than anything the LRU clock alone can prove)."""
+        with self._lock:
+            return {k: st["idle"] for k, st in self.detector._state.items()}
+
     def leak_report(self) -> dict:
         with self._lock:
             return {"sweeps": self.detector.generation,
@@ -520,7 +540,8 @@ class MemoryMeter:
 
     def summary(self, top_n: int = 10, refresh: bool = True) -> dict:
         """The ``/3/Memory`` payload: host + device stats, keyed totals,
-        top-N keys, watermarks, leak report."""
+        top-N keys, watermarks, leak report, and the Cleaner's spill view
+        (budget, spill/fault-in counters, what sits in the ice_root)."""
         if refresh:
             self.refresh()
         host = host_stats()
@@ -529,12 +550,14 @@ class MemoryMeter:
         # above — no second /proc read or live-array walk)
         self.sample(rss=host["rss_bytes"], dev=dev["bytes_in_use"])
         total, by_kind, nkeys = self.dkv_totals()
+        from h2o3_tpu.utils.cleaner import CLEANER
         return {"host": host, "device": dev,
                 "dkv": {"total_bytes": total, "by_kind": by_kind,
                         "keys": nkeys},
                 "top_keys": self.top_keys(top_n),
                 "watermarks": self.watermarks,
-                "leaks": self.leak_report()}
+                "leaks": self.leak_report(),
+                "spill": CLEANER.stats()}
 
 
 #: the process-wide meter (reference: the MemoryManager singleton)

@@ -48,13 +48,42 @@ class KeyedStore:
             # new value's lookup table starts empty, so they would hold
             # full-size device buffers in /3/Memory forever
             self._drop_mesh_views(key)
-        if type(value).__name__ == "Frame":
+        if old is not None and old is not value \
+                and type(old).__name__ in ("SwappedFrame", "SwappedValue"):
+            # a user put over a SPILLED key orphans its snapshot — retire
+            # it or the ice_root leaks one artifact per overwrite
+            from h2o3_tpu.utils.cleaner import CLEANER, discard_snapshot
+            discard_snapshot(old.path)
+            CLEANER.forget(key)
+        if type(value).__name__ in ("Frame", "RawFile"):
             # Cleaner hook (reference: Cleaner LRU sweep on heap pressure);
-            # no-op unless a budget is enabled
+            # no-op unless a budget is enabled. Raw upload payloads are
+            # spillable values too (per-value spill, docs/INGEST.md)
             from h2o3_tpu.utils.cleaner import CLEANER
             CLEANER.touch(key)
             CLEANER.sweep(protect=key)
         return key
+
+    def replace_if(self, key: str, expected: Any, value: Any) -> bool:
+        """Atomic compare-and-swap: install ``value`` only while the store
+        still holds ``expected`` (identity). Runs the byte registration but
+        NOT the Cleaner put-hook — callers (the Cleaner's spill/fault-in
+        paths) touch/sweep themselves OUTSIDE the store lock, because a
+        sweep takes the Cleaner IO lock and a concurrent sweep holding that
+        lock CASes here: hook-under-store-lock would be an ABBA deadlock."""
+        from h2o3_tpu.utils.memory import MEMORY
+        with self._lock:
+            if self._store.get(key) is not expected:
+                return False
+            self._store[key] = value
+            n = len(self._store)
+            MEMORY.register(key, value)
+        _tm.DKV_PUTS.inc()
+        _tm.DKV_KEYS.set(n)
+        if expected is not None and expected is not value \
+                and type(expected).__name__ in ("Frame", "SwappedFrame"):
+            self._drop_mesh_views(key)
+        return True
 
     def _resolve(self, key: str, value: Any) -> Any:
         if value is None:
@@ -63,7 +92,10 @@ class KeyedStore:
         if tname == "SwappedFrame":
             from h2o3_tpu.utils.cleaner import CLEANER
             return CLEANER.resolve(key, value)
-        if tname == "Frame":
+        if tname == "SwappedValue":
+            from h2o3_tpu.utils.cleaner import CLEANER
+            return CLEANER.resolve_value(key, value)
+        if tname in ("Frame", "RawFile"):
             from h2o3_tpu.utils.cleaner import CLEANER
             if CLEANER.budget is not None:
                 CLEANER.touch(key)
@@ -94,12 +126,11 @@ class KeyedStore:
             MEMORY.unregister(key)
         _tm.DKV_REMOVES.inc()
         _tm.DKV_KEYS.set(n)
-        if type(v).__name__ == "SwappedFrame":
-            import contextlib
-            import os
-            from h2o3_tpu.utils.cleaner import CLEANER
-            with contextlib.suppress(OSError):
-                os.remove(v.path)
+        if type(v).__name__ in ("SwappedFrame", "SwappedValue"):
+            # frame snapshots are DIRECTORIES — discard_snapshot handles
+            # both shapes (a bare os.remove leaked the ice_root forever)
+            from h2o3_tpu.utils.cleaner import CLEANER, discard_snapshot
+            discard_snapshot(v.path)
             CLEANER.forget(key)
             # a spilled source's views are just as unreachable as a live
             # one's — the stub carries no view table, so cascade by key
@@ -154,12 +185,10 @@ class KeyedStore:
             MEMORY.clear()
         _tm.DKV_REMOVES.inc(len(items))
         _tm.DKV_KEYS.set(0)
-        import contextlib
-        import os
+        from h2o3_tpu.utils.cleaner import discard_snapshot
         for _k, v in items:
-            if type(v).__name__ == "SwappedFrame":
-                with contextlib.suppress(OSError):
-                    os.remove(v.path)
+            if type(v).__name__ in ("SwappedFrame", "SwappedValue"):
+                discard_snapshot(v.path)
         from h2o3_tpu.utils.cleaner import CLEANER
         CLEANER.forget_all()
 

@@ -407,6 +407,45 @@ PARSE_BYTES = METRICS.counter("h2o3_parse_bytes", "source bytes parsed")
 PARSE_CHUNKS = METRICS.counter(
     "h2o3_parse_chunks", "column chunks (vecs) created by parses")
 
+# streaming ingest pipeline (ingest/pipeline.py — docs/INGEST.md)
+INGEST_CHUNKS = METRICS.counter(
+    "h2o3_ingest_chunks", "fixed-row-count chunk batches through the "
+    "streaming parse pipeline")
+INGEST_ROWS = METRICS.counter(
+    "h2o3_ingest_rows", "rows parsed by the streaming pipeline")
+INGEST_BYTES = METRICS.counter(
+    "h2o3_ingest_bytes", "decompressed source bytes consumed by the "
+    "streaming pipeline")
+INGEST_ENCODED_BYTES = METRICS.counter(
+    "h2o3_ingest_encoded_bytes", "compressed host payload bytes produced "
+    "by the chunk encoders (vs 4B/value eager columns)")
+INGEST_RESTARTS = METRICS.counter(
+    "h2o3_ingest_restarts", "promote-and-reparse restarts (a chunk past "
+    "the type-inference sample broke a numeric guess)")
+
+# compressed-chunk seam (frame/vec.py lazy decompress-on-access)
+CHUNK_DECOMPRESS = METRICS.counter(
+    "h2o3_chunk_decompress", "compressed columns materialized to device "
+    "arrays on access (Chunk.atd decompress-on-access)")
+CHUNK_DECOMPRESS_BYTES = METRICS.counter(
+    "h2o3_chunk_decompress_bytes", "decoded bytes materialized on access")
+CHUNK_VIEW_DROPS = METRICS.counter(
+    "h2o3_chunk_view_drops", "derived device views of compressed columns "
+    "dropped by the Cleaner (tier-1 eviction)")
+CHUNK_VIEW_DROP_BYTES = METRICS.counter(
+    "h2o3_chunk_view_drop_bytes", "device bytes freed by view drops")
+
+# Cleaner spill/fault-in (utils/cleaner.py — docs/INGEST.md "Spill")
+SPILLS = METRICS.counter(
+    "h2o3_spill", "DKV values spilled to the ice_root", ("kind",))
+SPILL_BYTES = METRICS.counter(
+    "h2o3_spill_bytes", "resident bytes released by spills", ("kind",))
+SPILL_RESTORES = METRICS.counter(
+    "h2o3_spill_restore", "spilled values faulted back in on access",
+    ("kind",))
+SPILL_RESTORE_BYTES = METRICS.counter(
+    "h2o3_spill_restore_bytes", "bytes faulted back in on access", ("kind",))
+
 # DKV (utils/registry.py)
 DKV_PUTS = METRICS.counter("h2o3_dkv_puts", "DKV puts")
 DKV_GETS = METRICS.counter("h2o3_dkv_gets", "DKV gets")
@@ -416,7 +455,8 @@ DKV_KEYS = METRICS.gauge("h2o3_dkv_keys", "resident DKV keys")
 # memory accounting (utils/memory.py MemoryMeter)
 DKV_BYTES = METRICS.gauge(
     "h2o3_dkv_bytes", "resident DKV bytes by value kind "
-    "(frame/model/raw/swapped/job/other)", ("kind",))
+    "(frame/model/raw/job/other; `spilled` carries ON-DISK bytes so the "
+    "view reconciles across a Cleaner sweep)", ("kind",))
 HOST_RSS_BYTES = METRICS.gauge(
     "h2o3_host_rss_bytes", "process resident set size (/proc/self/status)")
 HOST_RSS_PEAK_BYTES = METRICS.gauge(

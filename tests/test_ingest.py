@@ -1,0 +1,553 @@
+"""Streaming ingest + out-of-core frames (docs/INGEST.md).
+
+Reference behaviors under test: the overlapped chunked parse
+(``ParseDataset``'s setup-sample + chunk MRTask shape), compressed chunk
+encodings with decompress-on-access (``NewChunk`` codec choice /
+``Chunk.atd``), and Cleaner-driven spill with transparent fault-in
+(``water/Cleaner.java`` + ``water/Value.java`` spill state).
+"""
+
+import gzip
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.types import VecType
+from h2o3_tpu.utils.registry import DKV
+
+
+def _write_csv(path, nrows, rng, gz=False, cats=("aa", "bb", "cc")):
+    lines = ["xi,yf,cat"]
+    xi = rng.integers(-40, 90, size=nrows)
+    yf = rng.normal(size=nrows)
+    cs = [cats[i % len(cats)] for i in range(nrows)]
+    for a, b, c in zip(xi, yf, cs):
+        lines.append(f"{a},{b:.6f},{c}")
+    text = "\n".join(lines) + "\n"
+    if gz:
+        with gzip.open(path, "wt") as f:
+            f.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+    return xi.astype(np.float32), yf.astype(np.float32), cs
+
+
+# -- streaming chunked parse -------------------------------------------------
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_stream_parse_matches_eager(tmp_path, rng, gz):
+    from h2o3_tpu.ingest import stream_import
+    p = str(tmp_path / ("t.csv.gz" if gz else "t.csv"))
+    xi, yf, cs = _write_csv(p, 3000, rng, gz=gz)
+    fr = stream_import(p, key="s.hex", chunk_rows=512)
+    assert fr.nrows == 3000 and fr.ncols == 3
+    assert fr.types == {"xi": "int", "yf": "real", "cat": "enum"}
+    np.testing.assert_array_equal(fr.vec("xi").to_numpy(), xi)
+    assert list(fr.vec("cat").labels()) == cs
+    # bit-exact against the eager pandas path (the parity reference)
+    from h2o3_tpu.frame.parse import import_file
+    fe = import_file(p, key="se.hex")
+    np.testing.assert_array_equal(fr.vec("yf").to_numpy(),
+                                  fe.vec("yf").to_numpy())
+    np.testing.assert_allclose(fr.vec("yf").to_numpy(), yf, atol=1e-6)
+    assert DKV.get("s.hex") is fr
+    # the parse ran chunked, with bounded transient memory between stages
+    st = fr._ingest_stats
+    assert st["chunks"] >= 5 and st["rows"] == 3000
+    assert st["inflight_peak_bytes"] < st["bytes_in"]
+
+
+def test_stream_parse_compresses(tmp_path, rng):
+    from h2o3_tpu.ingest import stream_import
+    p = str(tmp_path / "c.csv")
+    _write_csv(p, 4000, rng)
+    fr = stream_import(p, key="c.hex", chunk_rows=1024)
+    # xi spans < 256 integral values -> i8; cat cardinality 3 -> dict8;
+    # yf is fractional -> f32 identity
+    assert fr.vec("xi").compressed.codec == "i8"
+    assert fr.vec("cat").compressed.codec == "dict8"
+    assert fr.vec("yf").compressed.codec == "f32"
+    assert fr._ingest_stats["compression_ratio"] > 1.5
+
+
+def test_promote_and_reparse(tmp_path):
+    """A chunk past the inference sample that breaks a numeric guess forces
+    one bounded restart with the column categorical."""
+    from h2o3_tpu.ingest import stream_import
+    lines = ["a,b"] + [f"{i},{i * 2}" for i in range(1500)] \
+        + ["surprise,3000"] + [f"{i},{i}" for i in range(50)]
+    p = tmp_path / "p.csv"
+    p.write_text("\n".join(lines) + "\n")
+    fr = stream_import(str(p), key="p.hex", chunk_rows=256)
+    assert fr.nrows == 1551
+    assert fr.types["a"] == "enum" and fr.types["b"] == "int"
+    assert fr._ingest_stats["restarts"] == 1
+    assert "surprise" in fr.vec("a").domain
+    # k columns breaking in the SAME chunk ride one restart, not k
+    lines2 = ["m,n"] + [f"{i},{i}" for i in range(1000)] + ["uh,oh"]
+    p2 = tmp_path / "p2.csv"
+    p2.write_text("\n".join(lines2) + "\n")
+    fr2 = stream_import(str(p2), key="p2.hex", chunk_rows=256)
+    assert fr2.types == {"m": "enum", "n": "enum"}
+    assert fr2._ingest_stats["restarts"] == 1
+
+
+def test_import_file_routes_streaming(tmp_path, rng, monkeypatch):
+    """``import_file`` routes through the pipeline behind
+    H2O3TPU_INGEST_STREAMING, and parse is a real Job with row/byte
+    progress."""
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.models.job import Job
+    p = str(tmp_path / "r.csv")
+    xi, _, _ = _write_csv(p, 2000, rng)
+    monkeypatch.setenv("H2O3TPU_INGEST_STREAMING", "1")
+    fr = import_file(p, key="r.hex")
+    assert hasattr(fr, "_ingest_stats") and fr.nrows == 2000
+    np.testing.assert_array_equal(fr.vec("xi").to_numpy(), xi)
+    jobs = [v for _k, v in DKV.raw_items() if isinstance(v, Job)
+            and v.description.startswith("Parse")]
+    assert jobs and jobs[-1].status == Job.DONE
+    assert jobs[-1].progress == 1.0
+    assert "rows" in jobs[-1].progress_msg and "bytes" in jobs[-1].progress_msg
+    # off switch: the eager path produces a frame with no ingest stats
+    monkeypatch.setenv("H2O3TPU_INGEST_STREAMING", "0")
+    fr2 = import_file(p, key="r2.hex")
+    assert not hasattr(fr2, "_ingest_stats")
+
+
+# -- compressed chunk encodings ----------------------------------------------
+
+
+def test_encode_roundtrip_widths():
+    from h2o3_tpu.ingest.encode import encode_codes, encode_numeric
+    # i8: small-span integral with NA
+    v = np.array([10, 11, np.nan, 137, 10], np.float32)
+    ch = encode_numeric(v)
+    assert ch.codec == "i8" and ch.nbytes == 5
+    np.testing.assert_array_equal(ch.decode(), v)
+    # i16: span past 255
+    v2 = np.arange(0, 40000, 13, dtype=np.float32)
+    ch2 = encode_numeric(v2)
+    assert ch2.codec == "i16"
+    np.testing.assert_array_equal(ch2.decode(), v2)
+    # fractional -> identity
+    v3 = np.array([0.5, 1.25, np.nan], np.float32)
+    assert encode_numeric(v3).codec == "f32"
+    np.testing.assert_array_equal(encode_numeric(v3).decode(), v3)
+    # huge integral values past float32's exact-int range -> identity wins
+    v4 = np.array([2.0**25, 2.0**25 + 2], np.float32)
+    np.testing.assert_array_equal(encode_numeric(v4).decode(), v4)
+    # dict widths follow cardinality; CAT_NA (-1) survives every width
+    codes = np.array([0, 1, -1, 2], np.int32)
+    assert encode_codes(codes, 3).codec == "dict8"
+    assert encode_codes(codes, 300).codec == "dict16"
+    assert encode_codes(codes, 70000).codec == "dict32"
+    np.testing.assert_array_equal(encode_codes(codes, 300).decode(), codes)
+
+
+def test_lazy_decompress_and_view_drop(tmp_path, rng):
+    """A compressed Vec's device array is a derived view: materialized on
+    first access, droppable by the Cleaner, rebuilt on the next access —
+    and accounting never forces a materialization."""
+    from h2o3_tpu.ingest import stream_import
+    p = str(tmp_path / "l.csv")
+    xi, _, _ = _write_csv(p, 2048, rng)
+    fr = stream_import(p, key="l.hex", chunk_rows=512)
+    v = fr.vec("xi")
+    assert not v.device_resident
+    nb_cold = v.nbytes                      # compressed payload only
+    assert nb_cold == v.compressed.nbytes
+    _ = v.data                              # decompress-on-access
+    assert v.device_resident
+    assert v.nbytes > nb_cold               # device view now accounted too
+    freed = fr.drop_device_views()
+    assert freed > 0 and not v.device_resident
+    np.testing.assert_array_equal(v.to_numpy(), xi)   # host decode path
+    _ = v.data
+    assert v.device_resident                # rebuilt on demand
+
+
+def test_cleaner_drops_views_before_spilling(tmp_path, rng):
+    """Tier-1 eviction: under budget pressure the Cleaner frees derived
+    device views of compressed frames before writing anything to disk."""
+    from h2o3_tpu.ingest import stream_import
+    from h2o3_tpu.utils.cleaner import CLEANER, disable_cleaner, enable_cleaner
+    p = str(tmp_path / "v.csv")
+    _write_csv(p, 4096, rng)
+    try:
+        fr = stream_import(p, key="v.hex", chunk_rows=1024)
+        for name in fr.names:
+            _ = fr.vec(name).data           # materialize every view
+        resident = fr.nbytes
+        # budget between compressed-only and fully-materialized size
+        enable_cleaner(resident - 1000, ice_root=str(tmp_path / "ice"))
+        spilled = CLEANER.sweep()
+        assert spilled == []                # view drops sufficed
+        assert CLEANER.stats()["view_drops"] >= 1
+        assert any(not v.device_resident for v in fr.vecs)
+        with DKV._lock:
+            assert isinstance(DKV._store["v.hex"], Frame)   # never stubbed
+    finally:
+        disable_cleaner()
+
+
+# -- spill accounting + races ------------------------------------------------
+
+
+def _mk_frame(key, rng, n=4096, ncols=4):
+    f = Frame.from_arrays(
+        {f"c{i}": rng.normal(size=n).astype(np.float32)
+         for i in range(ncols)}, key=key)
+    DKV.put(key, f)
+    return f
+
+
+def test_spilled_kind_reconciles_memory_view(tmp_path, rng):
+    """ISSUE 14 satellite: a SwappedFrame stub must not vanish from
+    /3/Memory — its on-disk bytes register under the `spilled` kind and the
+    stub stays in the top-keys view."""
+    from h2o3_tpu.utils.cleaner import (SwappedFrame, disable_cleaner,
+                                        enable_cleaner)
+    from h2o3_tpu.utils.memory import MEMORY
+    try:
+        enable_cleaner(150_000, ice_root=str(tmp_path))
+        _mk_frame("fr_a", rng)
+        _mk_frame("fr_b", rng)
+        DKV.get("fr_b")
+        _mk_frame("fr_c", rng)              # over budget -> LRU (fr_a) spills
+        with DKV._lock:
+            stub = DKV._store["fr_a"]
+        assert isinstance(stub, SwappedFrame) and stub.disk_bytes > 0
+        summary = MEMORY.summary(refresh=True)
+        by_kind = summary["dkv"]["by_kind"]
+        assert by_kind.get("spilled", 0) == stub.disk_bytes
+        assert any(r["key"] == "fr_a" and r["kind"] == "spilled"
+                   for r in summary["top_keys"])
+        sp = summary["spill"]
+        assert sp["spill_count"] >= 1 and sp["spilled_disk_bytes"] > 0
+        assert any(r["key"] == "fr_a" for r in sp["spilled_keys"])
+    finally:
+        disable_cleaner()
+
+
+def test_raw_value_spill_and_fault_in(tmp_path, rng):
+    """Per-value spill beyond frames: a cold RawFile payload spills to the
+    ice_root behind a SwappedValue stub and faults back in on access."""
+    from h2o3_tpu.frame.parse import RawFile
+    from h2o3_tpu.utils.cleaner import (CLEANER, SwappedValue,
+                                        disable_cleaner, enable_cleaner)
+    try:
+        enable_cleaner(150_000, ice_root=str(tmp_path))
+        payload = bytes(rng.integers(0, 256, size=120_000, dtype=np.uint8))
+        DKV.put("up1", RawFile(payload, name="big.csv"))
+        _mk_frame("fr_hot", rng)            # pushes the cold raw key out
+        with DKV._lock:
+            stub = DKV._store.get("up1")
+        assert isinstance(stub, SwappedValue)
+        assert stub.disk_bytes == len(payload)
+        back = DKV["up1"]                   # transparent fault-in
+        assert isinstance(back, RawFile) and back.data == payload
+        assert back.name == "big.csv"
+        st = CLEANER.stats()
+        assert st["restore_count"] >= 1
+    finally:
+        disable_cleaner()
+
+
+def test_dkv_get_races_cleaner_sweep(tmp_path, rng):
+    """ISSUE 14 satellite: concurrent DKV.get racing Cleaner.sweep on the
+    same key — resolve-vs-swap interleaving must never hand a stub to a
+    caller."""
+    from h2o3_tpu.utils.cleaner import disable_cleaner, enable_cleaner
+    try:
+        # budget fits ~1 frame: every get of one key tends to spill the other
+        enable_cleaner(70_000, ice_root=str(tmp_path))
+        want = {}
+        for k in ("race_a", "race_b"):
+            want[k] = _mk_frame(k, rng).vec("c0").to_numpy().copy()
+        errors: list = []
+        stop = threading.Event()
+
+        def hammer(key):
+            try:
+                while not stop.is_set():
+                    got = DKV.get(key)
+                    assert isinstance(got, Frame), f"stub escaped: {got!r}"
+                    np.testing.assert_allclose(
+                        got.vec("c0").to_numpy(), want[key], rtol=1e-6)
+            except BaseException as e:   # noqa: BLE001 — surfaced below
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=hammer, args=(k,), daemon=True)
+                   for k in want for _ in range(2)]
+        for t in threads:
+            t.start()
+        for _ in range(40):
+            if stop.is_set():
+                break
+            stop.wait(timeout=0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors, errors[0]
+    finally:
+        disable_cleaner()
+
+
+# -- structured import errors ------------------------------------------------
+
+
+def test_import_file_missing_path_raises_structured():
+    from h2o3_tpu.frame.parse import import_file
+    with pytest.raises(FileNotFoundError, match="no such file"):
+        import_file("/definitely/not/here.csv")
+    with pytest.raises(IsADirectoryError, match="directory"):
+        import_file("/tmp")
+
+
+def test_import_files_bad_path_is_400_not_500():
+    """POST /3/ImportFiles on a nonexistent path must reply a structured
+    400 H2OErrorV3 (and the client maps it to FileNotFoundError), never a
+    500 traceback."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api import H2OServer
+    from h2o3_tpu.api.client import H2OClient
+    s = H2OServer(port=0).start()
+    try:
+        body = b"path=%2Fno%2Fsuch%2Ffile.csv"
+        req = urllib.request.Request(f"{s.url}/3/ImportFiles", data=body,
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        payload = json.loads(ei.value.read().decode())
+        assert payload["__meta"]["schema_type"] == "H2OErrorV3"
+        assert "no such file" in payload["msg"]
+        with pytest.raises(FileNotFoundError):
+            H2OClient(s.url).import_file("/no/such/file.csv")
+    finally:
+        s.stop()
+
+
+# -- end-to-end out-of-core proof --------------------------------------------
+
+
+def test_glm_bit_identity_streaming_vs_eager(tmp_path, rng):
+    """The acceptance contract: a compressed, lazily-materialized,
+    spill-cycled frame trains/predicts bit-identically to the eager
+    resident path."""
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.ingest import stream_import
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.utils.cleaner import disable_cleaner, enable_cleaner
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    yb = (1 / (1 + np.exp(-(0.5 * x1 - 0.8 * x2)))
+          > rng.uniform(size=n))
+    lines = ["x1,x2,y"] + [
+        f"{a:.6f},{b:.6f},{'yes' if c else 'no'}"
+        for a, b, c in zip(x1, x2, yb)]
+    p = tmp_path / "g.csv"
+    p.write_text("\n".join(lines) + "\n")
+    fs = stream_import(str(p), key="gs.hex", chunk_rows=512)
+    fe = import_file(str(p), key="ge.hex")
+    try:
+        # force a full spill/fault-in cycle through the streamed frame
+        # (sweeps run on put — drive one explicitly, then fault back in)
+        from h2o3_tpu.utils.cleaner import CLEANER, SwappedFrame
+        enable_cleaner(1, ice_root=str(tmp_path / "ice"))
+        spilled = CLEANER.sweep()
+        assert "gs.hex" in spilled
+        with DKV._lock:
+            assert isinstance(DKV._store["gs.hex"], SwappedFrame)
+        fs_back = DKV["gs.hex"]
+    finally:
+        disable_cleaner()
+    kw = dict(family="binomial", lambda_=1e-4, max_iterations=20, seed=7)
+    ms = GLM(**kw).train(y="y", training_frame=fs_back)
+    me = GLM(**kw).train(y="y", training_frame=fe)
+    ps = ms.predict(fs_back).vec("pyes").to_numpy()
+    pe = me.predict(fe).vec("pyes").to_numpy()
+    assert np.array_equal(ps, pe), \
+        f"max divergence {np.abs(ps - pe).max()}"
+
+
+def test_multi_member_gzip_reads_every_member(tmp_path):
+    """Concatenated gzip members (pigz, log rotation, `cat a.gz b.gz`) are
+    one valid stream: the incremental gunzip must restart across member
+    boundaries, matching the eager gzip-module path."""
+    from h2o3_tpu.ingest import stream_import
+    a = gzip.compress(b"x,y\n1,10\n2,20\n")
+    b = gzip.compress(b"3,30\n4,40\n5,50\n")
+    p = tmp_path / "multi.csv.gz"
+    p.write_bytes(a + b)
+    fr = stream_import(str(p), key="mm.hex", chunk_rows=64)
+    assert fr.nrows == 5
+    np.testing.assert_array_equal(fr.vec("x").to_numpy(), [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(fr.vec("y").to_numpy(),
+                                  [10, 20, 30, 40, 50])
+
+
+def test_quoted_header_names(tmp_path):
+    """Header names quoted around the separator parse through the same
+    CSV reader as the data rows, not a naive split."""
+    from h2o3_tpu.ingest import stream_import
+    p = tmp_path / "q.csv"
+    p.write_text('"last,first",age\n"x",1\n"y",2\n')
+    fr = stream_import(str(p), key="q.hex")
+    assert fr.names == ["last,first", "age"]
+    np.testing.assert_array_equal(fr.vec("age").to_numpy(), [1, 2])
+
+
+def test_removed_spilled_key_deletes_snapshot(tmp_path, rng):
+    """DKV.remove of a spilled key must delete the on-disk snapshot —
+    frame snapshots are directories, and leaking them grows the ice_root
+    without bound over a long-running server."""
+    from h2o3_tpu.utils.cleaner import (SwappedFrame, disable_cleaner,
+                                        enable_cleaner)
+    ice = tmp_path / "ice"
+    try:
+        enable_cleaner(150_000, ice_root=str(ice))
+        _mk_frame("gone_a", rng)
+        _mk_frame("gone_b", rng)
+        _mk_frame("gone_c", rng)            # forces a spill
+        with DKV._lock:
+            stubs = [v for v in DKV._store.values()
+                     if isinstance(v, SwappedFrame)]
+        assert stubs and all(os.path.exists(s.path) for s in stubs)
+        for s in stubs:
+            DKV.remove(s.key)
+        assert not any(os.path.exists(s.path) for s in stubs)
+        # restore path also retires the consumed snapshot
+        _mk_frame("gone_d", rng)
+        _mk_frame("gone_e", rng)
+        with DKV._lock:
+            stub = next(v for v in DKV._store.values()
+                        if isinstance(v, SwappedFrame))
+        _ = DKV[stub.key]                   # fault-in
+        assert not os.path.exists(stub.path)
+    finally:
+        disable_cleaner()
+
+
+def test_quoted_embedded_newlines(tmp_path):
+    """RFC-4180: a quoted field may contain embedded newlines — record
+    splitting is quote-aware, so such files parse identically to the
+    eager path instead of tearing records in two."""
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.ingest import stream_import
+    p = tmp_path / "nl.csv"
+    p.write_text('txt,v\n"line1\nline2",5\n"plain",7\n"a\nb\nc",9\n')
+    fr = stream_import(str(p), key="nl.hex")
+    fe = import_file(str(p), key="nle.hex")
+    assert fr.nrows == fe.nrows == 3
+    np.testing.assert_array_equal(fr.vec("v").to_numpy(),
+                                  fe.vec("v").to_numpy())
+    assert list(fr.vec("txt").labels()) == list(fe.vec("txt").labels())
+
+
+def test_forced_numeric_bad_tokens_become_na(tmp_path):
+    """A USER-forced numeric column never promotes: unparseable tokens
+    coerce to NA (h2o-py col_types semantics); only guessed columns
+    restart."""
+    from h2o3_tpu.ingest import stream_import
+    lines = ["x,v"] + [f"{i},{i}" for i in range(300)] + ["oops,300"]
+    p = tmp_path / "na.csv"
+    p.write_text("\n".join(lines) + "\n")
+    fr = stream_import(str(p), key="na.hex", chunk_rows=64,
+                       col_types={"x": "numeric"})
+    assert fr.types["x"] in ("int", "real")
+    assert fr._ingest_stats["restarts"] == 0
+    got = fr.vec("x").to_numpy()
+    assert np.isnan(got[300]) and got[299] == 299
+
+
+def test_to_numpy_returns_fresh_array(tmp_path, rng):
+    """Mutating a to_numpy() result must never corrupt the compressed
+    host payload (the identity codec decodes to the payload itself)."""
+    from h2o3_tpu.ingest import stream_import
+    p = str(tmp_path / "mut.csv")
+    _write_csv(p, 256, rng)
+    fr = stream_import(p, key="mut.hex", chunk_rows=64)
+    want = fr.vec("yf").to_numpy().copy()
+    arr = fr.vec("yf").to_numpy()
+    arr[:] = 0.0
+    np.testing.assert_array_equal(fr.vec("yf").to_numpy(), want)
+
+
+def test_header_edge_cases_match_eager(tmp_path):
+    """A column literally named 'NA' keeps its name (header parses without
+    NA filtering) and duplicate names mangle pandas-style (x, x.1)."""
+    from h2o3_tpu.frame.parse import import_file
+    from h2o3_tpu.ingest import stream_import
+    p = tmp_path / "h.csv"
+    p.write_text("NA,x,x\n1,2,3\n4,5,6\n")
+    fr = stream_import(str(p), key="h.hex")
+    fe = import_file(str(p), key="he.hex")
+    assert fr.names == fe.names == ["NA", "x", "x.1"]
+    np.testing.assert_array_equal(fr.vec("NA").to_numpy(),
+                                  fe.vec("NA").to_numpy())
+
+
+def test_wide_integral_span_still_types_int(tmp_path):
+    """An integral column whose span exceeds the i16 codec falls back to
+    the f32 payload but must still TYPE as int (the eager _guess_type
+    contract) — typing follows the values, not the achieved codec."""
+    from h2o3_tpu.ingest import stream_import
+    lines = ["id"] + [str(i * 7) for i in range(20000)]
+    p = tmp_path / "w.csv"
+    p.write_text("\n".join(lines) + "\n")
+    fr = stream_import(str(p), key="w.hex", chunk_rows=4096)
+    assert fr.vec("id").compressed.codec == "f32"   # span > i16
+    assert fr.types["id"] == "int"
+
+
+def test_cancelled_parse_raises_not_none(tmp_path, rng):
+    """A parse job cancelled mid-stream surfaces a structured error from
+    import_file — never a silent None (which became a 500 at REST)."""
+    from h2o3_tpu.frame.parse import import_file
+    p = str(tmp_path / "c.csv")
+    _write_csv(p, 5000, rng)
+    from h2o3_tpu.models import job as jobmod
+    orig_init = jobmod.Job.__init__
+
+    def cancelled_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        if self.description.startswith("Parse"):
+            self.cancel()                    # cancel before the first chunk
+
+    os.environ["H2O3TPU_INGEST_STREAMING"] = "1"
+    try:
+        jobmod.Job.__init__ = cancelled_init
+        with pytest.raises(ValueError, match="cancelled"):
+            import_file(p, key="cx.hex")
+    finally:
+        jobmod.Job.__init__ = orig_init
+        os.environ.pop("H2O3TPU_INGEST_STREAMING", None)
+
+
+def test_stream_parse_col_types_override(tmp_path):
+    """h2o-py style col_types force a column categorical up front — no
+    promote restart needed."""
+    from h2o3_tpu.ingest import stream_import
+    lines = ["zip,v"] + [f"{94000 + i % 5},{i}" for i in range(400)]
+    p = tmp_path / "z.csv"
+    p.write_text("\n".join(lines) + "\n")
+    fr = stream_import(str(p), key="z.hex", chunk_rows=128,
+                       col_types={"zip": "enum"})
+    assert fr.types["zip"] == "enum"
+    assert fr.vec("zip").cardinality() == 5
+    assert fr._ingest_stats["restarts"] == 0
+    fr2 = stream_import(str(p), key="z2.hex", chunk_rows=128,
+                        col_types={"zip": VecType.CAT})
+    assert fr2.types["zip"] == "enum"

@@ -657,6 +657,64 @@ def test_wtx001_suppressible(tmp_path):
 
 
 
+# -- ingest discipline (ING) -------------------------------------------------
+
+def test_ing001_unbounded_reads_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"ingest/stage.py": """
+        import numpy as np
+
+        def read_stage(path, q):
+            with open(path, "rb") as fh:
+                data = fh.read()               # whole file at once
+            q.put(data)
+
+        def line_stage(fh):
+            return fh.readlines()              # every line at once
+
+        def bulk_stage(path):
+            return np.loadtxt(path)            # whole-file loader
+    """})
+    ing = [f for f in run_lint(pkg) if f.rule == "ING001"]
+    assert len(ing) == 3
+    assert {f.detail for f in ing} == {"unbounded-read", "readlines",
+                                       "whole-file-loader"}
+    assert {f.where for f in ing} == {"read_stage", "line_stage",
+                                      "bulk_stage"}
+
+
+def test_ing001_bounded_and_outside_ingest_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "ingest/stage.py": """
+            def read_stage(path, q, abort):
+                with open(path, "rb") as fh:
+                    while True:
+                        block = fh.read(1 << 20)    # bounded block
+                        if not block:
+                            break
+                        q.put(block, timeout=1.0)
+
+            def sized(fh, n):
+                return fh.read(n)
+        """,
+        # the same unbounded read OUTSIDE ingest/ is another rule's problem
+        "persist/io.py": """
+            def slurp(path):
+                with open(path, "rb") as fh:
+                    return fh.read()
+        """})
+    assert "ING001" not in rules_of(run_lint(pkg))
+
+
+def test_ing001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"ingest/stage.py": """
+        def header_stage(path):
+            with open(path, "rb") as fh:
+                # graftlint: ok(sidecar header file is bytes-tiny)
+                return fh.read()
+    """})
+    assert "ING001" not in rules_of(run_lint(pkg))
+
+
 # -- profiling attribution (PRF) ---------------------------------------------
 
 def test_prf001_anonymous_jit_flagged(tmp_path):
