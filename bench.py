@@ -1331,6 +1331,127 @@ def _memory_gate(memsec: dict) -> None:
         sys.exit(3)
 
 
+def bench_health(ndev: int) -> dict:
+    """Ops-plane proof (ISSUE 15): the health evaluator watching a CLEAN
+    GLM run must report every subsystem healthy and open ZERO incidents
+    (a trip here means a rule's threshold pages on normal operation — the
+    boy-who-cried-wolf failure), the sweep thread must have actually swept
+    (a hollow watchdog that never ran also reads "healthy"), and the
+    evaluator's wall overhead vs ``H2O3TPU_HEALTH_OFF=1`` must stay under
+    the same 2% always-on budget the tracer holds."""
+    import jax
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.utils.health import HealthEvaluator
+    from h2o3_tpu.utils.incidents import INCIDENTS
+
+    n = 3_000 if SMOKE else (50_000 if CPU_FALLBACK else 1_000_000)
+    iters = 10 if SMOKE else 25
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(n, 12)).astype(np.float32)
+    logit = X[:, :5] @ np.array([0.8, -0.5, 0.3, -0.2, 0.4], np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit)))
+    cols = {f"x{i}": X[:, i] for i in range(12)}
+    cols["resp"] = np.where(y, "YES", "NO")
+    fr = Frame.from_arrays(cols)
+
+    def train():
+        GLM(family="binomial", lambda_=1e-4, max_iterations=iters).train(
+            y="resp", training_frame=fr)
+
+    train()                       # warm-up: compiles out of the timed region
+    jax.effects_barrier()
+    # the watched/off comparison needs the knob in both positions; an
+    # operator-exported H2O3TPU_HEALTH_OFF=1 must come back afterwards
+    saved_off = os.environ.pop("H2O3TPU_HEALTH_OFF", None)
+
+    def timed_watched() -> tuple:
+        ev = HealthEvaluator(interval_s=0.05)
+        opened0 = INCIDENTS.opened_total()
+        ev.evaluate()             # baseline window deltas pre-run
+        ev.start()
+        t0 = time.perf_counter()
+        train()
+        wall = time.perf_counter() - t0
+        # hollow-watchdog proof: the THREAD must demonstrably sweep (the
+        # two inline evaluate() calls here don't count) — a bounded wait
+        # OUTSIDE the timed window so sub-interval smoke runs still see it
+        deadline = time.monotonic() + 5.0
+        while ev.thread_sweeps() < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        verdict = ev.evaluate()   # one final sweep over the finished run
+        ev.stop()
+        return (wall, verdict, INCIDENTS.opened_total() - opened0,
+                ev.thread_sweeps())
+
+    def timed_off() -> float:
+        os.environ["H2O3TPU_HEALTH_OFF"] = "1"
+        try:
+            t0 = time.perf_counter()
+            train()
+            return time.perf_counter() - t0
+        finally:
+            os.environ.pop("H2O3TPU_HEALTH_OFF", None)
+
+    reps = 1 if SMOKE else 2      # min-of-N damps scheduler noise
+    try:
+        watched = [timed_watched() for _ in range(reps)]
+        t_on = min(w[0] for w in watched)
+        t_off = min(timed_off() for _ in range(reps))
+    finally:
+        if saved_off is not None:
+            os.environ["H2O3TPU_HEALTH_OFF"] = saved_off
+    # the gate must see EVERY rep, not the last: an incident tripped in
+    # rep 1 that clears by rep 2 is still a rule paging on normal
+    # operation — sum the opens, keep the WORST verdict, and require the
+    # thread to have swept in every rep
+    rank = {"healthy": 0, "degraded": 1, "unhealthy": 2}
+    verdict = max((w[1] for w in watched), key=lambda v: rank[v["status"]])
+    opened = sum(w[2] for w in watched)
+    thread_sweeps = min(w[3] for w in watched)
+    overhead = t_on / max(t_off, 1e-9) - 1.0
+    return dict(
+        seconds_watched=round(t_on, 3), seconds_off=round(t_off, 3),
+        overhead_pct=round(overhead * 100, 2),
+        status=verdict["status"],
+        subsystems={s: v["status"]
+                    for s, v in verdict["subsystems"].items()},
+        findings=verdict["findings"],
+        sweeps=thread_sweeps, incidents_opened=opened,
+        open_incidents=verdict["open_incidents"],
+        rules=len(verdict["rules"]))
+
+
+def _health_gate(hl: dict) -> None:
+    """Refuse to stamp when the ops plane is hollow or noisy: a clean run
+    that trips ANY incident means a rule pages on normal operation; a
+    sweep count of zero means the watchdog thread never actually watched;
+    >2% overhead on real runs breaks the always-on budget."""
+    if hl.get("error"):
+        print(f"# bench REFUSED: health section failed: {hl['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    if hl["sweeps"] <= 0:
+        # thread-driven sweeps only — the section's own inline evaluate()
+        # calls don't count as the watchdog having watched
+        print("# bench REFUSED: health sweep thread never swept — the "
+              "watchdog is hollow", file=sys.stderr)
+        sys.exit(3)
+    if hl["incidents_opened"] > 0 or hl["status"] != "healthy":
+        for f in hl["findings"]:
+            print(f"# health finding: {f}", file=sys.stderr)
+        print(f"# bench REFUSED: clean run reads {hl['status']} with "
+              f"{hl['incidents_opened']} incident(s) opened — a health "
+              "rule pages on normal operation", file=sys.stderr)
+        sys.exit(3)
+    if not SMOKE and not CPU_FALLBACK and hl["overhead_pct"] > 2.0:
+        print(f"# bench REFUSED: health evaluator overhead "
+              f"{hl['overhead_pct']}% exceeds the 2% always-on budget",
+              file=sys.stderr)
+        sys.exit(3)
+
+
 def _tracing_gate(trc: dict) -> None:
     """Refuse to stamp an artifact whose tracing section is hollow: an
     empty trace store after an instrumented run means the span plumbing
@@ -1758,6 +1879,17 @@ def main() -> None:
         memsec = {"error": f"{type(e).__name__}: {e}"}
     out["extra"]["memory"] = memsec
     _memory_gate(memsec)
+    # ops plane: the health evaluator watching a clean GLM run must stay
+    # healthy with zero incidents (hollow-watchdog guard: it must also
+    # have actually swept) and under the 2% always-on overhead budget vs
+    # H2O3TPU_HEALTH_OFF=1 (ISSUE 15; docs/OBSERVABILITY.md "Health &
+    # incidents")
+    try:
+        hl = bench_health(ndev)
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        hl = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["health"] = hl
+    _health_gate(hl)
     # metrics snapshot rides along in the artifact (dispatch counts, parse
     # bytes, model-build latencies) so the perf trajectory carries telemetry;
     # buckets omitted to keep the JSON line compact

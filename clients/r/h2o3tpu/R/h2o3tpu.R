@@ -669,6 +669,41 @@ h2o.profiler <- function(depth = 5) {
   .http("GET", paste0("/3/Profiler?depth=", as.integer(depth)))
 }
 
+# -- ops plane (server /3/Health, /3/Incidents, /3/Diagnostics/bundle;
+#    docs/OBSERVABILITY.md "Health & incidents") ------------------------------
+
+h2o.health <- function() {
+  # subsystem-scored verdict (healthy/degraded/unhealthy per subsystem:
+  # elastic/serving/memory/compute/dispatch); every finding carries the
+  # tripping rule, the observed value, and the threshold
+  .http("GET", "/3/Health")
+}
+
+h2o.incidents <- function() {
+  # bounded incident ring, newest first (one open incident per rule);
+  # fetch one with h2o.incident(id) for its trip-time context
+  .http("GET", "/3/Incidents")$incidents
+}
+
+h2o.incident <- function(incident_id) {
+  # one incident with correlated context captured at trip time: trace
+  # ids, log tail, memory top-keys, compute loop rows, observed series
+  .http("GET", paste0("/3/Incidents/", incident_id))
+}
+
+h2o.diagnosticsBundle <- function(path) {
+  # the `h2o logs download` analog: one gzip tar of all four pillar
+  # snapshots + health verdict + incident ring + logs + hardware
+  # fingerprint + secrets-redacted config dump, saved to `path`
+  # (the route serves GET for plain downloaders like this one, and POST
+  # for API symmetry with the Python client)
+  host <- .h2o3tpu$host
+  if (is.null(host)) stop("not connected: call h2o.init()/h2o.connect() first")
+  url <- paste0("http://", host, ":", .h2o3tpu$port, "/3/Diagnostics/bundle")
+  utils::download.file(url, destfile = path, mode = "wb", quiet = TRUE)
+  invisible(path)
+}
+
 h2o.shutdown <- function(prompt = FALSE) {
   invisible(tryCatch(.http("POST", "/3/Shutdown"), error = function(e) NULL))
 }

@@ -335,6 +335,38 @@ class H2OClient:
             f.write(data)
         return path
 
+    def health(self) -> dict:
+        """The ops-plane verdict (``GET /3/Health``): overall +
+        per-subsystem healthy/degraded/unhealthy, each finding naming the
+        tripping rule, observed value, and threshold
+        (docs/OBSERVABILITY.md "Health & incidents")."""
+        return self.request("GET", "/3/Health")
+
+    def incidents(self) -> list[dict]:
+        """Incident-ring summaries, newest first (``GET /3/Incidents``);
+        fetch one with :meth:`incident` for its trip-time context."""
+        return self.request("GET", "/3/Incidents")["incidents"]
+
+    def incident(self, incident_id: str) -> dict:
+        """One incident with its correlated context — trace ids, log
+        tail, memory top-keys, compute rows, observed-value series
+        (``GET /3/Incidents/{id}``)."""
+        return self.request("GET", f"/3/Incidents/{incident_id}")
+
+    def diagnostics_bundle(self, path: str) -> str:
+        """Download the one-call diagnostic bundle — a gzip tar of all
+        four pillar snapshots + health verdict + incident ring + logs +
+        hardware fingerprint + redacted config (``POST
+        /3/Diagnostics/bundle``; the ``h2o logs download`` analog) — to
+        ``path`` and return it."""
+        req = urllib.request.Request(self.url + "/3/Diagnostics/bundle",
+                                     method="POST")
+        with urllib.request.urlopen(req) as resp:
+            data = resp.read()
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
     def metrics_text(self) -> str:
         """Raw Prometheus/OpenMetrics exposition (``GET /metrics``)."""
         with urllib.request.urlopen(self.url + "/metrics") as resp:

@@ -112,6 +112,32 @@ def compute_v3(snapshot: dict) -> dict:
     return {**_meta("ComputeV3"), **_clean(snapshot)}
 
 
+def health_v3(verdict: dict) -> dict:
+    """``GET /3/Health`` — the health evaluator's subsystem-scored verdict
+    (utils/health.py): overall + per-subsystem ``healthy`` / ``degraded``
+    / ``unhealthy``, every finding carrying the tripping rule, the
+    observed value, and the threshold; plus the rule catalog with its env
+    knobs and the currently-open incident rules
+    (docs/OBSERVABILITY.md "Health & incidents")."""
+    return {**_meta("HealthV3"), **_clean(verdict)}
+
+
+def incidents_v3(summaries: list) -> dict:
+    """``GET /3/Incidents`` — the bounded incident ring, newest first:
+    rule / subsystem / severity / status / observed vs threshold /
+    repeats / timestamps (contexts served per-incident by
+    ``GET /3/Incidents/{id}``)."""
+    return {**_meta("IncidentsV3"), "incidents": _clean(summaries)}
+
+
+def incident_v3(record: dict) -> dict:
+    """``GET /3/Incidents/{id}`` — one incident with its trip-time
+    correlated context: recent trace ids, log-ring tail, memory top-keys,
+    compute loop rows, the rule's observed-value series, and (for
+    profiled compute incidents) the profiler capture id."""
+    return {**_meta("IncidentV3"), **_clean(record)}
+
+
 def _column_histogram(vec, r, nbins: int = 20) -> dict:
     """ColV3 histogram fields (reference ``FrameV3.ColV3``: Flow's frame
     inspector renders these as sparklines): fixed-stride bins over

@@ -302,7 +302,7 @@ class _Handler(BaseHTTPRequestHandler):
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
         r"Logs(?:/.*)?|Memory|Metrics|Compute|Score|Timeline|JStack|"
-        r"WaterMeter[^/]*(?:/\d+)?|"
+        r"WaterMeter[^/]*(?:/\d+)?|Health|Incidents(?:/[^/]+)?|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
 
     def _route(self, method: str):
@@ -1226,6 +1226,49 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # -- ops plane (utils/health.py + utils/incidents.py — the reference's
+    #    cloud_healthy consensus + `h2o logs download` analog) --------------
+
+    def r_health(self):
+        """``GET /3/Health`` — the health evaluator's subsystem-scored
+        verdict: healthy/degraded/unhealthy per subsystem (elastic,
+        serving, memory, compute, dispatch) with the tripping rule,
+        observed value, and threshold in every finding. Served from the
+        background sweep when it runs, evaluated inline otherwise
+        (docs/OBSERVABILITY.md "Health & incidents")."""
+        from h2o3_tpu.utils.health import HEALTH
+        self._reply(schemas.health_v3(HEALTH.verdict()))
+
+    def r_incidents(self):
+        """``GET /3/Incidents`` — the bounded incident ring, newest first
+        (one open incident per rule; repeats fold in). Contexts are
+        served per-incident by ``GET /3/Incidents/{id}``."""
+        from h2o3_tpu.utils.incidents import INCIDENTS
+        self._reply(schemas.incidents_v3(INCIDENTS.list()))
+
+    def r_incident(self, incident_id):
+        """``GET /3/Incidents/{id}`` — one incident with the correlated
+        context captured at trip time: trace ids, log tail, memory
+        top-keys, compute loop rows, and the rule's observed series."""
+        from h2o3_tpu.utils.incidents import INCIDENTS
+        self._reply(schemas.incident_v3(INCIDENTS.get(incident_id)))
+
+    def r_diagnostics_bundle(self):
+        """``POST /3/Diagnostics/bundle`` (GET also served for plain
+        browser/curl downloads) — the ``h2o logs download`` analog: one
+        gzip tar with all four pillar snapshots (metrics, traces, memory,
+        compute), the health verdict, the incident ring, the log ring,
+        the hardware fingerprint, and the secrets-redacted config dump."""
+        from h2o3_tpu.utils.health import diagnostic_bundle
+        body, fname = diagnostic_bundle()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/gzip")
+        self.send_header("Content-Disposition",
+                         f'attachment; filename="{fname}"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def r_metrics_json(self):
         """JSON metrics snapshot — flat {name, type, labels, value} rows
         (TwoDimTable-friendly; the Python client's ``client.metrics()``)."""
@@ -1916,6 +1959,11 @@ _ROUTES = [
     (r"/3/Logs/nodes/(-?\d+)/files/([^/]+)", "GET", _Handler.r_logs_file),
     (r"/3/Memory", "GET", _Handler.r_memory),
     (r"/3/Compute", "GET", _Handler.r_compute),
+    (r"/3/Health", "GET", _Handler.r_health),
+    (r"/3/Incidents", "GET", _Handler.r_incidents),
+    (r"/3/Incidents/([^/]+)", "GET", _Handler.r_incident),
+    (r"/3/Diagnostics/bundle", "POST", _Handler.r_diagnostics_bundle),
+    (r"/3/Diagnostics/bundle", "GET", _Handler.r_diagnostics_bundle),
     (r"/3/Profiler/capture", "POST", _Handler.r_profiler_capture),
     (r"/3/Profiler/captures", "GET", _Handler.r_profiler_captures),
     (r"/3/Profiler/captures/([^/]+)/download", "GET",
@@ -2062,6 +2110,12 @@ class H2OServer:
         from h2o3_tpu.utils import extensions as _ext
         _ext.load_env_extensions()
         _ext.init_all()
+        # ops plane: the health evaluator sweeps the live registries on a
+        # bounded interval (reference: the heartbeat-driven cloud_healthy
+        # consensus). H2O3TPU_HEALTH_OFF=1 disables; /3/Health then
+        # evaluates inline per request or reports "disabled".
+        from h2o3_tpu.utils.health import HEALTH
+        self._started_health = HEALTH.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -2071,6 +2125,12 @@ class H2OServer:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_started_health", False):
+            # only the server that actually started the sweep stops it —
+            # a second embedded server must not kill the first one's
+            from h2o3_tpu.utils.health import HEALTH
+            HEALTH.stop()
+            self._started_health = False
         self.httpd.shutdown()
         self.httpd.server_close()
 

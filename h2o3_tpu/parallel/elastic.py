@@ -151,6 +151,21 @@ ELASTIC_STATS = _ElasticStats()
 _LIVE_GROUPS: "weakref.WeakSet" = weakref.WeakSet()
 
 
+def live_rows() -> "list[dict]":
+    """Membership rows of groups whose build is STILL RUNNING — the health
+    evaluator's view (utils/health.py). :data:`ELASTIC_STATS` keeps
+    finished groups for ``/3/Cloud`` pollers, but a completed build's
+    workers stopped heartbeating *legitimately*: rating their silence
+    against the lease would page on every finished build forever."""
+    out: "list[dict]" = []
+    for g in list(_LIVE_GROUPS):
+        with g._cond:
+            if not g.started or g._stop:
+                continue
+            out.extend(g._rows_locked())
+    return out
+
+
 def drain(timeout: float = 30.0) -> None:
     """Join every elastic worker thread still alive.
 

@@ -109,8 +109,16 @@ class LatencyRing:
         self._lock = threading.Lock()
 
     def record(self, latency_s: float) -> None:
+        v = float(latency_s)
+        if not math.isfinite(v) or v < 0.0:
+            # a NaN in the buffer poisons every percentile read (sorted()
+            # with NaN is partial order — the controller would steer the
+            # window off garbage); drop and account instead
+            from h2o3_tpu.utils.telemetry import METRICS
+            METRICS.reject("latency_ring")
+            return
         with self._lock:
-            self._buf[self._next] = float(latency_s)
+            self._buf[self._next] = v
             self._next = (self._next + 1) % self._size
             self._count += 1
 

@@ -1,0 +1,87 @@
+"""graftlint metric-documentation rule (MTR) — metric/doc drift.
+
+``docs/OBSERVABILITY.md``'s metric catalog is the operator contract: an
+alert, a dashboard, or a capacity review starts from that table, not from
+grepping the source. Every PR so far has added ``h2o3_*`` instruments and
+(manually) their doc rows — MTR001 makes the drift structural instead of
+reviewed:
+
+- **MTR001** — a metric family registered in code (a ``counter`` /
+  ``gauge`` / ``histogram`` call whose literal name starts ``h2o3_``) has
+  no row in ``docs/OBSERVABILITY.md``. Counters match with or without the
+  OpenMetrics ``_total`` suffix the doc rows use. One finding per metric
+  NAME (the first registration site), not per call site — a shared lazy
+  registration (``h2o3_telemetry_rejected``) is one contract, not N.
+
+The doc file is looked up next to the scanned package root
+(``<root>/docs/OBSERVABILITY.md`` or ``<root>/../docs/OBSERVABILITY.md``
+— the repo layout puts ``docs/`` beside ``h2o3_tpu/``). A tree with no
+doc file produces no findings: there is nothing to be in drift *with*
+(fixture packages opt in by shipping a doc file).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from h2o3_tpu.tools.core import Finding, PackageIndex
+
+#: registry factory methods whose first literal argument names a family
+_REG_METHODS = {"counter", "gauge", "histogram"}
+
+#: the documentation file metric rows live in
+DOC_NAME = "OBSERVABILITY.md"
+
+
+def _metric_name(node: ast.AST) -> str | None:
+    """The ``h2o3_*`` family name a registration call declares, or None."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REG_METHODS and node.args):
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+            and first.value.startswith("h2o3_"):
+        return first.value
+    return None
+
+
+def find_doc(root: Path) -> Path | None:
+    for base in (Path(root), Path(root).parent):
+        cand = base / "docs" / DOC_NAME
+        if cand.is_file():
+            return cand
+    return None
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    doc = find_doc(index.root)
+    if doc is None:
+        return []
+    # only CATALOG ROWS satisfy the rule — a prose mention elsewhere in
+    # the doc ("unlike `h2o3_foo`, this gauge…") is not the name/type/
+    # labels/meaning contract the rule enforces
+    text = "\n".join(ln for ln in doc.read_text().splitlines()
+                     if ln.lstrip().startswith("|"))
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            name = _metric_name(node)
+            if name is None or name in seen:
+                continue
+            seen.add(name)
+            # counters are documented in exposition form (name_total);
+            # gauges/histograms by their family name — accept either
+            if re.search(rf"\b{re.escape(name)}(?:_total)?\b", text):
+                continue
+            findings.append(Finding(
+                "MTR001", mod.path, node.lineno, "",
+                f"metric `{name}` is registered here but has no row in "
+                f"docs/{DOC_NAME} — the metric catalog is the operator "
+                "contract; add a row (name, type, labels, meaning) or "
+                "suppress with a reason",
+                detail=f"undocumented-metric:{name}"))
+    return findings

@@ -417,6 +417,14 @@ class CostMeter:
                 "recompile_events": sum(len(s["recompile_events"])
                                         for s in sites)}
 
+    def loops(self) -> dict:
+        """Per-loop achieved-throughput rows only (utilization / samples /
+        roofline) — the health evaluator's MFU-collapse probe reads this
+        every sweep, so it must not pay :meth:`snapshot`'s full per-site
+        signature copy."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._loops.items()}
+
     def signature_count(self) -> int:
         """Total distinct signatures across sites — the bench's
         steady-state recompile probe: a warm scenario re-run must not grow

@@ -53,14 +53,25 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
-def _escape(v: str) -> str:
+def _escape_label(v: str) -> str:
+    """Label-value escaping per the OpenMetrics exposition format: exactly
+    backslash, double-quote, and line feed — in that order (escaping the
+    escape character first, or a pre-escaped ``\\n`` would double)."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: the format defines only ``\\\\`` and ``\\n``
+    here — a ``\\"`` in HELP is an *invalid* escape sequence that makes
+    strict OpenMetrics parsers reject the whole exposition, so quotes pass
+    through verbatim (unlike label values, HELP is not quote-delimited)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _label_str(labels: dict) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels.items())
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels.items())
     return "{" + inner + "}"
 
 
@@ -103,12 +114,22 @@ class _Gauge:
 
 class _Histogram:
     """Fixed-bucket histogram child; also tracks min/max so per-dispatch
-    duration spreads (straggler visibility) survive aggregation."""
+    duration spreads (straggler visibility) survive aggregation.
 
-    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "min", "max")
+    Observations are validated: every histogram here measures a duration,
+    a size, or a count — all non-negative and finite by definition. A NaN
+    poisons ``_sum`` (and every percentile read downstream) irreversibly,
+    a negative or infinite value corrupts it silently; such observations
+    are DROPPED and accounted in ``h2o3_telemetry_rejected_total{where}``
+    instead (the instrument reports its own bad inputs rather than lying
+    with them)."""
 
-    def __init__(self, lock: threading.Lock, buckets: tuple):
+    __slots__ = ("_lock", "_reject", "buckets", "counts", "sum", "count",
+                 "min", "max")
+
+    def __init__(self, lock: threading.Lock, buckets: tuple, reject=None):
         self._lock = lock
+        self._reject = reject               # callable: count a dropped obs
         self.buckets = buckets              # ascending upper bounds, no +Inf
         self.counts = [0] * (len(buckets) + 1)   # last slot = +Inf
         self.sum = 0.0
@@ -118,6 +139,10 @@ class _Histogram:
 
     def observe(self, value: float) -> None:
         v = float(value)
+        if not math.isfinite(v) or v < 0.0:
+            if self._reject is not None:
+                self._reject()
+            return
         with self._lock:
             self.counts[bisect.bisect_left(self.buckets, v)] += 1
             self.sum += v
@@ -141,6 +166,7 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self.buckets = buckets
+        self._registry = registry
         self._lock = registry._lock
         self._children: dict[tuple, object] = {}
 
@@ -153,7 +179,8 @@ class _Family:
             child = self._children.get(key)
             if child is None:
                 cls = _KINDS[self.kind]
-                child = (cls(self._lock, self.buckets)
+                child = (cls(self._lock, self.buckets,
+                             reject=self._registry._rejecter(self.name))
                          if self.kind == "histogram" else cls(self._lock))
                 self._children[key] = child
         return child
@@ -215,6 +242,25 @@ class MetricsRegistry:
                   buckets: tuple = DEFAULT_BUCKETS) -> _Family:
         return self._family(name, "histogram", help, labelnames,
                             tuple(sorted(buckets)))
+
+    def reject(self, where: str) -> None:
+        """Account one invalid observation (NaN / negative / infinite)
+        dropped at ``where`` instead of poisoning an instrument. The ONE
+        home of the ``h2o3_telemetry_rejected`` registration — histogram
+        children and the serving ``LatencyRing`` both route here, so the
+        name/help/labels can never drift apart. ``where`` is a family
+        name or a code-defined site, so cardinality stays bounded."""
+        self.counter(
+            "h2o3_telemetry_rejected",
+            "invalid observations (NaN/negative/non-finite) dropped "
+            "instead of poisoning a histogram or percentile ring",
+            ("where",)).labels(where=where).inc()
+
+    def _rejecter(self, where: str):
+        """The per-family drop callback histogram children hold."""
+        def count() -> None:
+            self.reject(where)
+        return count
 
     def reset(self) -> None:
         """Drop every family (tests only — production metrics are append-only)."""
@@ -283,7 +329,7 @@ class MetricsRegistry:
         for fam in self._families.values():
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             if fam.help:
-                lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+                lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             for labels, child in fam.children():
                 ls = _label_str(labels)
                 if fam.kind == "counter":

@@ -1,0 +1,540 @@
+"""Ops plane (ISSUE 15): health rules, incident detection, diagnostic
+bundles — rule semantics over monkeypatched registries, incident
+auto-capture under the PR 8 chaos harness (each injected fault class
+opens exactly ONE incident of the right rule class with non-empty
+context), the REST/client surface, and the one-call bundle round trip
+(docs/OBSERVABILITY.md "Health & incidents")."""
+
+import io
+import json
+import tarfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.utils import health as hm
+from h2o3_tpu.utils.health import (DEGRADED, HEALTHY, UNHEALTHY,
+                                   HealthEvaluator, diagnostic_bundle,
+                                   redacted_config)
+from h2o3_tpu.utils.incidents import IncidentLog
+from h2o3_tpu.utils.registry import DKV
+
+
+def _evaluator(**kw):
+    """An isolated evaluator: its own incident log, fast interval."""
+    kw.setdefault("interval_s", 0.1)
+    kw.setdefault("incidents", IncidentLog(capacity=16))
+    return HealthEvaluator(**kw)
+
+
+def _findings_by_rule(verdict):
+    return {f["rule"]: f for f in verdict["findings"]}
+
+
+# -- verdict shape / clean state ---------------------------------------------
+
+def test_clean_registries_read_healthy():
+    ev = _evaluator()
+    v = ev.evaluate()
+    assert v["status"] == HEALTHY and v["healthy"] is True
+    assert v["findings"] == []
+    assert set(v["subsystems"]) == set(hm.SUBSYSTEMS)
+    assert all(s["status"] == HEALTHY for s in v["subsystems"].values())
+    # the rule catalog rides along with thresholds + env knobs
+    assert {r["rule"] for r in v["rules"]} >= {
+        "elastic_heartbeat_gap", "serving_p99_slo", "memory_spill_thrash",
+        "compute_recompile_storm", "dispatch_retry_exhaustion"}
+    assert all(r["env"].startswith("H2O3TPU_HEALTH_") for r in v["rules"])
+
+
+def test_finding_carries_rule_value_threshold(monkeypatch):
+    """Every finding names the tripping rule, the observed value, and the
+    threshold — the ISSUE's no-bare-boolean contract."""
+    monkeypatch.setattr(hm, "_elastic_rows", lambda: [
+        {"state": "ACTIVE", "last_heartbeat_ago_ms": 99_000.0}])
+    monkeypatch.setenv("H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS", "30")
+    ev = _evaluator()
+    v = ev.evaluate()
+    assert v["status"] == UNHEALTHY
+    assert v["subsystems"]["elastic"]["status"] == UNHEALTHY
+    f = _findings_by_rule(v)["elastic_heartbeat_gap"]
+    assert f["observed"] == 99.0
+    assert f["threshold"] == 30.0
+    assert f["severity"] == UNHEALTHY
+    assert "elastic_heartbeat_gap" in f["message"]
+
+
+def test_heartbeat_gap_ignores_ejected_workers(monkeypatch):
+    """An EJECTED worker's silence is the state machine doing its job —
+    only live states (ACTIVE/SUSPECT/JOINING) rate against the lease."""
+    monkeypatch.setattr(hm, "_elastic_rows", lambda: [
+        {"state": "EJECTED", "last_heartbeat_ago_ms": 9e6},
+        {"state": "ACTIVE", "last_heartbeat_ago_ms": 10.0}])
+    assert _evaluator().evaluate()["status"] == HEALTHY
+
+
+def test_suspect_dwell_trips_on_streak_not_blip(monkeypatch):
+    rows = [{"state": "SUSPECT", "last_heartbeat_ago_ms": 10.0}]
+    monkeypatch.setattr(hm, "_elastic_rows", lambda: rows)
+    ev = _evaluator()
+    v1 = ev.evaluate()            # streak 1: not past the 1-sweep default
+    assert "elastic_suspect_dwell" not in _findings_by_rule(v1)
+    v2 = ev.evaluate()            # streak 2: dwelling
+    f = _findings_by_rule(v2)["elastic_suspect_dwell"]
+    assert f["observed"] == 2.0 and f["severity"] == DEGRADED
+    rows[:] = [{"state": "ACTIVE", "last_heartbeat_ago_ms": 10.0}]
+    v3 = ev.evaluate()            # recovery resets the streak
+    assert v3["status"] == HEALTHY
+
+
+def test_serving_rules_rate_shed_and_p99(monkeypatch):
+    stats = {"shed_total": 0, "resident": [
+        {"model": "m", "slo": {"target_ms": 50.0, "p99_ms": 20.0}}]}
+    monkeypatch.setattr(hm, "_serving_stats", lambda: stats)
+    monkeypatch.setattr(hm, "_score_requests_total", lambda: 100.0)
+    ev = _evaluator()
+    assert ev.evaluate()["status"] == HEALTHY      # baseline window
+    # window 2: 40 of 100 admissions shed → rate 0.4 (the request counter
+    # already includes sheds as status=error — service.score counts the
+    # ServiceUnavailable on its way out, so the denominator is the
+    # all-status delta alone, NOT shed+delta); p99 blows the SLO
+    stats["shed_total"] = 40
+    stats["resident"][0]["slo"]["p99_ms"] = 75.0
+    monkeypatch.setattr(hm, "_score_requests_total", lambda: 200.0)
+    v = ev.evaluate()
+    by = _findings_by_rule(v)
+    assert by["serving_shed_rate"]["observed"] == 0.4
+    assert by["serving_p99_slo"]["observed"] == 1.5
+    assert v["subsystems"]["serving"]["status"] == UNHEALTHY  # p99 wins
+
+
+def test_shed_rate_total_overload_reads_one(monkeypatch):
+    """100% shed must read 1.0, not saturate at 0.5 — the double-count
+    regression: every shed already rides in the request counter."""
+    stats = {"shed_total": 0, "resident": []}
+    monkeypatch.setattr(hm, "_serving_stats", lambda: stats)
+    monkeypatch.setattr(hm, "_score_requests_total", lambda: 0.0)
+    ev = _evaluator()
+    ev.evaluate()                                  # baseline
+    stats["shed_total"] = 50
+    monkeypatch.setattr(hm, "_score_requests_total", lambda: 50.0)
+    f = _findings_by_rule(ev.evaluate())["serving_shed_rate"]
+    assert f["observed"] == 1.0
+
+
+def test_mfu_collapse_only_on_rated_backends(monkeypatch):
+    loops = {"glm_irls": {"utilization": None, "samples": 50}}
+    monkeypatch.setattr(hm, "_compute_loops", lambda: loops)
+    ev = _evaluator()
+    assert ev.evaluate()["status"] == HEALTHY      # null util never trips
+    loops["glm_irls"] = {"utilization": 0.001, "samples": 50}
+    f = _findings_by_rule(ev.evaluate())["compute_mfu_collapse"]
+    assert f["observed"] == 0.001 and f["threshold"] == 0.02
+
+
+def test_window_deltas_baseline_on_first_sweep(monkeypatch):
+    """Pre-existing counter totals must never page a fresh evaluator —
+    the first sweep baselines, movement pages."""
+    total = [50.0]
+    monkeypatch.setattr(hm, "_recompile_total", lambda: total[0])
+    ev = _evaluator()
+    assert ev.evaluate()["status"] == HEALTHY      # 50 pre-existing: quiet
+    total[0] = 51.0
+    assert ev.evaluate()["status"] == HEALTHY      # +1 under threshold 2
+    total[0] = 60.0
+    f = _findings_by_rule(ev.evaluate())["compute_recompile_storm"]
+    assert f["observed"] == 9.0 and f["subsystem"] == "compute"
+
+
+def test_probe_failure_degrades_not_crashes(monkeypatch):
+    def boom():
+        raise RuntimeError("registry sick")
+    monkeypatch.setattr(hm, "_cleaner_stats", boom)
+    v = _evaluator().evaluate()
+    assert v["status"] == DEGRADED
+    f = _findings_by_rule(v)["memory_spill_thrash"]
+    assert "probe failed" in f["message"] and f["observed"] is None
+
+
+def test_failed_probe_does_not_resolve_open_incident(monkeypatch):
+    """A probe that starts raising is blindness, not recovery: the rule's
+    open incident must stay open (and re-trips after the probe heals must
+    not mint a duplicate)."""
+    stats = {"shed_total": 0, "resident": [
+        {"model": "m", "slo": {"target_ms": 50.0, "p99_ms": 90.0}}]}
+    monkeypatch.setattr(hm, "_serving_stats", lambda: stats)
+    ev = _evaluator()
+    ev.evaluate()                                  # p99 1.8x SLO → open
+    [inc] = ev.incidents.list()
+    assert inc["rule"] == "serving_p99_slo" and inc["status"] == "open"
+
+    def boom():
+        raise RuntimeError("registry sick")
+    monkeypatch.setattr(hm, "_serving_stats", boom)
+    v = ev.evaluate()                              # probe fails this sweep
+    assert "probe failed" in _findings_by_rule(v)["serving_p99_slo"]["message"]
+    [inc] = ev.incidents.list()
+    assert inc["status"] == "open"                 # NOT falsely resolved
+    monkeypatch.setattr(hm, "_serving_stats", lambda: stats)
+    ev.evaluate()                                  # heals, still tripping
+    assert len(ev.incidents.list()) == 1           # same incident, no dupe
+    stats["resident"][0]["slo"]["p99_ms"] = 10.0
+    ev.evaluate()                                  # genuine recovery
+    [inc] = ev.incidents.list()
+    assert inc["status"] == "resolved"
+
+
+# -- incidents ---------------------------------------------------------------
+
+def test_incident_dedupe_resolve_and_reopen(monkeypatch):
+    total = [0.0]
+    monkeypatch.setattr(hm, "_ejections_total", lambda: total[0])
+    ev = _evaluator()
+    ev.evaluate()                                  # baseline
+    total[0] = 1.0
+    ev.evaluate()                                  # rising edge → open
+    total[0] = 2.0
+    ev.evaluate()                                  # still tripping → repeat
+    incs = ev.incidents.list()
+    assert len(incs) == 1
+    assert incs[0]["rule"] == "elastic_ejections"
+    assert incs[0]["status"] == "open" and incs[0]["repeats"] == 2
+    ev.evaluate()                                  # no movement → resolve
+    incs = ev.incidents.list()
+    assert incs[0]["status"] == "resolved"
+    assert incs[0]["resolved_ms"] is not None
+    total[0] = 3.0
+    ev.evaluate()                                  # new edge → NEW incident
+    assert len(ev.incidents.list()) == 2
+
+
+def test_incident_context_capture_and_series():
+    log = IncidentLog(capacity=8)
+    iid = log.open("compute_recompile_storm", "compute", DEGRADED,
+                   "storm", 7.0, 2.0, series=[1.0, 3.0, 7.0])
+    rec = log.get(iid)
+    ctx = rec["context"]
+    assert ctx["series"] == [1.0, 3.0, 7.0]
+    assert isinstance(ctx["logs"], list)
+    assert isinstance(ctx["traces"], list)
+    assert "top_keys" in ctx["memory"]
+    assert "loops" in ctx["compute"]
+    with pytest.raises(KeyError):
+        log.get("inc_nope")
+
+
+def test_incident_ring_bounded():
+    log = IncidentLog(capacity=4)
+    for i in range(7):
+        log.open(f"rule_{i}", "memory", DEGRADED, "m", i, 0)
+        log.resolve(f"rule_{i}")
+    incs = log.list()
+    assert len(incs) == 4
+    assert [i["rule"] for i in incs] == ["rule_6", "rule_5", "rule_4",
+                                        "rule_3"]
+    assert log.opened_total() == 7                 # monotonic, not ring size
+
+
+def test_ring_eviction_spares_open_incidents():
+    """Eviction takes the oldest RESOLVED record — an ongoing episode
+    must keep its id (a mid-episode eviction would re-count
+    h2o3_incidents_total when the still-tripping rule re-opens)."""
+    log = IncidentLog(capacity=4)
+    ongoing = log.open("serving_shed_rate", "serving", DEGRADED,
+                       "overload", 0.4, 0.05)      # stays OPEN throughout
+    for i in range(6):                             # 6 flapping rules churn
+        log.open(f"flap_{i}", "memory", DEGRADED, "m", i, 0)
+        log.resolve(f"flap_{i}")
+    assert log.get(ongoing)["status"] == "open"    # survived the churn
+    # the still-tripping rule folds into the SAME record, no new id
+    assert log.open("serving_shed_rate", "serving", DEGRADED,
+                    "overload", 0.5, 0.05) == ongoing
+    assert log.get(ongoing)["repeats"] == 2
+    assert log.opened_total() == 7                 # one open per episode
+
+
+def test_compute_incident_fires_single_flight_profile(monkeypatch):
+    """H2O3TPU_INCIDENT_PROFILE=1: a compute-class incident enriches
+    itself with one bounded profiler capture (skipped, never queued, when
+    the profiler is busy)."""
+    monkeypatch.setenv("H2O3TPU_INCIDENT_PROFILE", "1")
+    log = IncidentLog(capacity=4)
+    iid = log.open("compute_recompile_storm", "compute", DEGRADED,
+                   "storm", 9.0, 2.0)
+    deadline = time.monotonic() + 20.0
+    cap = None
+    while time.monotonic() < deadline:
+        cap = log.get(iid)["context"].get("profiler_capture")
+        if cap is not None:
+            break
+        time.sleep(0.05)
+    assert cap is not None and cap.startswith("cap_")
+
+
+# -- chaos harness: each injected fault class → exactly one incident ---------
+
+def test_injected_retry_exhaustion_opens_one_dispatch_incident(monkeypatch):
+    import jax.numpy as jnp
+
+    from h2o3_tpu.ops.map_reduce import DispatchFailed, map_reduce
+    from h2o3_tpu.utils.timeline import inject_faults
+    monkeypatch.setenv("H2O3TPU_DISPATCH_BACKOFF_MS", "1")
+    ev = _evaluator()
+    ev.evaluate()                                  # baseline the window
+    with inject_faults(drop_rate=1.0):
+        with pytest.raises(DispatchFailed):
+            map_reduce(lambda s: s.sum(), jnp.ones(16, jnp.float32))
+    v = ev.evaluate()
+    assert v["subsystems"]["dispatch"]["status"] == UNHEALTHY
+    f = _findings_by_rule(v)["dispatch_retry_exhaustion"]
+    assert f["observed"] >= 1.0
+    incs = ev.incidents.list()
+    assert len(incs) == 1 and incs[0]["rule"] == "dispatch_retry_exhaustion"
+    ctx = ev.incidents.get(incs[0]["id"])["context"]
+    assert ctx["logs"] or ctx["traces"] or ctx["memory"]  # non-empty capture
+    assert ctx["series"]
+
+
+@pytest.mark.slow
+def test_stalled_elastic_worker_opens_one_elastic_incident(rng, monkeypatch):
+    """A worker stalled dead mid-build (PR 12 chaos `stall`) decays the
+    membership — the ejection lands as exactly one elastic-class
+    incident with correlated context."""
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.parallel import elastic
+    from h2o3_tpu.utils.timeline import inject_faults
+    monkeypatch.setenv("H2O3TPU_DISPATCH_BACKOFF_MS", "1")
+    monkeypatch.setenv("H2O3TPU_ELASTIC_ROUND_DEADLINE_SECS", "2.0")
+    monkeypatch.setenv("H2O3TPU_ELASTIC_LEASE_SECS", "1.0")
+    X = rng.normal(size=(512, 6)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["y"] = np.where(rng.random(512) < 0.5, "yes", "no")
+    fr = Frame.from_arrays(cols)
+    ev = _evaluator()
+    ev.evaluate()                                  # baseline the window
+    try:
+        with inject_faults(worker_rates={1: {"stall_rate": 1.0,
+                                             "stall_ms": 60_000,
+                                             "after": 4}}):
+            b = DeepLearning(hidden=[8], epochs=3, elastic=2,
+                             local_steps=1, mini_batch_size=64, seed=5)
+            b.train(y="y", training_frame=fr)
+        assert b.job.workers_ejected == 1
+        v = ev.evaluate()
+        f = _findings_by_rule(v)["elastic_ejections"]
+        assert f["observed"] == 1.0 and f["subsystem"] == "elastic"
+        elastic_incs = [i for i in ev.incidents.list()
+                        if i["subsystem"] == "elastic"]
+        assert len(elastic_incs) == 1
+        assert elastic_incs[0]["rule"] == "elastic_ejections"
+        ctx = ev.incidents.get(elastic_incs[0]["id"])["context"]
+        assert ctx["series"] and isinstance(ctx["logs"], list)
+    finally:
+        elastic.drain(60.0)
+
+
+def test_forced_spill_thrash_opens_one_memory_incident(tmp_path, rng):
+    """A working set thrashing through the Cleaner (spill → fault-in →
+    spill, PR 14) trips memory_spill_thrash exactly once."""
+    from h2o3_tpu.utils.cleaner import disable_cleaner, enable_cleaner
+
+    def mk(key):
+        f = Frame.from_arrays(
+            {f"c{i}": rng.normal(size=4096).astype(np.float32)
+             for i in range(4)}, key=key)
+        DKV.put(key, f)
+        return f
+
+    try:
+        # budget fits ~1 frame: each get of one key spills the other
+        enable_cleaner(70_000, ice_root=str(tmp_path))
+        mk("thrash_a")
+        mk("thrash_b")
+        ev = _evaluator()
+        ev.evaluate()                              # baseline post-setup
+        for _ in range(8):
+            DKV.get("thrash_a")
+            DKV.get("thrash_b")
+        v = ev.evaluate()
+        f = _findings_by_rule(v)["memory_spill_thrash"]
+        assert f["observed"] > f["threshold"]
+        mem_incs = [i for i in ev.incidents.list()
+                    if i["subsystem"] == "memory"]
+        assert len(mem_incs) == 1
+        assert mem_incs[0]["rule"] == "memory_spill_thrash"
+        assert ev.incidents.get(mem_incs[0]["id"])["context"]["series"]
+    finally:
+        disable_cleaner()
+
+
+# -- the sweep thread --------------------------------------------------------
+
+def test_sweep_thread_runs_and_stops_bounded():
+    ev = _evaluator(interval_s=0.05)
+    assert ev.start() is True
+    assert ev.start() is False                     # idempotent
+    deadline = time.monotonic() + 10.0
+    while ev.sweeps() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ev.sweeps() >= 2
+    t0 = time.monotonic()
+    ev.stop()
+    assert time.monotonic() - t0 < 5.0
+    assert not ev.running()
+
+
+def test_health_off_disables(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_HEALTH_OFF", "1")
+    ev = _evaluator()
+    assert ev.start() is False
+    v = ev.verdict()
+    assert v["status"] == "disabled" and v["healthy"] is None
+    assert ev.sweeps() == 0                        # never evaluated
+
+
+def test_verdict_evaluates_inline_without_thread():
+    ev = _evaluator()
+    v = ev.verdict()
+    assert v["sweep"] == 1 and v["status"] == HEALTHY
+
+
+# -- bundle ------------------------------------------------------------------
+
+BUNDLE_MEMBERS = {
+    "metrics.json", "metrics.prom", "traces.json", "memory.json",
+    "compute.json", "health.json", "incidents.json", "logs.txt",
+    "hardware.json", "config.json"}
+
+
+def _unpack(data: bytes) -> dict:
+    tar = tarfile.open(fileobj=io.BytesIO(data), mode="r:gz")
+    return {m.name.split("/", 1)[1]: tar.extractfile(m).read()
+            for m in tar.getmembers()}
+
+
+def test_bundle_contains_all_pillars_and_redacts_secrets(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_ADMIN_PASSWORD", "hunter2")
+    monkeypatch.setenv("H2O3TPU_LDAP_TOKEN", "s3cr3t-tok")
+    monkeypatch.setenv("H2O3TPU_MEGASTEP_K", "4")
+    ev = _evaluator()
+    ev.incidents.open("compute_recompile_storm", "compute", DEGRADED,
+                      "storm", 5.0, 2.0)
+    data, fname = diagnostic_bundle(ev)
+    assert fname.startswith("h2o3_diagnostics_") and fname.endswith(".tar.gz")
+    members = _unpack(data)
+    assert set(members) == BUNDLE_MEMBERS
+    # all four pillar snapshots parse and carry their signature keys
+    assert isinstance(json.loads(members["metrics.json"]), list)
+    assert members["metrics.prom"].rstrip().endswith(b"# EOF")
+    assert "traces" in json.loads(members["traces.json"])
+    assert "dkv" in json.loads(members["memory.json"])
+    assert "loops" in json.loads(members["compute.json"])
+    health = json.loads(members["health.json"])
+    assert health["status"] in ("healthy", "degraded", "unhealthy")
+    incidents = json.loads(members["incidents.json"])
+    assert incidents and incidents[0]["rule"] == "compute_recompile_storm"
+    assert incidents[0]["context"] is not None
+    cfg = json.loads(members["config.json"])
+    assert cfg["H2O3TPU_ADMIN_PASSWORD"] == "[redacted]"
+    assert cfg["H2O3TPU_LDAP_TOKEN"] == "[redacted]"
+    assert cfg["H2O3TPU_MEGASTEP_K"] == "4"        # knobs ship in clear
+    assert b"hunter2" not in data and b"s3cr3t-tok" not in data
+
+
+def test_redacted_config_name_patterns(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_S3_ACCESS_KEY", "AKIAxxx")
+    monkeypatch.setenv("H2O3TPU_TLS_CERT", "pem-blob")
+    monkeypatch.setenv("H2O3TPU_HEALTH_INTERVAL_SECS", "5")
+    monkeypatch.setenv("HOME_SECRET", "outside-prefix")   # not shipped at all
+    cfg = redacted_config()
+    assert cfg["H2O3TPU_S3_ACCESS_KEY"] == "[redacted]"
+    assert cfg["H2O3TPU_TLS_CERT"] == "[redacted]"
+    assert cfg["H2O3TPU_HEALTH_INTERVAL_SECS"] == "5"
+    assert "HOME_SECRET" not in cfg
+
+
+# -- REST + clients ----------------------------------------------------------
+
+@pytest.fixture
+def server(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_HEALTH_INTERVAL_SECS", "0.2")
+    from h2o3_tpu.api import H2OServer
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get_json(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+def test_rest_health_round_trip(server):
+    out = _get_json(server, "/3/Health")
+    assert out["__meta"]["schema_type"] == "HealthV3"
+    assert out["status"] == "healthy"
+    assert set(out["subsystems"]) == set(hm.SUBSYSTEMS)
+    assert out["rules"]                            # catalog served
+
+
+def test_rest_incidents_round_trip(server):
+    from h2o3_tpu.utils.incidents import INCIDENTS
+    iid = INCIDENTS.open("serving_shed_rate", "serving", DEGRADED,
+                         "overload", 0.4, 0.05)
+    try:
+        out = _get_json(server, "/3/Incidents")
+        assert out["__meta"]["schema_type"] == "IncidentsV3"
+        assert any(i["id"] == iid for i in out["incidents"])
+        one = _get_json(server, f"/3/Incidents/{iid}")
+        assert one["__meta"]["schema_type"] == "IncidentV3"
+        assert one["rule"] == "serving_shed_rate"
+        assert one["context"] and "logs" in one["context"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.url + "/3/Incidents/inc_nope")
+        assert ei.value.code == 404
+    finally:
+        INCIDENTS.reset()
+
+
+def test_rest_bundle_and_python_client(server, tmp_path):
+    from h2o3_tpu.api.client import H2OClient
+    client = H2OClient(server.url)
+    h = client.health()
+    assert h["status"] == "healthy"
+    assert client.incidents() == [] or isinstance(client.incidents(), list)
+    path = client.diagnostics_bundle(str(tmp_path / "diag.tar.gz"))
+    members = _unpack(open(path, "rb").read())
+    assert set(members) == BUNDLE_MEMBERS
+    # POST and GET serve the same artifact class (R's downloader GETs)
+    req = urllib.request.Request(server.url + "/3/Diagnostics/bundle",
+                                 method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert r.headers["Content-Type"] == "application/gzip"
+        assert set(_unpack(r.read())) == BUNDLE_MEMBERS
+
+
+def test_server_runs_and_stops_global_evaluator(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_HEALTH_INTERVAL_SECS", "0.1")
+    from h2o3_tpu.api import H2OServer
+    from h2o3_tpu.utils.health import HEALTH
+    s = H2OServer(port=0).start()
+    try:
+        assert HEALTH.running()
+    finally:
+        s.stop()
+    assert not HEALTH.running()
+
+
+def test_metric_counts_incident_opens():
+    from h2o3_tpu.utils.incidents import INCIDENTS_TOTAL
+    child = INCIDENTS_TOTAL.labels(rule="memory_leak_growth",
+                                   subsystem="memory")
+    before = child.value
+    log = IncidentLog(capacity=4)
+    log.open("memory_leak_growth", "memory", DEGRADED, "leak", 1, 0)
+    log.open("memory_leak_growth", "memory", DEGRADED, "leak", 2, 0)  # repeat
+    assert child.value == before + 1               # opens count, repeats don't

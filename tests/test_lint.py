@@ -715,6 +715,91 @@ def test_ing001_suppressible(tmp_path):
     assert "ING001" not in rules_of(run_lint(pkg))
 
 
+# -- metric documentation (MTR) ----------------------------------------------
+
+def test_mtr001_undocumented_metric_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "telemetry.py": """
+            METRICS = object()
+            DOCUMENTED = METRICS.counter("h2o3_documented", "d", ("k",))
+            MISSING = METRICS.gauge("h2o3_missing_gauge", "m")
+            MISSING_H = METRICS.histogram("h2o3_missing_seconds", "m")
+        """,
+        "docs/OBSERVABILITY.md": """
+            | Name | Type | Labels | Meaning |
+            |---|---|---|---|
+            | `h2o3_documented_total` | counter | k | documented |
+        """})
+    mtr = [f for f in run_lint(pkg) if f.rule == "MTR001"]
+    assert len(mtr) == 2
+    assert {f.detail for f in mtr} == {
+        "undocumented-metric:h2o3_missing_gauge",
+        "undocumented-metric:h2o3_missing_seconds"}
+
+
+def test_mtr001_total_suffix_dedupe_and_non_h2o3_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "a.py": """
+            def reg(m):
+                # counters documented in exposition (_total) form match
+                m.counter("h2o3_spills", "s", ("kind",))
+                # one finding per NAME: a shared lazy registration is one
+                # contract — the second call site must not double-report
+                m.counter("h2o3_shared", "s", ("where",))
+        """,
+        "b.py": """
+            def reg2(m):
+                m.counter("h2o3_shared", "s", ("where",))
+                m.gauge("internal_gauge", "not an h2o3_* family")
+                other.counter(dynamic_name, "non-literal name: unknowable")
+        """,
+        "docs/OBSERVABILITY.md": """
+            | `h2o3_spills_total` | counter | kind | documented as _total |
+        """})
+    mtr = [f for f in run_lint(pkg) if f.rule == "MTR001"]
+    assert len(mtr) == 1
+    assert mtr[0].detail == "undocumented-metric:h2o3_shared"
+
+
+def test_mtr001_prefix_match_is_word_bounded(tmp_path):
+    """`h2o3_spill` must NOT be satisfied by a doc row for
+    `h2o3_spill_bytes_total` — only the exact name (± _total)."""
+    pkg = make_pkg(tmp_path, {
+        "a.py": 'M.counter("h2o3_spill", "s")\n',
+        "docs/OBSERVABILITY.md": "| `h2o3_spill_bytes_total` | counter |\n"})
+    mtr = [f for f in run_lint(pkg) if f.rule == "MTR001"]
+    assert [f.detail for f in mtr] == ["undocumented-metric:h2o3_spill"]
+
+
+def test_mtr001_prose_mention_is_not_a_row(tmp_path):
+    """A narrative mention of the name outside a catalog table row does
+    NOT satisfy the rule — the contract is a row, not a citation."""
+    pkg = make_pkg(tmp_path, {
+        "a.py": 'M.gauge("h2o3_foo", "f")\n',
+        "docs/OBSERVABILITY.md":
+            "Unlike `h2o3_foo`, this gauge resets on restart.\n"})
+    mtr = [f for f in run_lint(pkg) if f.rule == "MTR001"]
+    assert [f.detail for f in mtr] == ["undocumented-metric:h2o3_foo"]
+
+
+def test_mtr001_no_docs_file_skips(tmp_path):
+    """A tree without docs/OBSERVABILITY.md has nothing to drift with —
+    the rule stays silent instead of flagging every registration."""
+    pkg = make_pkg(tmp_path, {
+        "a.py": 'M.counter("h2o3_orphan", "o")\n'})
+    assert "MTR001" not in rules_of(run_lint(pkg))
+
+
+def test_mtr001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "a.py": """
+            # graftlint: ok(internal debug metric, deliberately uncataloged)
+            M.counter("h2o3_debug_only", "d")
+        """,
+        "docs/OBSERVABILITY.md": "| nothing |\n"})
+    assert "MTR001" not in rules_of(run_lint(pkg))
+
+
 # -- profiling attribution (PRF) ---------------------------------------------
 
 def test_prf001_anonymous_jit_flagged(tmp_path):
@@ -993,6 +1078,26 @@ def test_slo_serving_modules_scan_clean(live_findings):
             if f.path in ("serving/slo.py", "serving/replicas.py",
                           "serving/batcher.py", "serving/service.py",
                           "tools/envs.py")]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_ops_plane_modules_scan_clean(live_findings):
+    """The ops plane (ISSUE 15) ships lint-clean across every rule family
+    — including MTR001, whose doc-drift contract the new
+    h2o3_incidents_total / h2o3_telemetry_rejected_total registrations
+    must themselves satisfy."""
+    hits = [f for f in live_findings
+            if f.path in ("utils/health.py", "utils/incidents.py",
+                          "tools/metrics.py")]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_package_has_no_mtr001_findings(live_findings):
+    """Every h2o3_* metric registered in the live package has a row in
+    docs/OBSERVABILITY.md — zero MTR001 findings, baselined or not: the
+    metric catalog is the operator contract, and undocumented instruments
+    don't get grandfathered."""
+    hits = [f for f in live_findings if f.rule == "MTR001"]
     assert hits == [], "\n".join(f.render() for f in hits)
 
 

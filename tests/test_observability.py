@@ -105,6 +105,66 @@ def test_openmetrics_text_shape():
     assert "\nh_count 1\n" in text and "\nh_sum 0.5\n" in text
 
 
+def test_openmetrics_hostile_label_values_conform():
+    """ISSUE 15 satellite: label-value escaping per the exposition format
+    — backslash, double-quote, and line feed escape (in that order: the
+    escape char first), and each exposition line stays one physical line
+    whatever the label value carries."""
+    reg = MetricsRegistry()
+    hostile = 'back\\slash "quote"\nnewline'
+    reg.counter("c", "", ("k",)).labels(k=hostile).inc()
+    text = reg.to_openmetrics()
+    line = [ln for ln in text.splitlines() if ln.startswith("c_total")][0]
+    assert line == 'c_total{k="back\\\\slash \\"quote\\"\\nnewline"} 1'
+    # the escaped value round-trips: unescape recovers the original
+    m = re.search(r'c_total\{k="((?:[^"\\]|\\.)*)"\}', line)
+    unescaped = m.group(1).replace("\\n", "\n").replace('\\"', '"') \
+                          .replace("\\\\", "\\")
+    assert unescaped == hostile
+    # every line of the exposition is parseable as comment/sample/EOF
+    for ln in text.splitlines():
+        assert ln.startswith("#") or re.fullmatch(
+            r'\S+(\{[^{}]*\})? \S+', ln), f"malformed line: {ln!r}"
+
+
+def test_openmetrics_help_escapes_backslash_newline_only():
+    """HELP text defines only \\\\ and \\n escapes — a \\\" in HELP is an
+    invalid sequence strict OpenMetrics parsers reject, so quotes must
+    pass through verbatim (they are only special inside label values)."""
+    reg = MetricsRegistry()
+    reg.counter("c", 'help with "quotes", a \\ and\na newline')
+    text = reg.to_openmetrics()
+    [help_line] = [ln for ln in text.splitlines()
+                   if ln.startswith("# HELP c ")]
+    assert help_line == '# HELP c help with "quotes", a \\\\ and\\na newline'
+    assert '\\"' not in help_line
+
+
+def test_histogram_rejects_nan_negative_and_counts_drops():
+    """ISSUE 15 satellite: a NaN observation poisons _sum (and every
+    percentile read) irreversibly, a negative corrupts it silently —
+    both drop and account in h2o3_telemetry_rejected_total{where}."""
+    import math
+
+    reg = MetricsRegistry()
+    h = reg.histogram("h2o3_test_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(float("nan"))
+    h.observe(-3.0)
+    h.observe(float("inf"))
+    h.observe(0.25)
+    child = h.labels()
+    assert child.count == 2 and child.sum == 0.75
+    assert math.isfinite(child.sum)
+    assert child.counts == [2, 0]                  # nothing leaked to +Inf
+    rej = reg.counter("h2o3_telemetry_rejected", "", ("where",))
+    assert rej.labels(where="h2o3_test_seconds").value == 3
+    # the exposition stays NaN-free (no SAMPLE renders NaN; the rejected
+    # counter's HELP legitimately mentions the word)
+    assert not [ln for ln in reg.to_openmetrics().splitlines()
+                if not ln.startswith("#") and ln.endswith(" NaN")]
+
+
 # -- LogRing ----------------------------------------------------------------
 
 # MM-dd HH:mm:ss.SSS pid thread LEVEL logger: msg (thread names may contain

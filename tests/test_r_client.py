@@ -144,7 +144,8 @@ def test_r_package_sources_complete():
                "h2o.glm", "h2o.predict", "h2o.performance", "h2o.splitFrame",
                "h2o.auc", "h2o.removeAll", "h2o.compute",
                "h2o.profilerCapture", "h2o.profilerCaptures",
-               "h2o.workers"):
+               "h2o.workers", "h2o.health", "h2o.incidents", "h2o.incident",
+               "h2o.diagnosticsBundle"):
         assert f"export({fn})" in ns, fn
         assert f"{fn} <- function" in code, fn
 
@@ -362,3 +363,36 @@ def test_r_wire_contract_compute(server):
     st, caps = _raw_http(server, "GET", "/3/Profiler/captures")
     assert st == 200
     assert any(c["capture_id"] == rec["capture_id"] for c in caps["captures"])
+
+
+def test_r_wire_contract_ops_plane(server):
+    """ISSUE 15 R verbs: h2o.health (GET /3/Health), h2o.incidents /
+    h2o.incident (GET /3/Incidents[/{id}]), and h2o.diagnosticsBundle —
+    whose downloader GETs /3/Diagnostics/bundle (utils::download.file
+    cannot POST; the route serves both)."""
+    st, health = _raw_http(server, "GET", "/3/Health")
+    assert st == 200
+    assert health["__meta"]["schema_type"] == "HealthV3"
+    assert health["status"] in ("healthy", "degraded", "unhealthy")
+    assert set(health["subsystems"]) == {"elastic", "serving", "memory",
+                                         "compute", "dispatch"}
+    from h2o3_tpu.utils.incidents import INCIDENTS
+    iid = INCIDENTS.open("serving_shed_rate", "serving", "degraded",
+                         "overload", 0.5, 0.05)
+    try:
+        st, incs = _raw_http(server, "GET", "/3/Incidents")
+        assert st == 200
+        assert any(i["id"] == iid for i in incs["incidents"])
+        st, one = _raw_http(server, "GET", f"/3/Incidents/{iid}")
+        assert st == 200 and one["rule"] == "serving_shed_rate"
+    finally:
+        INCIDENTS.reset()
+    # the bundle route answers GET with a gzip tar (R's download.file is
+    # a plain GET; binary body — fetched here via urllib, not the
+    # text-decoding raw socket helper)
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}/3/Diagnostics/bundle") as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/gzip"
+        assert r.read()[:2] == b"\x1f\x8b"          # gzip magic

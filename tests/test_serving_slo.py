@@ -69,6 +69,26 @@ class TestController:
         assert ring.percentile(50) == pytest.approx(0.064, abs=0.015)
         assert ring.percentile(99) >= 0.099
 
+    def test_ring_rejects_nan_negative_and_counts_drops(self):
+        """ISSUE 15 satellite: a NaN in the ring makes sorted() a partial
+        order — every percentile read downstream would steer the SLO
+        controller off garbage. Invalid latencies drop and account in
+        h2o3_telemetry_rejected_total{where=latency_ring}."""
+        from h2o3_tpu.utils.telemetry import METRICS
+        rejected = METRICS.counter("h2o3_telemetry_rejected", "",
+                                   ("where",)).labels(where="latency_ring")
+        before = rejected.value
+        ring = LatencyRing(size=64)
+        for v in range(1, 101):
+            ring.record(v / 1000.0)
+        p99_clean = ring.percentile(99)
+        ring.record(float("nan"))
+        ring.record(-1.0)
+        ring.record(float("inf"))
+        assert rejected.value == before + 3
+        assert ring.count == 100                    # drops never landed
+        assert ring.percentile(99) == p99_clean     # signal unpoisoned
+
     def test_no_target_is_fixed_window_and_never_sheds(self):
         c = SLOController(base_window_s=0.002, slo_ms=None)
         assert not c.active

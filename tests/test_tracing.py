@@ -453,6 +453,30 @@ def test_concurrent_requests_get_distinct_trace_ids(server):
     assert len(results) == 16 and len(set(results)) == 16
 
 
+def test_health_polling_routes_are_ephemeral(server):
+    """ISSUE 15 satellite: the ops-plane polling endpoints (/3/Health,
+    /3/Incidents) are scraped like /metrics and /3/Jobs — a health
+    scraper must not churn the completed-trace ring. Propagation still
+    works: each reply carries a traceparent, and sending one records the
+    call in the caller's trace as usual."""
+    import time
+    for path in ("/3/Health", "/3/Incidents"):
+        _, headers = _get(server, path)
+        tp = parse_traceparent(headers["traceparent"])
+        assert tp is not None                  # propagation still works
+        time.sleep(0.05)
+        with pytest.raises(KeyError):
+            TRACER.get_trace(tp.trace_id)      # ...but nothing was stored
+        assert all(t["trace_id"] != tp.trace_id
+                   for t in TRACER.list_traces())
+    # an explicit caller traceparent opts the call INTO recording
+    caller = f"00-{'5e' * 16}-{'7a' * 8}-01"
+    _, headers = _get(server, "/3/Health", headers={"traceparent": caller})
+    assert parse_traceparent(headers["traceparent"]).trace_id == "5e" * 16
+    trace = TRACER.get_trace("5e" * 16)
+    assert any(s["name"] == "GET /3/Health" for s in trace["spans"])
+
+
 def test_unmatched_routes_are_ephemeral(server):
     """A scanner hitting unknown paths must not churn the trace ring."""
     import urllib.error
