@@ -1452,6 +1452,252 @@ def _health_gate(hl: dict) -> None:
         sys.exit(3)
 
 
+def bench_ops(ndev: int) -> dict:
+    """Self-driving ops proof (ISSUE 16): replay the three chaos classes
+    with remediation switched to ACT mode — each must heal with NO human
+    intervention: the health rule trips, the incident rising edge fires
+    the engine, exactly ONE bounded audited action of the right class
+    lands on the live target, and the incident resolves on the next clean
+    sweep. Then a CLEAN GLM run under the same act mode must take ZERO
+    actions — an engine that remediates normal operation is worse than no
+    engine. Spill-thrash and the stalled worker run fully live (real
+    Cleaner/DKV ping-pong, real ElasticGroup with a wedged thread); the
+    serving replay injects the shed counters but the action still lands
+    on the REAL scoring tier's admission targets."""
+    import shutil
+    import tempfile
+    import threading
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.ops_plane.actions import ActionLog
+    from h2o3_tpu.ops_plane.remediate import RemediationEngine
+    from h2o3_tpu.utils import health as hm
+    from h2o3_tpu.utils.health import HealthEvaluator
+    from h2o3_tpu.utils.incidents import IncidentLog
+    from h2o3_tpu.utils.registry import DKV
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("H2O3TPU_REMEDIATE", "H2O3TPU_OPS_COOLDOWN_SECS",
+                  "H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS")}
+    os.environ["H2O3TPU_REMEDIATE"] = "act"
+    os.environ["H2O3TPU_OPS_COOLDOWN_SECS"] = "0"
+
+    def rig():
+        ev = HealthEvaluator(interval_s=9.0,
+                             incidents=IncidentLog(capacity=16))
+        eng = RemediationEngine(actions=ActionLog())
+        eng.install(ev.incidents)
+        return ev, eng
+
+    def outcome(ev, eng, rule):
+        applied = [r for r in eng.actions.list()
+                   if r["outcome"] == "applied"]
+        resolved = [r for r in ev.incidents.list(state="resolved")
+                    if r["rule"] == rule]
+        return dict(
+            rule=rule,
+            applied_actions=[r["action"] for r in applied],
+            healed=bool(resolved) and not ev.incidents.list(state="open"),
+            action_stamped=bool(resolved)
+            and resolved[0]["action_id"] is not None,
+            records=eng.actions.recorded_total())
+
+    out: dict = {}
+
+    # -- chaos 1: spill-thrash, fully live -----------------------------------
+    # two frames + a budget that fits only one → every touch of the cold
+    # one restores it and spills the other; the remediation's 1.5× budget
+    # raise makes BOTH fit, so the ping-pong goes quiet and the incident
+    # resolves on the evidence of the real Cleaner counters
+    from h2o3_tpu.utils.cleaner import CLEANER, disable_cleaner, enable_cleaner
+    ice = tempfile.mkdtemp(prefix="ops_bench_ice_")
+    rng = np.random.default_rng(61)
+    ev, eng = rig()
+    try:
+        frames = {}
+        for key in ("ops_thrash_a", "ops_thrash_b"):
+            fr = Frame.from_arrays(
+                {f"c{i}": rng.normal(size=20_000).astype(np.float32)
+                 for i in range(4)}, key=key)
+            DKV.put(key, fr)
+            frames[key] = fr
+        one = frames["ops_thrash_a"].nbytes
+        enable_cleaner(int(one * 1.5), ice_root=ice)
+        CLEANER.sweep()
+        ev.evaluate()                             # window baseline
+        for _ in range(4):                        # the thrash
+            DKV.get("ops_thrash_a"); CLEANER.sweep()
+            DKV.get("ops_thrash_b"); CLEANER.sweep()
+        ev.evaluate()                             # trips → engine → budget up
+        budget_after = CLEANER.budget
+        for _ in range(2):                        # working set fits now
+            DKV.get("ops_thrash_a"); CLEANER.sweep()
+            DKV.get("ops_thrash_b"); CLEANER.sweep()
+        ev.evaluate()                             # quiet window → resolve
+        out["spill_thrash"] = dict(
+            outcome(ev, eng, "memory_spill_thrash"),
+            budget_before=int(one * 1.5), budget_after=budget_after,
+            budget_raised=budget_after is not None
+            and budget_after > int(one * 1.5))
+    finally:
+        eng.uninstall()
+        for key in ("ops_thrash_a", "ops_thrash_b"):
+            try:
+                DKV.remove(key)
+            except KeyError:
+                pass
+        disable_cleaner()
+        shutil.rmtree(ice, ignore_errors=True)
+
+    # -- chaos 2: serving overload — replayed counters, live admission -------
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.serving.service import SCORING
+    SCORING.reset()
+    n = 300
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    cols = {f"x{i}": X[:, i] for i in range(3)}
+    cols["y"] = np.where(X[:, 0] > 0, "yes", "no")
+    fr = Frame.from_arrays(cols, key="ops_serve_train")
+    glm = GLM(family="binomial", lambda_=1e-4,
+              model_id="ops_serve_glm").train(y="y", training_frame=fr)
+    rows = [{f"x{i}": float(X[r, i]) for i in range(3)} for r in range(4)]
+    SCORING.score(glm.key, rows, slo_ms=50.0)     # resident, target 50ms
+    orig_stats, orig_total = hm._serving_stats, hm._score_requests_total
+    shed, total = [0.0], [100.0]
+    hm._serving_stats = lambda: {
+        "shed_total": shed[0],
+        "resident": [{"model": glm.key,
+                      "slo": {"target_ms": 50.0, "p99_ms": 20.0}}]}
+    hm._score_requests_total = lambda: total[0]
+    ev, eng = rig()
+    try:
+        ev.evaluate()                             # baseline
+        shed[0], total[0] = 40.0, 200.0           # 40% shed this window
+        ev.evaluate()                             # trips → widen admission
+        live = orig_stats()                       # REAL tier, post-action
+        target_after = next(
+            (m["slo"]["target_ms"] for m in live["resident"]
+             if m["model"] == glm.key and m.get("slo")), None)
+        ev.evaluate()                             # traffic drained → resolve
+        out["serving_overload"] = dict(
+            outcome(ev, eng, "serving_shed_rate"),
+            target_ms_after=target_after,
+            admission_widened=bool(target_after and target_after > 50.0))
+    finally:
+        eng.uninstall()
+        hm._serving_stats, hm._score_requests_total = orig_stats, orig_total
+        SCORING.reset()
+        try:
+            DKV.remove("ops_serve_train")
+        except KeyError:
+            pass
+
+    # -- chaos 3: stalled elastic worker, fully live -------------------------
+    # worker 1 wedges mid-round (blocked thread, heartbeat silent); the
+    # engine must preempt-reassign its shards BEFORE the 120s lease would
+    # have noticed, after which the probe no longer counts the ejected
+    # slot and the incident resolves
+    from h2o3_tpu.parallel import elastic
+    from h2o3_tpu.parallel.elastic import ElasticGroup
+    os.environ["H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS"] = "1"
+    stall = threading.Event()
+    g = ElasticGroup(3, lease_secs=120.0, round_deadline_secs=300.0,
+                     group_id="ops_bench_elastic").start()
+    thunks = {0: lambda: time.sleep(0.01),
+              1: lambda: stall.wait(timeout=60.0),
+              2: lambda: time.sleep(0.01)}
+    runner = threading.Thread(target=g.run_round, args=(1, thunks),
+                              daemon=True)
+    ev, eng = rig()
+    try:
+        runner.start()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:       # healthy slots heartbeat;
+            g.heartbeat(0); g.heartbeat(2)       # the wedged one is silent
+            time.sleep(0.1)
+        ev.evaluate()                             # gap > 1s → preempt
+        membership = g.membership()
+        g.heartbeat(0); g.heartbeat(2)
+        ev.evaluate()                             # ejected slot not counted
+        out["stalled_worker"] = dict(
+            outcome(ev, eng, "elastic_heartbeat_gap"),
+            worker_ejected=membership.get(1) == "EJECTED",
+            survivors=[w for w, s in membership.items() if s == "ACTIVE"])
+    finally:
+        eng.uninstall()
+        stall.set()
+        runner.join(timeout=30.0)
+        g.shutdown()
+        elastic.drain(timeout=10.0)
+        if saved_env["H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS"] is None:
+            os.environ.pop("H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS", None)
+
+    # -- the negative: a clean run must take ZERO actions --------------------
+    nclean = 2_000 if SMOKE else 20_000
+    Xc = rng.normal(size=(nclean, 8)).astype(np.float32)
+    colsc = {f"x{i}": Xc[:, i] for i in range(8)}
+    colsc["y"] = np.where(Xc[:, 0] - Xc[:, 1] > 0, "Y", "N")
+    frc = Frame.from_arrays(colsc)
+
+    def clean_train():
+        GLM(family="binomial", lambda_=1e-4, max_iterations=8).train(
+            y="y", training_frame=frc)
+
+    clean_train()      # warm-up: compiles land OUTSIDE the watched window
+    ev, eng = rig()
+    try:
+        ev.evaluate()                             # baseline
+        clean_train()
+        ev.evaluate()
+        out["clean_run"] = dict(
+            actions_taken=eng.actions.recorded_total(),
+            incidents_opened=ev.incidents.opened_total())
+    finally:
+        eng.uninstall()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+_OPS_EXPECTED = {"spill_thrash": "raise_cleaner_budget",
+                 "serving_overload": "serving_relief",
+                 "stalled_worker": "reassign_shards"}
+
+
+def _ops_gate(op: dict) -> None:
+    """Refuse to stamp unless the remediation engine healed every chaos
+    class hands-off — exactly one applied action of the RIGHT class per
+    incident, the incident resolved and stamped with the action id — and
+    took zero actions on the clean run (a trigger-happy engine pages ops
+    with changes nobody asked for)."""
+    if op.get("error"):
+        print(f"# bench REFUSED: ops section failed: {op['error']}",
+              file=sys.stderr)
+        sys.exit(3)
+    for name, want in _OPS_EXPECTED.items():
+        sc = op.get(name) or {}
+        if sc.get("applied_actions") != [want]:
+            print(f"# bench REFUSED: ops chaos '{name}' applied "
+                  f"{sc.get('applied_actions')} — expected exactly one "
+                  f"'{want}' action", file=sys.stderr)
+            sys.exit(3)
+        if not sc.get("healed") or not sc.get("action_stamped"):
+            print(f"# bench REFUSED: ops chaos '{name}' did not heal "
+                  f"hands-off (healed={sc.get('healed')}, "
+                  f"stamped={sc.get('action_stamped')}) — a human would "
+                  "have had to step in", file=sys.stderr)
+            sys.exit(3)
+    clean = op.get("clean_run") or {}
+    if clean.get("actions_taken", 1) != 0:
+        print(f"# bench REFUSED: remediation took "
+              f"{clean.get('actions_taken')} action(s) on a CLEAN run — "
+              "the engine remediates normal operation", file=sys.stderr)
+        sys.exit(3)
+
+
 def _tracing_gate(trc: dict) -> None:
     """Refuse to stamp an artifact whose tracing section is hollow: an
     empty trace store after an instrumented run means the span plumbing
@@ -1890,6 +2136,16 @@ def main() -> None:
         hl = {"error": f"{type(e).__name__}: {e}"}
     out["extra"]["health"] = hl
     _health_gate(hl)
+    # self-driving ops: replay the chaos classes with remediation in ACT
+    # mode — the gate refuses unless every class heals hands-off via one
+    # audited action of the right class and the clean run takes none
+    # (ISSUE 16; docs/OPERATIONS.md)
+    try:
+        op = bench_ops(ndev)
+    except Exception as e:   # noqa: BLE001 — gate reports, then refuses
+        op = {"error": f"{type(e).__name__}: {e}"}
+    out["extra"]["ops"] = op
+    _ops_gate(op)
     # metrics snapshot rides along in the artifact (dispatch counts, parse
     # bytes, model-build latencies) so the perf trajectory carries telemetry;
     # buckets omitted to keep the JSON line compact

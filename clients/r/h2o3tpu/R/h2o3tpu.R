@@ -679,16 +679,43 @@ h2o.health <- function() {
   .http("GET", "/3/Health")
 }
 
-h2o.incidents <- function() {
+h2o.incidents <- function(state = NULL) {
   # bounded incident ring, newest first (one open incident per rule);
-  # fetch one with h2o.incident(id) for its trip-time context
-  .http("GET", "/3/Incidents")$incidents
+  # state = "open"|"resolved" filters; fetch one with h2o.incident(id)
+  # for its trip-time context
+  path <- "/3/Incidents"
+  if (!is.null(state))
+    path <- paste0(path, "?state=", URLencode(state, reserved = TRUE))
+  .http("GET", path)$incidents
 }
 
 h2o.incident <- function(incident_id) {
   # one incident with correlated context captured at trip time: trace
   # ids, log tail, memory top-keys, compute loop rows, observed series
   .http("GET", paste0("/3/Incidents/", incident_id))
+}
+
+h2o.ops <- function() {
+  # the self-driving ops surface: remediation policy (mode/cooldown/
+  # bounds), the append-only ActionLog (newest first, rollback tokens),
+  # and per-tenant quota usage (docs/OPERATIONS.md)
+  .http("GET", "/3/Ops")
+}
+
+h2o.setQuota <- function(tenant, qps = NULL, device_seconds = NULL,
+                         bytes = NULL) {
+  # install/update a tenant admission budget; over-quota requests shed
+  # with HTTP 429 + Retry-After, never silently dropped
+  body <- list(tenant = tenant)
+  if (!is.null(qps)) body$qps <- qps
+  if (!is.null(device_seconds)) body$device_seconds <- device_seconds
+  if (!is.null(bytes)) body$bytes <- bytes
+  .http("POST", "/3/Ops", body)
+}
+
+h2o.removeQuota <- function(tenant) {
+  # drop a tenant's budget (back to unlimited admission)
+  .http("POST", "/3/Ops", list(remove_quota = tenant))$removed
 }
 
 h2o.diagnosticsBundle <- function(path) {

@@ -17,8 +17,12 @@ import urllib.request
 class H2OClient:
     """``H2OClient(url)`` speaks to a running :class:`H2OServer`."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, tenant: str | None = None):
         self.url = url.rstrip("/")
+        #: tenant id sent as ``X-H2O3-Tenant`` on every request (None =
+        #: the server's default tenant) — the multi-tenant admission
+        #: identity (docs/OPERATIONS.md "Tenancy")
+        self.tenant = tenant
         # trace id of the most recent request (from the server's W3C
         # ``traceparent`` response header) — feed it to :meth:`trace`
         self.last_trace_id: str | None = None
@@ -29,6 +33,8 @@ class H2OClient:
         url = self.url + path
         body = None
         headers = {}
+        if self.tenant is not None:
+            headers["X-H2O3-Tenant"] = str(self.tenant)
         if data is not None:
             body = urllib.parse.urlencode(
                 {k: (json.dumps(v) if isinstance(v, (dict, list)) else v)
@@ -342,10 +348,46 @@ class H2OClient:
         (docs/OBSERVABILITY.md "Health & incidents")."""
         return self.request("GET", "/3/Health")
 
-    def incidents(self) -> list[dict]:
-        """Incident-ring summaries, newest first (``GET /3/Incidents``);
-        fetch one with :meth:`incident` for its trip-time context."""
-        return self.request("GET", "/3/Incidents")["incidents"]
+    def incidents(self, state: str | None = None) -> list[dict]:
+        """Incident-ring summaries, newest first (``GET /3/Incidents``),
+        optionally filtered to ``state="open"`` or ``"resolved"``; fetch
+        one with :meth:`incident` for its trip-time context."""
+        path = "/3/Incidents"
+        if state is not None:
+            path += f"?state={urllib.parse.quote(str(state))}"
+        return self.request("GET", path)["incidents"]
+
+    def ops(self) -> dict:
+        """The ops plane in one view (``GET /3/Ops``): remediation policy
+        (mode, rule→action map, bounds), the audited action log,
+        per-tenant usage, and configured quotas (docs/OPERATIONS.md)."""
+        return self.request("GET", "/3/Ops")
+
+    def set_quota(self, tenant: str, qps=None, device_seconds=None,
+                  bytes=None) -> dict:   # noqa: A002 — the REST param name
+        """Install per-tenant budgets (``POST /3/Ops``): requests/second,
+        device-seconds per rolling window, and DKV bytes. Omitted
+        dimensions are unlimited; over-quota requests are shed with
+        ``429 + Retry-After``."""
+        data = {"tenant": tenant}
+        if qps is not None:
+            data["qps"] = qps
+        if device_seconds is not None:
+            data["device_seconds"] = device_seconds
+        if bytes is not None:
+            data["bytes"] = bytes
+        return self.request("POST", "/3/Ops", data)["quota"]
+
+    def remove_quota(self, tenant: str) -> bool:
+        """Drop a tenant's budgets (``POST /3/Ops`` remove_quota)."""
+        return bool(self.request("POST", "/3/Ops",
+                                 {"remove_quota": tenant})["removed"])
+
+    def rollback_action(self, action_id: str) -> bool:
+        """Undo a recorded remediation action by its id
+        (``POST /3/Ops`` rollback); the rollback is itself audited."""
+        return bool(self.request("POST", "/3/Ops",
+                                 {"rollback": action_id})["rolled_back"])
 
     def incident(self, incident_id: str) -> dict:
         """One incident with its correlated context — trace ids, log

@@ -130,6 +130,13 @@ def incidents_v3(summaries: list) -> dict:
     return {**_meta("IncidentsV3"), "incidents": _clean(summaries)}
 
 
+def ops_v3(payload: dict) -> dict:
+    """``GET/POST /3/Ops`` — the ops plane: remediation policy view
+    (mode/map/bounds), the append-only action log, per-tenant usage, and
+    the configured quotas (docs/OPERATIONS.md is the operator catalog)."""
+    return {**_meta("OpsV3"), **_clean(payload)}
+
+
 def incident_v3(record: dict) -> dict:
     """``GET /3/Incidents/{id}`` — one incident with its trip-time
     correlated context: recent trace ids, log-ring tail, memory top-keys,

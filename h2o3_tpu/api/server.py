@@ -302,8 +302,17 @@ class _Handler(BaseHTTPRequestHandler):
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
         r"Logs(?:/.*)?|Memory|Metrics|Compute|Score|Timeline|JStack|"
-        r"WaterMeter[^/]*(?:/\d+)?|Health|Incidents(?:/[^/]+)?|"
+        r"WaterMeter[^/]*(?:/\d+)?|Health|Incidents(?:/[^/]+)?|Ops|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
+
+    #: endpoints that do real work — the ones tenant quotas meter
+    #: (monitoring GETs and session plumbing are never shed: an operator
+    #: must be able to LOOK at an over-quota tenant's usage)
+    _METERED = re.compile(
+        r"/3/(?:Score/[^/]+|Parse|PostFile(?:\.bin)?|"
+        r"Predictions/models/[^/]+/frames/[^/]+)|"
+        r"/4/Predictions/models/[^/]+/frames/[^/]+|"
+        r"/(?:3|99)/ModelBuilders/[^/]+")
 
     def _route(self, method: str):
         path = urllib.parse.urlparse(self.path).path
@@ -351,27 +360,63 @@ class _Handler(BaseHTTPRequestHandler):
             self._route_label = "(unauthorized)"
             return
         try:
-            for pat, m, fn in _ROUTES:
-                match = re.fullmatch(pat, path)
-                if match and m == method:
-                    self._route_label = _route_label_of(pat)
-                    fn(self, *match.groups())
-                    return
-            # extension-contributed routes (reference RestApiExtension SPI)
-            from h2o3_tpu.utils import extensions as _ext
-            for pat, m, fn in _ext.rest_routes():
-                match = re.fullmatch(pat, path)
-                if match and m == method:
-                    self._route_label = _route_label_of(pat)
-                    fn(self, *match.groups())
-                    return
-            self._error(404, f"no route for {method} {path}")
+            import sys as _sys
+            ten = _sys.modules.get("h2o3_tpu.ops_plane.tenancy")
+            if ten is None:
+                # multi-tenancy not loaded (embedded/library use): zero
+                # overhead, exactly the pre-ops-plane dispatch
+                self._run_routes(method, path)
+                return
+            raw = self.headers.get("X-H2O3-Tenant")
+            if raw is None:
+                q = urllib.parse.urlparse(self.path).query
+                raw = {k: v[0] for k, v in
+                       urllib.parse.parse_qs(q).items()}.get("tenant")
+            try:
+                tenant = ten.sanitize_tenant(raw)
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            with ten.tenant_scope(tenant):
+                if method == "POST" \
+                        and re.fullmatch(self._METERED, path) is not None:
+                    try:
+                        ten.QUOTAS.admit(tenant)
+                    except ten.QuotaExceeded as e:
+                        # over-quota is 429 + Retry-After — shed loudly,
+                        # never dropped (reference: the 503 shed contract
+                        # of r_score, but quota is the CALLER'S budget,
+                        # not the server's capacity)
+                        retry_s = max(int(e.retry_after_s + 0.999), 1)
+                        self._error(429, str(e), headers={
+                            "Retry-After": str(retry_s),
+                            "X-Retry-After-Ms":
+                                str(int(e.retry_after_s * 1000))})
+                        return
+                self._run_routes(method, path)
         except PayloadTooLarge as e:
             self._error(413, str(e))
         except KeyError as e:
             self._error(404, str(e))
         except Exception as e:   # one bad request must not kill the server
             self._error(500, f"{type(e).__name__}: {e}")
+
+    def _run_routes(self, method: str, path: str):
+        for pat, m, fn in _ROUTES:
+            match = re.fullmatch(pat, path)
+            if match and m == method:
+                self._route_label = _route_label_of(pat)
+                fn(self, *match.groups())
+                return
+        # extension-contributed routes (reference RestApiExtension SPI)
+        from h2o3_tpu.utils import extensions as _ext
+        for pat, m, fn in _ext.rest_routes():
+            match = re.fullmatch(pat, path)
+            if match and m == method:
+                self._route_label = _route_label_of(pat)
+                fn(self, *match.groups())
+                return
+        self._error(404, f"no route for {method} {path}")
 
     # -- routes (reference: RequestServer route registrations) ---------------
 
@@ -1240,11 +1285,75 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(schemas.health_v3(HEALTH.verdict()))
 
     def r_incidents(self):
-        """``GET /3/Incidents`` — the bounded incident ring, newest first
-        (one open incident per rule; repeats fold in). Contexts are
-        served per-incident by ``GET /3/Incidents/{id}``."""
+        """``GET /3/Incidents[?state=open|resolved]`` — the bounded
+        incident ring, newest first (one open incident per rule; repeats
+        fold in). Records carry ``resolved_at`` and, when the remediation
+        engine acted, the ``action_id``. Contexts are served per-incident
+        by ``GET /3/Incidents/{id}``."""
         from h2o3_tpu.utils.incidents import INCIDENTS
-        self._reply(schemas.incidents_v3(INCIDENTS.list()))
+        state = self._params().get("state") or None
+        try:
+            rows = INCIDENTS.list(state=state)
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        self._reply(schemas.incidents_v3(rows))
+
+    def r_ops(self):
+        """``GET /3/Ops`` — the self-driving ops plane in one view: the
+        remediation policy (mode, rule→action map, bounds, cooldown), the
+        append-only action log, and per-tenant usage + configured quotas
+        (docs/OPERATIONS.md is the operator catalog)."""
+        from h2o3_tpu.ops_plane import ACTIONS, ENGINE, QUOTAS
+        self._reply(schemas.ops_v3({
+            "remediation": ENGINE.policy_view(),
+            "actions": ACTIONS.list(),
+            "tenants": QUOTAS.usage_all(),
+            "quotas": QUOTAS.quotas()}))
+
+    def r_ops_post(self):
+        """``POST /3/Ops`` — quota CRUD + action rollback:
+
+        - ``tenant`` (+ optional ``qps``/``device_seconds``/``bytes``)
+          installs that tenant's budgets (omitted dimension = unlimited);
+        - ``remove_quota=<tenant>`` drops a tenant's budgets;
+        - ``rollback=<action_id>`` undoes a recorded action by token.
+        """
+        from h2o3_tpu.ops_plane import ACTIONS, QUOTAS
+        p = self._params()
+        if p.get("rollback"):
+            ok = ACTIONS.rollback(str(p["rollback"]))
+            self._reply(schemas.ops_v3(
+                {"rolled_back": ok, "action_id": p["rollback"],
+                 "actions": ACTIONS.list()}))
+            return
+        if p.get("remove_quota"):
+            try:
+                removed = QUOTAS.remove_quota(str(p["remove_quota"]))
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            self._reply(schemas.ops_v3({"removed": removed,
+                                        "quotas": QUOTAS.quotas()}))
+            return
+        if not p.get("tenant"):
+            self._error(400, "POST /3/Ops needs tenant (quota CRUD), "
+                             "remove_quota, or rollback")
+            return
+        try:
+            rec = QUOTAS.set_quota(
+                p["tenant"],
+                qps=float(p["qps"]) if p.get("qps") is not None else None,
+                device_seconds=(float(p["device_seconds"])
+                                if p.get("device_seconds") is not None
+                                else None),
+                bytes=(int(float(p["bytes"]))
+                       if p.get("bytes") is not None else None))
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        self._reply(schemas.ops_v3({"quota": rec,
+                                    "quotas": QUOTAS.quotas()}))
 
     def r_incident(self, incident_id):
         """``GET /3/Incidents/{id}`` — one incident with the correlated
@@ -1962,6 +2071,8 @@ _ROUTES = [
     (r"/3/Health", "GET", _Handler.r_health),
     (r"/3/Incidents", "GET", _Handler.r_incidents),
     (r"/3/Incidents/([^/]+)", "GET", _Handler.r_incident),
+    (r"/3/Ops", "GET", _Handler.r_ops),
+    (r"/3/Ops", "POST", _Handler.r_ops_post),
     (r"/3/Diagnostics/bundle", "POST", _Handler.r_diagnostics_bundle),
     (r"/3/Diagnostics/bundle", "GET", _Handler.r_diagnostics_bundle),
     (r"/3/Profiler/capture", "POST", _Handler.r_profiler_capture),
@@ -2116,6 +2227,12 @@ class H2OServer:
         # evaluates inline per request or reports "disabled".
         from h2o3_tpu.utils.health import HEALTH
         self._started_health = HEALTH.start()
+        # remediation engine: subscribe to incident rising edges (the
+        # kill switch H2O3TPU_REMEDIATE — default `observe` — is resolved
+        # per incident, so installing here commits to nothing). Importing
+        # ops_plane also arms the tenancy hooks in dispatch/DKV/serving.
+        from h2o3_tpu import ops_plane as _ops
+        _ops.install()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -166,6 +166,18 @@ def live_rows() -> "list[dict]":
     return out
 
 
+def live_groups() -> "list[ElasticGroup]":
+    """Groups whose build is still running — the ops-plane remediation
+    seam (:mod:`h2o3_tpu.ops_plane.actions` picks the stalled worker's
+    group here rather than reaching into :data:`_LIVE_GROUPS`)."""
+    out: list = []
+    for g in list(_LIVE_GROUPS):
+        with g._cond:
+            if g.started and not g._stop:
+                out.append(g)
+    return out
+
+
 def drain(timeout: float = 30.0) -> None:
     """Join every elastic worker thread still alive.
 
@@ -405,6 +417,25 @@ class ElasticGroup:
                 self._eject_locked(w, reason)
         self._publish()
 
+    def preempt_reassign(self, wid: int,
+                         reason: str = "ops_preempt") -> "list[int]":
+        """Ops-plane preemptive reassignment: eject a silent worker NOW and
+        move its shards to the least-loaded survivors immediately, instead
+        of waiting for the round-boundary sweep to notice the lease expire.
+        Returns the shard ids that found a new home (empty when the worker
+        was already ejected or held none). The worker can re-enter later
+        via :meth:`request_join` — that is the action's rollback."""
+        with self._cond:
+            w = self._workers.get(wid)
+            if w is None or w.state == EJECTED:
+                return []
+            before = set(w.shards)
+            self._eject_locked(w, reason)
+            self._reassign_orphans_locked()
+            moved = sorted(before - set(self._orphan_shards))
+        self._publish()
+        return moved
+
     def _deadline_for(self) -> float:
         if self.round_deadline_secs > 0:
             d = self.round_deadline_secs
@@ -595,6 +626,12 @@ class ElasticGroup:
                             "shards": list(w.shards)}
                     for w in self._workers.values()},
             }
+
+    def rows(self) -> "list[dict]":
+        """Membership rows (public — the ops-plane remediation reads gaps
+        here without reaching into the condition lock)."""
+        with self._cond:
+            return self._rows_locked()
 
     def _rows_locked(self) -> "list[dict]":
         now = time.monotonic()

@@ -42,7 +42,7 @@ import time
 
 import numpy as np
 
-from h2o3_tpu.serving.scorer import MAX_BUCKET, bucket_for
+from h2o3_tpu.serving.scorer import MAX_BUCKET
 from h2o3_tpu.serving.slo import window_s_from_env
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils import tracing as _tr
@@ -292,7 +292,9 @@ class ModelBatcher:
         with mesh_cm:
             while start < total:
                 n = min(total - start, MAX_BUCKET)
-                bucket = bucket_for(n)
+                # cache-level selection so an ops-plane pin (recompile-storm
+                # remediation) takes effect at the one serving call site
+                bucket = self._cache.bucket_for(n)
                 pnum = np.zeros((bucket, num.shape[1]), dtype=np.float32)
                 pcat = np.full((bucket, cat.shape[1]), -1, dtype=np.int32)
                 pnum[:n] = num[start:start + n]

@@ -125,6 +125,43 @@ class ScorerCache:
         self._entries: dict[tuple, CompiledScorer] = {}
         self.hits = 0
         self.misses = 0
+        self._pinned_bucket: int | None = None
+
+    # -- bucket pinning (ops-plane recompile-storm remediation) --------------
+
+    def pin_bucket(self, bucket: int) -> int:
+        """Pin a floor bucket: requests whose natural bucket is SMALLER
+        score in the pinned one instead, collapsing a storm of churning
+        small signatures onto one warm executable (padding waste bounded
+        by the pin). Returns the clamped pin actually installed."""
+        b = MIN_BUCKET
+        while b < bucket and b < MAX_BUCKET:
+            b <<= 1
+        with self._lock:
+            self._pinned_bucket = b
+        return b
+
+    def unpin_bucket(self) -> None:
+        with self._lock:
+            self._pinned_bucket = None
+
+    def pinned_bucket(self) -> "int | None":
+        with self._lock:
+            return self._pinned_bucket
+
+    def bucket_for(self, n: int) -> int:
+        """Bucket selection honoring the pin — the batcher's sole seam
+        (module-level :func:`bucket_for` stays the pure natural law)."""
+        natural = bucket_for(n)
+        with self._lock:
+            pin = self._pinned_bucket
+        return pin if pin is not None and pin > natural else natural
+
+    def compiled_buckets(self) -> "list[int]":
+        """Distinct buckets with a compiled signature — what the ops-plane
+        recompile-storm action may pin to."""
+        with self._lock:
+            return sorted({sig[5] for sig in self._entries})
 
     @staticmethod
     def _signature(model, schema: ServingSchema, bucket: int) -> tuple:
@@ -164,7 +201,8 @@ class ScorerCache:
     def stats(self) -> dict:
         with self._lock:
             return {"signatures": len(self._entries),
-                    "hits": self.hits, "misses": self.misses}
+                    "hits": self.hits, "misses": self.misses,
+                    "pinned_bucket": self._pinned_bucket}
 
     def clear(self) -> None:
         with self._lock:

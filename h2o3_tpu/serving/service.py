@@ -49,6 +49,13 @@ from h2o3_tpu.utils.registry import DKV
 _SCALE_CHECK_EVERY = 32
 
 
+def _tenancy_mod():
+    """The ops-plane tenancy module ONLY if something already imported it
+    — the scoring hot path must not be what pulls in multi-tenancy."""
+    import sys
+    return sys.modules.get("h2o3_tpu.ops_plane.tenancy")
+
+
 class ServiceUnavailable(RuntimeError):
     """Admission refused under the residency budget (HTTP 503 + retry)."""
 
@@ -124,6 +131,7 @@ class ScoringService:
         self.pool = None
         self._pool_checked = False
         self._shed: dict[tuple, int] = {}      # (reason, priority) -> count
+        self._admission_base: dict[str, float] = {}  # key -> original slo_ms
 
     # -- replica pool ---------------------------------------------------------
 
@@ -187,6 +195,51 @@ class ScoringService:
                 raise ServiceUnavailable(
                     f"scoring replica pool unavailable: {e}") from None
         return self.pool
+
+    # -- admission widening (ops-plane overload relief) ----------------------
+
+    def widen_admission(self, factor: float = 1.5,
+                        cap: float = 4.0) -> "list[dict]":
+        """Overload relief without a replica: raise every resident model's
+        SLO admission target by ``factor`` so the shed estimator admits a
+        deeper queue. Cumulative widening is bounded at ``cap``× each
+        model's ORIGINAL target (recorded on first widen). Models with no
+        target are untouched. Returns ``[{model, target_ms}]`` for the
+        audit record; :meth:`restore_admission` is the rollback."""
+        with self._lock:
+            entries = list(self._resident.values())
+            plan = []
+            for e in entries:
+                target = e.slo.slo_ms
+                if not target:
+                    continue
+                base = self._admission_base.setdefault(e.key, target)
+                new_target = min(target * factor, base * cap)
+                if new_target > target:
+                    plan.append((e, new_target))
+        changed = []
+        for e, new_target in plan:
+            # set_target outside the service lock (slo has its own lock;
+            # keep the order service→slo one-way and brief)
+            e.slo.set_target(new_target)
+            changed.append({"model": e.key,
+                            "target_ms": round(new_target, 3)})
+        return changed
+
+    def restore_admission(self) -> "list[dict]":
+        """Undo :meth:`widen_admission`: every widened resident returns to
+        its recorded original target."""
+        with self._lock:
+            base = dict(self._admission_base)
+            self._admission_base.clear()
+            entries = {e.key: e for e in self._resident.values()}
+        restored = []
+        for key, orig in base.items():
+            e = entries.get(key)
+            if e is not None:
+                e.slo.set_target(orig)
+                restored.append({"model": key, "target_ms": orig})
+        return restored
 
     # -- scoring -------------------------------------------------------------
 
@@ -276,6 +329,16 @@ class ScoringService:
             out["replica"] = replica
         _tm.SCORE_REQUESTS.labels(algo=algo, status="ok").inc()
         _tm.SCORE_SECONDS.labels(algo=algo).observe(latency)
+        ten = _tenancy_mod()
+        if ten is not None:
+            # per-tenant device-seconds: this request's pro-rata share of
+            # its batch's device wall (queue wait excluded — waiting burns
+            # no device). Zero overhead unless ops_plane is loaded.
+            busy = latency
+            if pending.queue_wait_s is not None:
+                busy = max(latency - pending.queue_wait_s, 0.0)
+            share = busy * (len(rows) / max(pending.batch_rows or 0, len(rows)))
+            ten.QUOTAS.charge_device_seconds(ten.current_tenant(), share)
         return out
 
     def _count_shed(self, reason: str, priority: int) -> None:
@@ -431,6 +494,7 @@ class ScoringService:
             self.cache.clear()
             self.evictions = 0
             self._shed.clear()
+            self._admission_base.clear()
             pool, self.pool = self.pool, None
             self._pool_checked = False
             self._export_locked()

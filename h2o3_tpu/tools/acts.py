@@ -1,0 +1,138 @@
+"""graftlint remediation-audit rule (ACT) — unaudited ops-plane mutations.
+
+The remediation engine's whole safety story is the append-only ActionLog:
+every change it makes to live policy (replica counts, admission targets,
+Cleaner budgets, shard ownership, compile-bucket pins) is recorded with
+its trigger incident, parameters, outcome, and rollback token — that is
+what lets an operator audit "what did the machine do and why" and undo
+it. The contract holds only if ops-plane code CANNOT reach a policy
+setter except through a catalogued ``act_*`` function executed by
+``ActionLog.record``.
+
+- **ACT001** — inside ``ops_plane/`` modules, a call to a live-policy
+  setter (``configure_replicas``, ``widen_admission``/``restore_admission``,
+  ``set_target``, ``enable_cleaner``/``disable_cleaner``, ``force_spill``,
+  ``preempt_reassign``, ``request_join``, ``eject``, ``pin_bucket``/
+  ``unpin_bucket``) or an assignment to a ``.budget`` attribute, from a
+  function NOT rooted in a top-level ``act_*`` catalog function; also a
+  direct call to an ``act_*`` function from anywhere but ``ActionLog``
+  (bypassing the audit record). Rollback closures nested inside an
+  ``act_*`` body are fine — their audit trail is the recording action's.
+
+The rule scopes to ``ops_plane/`` on purpose: the setters themselves live
+in serving/elastic/memory modules and are legitimate API for tests, the
+REST layer, and operators — only the *automation* must be audited.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.tools.core import (Finding, FunctionInfo, ModuleInfo,
+                                 PackageIndex, dotted_name)
+
+#: live-policy setters the engine may only touch through the catalog
+_POLICY_SETTERS = {
+    "configure_replicas", "widen_admission", "restore_admission",
+    "set_target", "enable_cleaner", "disable_cleaner", "force_spill",
+    "preempt_reassign", "request_join", "eject", "pin_bucket",
+    "unpin_bucket",
+}
+#: attribute stores that ARE policy mutations (Cleaner.budget)
+_POLICY_ATTRS = {"budget"}
+
+
+def _owners(index: PackageIndex, mod: ModuleInfo) -> dict[int, FunctionInfo]:
+    """node id -> innermost enclosing FunctionInfo. Parents are painted
+    first, nested defs overwrite — innermost wins. Lambda bodies map to
+    the function the lambda sits in (they have no FunctionInfo), which is
+    exactly the audit scope they execute under."""
+    fns = [f for f in index.functions.values() if f.module is mod]
+
+    def depth(fn: FunctionInfo) -> int:
+        d, cur = 0, fn
+        while cur is not None and cur.parent:
+            cur = index.functions.get(f"{mod.name}::{cur.parent}")
+            d += 1
+        return d
+
+    out: dict[int, FunctionInfo] = {}
+    for fn in sorted(fns, key=depth):
+        for node in ast.walk(fn.node):
+            out[id(node)] = fn
+    return out
+
+
+def _rooted_in_act(index: PackageIndex, mod: ModuleInfo,
+                   fn: FunctionInfo | None) -> bool:
+    """True when ``fn``'s outermost enclosing def is a top-level ``act_*``
+    catalog function — the only scope allowed to mutate live policy."""
+    cur = fn
+    while cur is not None and cur.parent:
+        cur = index.functions.get(f"{mod.name}::{cur.parent}")
+    return (cur is not None and cur.class_name is None
+            and cur.qualname.startswith("act_"))
+
+
+def _in_action_log(index: PackageIndex, mod: ModuleInfo,
+                   fn: FunctionInfo | None) -> bool:
+    cur = fn
+    while cur is not None and cur.parent:
+        cur = index.functions.get(f"{mod.name}::{cur.parent}")
+    return cur is not None and cur.class_name == "ActionLog"
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        if "ops_plane/" not in mod.path and not \
+                mod.path.startswith("ops_plane"):
+            continue
+        owners = _owners(index, mod)
+        for node in ast.walk(mod.tree):
+            fn = owners.get(id(node))
+            where = fn.qualname if fn else ""
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                leaf = name.rpartition(".")[2]
+                if leaf in _POLICY_SETTERS and not _rooted_in_act(
+                        index, mod, fn):
+                    findings.append(Finding(
+                        "ACT001", mod.path, node.lineno, where,
+                        f"ops-plane call to live-policy setter `{name}` "
+                        "outside an act_* catalog function — policy "
+                        "mutations must flow through ActionLog.record so "
+                        "they are audited and rollback-able",
+                        detail=f"unaudited-mutation:{leaf}"))
+                elif leaf.startswith("act_") and not _in_action_log(
+                        index, mod, fn):
+                    findings.append(Finding(
+                        "ACT001", mod.path, node.lineno, where,
+                        f"direct call to catalog action `{name}` bypasses "
+                        "ActionLog.record — no audit entry, no rollback "
+                        "token, no metric; record it via "
+                        "ActionLog.record(action, rule, incident_id, mode)",
+                        detail=f"direct-action-call:{leaf}"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    # self.budget is an object's OWN state (a dataclass
+                    # field, an exception attribute) — the policy
+                    # mutation is a store through a FOREIGN receiver
+                    # (cleaner.budget = ...)
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr in _POLICY_ATTRS and not \
+                            (isinstance(tgt.value, ast.Name)
+                             and tgt.value.id == "self") and not \
+                            _rooted_in_act(index, mod, fn):
+                        findings.append(Finding(
+                            "ACT001", mod.path, node.lineno, where,
+                            f"ops-plane store to `.{tgt.attr}` outside an "
+                            "act_* catalog function — budget changes are "
+                            "live-policy mutations and must be audited "
+                            "through ActionLog.record",
+                            detail=f"unaudited-mutation:.{tgt.attr}"))
+    return findings

@@ -28,7 +28,7 @@ import json
 import sys
 from pathlib import Path
 
-from h2o3_tpu.tools import (envs, ingest, locks, mem, meshes, metrics,
+from h2o3_tpu.tools import (acts, envs, ingest, locks, mem, meshes, metrics,
                             profiles, rest, retry, sync, tracer, waits)
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
@@ -43,7 +43,8 @@ def run_lint(root: Path) -> list[Finding]:
                 + mem.check(index) + sync.check(index) + retry.check(index)
                 + meshes.check(index) + profiles.check(index)
                 + waits.check(index) + envs.check(index)
-                + ingest.check(index) + metrics.check(index))
+                + ingest.check(index) + metrics.check(index)
+                + acts.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),

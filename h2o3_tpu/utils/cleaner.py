@@ -284,6 +284,47 @@ class Cleaner:
                 spilled.append(k)
         return spilled
 
+    def force_spill(self, keys, limit: int = 2) -> list[str]:
+        """Targeted tier-3 spill of named DKV keys regardless of budget
+        headroom — the ops-plane's coldest-tenant relief (data is parked
+        on disk behind a stub, NEVER deleted; the next get faults it
+        back). Only frames and raw payloads spill (mesh views and
+        models/jobs are skipped); bounded by ``limit``. Returns the keys
+        actually spilled."""
+        from h2o3_tpu.frame.frame import Frame
+        from h2o3_tpu.frame.parse import RawFile
+        from h2o3_tpu.persist.frame_io import save_frame, snapshot_bytes
+        os.makedirs(self.ice_root, exist_ok=True)
+        spilled: list[str] = []
+        with self._io_lock:
+            for k in keys:
+                if len(spilled) >= limit:
+                    break
+                with DKV._lock:
+                    v = DKV._store.get(k)
+                if isinstance(v, RawFile):
+                    path = os.path.join(
+                        self.ice_root, f"{k}.{uuid.uuid4().hex[:8]}.raw")
+                    with open(path, "wb") as fh:
+                        fh.write(v.data)
+                    stub = SwappedValue(k, path, "raw", len(v.data),
+                                        meta={"name": v.name})
+                    if self._cas_stub(k, v, stub):
+                        self._note_spill("raw", len(v.data))
+                        spilled.append(k)
+                elif isinstance(v, Frame) \
+                        and not getattr(v, "_is_mesh_view", False):
+                    nbytes = self._value_bytes(v)
+                    path = os.path.join(
+                        self.ice_root, f"{k}.{uuid.uuid4().hex[:8]}")
+                    save_frame(v, path)
+                    stub = SwappedFrame(k, path, v.nrows, v.ncols,
+                                        disk_bytes=snapshot_bytes(path))
+                    if self._cas_stub(k, v, stub):
+                        self._note_spill("frame", nbytes)
+                        spilled.append(k)
+        return spilled
+
     def _cas_stub(self, key: str, expected, stub) -> bool:
         """Install a spill stub ONLY while the store still holds the value
         the snapshot was taken from. The snapshot write happens outside the

@@ -391,7 +391,13 @@ class HealthEvaluator:
                     # it — two sweeps would split every window delta
                     return
             try:
-                self.evaluate()
+                # a stop() landing while this sweep is in flight drains it:
+                # the abort seam is checked between rules AND between a
+                # probe and its incident open, so the final sweep can never
+                # open an incident after shutdown
+                verdict = self.evaluate(abort=self._stop.is_set)
+                if verdict is None:
+                    return
                 with self._eval_lock:
                     # thread-driven sweeps counted apart from inline
                     # evaluate() calls: the bench's hollow-watchdog guard
@@ -417,14 +423,18 @@ class HealthEvaluator:
 
     # -- evaluation ----------------------------------------------------------
 
-    def evaluate(self) -> dict:
+    def evaluate(self, abort=None) -> "dict | None":
         """Run every rule once; fold into the verdict; open/resolve
         incidents on rule edges. Thread-safe and re-entrant-free (one
-        evaluation at a time — window deltas must not interleave)."""
+        evaluation at a time — window deltas must not interleave).
+        ``abort`` (a zero-arg truth callable — the sweep thread passes its
+        stop flag) drains the sweep: checked between rules and between a
+        probe and its incident open, an aborted sweep returns ``None``
+        without opening incidents or publishing a verdict."""
         with self._eval_lock:
-            return self._evaluate_locked()
+            return self._evaluate_locked(abort)
 
-    def _evaluate_locked(self) -> dict:
+    def _evaluate_locked(self, abort=None) -> "dict | None":
         # graftlint: ok(_locked suffix: serialized by _eval_lock above)
         self._sweeps += 1
         findings: list[dict] = []
@@ -432,6 +442,8 @@ class HealthEvaluator:
         tripped_rules: set[str] = set()
         failed_rules: set[str] = set()
         for rule in self.rules:
+            if abort is not None and abort():
+                return None
             try:
                 observed = rule.probe(self)
             except Exception as e:   # noqa: BLE001 — a sick registry is a
@@ -464,6 +476,10 @@ class HealthEvaluator:
                              "threshold": threshold, "message": message})
             statuses[rule.subsystem] = max(
                 statuses[rule.subsystem], rule.severity, key=_RANK.get)
+            if abort is not None and abort():
+                # the stop flag rose while this rule's probe ran — the
+                # drained sweep must not open an incident after shutdown
+                return None
             self.incidents.open(rule.name, rule.subsystem, rule.severity,
                                 message, observed, threshold,
                                 series=series)

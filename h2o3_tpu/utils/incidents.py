@@ -15,7 +15,13 @@ Semantics:
 - **One open incident per rule.** A rule that keeps tripping sweep after
   sweep updates its open incident (``repeats`` + latest observed) instead
   of flooding the ring; when the rule stops tripping the incident resolves
-  (``status: resolved``, ``resolved_ms`` stamped).
+  (``status: resolved``, ``resolved_ms``/``resolved_at`` stamped; an
+  incident the remediation engine acted on names its ``action_id``).
+- **Rising edges notify.** A listener registered with
+  :meth:`IncidentLog.add_listener` fires once per incident OPEN (never on
+  repeats) — the subscription seam the ops-plane remediation engine
+  (:mod:`h2o3_tpu.ops_plane.remediate`) hangs off. Listeners run outside
+  the ring lock and are fault-isolated.
 - **Bounded.** The ring keeps the most recent ``H2O3TPU_INCIDENT_RING``
   records (default 64), oldest evicted first; ``h2o3_incidents_total
   {rule,subsystem}`` counts every OPEN over the process lifetime.
@@ -121,6 +127,24 @@ class IncidentLog:
         self._order: list[str] = []                 # oldest first
         self._open_by_rule: dict[str, str] = {}     # rule -> incident id
         self._opened_total = 0
+        self._listeners: list = []                  # rising-edge subscribers
+
+    # -- subscriptions -------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(record_snapshot, log)`` to incident OPENs (rising
+        edges only — repeat trips fold into the open record silently).
+        Listeners run on the opener's thread, outside the ring lock, after
+        the trip-time context is stamped; a raising listener is swallowed
+        (remediation must never crash the health sweep)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -150,7 +174,8 @@ class IncidentLog:
                    "message": message, "observed": observed,
                    "threshold": threshold, "repeats": 1,
                    "opened_ms": now_ms, "last_seen_ms": now_ms,
-                   "resolved_ms": None, "context": None}
+                   "resolved_ms": None, "resolved_at": None,
+                   "action_id": None, "context": None}
             self._ring[iid] = rec
             self._order.append(iid)
             self._open_by_rule[rule] = iid
@@ -179,6 +204,16 @@ class IncidentLog:
         with self._lock:
             if iid in self._ring:
                 self._ring[iid]["context"] = ctx
+            snapshot = dict(self._ring.get(iid) or rec)
+            listeners = list(self._listeners)
+        # rising-edge notification AFTER context capture, so a remediation
+        # listener reads the same trip-time picture an operator would;
+        # each listener fault-isolated — acting must never break reporting
+        for fn in listeners:
+            try:
+                fn(snapshot, self)
+            except Exception:   # noqa: BLE001 — subscriber bug stays local
+                pass
         if subsystem == "compute" and profile_on_incident():
             self._fire_profile(iid)
         return iid
@@ -190,8 +225,22 @@ class IncidentLog:
             iid = self._open_by_rule.pop(rule, None)
             rec = self._ring.get(iid) if iid else None
             if rec is not None:
+                now = time.time()
                 rec["status"] = "resolved"
-                rec["resolved_ms"] = int(time.time() * 1000)
+                rec["resolved_ms"] = int(now * 1000)
+                rec["resolved_at"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
+
+    def annotate_action(self, incident_id: str, action_id: str) -> None:
+        """Stamp the remediation ``action_id`` onto its trigger incident —
+        a resolved-by-action incident names what touched it (satellite:
+        the /3/Incidents record answers "did the machine do this?")."""
+        with self._lock:
+            rec = self._ring.get(incident_id)
+            if rec is not None:
+                rec["action_id"] = action_id
+                if isinstance(rec.get("context"), dict):
+                    rec["context"]["remediation_action"] = action_id
 
     def _fire_profile(self, incident_id: str) -> None:
         """Single-flight background profiler capture for a compute-class
@@ -216,16 +265,22 @@ class IncidentLog:
 
     # -- views ---------------------------------------------------------------
 
-    def list(self) -> list[dict]:
-        """Summaries, newest first (context omitted — fetch one by id)."""
+    def list(self, state: str | None = None) -> list[dict]:
+        """Summaries, newest first (context omitted — fetch one by id).
+        ``state`` filters to ``"open"`` or ``"resolved"`` records."""
+        if state not in (None, "open", "resolved"):
+            raise ValueError(f"state must be open|resolved, got {state!r}")
         with self._lock:
             out = []
             for iid in reversed(self._order):
                 rec = self._ring[iid]
-                out.append({k: rec[k] for k in
+                if state is not None and rec["status"] != state:
+                    continue
+                out.append({k: rec.get(k) for k in
                             ("id", "rule", "subsystem", "severity", "status",
                              "message", "observed", "threshold", "repeats",
-                             "opened_ms", "last_seen_ms", "resolved_ms")})
+                             "opened_ms", "last_seen_ms", "resolved_ms",
+                             "resolved_at", "action_id")})
             return out
 
     def get(self, incident_id: str) -> dict:

@@ -531,6 +531,13 @@ class MemoryMeter:
         with self._lock:
             return max(self._host_total, 0)
 
+    def key_bytes(self, key: str) -> int:
+        """Registered bytes of one DKV key (0 when unknown) — the tenancy
+        byte ledger prices a key by the same measure /3/Memory reports."""
+        with self._lock:
+            rec = self._keyed.get(key)
+            return rec[1] if rec is not None else 0
+
     def top_keys(self, n: int = 10) -> list[dict]:
         with self._lock:
             rows = [{"key": k, "kind": kind, "bytes": b}

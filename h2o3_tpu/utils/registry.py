@@ -19,6 +19,13 @@ from typing import Any, Iterator
 from h2o3_tpu.utils import telemetry as _tm
 
 
+def _tenancy():
+    """The ops-plane tenancy module ONLY if already imported — untagged
+    processes must not pay a multi-tenancy import on the DKV hot path."""
+    import sys
+    return sys.modules.get("h2o3_tpu.ops_plane.tenancy")
+
+
 class KeyedStore:
     def __init__(self):
         self._lock = threading.RLock()
@@ -41,6 +48,11 @@ class KeyedStore:
             MEMORY.register(key, value)
         _tm.DKV_PUTS.inc()
         _tm.DKV_KEYS.set(n)
+        ten = _tenancy()
+        if ten is not None:
+            # per-key tenant tagging: the byte ledger attributes this key
+            # to whoever the request context says is putting it
+            ten.QUOTAS.tag_key(key)
         if old is not None and old is not value \
                 and type(old).__name__ in ("Frame", "SwappedFrame"):
             # overwriting a keyed frame (re-put, spill to a stub, restore
@@ -126,6 +138,9 @@ class KeyedStore:
             MEMORY.unregister(key)
         _tm.DKV_REMOVES.inc()
         _tm.DKV_KEYS.set(n)
+        ten = _tenancy()
+        if ten is not None:
+            ten.QUOTAS.untag_key(key)
         if type(v).__name__ in ("SwappedFrame", "SwappedValue"):
             # frame snapshots are DIRECTORIES — discard_snapshot handles
             # both shapes (a bare os.remove leaked the ice_root forever)
@@ -185,6 +200,9 @@ class KeyedStore:
             MEMORY.clear()
         _tm.DKV_REMOVES.inc(len(items))
         _tm.DKV_KEYS.set(0)
+        ten = _tenancy()
+        if ten is not None:
+            ten.QUOTAS.untag_all()
         from h2o3_tpu.utils.cleaner import discard_snapshot
         for _k, v in items:
             if type(v).__name__ in ("SwappedFrame", "SwappedValue"):

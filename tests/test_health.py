@@ -402,6 +402,58 @@ def test_verdict_evaluates_inline_without_thread():
     assert v["sweep"] == 1 and v["status"] == HEALTHY
 
 
+def test_stop_during_sweep_drains_without_incident(monkeypatch):
+    """A stop() landing while a sweep is mid-probe DRAINS the sweep: the
+    abort seam between a probe's return and its incident open means the
+    dying thread can never open an incident (which nothing would ever
+    resolve) after shutdown. The probe here blocks until stop() is already
+    pending, then returns data that WOULD trip elastic_heartbeat_gap."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def blocking_rows():
+        entered.set()
+        release.wait(timeout=10.0)
+        return [{"state": "ACTIVE", "last_heartbeat_ago_ms": 9e6}]
+
+    monkeypatch.setattr(hm, "_elastic_rows", blocking_rows)
+    monkeypatch.setenv("H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS", "1")
+    ev = _evaluator(interval_s=0.01)
+    assert ev.start() is True
+    assert entered.wait(timeout=10.0)
+
+    stopper = threading.Thread(target=ev.stop)
+    stopper.start()
+    # stop() clears the thread slot (under the lock, with _stop set)
+    # before joining — once running() is False the abort flag is up and
+    # the probe may return its poison
+    deadline = time.monotonic() + 10.0
+    while ev.running() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert not ev.running()
+    release.set()
+    stopper.join(timeout=10.0)
+    assert not stopper.is_alive()
+
+    # the drained sweep opened nothing and never counted as a thread
+    # sweep (it returned None before the counter)
+    assert ev.incidents.list() == []
+    assert ev.thread_sweeps() == 0
+
+
+def test_inline_evaluate_unaffected_by_abort_seam(monkeypatch):
+    """evaluate() without an abort callable (the inline/REST path) still
+    trips and opens incidents exactly as before the drain fix."""
+    monkeypatch.setattr(hm, "_elastic_rows", lambda: [
+        {"state": "ACTIVE", "last_heartbeat_ago_ms": 9e6}])
+    monkeypatch.setenv("H2O3TPU_HEALTH_HEARTBEAT_GAP_SECS", "1")
+    ev = _evaluator()
+    v = ev.evaluate()
+    assert v is not None and v["status"] == UNHEALTHY
+    assert [r["rule"] for r in ev.incidents.list()] == \
+        ["elastic_heartbeat_gap"]
+
+
 # -- bundle ------------------------------------------------------------------
 
 BUNDLE_MEMBERS = {
