@@ -695,6 +695,27 @@ h2o.incident <- function(incident_id) {
   .http("GET", paste0("/3/Incidents/", incident_id))
 }
 
+h2o.timeseries <- function(name = NULL, labels = NULL, since = NULL) {
+  # the flight recorder's retained metric series (GET /3/TimeSeries):
+  # per series the raw [t, value] tail and min/max/mean/last rollup
+  # windows, plus recorder stats; `name` matches exactly or as a
+  # prefix, `labels` is a named list matched as a subset, `since` is
+  # epoch seconds (docs/OBSERVABILITY.md "Flight recorder & post-mortems")
+  q <- c()
+  if (!is.null(name))
+    q <- c(q, paste0("name=", URLencode(name, reserved = TRUE)))
+  if (!is.null(labels)) {
+    ks <- sort(names(labels))
+    pairs <- paste0(ks, "=", unlist(labels[ks]), collapse = ",")
+    q <- c(q, paste0("labels=", URLencode(pairs, reserved = TRUE)))
+  }
+  if (!is.null(since))
+    q <- c(q, paste0("since=", as.numeric(since)))
+  path <- "/3/TimeSeries"
+  if (length(q)) path <- paste0(path, "?", paste(q, collapse = "&"))
+  .http("GET", path)
+}
+
 h2o.ops <- function() {
   # the self-driving ops surface: remediation policy (mode/cooldown/
   # bounds), the append-only ActionLog (newest first, rollback tokens),

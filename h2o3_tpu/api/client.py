@@ -341,6 +341,26 @@ class H2OClient:
             f.write(data)
         return path
 
+    def timeseries(self, name: str | None = None,
+                   labels: dict | None = None,
+                   since: float | None = None) -> dict:
+        """The flight recorder's retained series
+        (``GET /3/TimeSeries``): per series the raw ``[t, value]`` tail
+        and the min/max/mean/last rollup windows, plus recorder stats.
+        ``name`` matches exactly or as a prefix; ``labels`` is a subset
+        match; ``since`` is epoch seconds
+        (docs/OBSERVABILITY.md "Flight recorder & post-mortems")."""
+        q = []
+        if name:
+            q.append("name=" + urllib.parse.quote(str(name)))
+        if labels:
+            pairs = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            q.append("labels=" + urllib.parse.quote(pairs))
+        if since is not None:
+            q.append(f"since={float(since)}")
+        path = "/3/TimeSeries" + (("?" + "&".join(q)) if q else "")
+        return self.request("GET", path)
+
     def health(self) -> dict:
         """The ops-plane verdict (``GET /3/Health``): overall +
         per-subsystem healthy/degraded/unhealthy, each finding naming the

@@ -130,6 +130,15 @@ def incidents_v3(summaries: list) -> dict:
     return {**_meta("IncidentsV3"), "incidents": _clean(summaries)}
 
 
+def timeseries_v3(payload: dict) -> dict:
+    """``GET /3/TimeSeries`` — the flight recorder (utils/flight.py):
+    matching retained series, each with its raw ``[t, value]`` tail and
+    min/max/mean/last rollup windows, plus the recorder's stats
+    (running / interval / retention / dropped-series counters)
+    (docs/OBSERVABILITY.md "Flight recorder & post-mortems")."""
+    return {**_meta("TimeSeriesV3"), **_clean(payload)}
+
+
 def ops_v3(payload: dict) -> dict:
     """``GET/POST /3/Ops`` — the ops plane: remediation policy view
     (mode/map/bounds), the append-only action log, per-tenant usage, and

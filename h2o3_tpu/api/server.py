@@ -301,7 +301,8 @@ class _Handler(BaseHTTPRequestHandler):
     #: an explicit request to record the call in the caller's trace
     _TRACE_NOISE = re.compile(
         r"/(?:flow/.*|metrics|3/(?:Jobs(?:/[^/]+)?|Ping|Cloud|About|"
-        r"Logs(?:/.*)?|Memory|Metrics|Compute|Score|Timeline|JStack|"
+        r"Logs(?:/.*)?|Memory|Metrics|TimeSeries|Compute|Score|Timeline|"
+        r"JStack|"
         r"WaterMeter[^/]*(?:/\d+)?|Health|Incidents(?:/[^/]+)?|Ops|"
         r"Traces(?:/.*)?)|99/(?:AutoML|Leaderboards)/[^/]+)?")
 
@@ -1384,10 +1385,49 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply({"__meta": {"schema_type": "MetricsV3"},
                      "metrics": _tm.METRICS.snapshot()})
 
+    def r_timeseries(self):
+        """``GET /3/TimeSeries?name=&labels=&since=`` — the flight
+        recorder's retained series (utils/flight.py): per series the raw
+        ``(t, value)`` tail and the min/max/mean/last rollup windows.
+        ``name`` matches exactly or as a prefix, ``labels`` is
+        ``k=v,k2=v2`` (subset match), ``since`` is epoch seconds
+        (docs/OBSERVABILITY.md "Flight recorder & post-mortems")."""
+        from h2o3_tpu.utils.flight import FLIGHT
+        p = self._params()
+        labels = None
+        if p.get("labels"):
+            labels = {}
+            for part in str(p["labels"]).split(","):
+                if "=" not in part:
+                    self._error(400, f"labels must be k=v,k2=v2 pairs, "
+                                     f"got {part!r}")
+                    return
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip()
+        since = None
+        if p.get("since") is not None:
+            try:
+                since = float(p["since"])
+            except ValueError:
+                self._error(400, f"since must be epoch seconds, "
+                                 f"got {p['since']!r}")
+                return
+        series = FLIGHT.query(name=p.get("name") or None, labels=labels,
+                              since=since)
+        stats = FLIGHT.stats()
+        # stats counts retained series under "series"; the payload key
+        # of that name is the series list itself
+        stats["series_retained"] = stats.pop("series")
+        self._reply(schemas.timeseries_v3({"series": series, **stats}))
+
     def r_metrics_text(self):
         """Prometheus/OpenMetrics exposition at ``/metrics`` — point a
-        Prometheus scrape job at this path (docs/OBSERVABILITY.md)."""
+        Prometheus scrape job at this path (docs/OBSERVABILITY.md). The
+        render itself is timed (``h2o3_metrics_scrape_seconds``) — the
+        observers are observed too."""
+        t0 = time.perf_counter()
         body = _tm.METRICS.to_openmetrics().encode()
+        _tm.SCRAPE_SECONDS.observe(time.perf_counter() - t0)
         self.send_response(200)
         self.send_header("Content-Type",
                          "application/openmetrics-text; version=1.0.0; "
@@ -2080,6 +2120,7 @@ _ROUTES = [
     (r"/3/Profiler/captures/([^/]+)/download", "GET",
      _Handler.r_profiler_capture_download),
     (r"/3/Metrics", "GET", _Handler.r_metrics_json),
+    (r"/3/TimeSeries", "GET", _Handler.r_timeseries),
     (r"/metrics", "GET", _Handler.r_metrics_text),
     (r"/3/Traces", "GET", _Handler.r_traces),
     (r"/3/Traces/([^/]+)", "GET", _Handler.r_trace),
@@ -2161,6 +2202,21 @@ _ROUTES = [
 ]
 
 
+class _H2OHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that proves its accept loop is alive:
+    ``service_actions`` runs once per ``serve_forever`` poll (~0.5s), so
+    it is exactly the seam where an accept-loop wedge shows as heartbeat
+    silence — the black-box watchdog pages on it, and the chaos harness
+    can stall it (``rest.accept``) to rehearse the wedge."""
+
+    def service_actions(self):
+        from h2o3_tpu.utils import blackbox as _bb
+        from h2o3_tpu.utils import timeline as _tl
+        if _tl.FAULTS is not None:
+            _tl.FAULTS.maybe_fault("rest.accept")
+        _bb.BLACKBOX.beat("rest_accept")
+
+
 class H2OServer:
     """Embeddable REST server (reference: ``water.H2OApp`` + Jetty).
 
@@ -2178,7 +2234,7 @@ class H2OServer:
                  username: str | None = None, password: str | None = None,
                  authenticator=None, ssl_certfile: str | None = None,
                  ssl_keyfile: str | None = None):
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _H2OHTTPServer((host, port), _Handler)
         self.httpd._session_id = f"_sid_{uuid.uuid4().hex[:10]}"
         self.httpd._session_props = {}
         self.httpd._rapids_sessions = {}
@@ -2227,6 +2283,22 @@ class H2OServer:
         # evaluates inline per request or reports "disabled".
         from h2o3_tpu.utils.health import HEALTH
         self._started_health = HEALTH.start()
+        # flight recorder: retained metric time series in fixed-memory
+        # rings (GET /3/TimeSeries; H2O3TPU_FLIGHT_OFF=1 disables) — the
+        # history the trend rules and post-mortems read
+        from h2o3_tpu.utils.flight import FLIGHT
+        self._started_flight = FLIGHT.start()
+        # black-box watchdog: wedge/crash post-mortems straight to
+        # ice_root without REST. Watch the two loops that can wedge —
+        # the accept loop (service_actions beats ~2×/s) and the health
+        # sweep (beats once per interval). An orderly stop() disarms
+        # BEFORE shutdown, so clean exits never dump.
+        from h2o3_tpu.utils.blackbox import BLACKBOX
+        self._armed_blackbox = BLACKBOX.arm()
+        if self._armed_blackbox:
+            BLACKBOX.watch("rest_accept", period_s=1.0)
+            if self._started_health:
+                BLACKBOX.watch("health_sweep", period_s=HEALTH.interval_s)
         # remediation engine: subscribe to incident rising edges (the
         # kill switch H2O3TPU_REMEDIATE — default `observe` — is resolved
         # per incident, so installing here commits to nothing). Importing
@@ -2242,12 +2314,24 @@ class H2OServer:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_armed_blackbox", False):
+            # disarm FIRST: this is the orderly-shutdown signal — once
+            # disarmed, neither the watchdog nor the exit hooks dump
+            from h2o3_tpu.utils.blackbox import BLACKBOX
+            BLACKBOX.disarm()
+            BLACKBOX.unwatch("rest_accept")
+            BLACKBOX.unwatch("health_sweep")
+            self._armed_blackbox = False
         if getattr(self, "_started_health", False):
             # only the server that actually started the sweep stops it —
             # a second embedded server must not kill the first one's
             from h2o3_tpu.utils.health import HEALTH
             HEALTH.stop()
             self._started_health = False
+        if getattr(self, "_started_flight", False):
+            from h2o3_tpu.utils.flight import FLIGHT
+            FLIGHT.stop()
+            self._started_flight = False
         self.httpd.shutdown()
         self.httpd.server_close()
 

@@ -310,7 +310,9 @@ class ScoringService:
         entry.slo.record_latency(latency)
         # per MODEL: two resident models of one algo have independent
         # controllers; an algo label would flap between their windows
-        _tm.SCORE_WINDOW_MS.labels(model=model_key).set(
+        # (residency is capped by the serve-budget LRU, so the label set
+        # is bounded by max resident models, not by DKV contents)
+        _tm.SCORE_WINDOW_MS.labels(model=model_key).set(  # graftlint: ok(label residency bounded by serve-budget LRU)
             entry.slo.current_window_s() * 1e3)
         if pending.queue_wait_s is not None:
             _tm.SCORE_QUEUE_WAIT.observe(pending.queue_wait_s)

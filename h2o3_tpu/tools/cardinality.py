@@ -1,0 +1,92 @@
+"""graftlint metric-cardinality rule (CRD) — unbounded label values.
+
+Every labelled child of a metric family lives forever in the registry and
+in every ``/metrics`` scrape, every flight-recorder sample, and every
+diagnostic bundle. The label-cardinality contract (telemetry.py, the
+flight recorder's ``max_series`` cap) is that label VALUES come from small
+closed sets — route patterns, algo names, outcome enums — never DKV keys,
+file paths, or raw tenant strings. One ``labels(model=frame_key)`` in a
+hot path turns a fixed-memory recorder into an unbounded one.
+
+- **CRD001** — a ``.labels(...)`` call with keyword arguments where some
+  keyword's value mentions an identifier whose name says "unbounded":
+  a segment like ``key``/``path``/``file``/``url``/``user``/``raw``/
+  ``id``/``token``. String literals and values routed through a
+  sanitizer-shaped call (``*sanitize*``, ``*bound*``, ``*bucket*``,
+  ``*label*``) are accepted — that is the fix shape: map the raw value
+  onto a closed set first (see ``ops_plane/tenancy.py``'s tenant-label
+  sanitizer). Deliberate bounded exceptions (e.g. a label whose residency
+  is capped by an LRU) carry an inline ``# graftlint: ok(<reason>)``.
+
+Only keyword-form calls are examined, so ``Vec.labels()`` / categorical
+``v.labels()`` accessors (always positional-free, argument-free) never
+match.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from h2o3_tpu.tools.core import Finding, PackageIndex, dotted_name
+
+#: identifier SEGMENTS (underscore-split) that mark a value as drawn from
+#: an open set: object keys, filesystem paths, user-supplied strings, ids
+_UNBOUNDED = re.compile(
+    r"(?:^|_)(?:key|keys|path|paths|file|filename|files|dir|url|uri|"
+    r"user|users|raw|query|sql|token|secret|id|ids|uid|dest|dst|src)(?:_|$)")
+
+#: callables whose NAME promises the value was folded onto a closed set
+_SANITIZER = re.compile(r"sanitiz|bound|bucket|label|enum|classify",
+                        re.IGNORECASE)
+
+
+def _is_sanitized(value: ast.AST) -> bool:
+    """True when the value is produced by a sanitizer-shaped call —
+    ``route_label(path)``, ``_bounded_tenant(raw)`` — whose name is the
+    documented promise of bounded output."""
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    return bool(name and _SANITIZER.search(name.rsplit(".", 1)[-1]))
+
+
+def _unbounded_ident(value: ast.AST) -> str | None:
+    """The first identifier inside ``value`` whose name marks an open
+    set, or None. Walks the whole expression so f-strings and arithmetic
+    over a key are caught, not just bare names."""
+    for node in ast.walk(value):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident and _UNBOUNDED.search(ident):
+            return ident
+    return None
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels" and node.keywords):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None or _is_sanitized(kw.value):
+                    continue
+                ident = _unbounded_ident(kw.value)
+                if ident is None:
+                    continue
+                findings.append(Finding(
+                    "CRD001", mod.path, node.lineno, "",
+                    f"label `{kw.arg}={ident}` feeds an open set into a "
+                    "metric family — every distinct value is a child that "
+                    "lives forever in the registry, the /metrics scrape, "
+                    "and the flight recorder; fold it onto a closed set "
+                    "via a bounded-label helper or suppress with the "
+                    "bound's reason",
+                    detail=f"unbounded-label:{kw.arg}={ident}"))
+    return findings

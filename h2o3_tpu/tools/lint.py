@@ -28,8 +28,9 @@ import json
 import sys
 from pathlib import Path
 
-from h2o3_tpu.tools import (acts, envs, ingest, locks, mem, meshes, metrics,
-                            profiles, rest, retry, sync, tracer, waits)
+from h2o3_tpu.tools import (acts, cardinality, envs, ingest, locks, mem,
+                            meshes, metrics, profiles, rest, retry, sync,
+                            tracer, waits)
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -44,7 +45,7 @@ def run_lint(root: Path) -> list[Finding]:
                 + meshes.check(index) + profiles.check(index)
                 + waits.check(index) + envs.check(index)
                 + ingest.check(index) + metrics.check(index)
-                + acts.check(index))
+                + acts.check(index) + cardinality.check(index))
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),

@@ -46,10 +46,18 @@ import tarfile
 import threading
 import time
 
+from h2o3_tpu.utils import flight as _fl
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.incidents import INCIDENTS
 
 _LOG = logging.getLogger("h2o3_tpu")
+
+#: wall seconds per health evaluation (thread sweeps and inline calls) —
+#: the observe-the-observers instrument: a sweep dragging toward its own
+#: interval is a probe reading a sick registry (docs/OBSERVABILITY.md)
+HEALTH_SWEEP_SECONDS = _tm.METRICS.histogram(
+    "h2o3_health_sweep_seconds",
+    "wall seconds per health-evaluator sweep")
 
 HEALTHY, DEGRADED, UNHEALTHY = "healthy", "degraded", "unhealthy"
 _RANK = {HEALTHY: 0, DEGRADED: 1, UNHEALTHY: 2}
@@ -157,7 +165,8 @@ class Rule:
 
     def __init__(self, name: str, subsystem: str, severity: str,
                  probe, *, env: str, default, direction: str = "above",
-                 unit: str = "", description: str = ""):
+                 unit: str = "", description: str = "",
+                 source_series: "str | None" = None):
         self.name = name
         self.subsystem = subsystem
         self.severity = severity
@@ -167,6 +176,10 @@ class Rule:
         self.direction = direction
         self.unit = unit
         self.description = description
+        #: the flight-recorder series this rule trends over (trend rules);
+        #: the incident context captures its ±window instead of only the
+        #: rule's own point samples
+        self.source_series = source_series
 
     def threshold(self) -> float:
         dflt = self.default() if callable(self.default) else self.default
@@ -254,6 +267,91 @@ def _probe_retry_exhaustion(ev: "HealthEvaluator"):
     return float(ev._streak("dispatch_exhausted", delta > 0))
 
 
+# -- trend probes (sustained-slope detectors over the flight recorder) -------
+#
+# Point rules answer "is it bad NOW"; trend rules answer "is it HEADING
+# bad" — a slow leak, a creeping p99, an MFU slide. Each reads a retained
+# series from the flight recorder (utils/flight.py) and compares the tail
+# of the window against its head, so a single noisy sample never pages.
+# With the recorder off (H2O3TPU_FLIGHT_OFF=1), not started, or not yet
+# holding a full window, every probe returns None (not-applicable) — the
+# clean-degrade contract incidents rely on.
+
+def trend_window() -> int:
+    """Raw samples a trend probe needs before it speaks
+    (``H2O3TPU_FLIGHT_TREND_SAMPLES``, default 12, min 4)."""
+    try:
+        return max(int(os.environ.get("H2O3TPU_FLIGHT_TREND_SAMPLES", "")
+                       or 12), 4)
+    except ValueError:
+        return 12
+
+
+def _trend_vals(name: str) -> "list | None":
+    """The last trend-window values of a flight series, or None when the
+    window isn't full yet (an under-filled window must not fabricate a
+    slope from two samples)."""
+    n = trend_window()
+    vals = _fl.FLIGHT.values(name, last_n=n)
+    return vals if len(vals) >= n else None
+
+
+def _edge_means(vals: list) -> "tuple[float, float]":
+    """(head_mean, tail_mean) over the window's first and last quartiles
+    — a noise-damped two-point slope."""
+    q = max(len(vals) // 4, 1)
+    head = sum(vals[:q]) / q
+    tail = sum(vals[-q:]) / q
+    return head, tail
+
+
+def _probe_trend_rss(ev: "HealthEvaluator"):
+    """Relative RSS growth across the trend window (0.05 = +5%)."""
+    vals = _trend_vals("derived.host_rss_bytes")
+    if vals is None:
+        return None
+    head, tail = _edge_means(vals)
+    if head <= 0 or tail <= head:
+        return 0.0
+    return round((tail - head) / head, 4)
+
+
+def _probe_trend_p99(ev: "HealthEvaluator"):
+    """p99/SLO-ratio rise across the window — only while the tail is
+    already near the SLO (a creep from 0.1 to 0.2 is headroom, not
+    danger)."""
+    vals = _trend_vals("derived.p99_slo_ratio")
+    if vals is None:
+        return None
+    head, tail = _edge_means(vals)
+    if tail < 0.8 or tail <= head:
+        return 0.0
+    return round(tail - head, 4)
+
+
+def _probe_trend_mfu(ev: "HealthEvaluator"):
+    """MFU lost across the window (positive = declining utilization)."""
+    vals = _trend_vals("derived.mfu_min")
+    if vals is None:
+        return None
+    head, tail = _edge_means(vals)
+    return round(max(head - tail, 0.0), 6)
+
+
+def _probe_trend_shed(ev: "HealthEvaluator"):
+    """Shed-rate acceleration: sheds in the window's second half minus
+    sheds in its first (the cumulative counter's second difference) — a
+    steady overload pages the point rule; this one pages when shedding
+    is getting WORSE."""
+    vals = _trend_vals("derived.score_shed_total")
+    if vals is None:
+        return None
+    mid = len(vals) // 2
+    first = vals[mid] - vals[0]
+    second = vals[-1] - vals[mid]
+    return round(max(second - first, 0.0), 4)
+
+
 def default_rules() -> list[Rule]:
     """The rule catalog (docs/OBSERVABILITY.md "Health & incidents" is the
     operator-facing table; keep both in step)."""
@@ -312,6 +410,34 @@ def default_rules() -> list[Rule]:
              description="consecutive sweeps with dispatch-retry budgets "
                          "exhausted — dispatches are failing through their "
                          "whole retry budget"),
+        # trend rules: sustained-slope detectors over the flight recorder
+        # (not-applicable — never a trip — while the recorder is off or
+        # its window unfilled; docs/OBSERVABILITY.md "Flight recorder")
+        Rule("trend_rss_growth", "memory", DEGRADED,
+             _probe_trend_rss,
+             env="H2O3TPU_HEALTH_TREND_RSS_GROWTH", default=0.05,
+             unit="fraction", source_series="derived.host_rss_bytes",
+             description="host RSS grew steadily across the trend window "
+                         "— a slow leak the point rules cannot see"),
+        Rule("trend_p99_creep", "serving", DEGRADED,
+             _probe_trend_p99,
+             env="H2O3TPU_HEALTH_TREND_P99_CREEP", default=0.1,
+             unit="ratio", source_series="derived.p99_slo_ratio",
+             description="a resident model's p99/SLO ratio is rising while "
+                         "already near the target — creeping toward an SLO "
+                         "breach"),
+        Rule("trend_mfu_decline", "compute", DEGRADED,
+             _probe_trend_mfu,
+             env="H2O3TPU_HEALTH_TREND_MFU_DECLINE", default=0.05,
+             unit="MFU", source_series="derived.mfu_min",
+             description="a rated loop's utilization slid across the trend "
+                         "window — throughput is decaying, not collapsed"),
+        Rule("trend_shed_accel", "serving", DEGRADED,
+             _probe_trend_shed,
+             env="H2O3TPU_HEALTH_TREND_SHED_ACCEL", default=5,
+             unit="sheds", source_series="derived.score_shed_total",
+             description="scoring sheds accelerated window-over-window — "
+                         "overload is compounding, not steady"),
     ]
 
 
@@ -383,6 +509,8 @@ class HealthEvaluator:
     def _run(self) -> None:
         # bounded wait (WTX001): stop() wakes it immediately, the interval
         # bounds it otherwise; the sweep itself never raises out
+        from h2o3_tpu.utils import blackbox as _bb
+        from h2o3_tpu.utils import timeline as _tl
         while not self._stop.wait(self.interval_s):
             with self._lock:
                 if self._thread is not threading.current_thread():
@@ -390,6 +518,13 @@ class HealthEvaluator:
                     # this thread alive; a later start() must not revive
                     # it — two sweeps would split every window delta
                     return
+            # heartbeat BEFORE the sweep: the black-box watchdog pages on
+            # silence, and the sweep body is exactly what can wedge (the
+            # chaos seam below is the injectable stall the bench drives;
+            # BLACKBOX looked up per sweep so tests can swap the instance)
+            _bb.BLACKBOX.beat("health_sweep")
+            if _tl.FAULTS is not None:
+                _tl.FAULTS.maybe_fault("health.sweep")
             try:
                 # a stop() landing while this sweep is in flight drains it:
                 # the abort seam is checked between rules AND between a
@@ -431,8 +566,14 @@ class HealthEvaluator:
         stop flag) drains the sweep: checked between rules and between a
         probe and its incident open, an aborted sweep returns ``None``
         without opening incidents or publishing a verdict."""
+        t0 = time.perf_counter()
         with self._eval_lock:
-            return self._evaluate_locked(abort)
+            verdict = self._evaluate_locked(abort)
+        if verdict is not None:
+            # aborted (drained) sweeps don't observe: a shutdown-time
+            # partial sweep would poison the duration distribution low
+            HEALTH_SWEEP_SECONDS.observe(time.perf_counter() - t0)
+        return verdict
 
     def _evaluate_locked(self, abort=None) -> "dict | None":
         # graftlint: ok(_locked suffix: serialized by _eval_lock above)
@@ -463,6 +604,13 @@ class HealthEvaluator:
             if observed is not None:
                 series.append(observed)
                 del series[:-SERIES_LEN]
+                # every rule's observed value is ALSO a retained flight
+                # series (health.rule.<name>) — the incident ±window and
+                # /3/TimeSeries read it; a no-op when the recorder is off
+                try:
+                    _fl.FLIGHT.ingest(f"health.rule.{rule.name}", observed)
+                except Exception:   # noqa: BLE001 — recording must never
+                    pass            # break evaluating
             threshold = rule.threshold()
             if not rule.tripped(observed, threshold):
                 continue
@@ -482,7 +630,8 @@ class HealthEvaluator:
                 return None
             self.incidents.open(rule.name, rule.subsystem, rule.severity,
                                 message, observed, threshold,
-                                series=series)
+                                series=series,
+                                source_series=rule.source_series)
         # falling edges resolve their incidents — but a FAILED probe is
         # blindness, not recovery: a rule whose probe raised stays in
         # whatever state it was (an open incident must not read "resolved"
@@ -530,6 +679,13 @@ class HealthEvaluator:
                 if self._last is not None:
                     return self._last
         return self.evaluate()
+
+    def last_verdict(self) -> "dict | None":
+        """The most recently PUBLISHED verdict, never evaluating inline —
+        the flight recorder's health-status series reads this each tick
+        (a recorder tick must not become a health sweep)."""
+        with self._lock:
+            return self._last
 
     def sweeps(self) -> int:
         with self._eval_lock:
@@ -623,8 +779,9 @@ def diagnostic_bundle(evaluator: HealthEvaluator | None = None
                       ) -> "tuple[bytes, str]":
     """One call, everything an operator needs: a gzip tar of all four
     pillar snapshots (metrics, traces, memory, compute), the health
-    verdict, the incident ring (contexts included), the log ring, the
-    hardware fingerprint, and the redacted config dump. Returns
+    verdict, the incident ring (contexts included), the ActionLog, the
+    flight-recorder time series, the log ring, the hardware fingerprint,
+    and the redacted config dump. Returns
     ``(bytes, filename)`` — the ``POST /3/Diagnostics/bundle`` payload
     and what both clients save to disk."""
     ev = evaluator if evaluator is not None else HEALTH
@@ -645,6 +802,8 @@ def diagnostic_bundle(evaluator: HealthEvaluator | None = None
     add("compute.json", lambda: _compute_snapshot_bytes())
     add("health.json", lambda: _jsonable(ev.verdict()))
     add("incidents.json", lambda: _jsonable(ev.incidents.export()))
+    add("actions.json", lambda: _jsonable(_actions_export()))
+    add("timeseries.json", lambda: _jsonable(_fl.FLIGHT.export()))
     add("logs.txt",
         lambda: "\n".join(_tm.install_log_ring().lines()).encode())
     add("hardware.json", lambda: _jsonable(hardware_fingerprint()))
@@ -659,6 +818,14 @@ def diagnostic_bundle(evaluator: HealthEvaluator | None = None
             info.mtime = now
             tar.addfile(info, io.BytesIO(data))
     return buf.getvalue(), f"h2o3_diagnostics_{now}.tar.gz"
+
+
+def _actions_export() -> list:
+    """The ActionLog, newest first — only when the ops plane is loaded
+    (the bundle must not be the thing that imports it)."""
+    import sys
+    acts = sys.modules.get("h2o3_tpu.ops_plane.actions")
+    return acts.ACTIONS.list() if acts is not None else []
 
 
 def _memory_summary_bytes() -> bytes:

@@ -99,11 +99,33 @@ def _capture_compute() -> dict:
             "signature_count": snap["signature_count"]}
 
 
+def _capture_flight_window(rule: str,
+                           source_series: "str | None") -> "dict | None":
+    """The ±window of the tripping series from the flight recorder: the
+    rule's declared source series (trend rules) when it holds samples,
+    else the rule's own recorded observed series (``health.rule.<rule>``).
+    None — cleanly, never a crash — when the recorder is off
+    (``H2O3TPU_FLIGHT_OFF=1``), not yet started, or holds no samples for
+    either name: the point-sample ``series`` fallback stands alone."""
+    from h2o3_tpu.utils.flight import FLIGHT
+    win = None
+    if source_series:
+        win = FLIGHT.window(source_series)
+    if win is None:
+        win = FLIGHT.window(f"health.rule.{rule}")
+    return win
+
+
 def capture_context(rule: str, subsystem: str,
-                    series: "list | None" = None) -> dict:
+                    series: "list | None" = None,
+                    source_series: "str | None" = None) -> dict:
     """The correlated context stamped into a new incident: what the
     observability pillars showed AT TRIP TIME. Every capture is
-    individually fault-isolated (a failed one records its error string)."""
+    individually fault-isolated (a failed one records its error string).
+    ``flight_window`` carries the ±window of the tripping series from the
+    flight recorder when one holds samples; incidents opened before the
+    recorder starts (or with ``H2O3TPU_FLIGHT_OFF=1``) degrade to the
+    point-sample ``series`` list — ``flight_window`` is then None."""
     ctx: dict = {"series": list(series or [])}
     for name, fn in (("traces", _capture_traces), ("logs", _capture_logs),
                      ("memory", _capture_memory),
@@ -112,6 +134,10 @@ def capture_context(rule: str, subsystem: str,
             ctx[name] = fn()
         except Exception as e:   # noqa: BLE001 — capture must never raise
             ctx[name] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        ctx["flight_window"] = _capture_flight_window(rule, source_series)
+    except Exception as e:   # noqa: BLE001 — capture must never raise
+        ctx["flight_window"] = {"error": f"{type(e).__name__}: {e}"}
     return ctx
 
 
@@ -149,7 +175,8 @@ class IncidentLog:
     # -- lifecycle -----------------------------------------------------------
 
     def open(self, rule: str, subsystem: str, severity: str, message: str,
-             observed, threshold, series=None) -> str:
+             observed, threshold, series=None,
+             source_series: "str | None" = None) -> str:
         """Open (or update) the incident for ``rule``. Returns its id.
         A rule with an incident already open updates it in place —
         ``repeats`` increments, ``observed``/``last_seen_ms`` refresh —
@@ -200,7 +227,8 @@ class IncidentLog:
         # context capture OUTSIDE the lock: the helpers read other
         # registries (their own locks) — holding ours across them invites
         # ordering trouble for zero benefit
-        ctx = capture_context(rule, subsystem, series)
+        ctx = capture_context(rule, subsystem, series,
+                              source_series=source_series)
         with self._lock:
             if iid in self._ring:
                 self._ring[iid]["context"] = ctx

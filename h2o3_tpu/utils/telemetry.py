@@ -436,6 +436,10 @@ REQUESTS = METRICS.counter(
 REQUEST_SECONDS = METRICS.histogram(
     "h2o3_request_duration_seconds", "REST request latency",
     ("route", "method"))
+SCRAPE_SECONDS = METRICS.histogram(
+    "h2o3_metrics_scrape_seconds",
+    "wall seconds to render the /metrics OpenMetrics exposition — a "
+    "scrape dragging means the registry itself is the bottleneck")
 
 # map_reduce substrate (ops/map_reduce.py)
 MR_DISPATCHES = METRICS.counter(

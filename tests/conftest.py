@@ -33,6 +33,18 @@ def _clear_dkv():
     DKV.clear()
 
 
+@pytest.fixture(autouse=True)
+def _clear_flight():
+    """The flight recorder is a process-global accumulator: real RSS
+    growth sampled across a long suite run fills the trend window, and
+    any default-rules HealthEvaluator in a later test would then open a
+    genuine (but noise, here) trend incident. Same isolation contract
+    as _clear_dkv."""
+    yield
+    from h2o3_tpu.utils.flight import FLIGHT
+    FLIGHT.reset()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches():
     """Free compiled executables between test modules: a long single-process

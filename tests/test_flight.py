@@ -1,0 +1,570 @@
+"""Flight recorder + black box (ISSUE 17): fixed-memory retained time
+series (ring bounds, rollup tiers, query surface), trend health rules
+reading the record and stamping the tripping window into their incident,
+clean degradation when the recorder is off/empty, and the black-box
+post-mortem — wedge detection fires exactly once, orderly shutdown never
+fires, the dump unpacks with every member and leaks no secrets
+(docs/OBSERVABILITY.md "Flight recorder & post-mortems")."""
+
+import io
+import json
+import os
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.utils import blackbox as bb_mod
+from h2o3_tpu.utils import flight as fl_mod
+from h2o3_tpu.utils.blackbox import DUMP_MEMBERS, BlackBox
+from h2o3_tpu.utils.flight import FLIGHT, FlightRecorder
+from h2o3_tpu.utils.health import (DEGRADED, HealthEvaluator, default_rules,
+                                   trend_window)
+from h2o3_tpu.utils.incidents import IncidentLog
+
+POSTMORTEM_MEMBERS = {"reason.json"} | {name for name, _ in DUMP_MEMBERS}
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    FLIGHT.reset()
+    yield
+    FLIGHT.stop()
+    FLIGHT.reset()
+
+
+def _trend_rules(*names):
+    rules = [r for r in default_rules() if r.name.startswith("trend_")]
+    if names:
+        rules = [r for r in rules if r.name in names]
+    return rules
+
+
+def _fill(name, values, rec=FLIGHT, labels=None, rollup_at=None):
+    for i, v in enumerate(values):
+        rec.ingest(name, v, labels=labels, now=float(i))
+
+
+# -- rings & rollup ----------------------------------------------------------
+
+def test_raw_ring_is_bounded():
+    rec = FlightRecorder(interval_s=1.0, raw_len=16, rollup_len=16,
+                         rollup_secs=100.0, max_series=8)
+    _fill("s", range(100), rec=rec)
+    vals = rec.values("s")
+    assert vals == [float(v) for v in range(84, 100)]   # last raw_len only
+    assert rec.stats()["samples_total"] == 100
+
+
+def test_rollup_windows_carry_min_max_mean_last():
+    rec = FlightRecorder(interval_s=1.0, raw_len=8, rollup_len=16,
+                         rollup_secs=4.0, max_series=8)
+    _fill("s", [10, 2, 30, 4, 99], rec=rec)     # t=0..4; t=4 closes window
+    [view] = rec.query("s")
+    assert len(view["rollup"]) == 1
+    w = view["rollup"][0]
+    assert w["min"] == 2 and w["max"] == 30 and w["count"] == 4
+    assert w["mean"] == pytest.approx(11.5) and w["last"] == 4
+    # the raw tail still holds everything recent, including the opener
+    # of the next pending window
+    assert rec.values("s")[-1] == 99.0
+
+
+def test_rollup_ring_is_bounded():
+    rec = FlightRecorder(interval_s=1.0, raw_len=8, rollup_len=4,
+                         rollup_secs=1.0, max_series=8)
+    _fill("s", range(50), rec=rec)              # every sample closes a window
+    [view] = rec.query("s")
+    assert len(view["rollup"]) == 4
+
+
+def test_max_series_overflow_counted_and_dropped():
+    rec = FlightRecorder(interval_s=1.0, raw_len=8, rollup_len=8,
+                         rollup_secs=30.0, max_series=4)
+    for i in range(10):
+        rec.ingest(f"s{i}", 1.0, now=0.0)
+    st = rec.stats()
+    assert st["series"] == 4
+    assert st["dropped_series"] == 6
+    assert rec.values("s9") == []               # dropped, never grown
+
+
+# -- query surface -----------------------------------------------------------
+
+def test_query_name_prefix_labels_subset_and_since():
+    rec = FlightRecorder(interval_s=1.0, raw_len=16, rollup_len=8,
+                         rollup_secs=30.0, max_series=16)
+    _fill("app.requests", range(6), rec=rec, labels={"route": "/3/Score"})
+    _fill("app.requests", range(6), rec=rec, labels={"route": "/3/Jobs"})
+    _fill("app.errors", range(6), rec=rec)
+    assert len(rec.query("app.")) == 3          # prefix match
+    assert len(rec.query("app.requests")) == 2  # exact match, both labels
+    [one] = rec.query("app.requests", labels={"route": "/3/Jobs"})
+    assert one["labels"] == {"route": "/3/Jobs"}
+    [late] = rec.query("app.errors", since=4.0)
+    assert [v for _, v in late["samples"]] == [4.0, 5.0]
+    assert rec.query("nope") == []
+
+
+def test_values_and_window_absent_series_degrade():
+    rec = FlightRecorder()
+    assert rec.values("missing") == []
+    assert rec.window("missing") is None
+
+
+def test_window_carries_cadence():
+    rec = FlightRecorder(interval_s=2.0, raw_len=8, rollup_len=8,
+                         rollup_secs=30.0, max_series=8)
+    _fill("s", [1, 2, 3], rec=rec)
+    win = rec.window("s", last_n=2)
+    assert [v for _, v in win["samples"]] == [2.0, 3.0]
+    assert win["interval_s"] == 2.0 and win["rollup_secs"] == 30.0
+
+
+def test_ingest_rejects_non_numeric_and_off(monkeypatch):
+    rec = FlightRecorder()
+    assert rec.ingest("s", "not-a-number") is False
+    assert rec.ingest("s", None) is False
+    monkeypatch.setenv("H2O3TPU_FLIGHT_OFF", "1")
+    assert rec.ingest("s", 1.0) is False
+    assert rec.sample_once() == 0
+    assert rec.start() is False
+    assert rec.stats()["series"] == 0
+
+
+# -- sampler -----------------------------------------------------------------
+
+def test_sample_once_snapshots_registry_and_derived():
+    rec = FlightRecorder(interval_s=1.0, max_series=2048)
+    wrote = rec.sample_once(now=1.0)
+    assert wrote > 0
+    names = rec.series_names()
+    assert "derived.host_rss_bytes" in names    # straight from /proc
+    assert any(n.startswith("h2o3_") for n in names)
+    assert rec.values("derived.host_rss_bytes")[0] > 0
+
+
+def test_sampler_thread_ticks_and_interval_resolves_at_start(monkeypatch):
+    rec = FlightRecorder()
+    assert rec.interval_s == 1.0
+    # the ENV001 contract: the knob lands at start(), not construction
+    monkeypatch.setenv("H2O3TPU_FLIGHT_INTERVAL_SECS", "0.05")
+    assert rec.start() is True
+    try:
+        assert rec.interval_s == 0.05
+        assert rec.start() is False             # idempotent while running
+        deadline = time.monotonic() + 5.0
+        while rec.ticks() < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.ticks() >= 2
+        assert rec.running()
+    finally:
+        rec.stop()
+    assert not rec.running()
+
+
+def test_interval_floor_prevents_busy_spin(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_FLIGHT_INTERVAL_SECS", "0.000001")
+    assert FlightRecorder().interval_s == 0.05
+    monkeypatch.setenv("H2O3TPU_FLIGHT_INTERVAL_SECS", "banana")
+    assert FlightRecorder().interval_s == 1.0
+
+
+# -- trend rules -------------------------------------------------------------
+
+def test_trend_rules_silent_until_window_full():
+    n = trend_window()
+    _fill("derived.host_rss_bytes", [1e9 * (1 + 0.2 * i)
+                                     for i in range(n - 1)])
+    ilog = IncidentLog(capacity=8)
+    ev = HealthEvaluator(interval_s=60, rules=_trend_rules(), incidents=ilog)
+    v = ev.evaluate()
+    assert v["status"] == "healthy" and v["findings"] == []
+    assert ilog.opened_total() == 0
+
+
+def test_rss_growth_trend_opens_one_windowed_incident():
+    n = trend_window()
+    _fill("derived.host_rss_bytes", [1e9 * (1 + 0.02 * i) for i in range(n)])
+    ilog = IncidentLog(capacity=8)
+    ev = HealthEvaluator(interval_s=60,
+                         rules=_trend_rules("trend_rss_growth"),
+                         incidents=ilog)
+    v = ev.evaluate()
+    assert v["status"] == DEGRADED
+    [f] = v["findings"]
+    assert f["rule"] == "trend_rss_growth" and f["observed"] > 0.05
+    ev.evaluate()                               # steady state: edge holds
+    assert ilog.opened_total() == 1
+    [summary] = ilog.list(state="open")
+    win = ilog.get(summary["id"])["context"]["flight_window"]
+    assert win["name"] == "derived.host_rss_bytes"
+    assert len(win["samples"]) >= 4             # the curve, not one number
+
+
+def test_flat_rss_never_trips_trend():
+    n = trend_window()
+    _fill("derived.host_rss_bytes", [1e9] * n)
+    ilog = IncidentLog(capacity=8)
+    ev = HealthEvaluator(interval_s=60, rules=_trend_rules(), incidents=ilog)
+    assert ev.evaluate()["status"] == "healthy"
+    assert ilog.opened_total() == 0
+
+
+def test_p99_creep_requires_near_slo_tail():
+    n = trend_window()
+    rules = _trend_rules("trend_p99_creep")
+    # rising but far from the SLO: headroom, not danger
+    _fill("derived.p99_slo_ratio", [0.1 + 0.02 * i for i in range(n)])
+    ev = HealthEvaluator(interval_s=60, rules=rules,
+                         incidents=IncidentLog(capacity=8))
+    assert ev.evaluate()["findings"] == []
+    FLIGHT.reset()
+    # rising INTO the SLO: pages before the point rule would
+    _fill("derived.p99_slo_ratio", [0.6 + (0.35 / n) * i for i in range(n)])
+    ilog = IncidentLog(capacity=8)
+    ev = HealthEvaluator(interval_s=60, rules=rules, incidents=ilog)
+    v = ev.evaluate()
+    assert [f["rule"] for f in v["findings"]] == ["trend_p99_creep"]
+    assert ilog.opened_total() == 1
+
+
+def test_shed_acceleration_second_difference():
+    n = trend_window()
+    rules = _trend_rules("trend_shed_accel")
+    # steady shedding (constant rate): the point rule's business, not ours
+    _fill("derived.score_shed_total", [10.0 * i for i in range(n)])
+    ev = HealthEvaluator(interval_s=60, rules=rules,
+                         incidents=IncidentLog(capacity=8))
+    assert ev.evaluate()["findings"] == []
+    FLIGHT.reset()
+    # accelerating: second half sheds far more than the first
+    _fill("derived.score_shed_total",
+          [i * i * 4.0 for i in range(n)])
+    ev = HealthEvaluator(interval_s=60, rules=rules,
+                         incidents=IncidentLog(capacity=8))
+    assert [f["rule"] for f in ev.evaluate()["findings"]] == \
+        ["trend_shed_accel"]
+
+
+def test_evaluator_pushes_rule_series_into_recorder():
+    ev = HealthEvaluator(interval_s=60, incidents=IncidentLog(capacity=8))
+    ev.evaluate()
+    names = FLIGHT.series_names()
+    assert any(n.startswith("health.rule.") for n in names)
+
+
+# -- clean degradation (satellite c) -----------------------------------------
+
+def test_incident_before_recorder_has_point_context():
+    """An incident opened with an EMPTY recorder still captures the
+    point-sample pillars — flight_window is None, nothing crashes."""
+    ilog = IncidentLog(capacity=8)
+    iid = ilog.open("compute_recompile_storm", "compute", DEGRADED,
+                    "storm", 5.0, 2.0, series=[1, 2, 5])
+    inc = ilog.get(iid)
+    assert inc["context"]["flight_window"] is None
+    assert inc["context"]["series"] == [1, 2, 5]
+    assert "traces" in inc["context"]
+
+
+def test_incident_with_flight_off_degrades(monkeypatch):
+    n = trend_window()
+    _fill("derived.host_rss_bytes", [1e9 * (1 + 0.02 * i) for i in range(n)])
+    monkeypatch.setenv("H2O3TPU_FLIGHT_OFF", "1")
+    # trend probes read nothing (values() path still works on retained
+    # data, but a fresh process would hold none) and incident capture
+    # must stay point-sample clean either way
+    ilog = IncidentLog(capacity=8)
+    iid = ilog.open("serving_shed_rate", "serving", DEGRADED,
+                    "overload", 0.4, 0.05)
+    assert ilog.get(iid)["context"] is not None
+
+
+def test_trend_probes_not_applicable_with_recorder_off(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_FLIGHT_OFF", "1")
+    FLIGHT.reset()
+    ilog = IncidentLog(capacity=8)
+    ev = HealthEvaluator(interval_s=60, rules=_trend_rules(), incidents=ilog)
+    v = ev.evaluate()
+    assert v["status"] == "healthy" and v["findings"] == []
+    assert ilog.opened_total() == 0
+
+
+# -- black box: heartbeats & watchdog ----------------------------------------
+
+def test_wedge_detection_scales_with_period(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "0.2")
+    bb = BlackBox(dump_dir="/nonexistent-never-written")
+    bb.stall_secs = 0.2
+    bb.watch("loop", period_s=0.01)
+    assert bb.wedged() is None                  # just stamped
+    time.sleep(0.3)
+    name, silence = bb.wedged()
+    assert name == "loop" and silence >= 0.2
+    bb.beat("loop")
+    assert bb.wedged() is None                  # beat clears it
+    bb.unwatch("loop")
+    time.sleep(0.05)
+    assert bb.wedged() is None                  # unwatched never wedges
+
+
+def test_beat_to_unwatched_name_is_ignored():
+    bb = BlackBox()
+    bb.beat("never-watched")                    # must not KeyError or arm
+    assert bb.wedged() is None
+
+
+def test_watchdog_dumps_exactly_once_on_wedge(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "0.2")
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_CHECK_SECS", "0.05")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    assert bb.arm() is True
+    assert bb.arm() is False                    # idempotent
+    try:
+        bb.watch("wedged_loop", period_s=0.01)  # never beats again
+        deadline = time.monotonic() + 5.0
+        while not bb.fired() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert bb.fired()
+        time.sleep(0.3)                         # wedge persists; no refire
+    finally:
+        bb.disarm()
+    dumps = os.listdir(tmp_path)
+    assert len(dumps) == 1
+    assert dumps[0].startswith("h2o3_postmortem_")
+    with tarfile.open(tmp_path / dumps[0]) as tar:
+        members = {m.name.split("/", 1)[1] for m in tar.getmembers()}
+        assert members == POSTMORTEM_MEMBERS
+        reason = json.loads(tar.extractfile(
+            f"h2o3_postmortem/reason.json").read())
+    assert reason["reason"] == "wedge:wedged_loop"
+    assert reason["watched"]["wedged_loop"]["silence_s"] > 0.2
+
+
+def test_clean_run_and_orderly_disarm_never_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "0.2")
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_CHECK_SECS", "0.05")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    bb.arm()
+    bb.watch("loop", period_s=0.05)
+    t_end = time.monotonic() + 0.5
+    while time.monotonic() < t_end:
+        bb.beat("loop")
+        time.sleep(0.02)
+    bb.disarm()                                 # ORDERLY shutdown
+    time.sleep(0.2)                             # watchdog is gone
+    bb._on_exit()                               # atexit after disarm: no-op
+    assert not bb.fired()
+    assert os.listdir(tmp_path) == []
+
+
+def test_exit_hook_dumps_only_while_armed(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "30")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    bb.arm()
+    bb._on_exit()                               # exit WITHOUT disarm
+    bb.disarm()
+    assert bb.fired() and len(os.listdir(tmp_path)) == 1
+
+
+def test_blackbox_off_never_arms(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_OFF", "1")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    assert bb.arm() is False
+    assert not bb.armed()
+
+
+# -- black box: the dump -----------------------------------------------------
+
+def _unpack(path):
+    with tarfile.open(path) as tar:
+        return {m.name.split("/", 1)[1]: tar.extractfile(m).read()
+                for m in tar.getmembers()}
+
+
+def test_dump_members_parse_and_fire_once(tmp_path):
+    FLIGHT.ingest("derived.host_rss_bytes", 123.0, now=1.0)
+    bb = BlackBox(dump_dir=str(tmp_path))
+    path = bb.dump("unit-test", detail={"k": "v"})
+    assert path and bb.last_dump() == path
+    assert bb.dump("again") is None             # exactly once per instance
+    members = _unpack(path)
+    assert set(members) == POSTMORTEM_MEMBERS
+    reason = json.loads(members["reason.json"])
+    assert reason["reason"] == "unit-test" and reason["detail"] == {"k": "v"}
+    assert reason["pid"] == os.getpid()
+    flight = json.loads(members["flight.json"])
+    assert any(s["name"] == "derived.host_rss_bytes"
+               for s in flight["series"])
+    threads = json.loads(members["threads.json"])
+    assert any("MainThread" in t["name"] for t in threads)
+    assert threads[0]["stack"]                  # formatted frames present
+    json.loads(members["traces.json"])
+    json.loads(members["incidents.json"])
+    assert isinstance(json.loads(members["actions.json"]), list)
+    json.loads(members["config.json"])
+
+
+def test_dump_redacts_secrets_in_raw_bytes(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_ADMIN_PASSWORD", "hunter2")
+    monkeypatch.setenv("H2O3TPU_LDAP_TOKEN", "s3cr3t-tok")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    path = bb.dump("secrets-check")
+    members = _unpack(path)
+    cfg = json.loads(members["config.json"])
+    assert cfg["H2O3TPU_ADMIN_PASSWORD"] == "[redacted]"
+    raw = b"".join(members.values()) + open(path, "rb").read()
+    assert b"hunter2" not in raw and b"s3cr3t-tok" not in raw
+
+
+def test_dump_member_fault_isolated(tmp_path, monkeypatch):
+    def sick():
+        raise RuntimeError("registry on fire")
+    patched = tuple(("flight.json", sick) if name == "flight.json"
+                    else (name, fn) for name, fn in bb_mod.DUMP_MEMBERS)
+    monkeypatch.setattr(bb_mod, "DUMP_MEMBERS", patched)
+    bb = BlackBox(dump_dir=str(tmp_path))
+    members = _unpack(bb.dump("sick-member"))
+    assert "flight.json.error" in members
+    assert b"registry on fire" in members["flight.json.error"]
+    assert "threads.json" in members            # the rest still landed
+
+
+def test_wedged_sweep_triggers_postmortem_via_fault_injection(
+        tmp_path, monkeypatch):
+    """The end-to-end wedge story: a FaultInjector stall on the health
+    sweep seam starves the heartbeat the sweep loop stamps, and the
+    watchdog dumps exactly one post-mortem."""
+    from h2o3_tpu.utils.timeline import inject_faults
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "0.2")
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_CHECK_SECS", "0.05")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    monkeypatch.setattr(bb_mod, "BLACKBOX", bb)
+    ev = HealthEvaluator(interval_s=0.05, rules=[],
+                         incidents=IncidentLog(capacity=4))
+    bb.arm()
+    bb.watch("health_sweep", period_s=0.05)
+    try:
+        with inject_faults(site_rates={"health.sweep": {
+                "stall_rate": 1.0, "stall_ms": 5_000}}):
+            ev.start()
+            deadline = time.monotonic() + 8.0
+            while not bb.fired() and time.monotonic() < deadline:
+                time.sleep(0.05)
+    finally:
+        ev.stop()
+        bb.disarm()
+    assert bb.fired()
+    dumps = [f for f in os.listdir(tmp_path) if f.endswith(".tar.gz")]
+    assert len(dumps) == 1
+    members = _unpack(tmp_path / dumps[0])
+    assert set(members) == POSTMORTEM_MEMBERS
+    assert json.loads(members["reason.json"])["reason"] == \
+        "wedge:health_sweep"
+
+
+def test_clean_sweep_never_triggers_postmortem(tmp_path, monkeypatch):
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_STALL_SECS", "0.2")
+    monkeypatch.setenv("H2O3TPU_BLACKBOX_CHECK_SECS", "0.05")
+    bb = BlackBox(dump_dir=str(tmp_path))
+    monkeypatch.setattr(bb_mod, "BLACKBOX", bb)
+    ev = HealthEvaluator(interval_s=0.05, rules=[],
+                         incidents=IncidentLog(capacity=4))
+    bb.arm()
+    bb.watch("health_sweep", period_s=0.05)
+    try:
+        ev.start()
+        time.sleep(0.6)                         # many sweeps, many beats
+    finally:
+        ev.stop()
+        bb.disarm()
+    assert not bb.fired()
+    assert os.listdir(tmp_path) == []
+
+
+# -- REST + clients ----------------------------------------------------------
+
+@pytest.fixture
+def server(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_HEALTH_INTERVAL_SECS", "0.2")
+    monkeypatch.setenv("H2O3TPU_FLIGHT_INTERVAL_SECS", "0.1")
+    from h2o3_tpu.api import H2OServer
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get_json(server, path):
+    with urllib.request.urlopen(server.url + path) as r:
+        return json.loads(r.read())
+
+
+def test_server_starts_recorder_and_serves_timeseries(server):
+    deadline = time.monotonic() + 5.0
+    while FLIGHT.ticks() < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    out = _get_json(server, "/3/TimeSeries")
+    assert out["__meta"]["schema_type"] == "TimeSeriesV3"
+    assert out["running"]
+    assert out["ticks"] >= 1
+    assert any(s["name"] == "derived.host_rss_bytes" for s in out["series"])
+    # name filter narrows to one series with samples
+    one = _get_json(server, "/3/TimeSeries?name=derived.host_rss_bytes")
+    assert len(one["series"]) == 1 and one["series"][0]["samples"]
+
+
+def test_timeseries_label_and_since_filters(server):
+    FLIGHT.ingest("unit.series", 1.0, labels={"k": "a"}, now=1.0)
+    FLIGHT.ingest("unit.series", 2.0, labels={"k": "a"}, now=2.0)
+    FLIGHT.ingest("unit.series", 9.0, labels={"k": "b"}, now=2.0)
+    out = _get_json(server, "/3/TimeSeries?name=unit.series&labels=k%3Da")
+    assert len(out["series"]) == 1
+    assert [v for _, v in out["series"][0]["samples"]] == [1.0, 2.0]
+    out = _get_json(server, "/3/TimeSeries?name=unit.series&since=1.5")
+    assert all(t >= 1.5 for s in out["series"] for t, _ in s["samples"])
+
+
+def test_timeseries_bad_params_are_400(server):
+    for path in ("/3/TimeSeries?labels=notapair",
+                 "/3/TimeSeries?since=banana"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(server.url + path)
+        assert exc.value.code == 400
+
+
+def test_python_client_timeseries_accessor(server):
+    from h2o3_tpu.api.client import H2OClient
+    client = H2OClient(server.url)
+    FLIGHT.ingest("unit.client", 7.0, labels={"k": "a"}, now=3.0)
+    out = client.timeseries(name="unit.client", labels={"k": "a"}, since=1.0)
+    assert out["__meta"]["schema_type"] == "TimeSeriesV3"
+    assert [v for _, v in out["series"][0]["samples"]] == [7.0]
+
+
+def test_server_stop_stops_recorder_and_disarms_blackbox(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_FLIGHT_INTERVAL_SECS", "0.1")
+    from h2o3_tpu.api import H2OServer
+    from h2o3_tpu.utils.blackbox import BLACKBOX
+    s = H2OServer(port=0).start()
+    try:
+        assert FLIGHT.running()
+        assert BLACKBOX.armed()
+    finally:
+        s.stop()
+    assert not FLIGHT.running()
+    assert not BLACKBOX.armed()
+    assert not BLACKBOX.fired()                 # orderly: no post-mortem
+
+
+def test_flight_off_server_still_serves(monkeypatch):
+    monkeypatch.setenv("H2O3TPU_FLIGHT_OFF", "1")
+    from h2o3_tpu.api import H2OServer
+    s = H2OServer(port=0).start()
+    try:
+        assert not FLIGHT.running()
+        out = _get_json(s, "/3/TimeSeries")
+        assert out["off"] and out["series"] == []
+    finally:
+        s.stop()

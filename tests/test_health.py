@@ -458,8 +458,8 @@ def test_inline_evaluate_unaffected_by_abort_seam(monkeypatch):
 
 BUNDLE_MEMBERS = {
     "metrics.json", "metrics.prom", "traces.json", "memory.json",
-    "compute.json", "health.json", "incidents.json", "logs.txt",
-    "hardware.json", "config.json"}
+    "compute.json", "health.json", "incidents.json", "actions.json",
+    "timeseries.json", "logs.txt", "hardware.json", "config.json"}
 
 
 def _unpack(data: bytes) -> dict:
@@ -490,6 +490,9 @@ def test_bundle_contains_all_pillars_and_redacts_secrets(monkeypatch):
     incidents = json.loads(members["incidents.json"])
     assert incidents and incidents[0]["rule"] == "compute_recompile_storm"
     assert incidents[0]["context"] is not None
+    assert isinstance(json.loads(members["actions.json"]), list)
+    ts = json.loads(members["timeseries.json"])
+    assert "stats" in ts and "series" in ts
     cfg = json.loads(members["config.json"])
     assert cfg["H2O3TPU_ADMIN_PASSWORD"] == "[redacted]"
     assert cfg["H2O3TPU_LDAP_TOKEN"] == "[redacted]"

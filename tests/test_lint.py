@@ -876,6 +876,53 @@ def test_act001_suppressible(tmp_path):
     assert "ACT001" not in rules_of(run_lint(pkg))
 
 
+# -- metric cardinality (CRD) ------------------------------------------------
+
+def test_crd001_unbounded_label_values_flagged(tmp_path):
+    pkg = make_pkg(tmp_path, {"serving/meter.py": """
+        def score(model_key, dest_path, m):
+            m.WINDOW.labels(model=model_key).set(1.0)
+            m.WRITES.labels(file=dest_path).inc()
+            m.HITS.labels(user=f"tenant:{raw_user}").inc()
+    """})
+    crd = [f for f in run_lint(pkg) if f.rule == "CRD001"]
+    assert {f.detail for f in crd} == {
+        "unbounded-label:model=model_key",
+        "unbounded-label:file=dest_path",
+        "unbounded-label:user=raw_user"}
+
+
+def test_crd001_bounded_and_sanitized_values_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {"serving/meter.py": """
+        def record(m, kind, outcome, tenant_raw):
+            m.SPILLS.labels(kind=kind).inc()              # closed-set var
+            m.REQS.labels(route="/3/Score", outcome=outcome).inc()
+            # sanitizer-shaped call: the bounded-label helper fix shape
+            m.TENANTS.labels(tenant=tenant_label(tenant_raw)).inc()
+            m.SHEDS.labels(reason=bounded_bucket(reason_key)).inc()
+    """})
+    assert "CRD001" not in rules_of(run_lint(pkg))
+
+
+def test_crd001_vec_labels_accessor_never_matches(tmp_path):
+    # Frame/Vec categorical accessors are argument-free .labels() calls —
+    # only keyword-form metric calls are examined
+    pkg = make_pkg(tmp_path, {"frame/utils.py": """
+        def decode(v, frame_key):
+            vals = v.labels()
+            return vals, frame_key
+    """})
+    assert "CRD001" not in rules_of(run_lint(pkg))
+
+
+def test_crd001_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"serving/meter.py": """
+        def record(m, model_key):
+            m.WINDOW.labels(model=model_key).set(1.0)  # graftlint: ok(LRU-bounded residency)
+    """})
+    assert "CRD001" not in rules_of(run_lint(pkg))
+
+
 # -- profiling attribution (PRF) ---------------------------------------------
 
 def test_prf001_anonymous_jit_flagged(tmp_path):
