@@ -16,6 +16,7 @@ import traceback
 import uuid
 from typing import Any, Callable
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import tracing as _tracing
 from h2o3_tpu.utils.registry import DKV
 
@@ -76,7 +77,7 @@ class Job:
         # writes status/progress/result while REST handler threads serialize
         # the job (schemas.job_v3 polls) — unlocked multi-field transitions
         # let a poller observe DONE with a stale result/progress
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("models.job.Job._lock")
         self._cancel_requested = threading.Event()
         self._partial_accepted = False
         self._done = threading.Event()

@@ -27,10 +27,10 @@ like the health evaluator's (utils/health.py).
 from __future__ import annotations
 
 import os
-import threading
 import time
 import uuid
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 #: every recorded action, by rule, action class, and outcome
@@ -102,7 +102,7 @@ def _quotas():
 #: Cleaner budget when remediation first raised it — the ceiling anchor.
 #: Keyed by id(cleaner) so a test's private Cleaner gets its own anchor.
 _CLEANER_BASE: dict[int, int] = {}
-_CLEANER_BASE_LOCK = threading.Lock()
+_CLEANER_BASE_LOCK = lockwitness.lock("ops_plane.actions._CLEANER_BASE_LOCK")
 
 
 class _ActionResult:
@@ -249,7 +249,7 @@ class ActionLog:
     outcome, and a rollback token when the action is reversible."""
 
     def __init__(self, capacity: int = LOG_CAPACITY):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("ops_plane.actions.ActionLog._lock")
         self._capacity = capacity
         self._records: list[dict] = []
         self._rollbacks: dict[str, object] = {}   # action id -> thunk

@@ -26,10 +26,10 @@ episode, one action, until the incident resolves and re-opens.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 from h2o3_tpu.ops_plane.actions import ACTIONS, ACTIONS_TOTAL
+from h2o3_tpu.utils import lockwitness
 
 #: health rule -> action class (actions.CATALOG names the functions)
 POLICY: dict = {
@@ -67,7 +67,7 @@ class RemediationEngine:
 
     def __init__(self, actions=None):
         self.actions = actions if actions is not None else ACTIONS
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("ops_plane.remediate.RemediationEngine._lock")
         self._last_action: dict[str, float] = {}    # rule -> monotonic
         self._installed_on: list = []
 

@@ -31,9 +31,9 @@ import contextlib
 import contextvars
 import os
 import re
-import threading
 import time
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 DEFAULT_TENANT = "default"
@@ -117,7 +117,7 @@ class QuotaManager:
     """Per-tenant budgets + usage ledgers (singleton :data:`QUOTAS`)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("ops_plane.tenancy.QuotaManager._lock")
         # tenant -> {"qps": float|None, "device_seconds": float|None,
         #            "bytes": int|None}
         self._quotas: dict[str, dict] = {}

@@ -27,11 +27,11 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
-import threading
 import time
 
 from h2o3_tpu.parallel.mesh import (bind_mesh, get_mesh, mesh_device_ids,
                                     slice_meshes)
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.tracing import TRACER
 
@@ -79,7 +79,8 @@ class _SliceStats:
     view (schedulers are per-run; the view must outlive them)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock(
+            "orchestration.scheduler._SliceStats._lock")
         self._layout: list[dict] = []
         self._per: dict[str, dict] = {}
         self._full_devices: list | None = None
@@ -184,10 +185,12 @@ class _LeaseState:
     """
 
     _registry: dict[tuple, "_LeaseState"] = {}
-    _registry_lock = threading.Lock()
+    _registry_lock = lockwitness.lock(
+        "orchestration.scheduler._LeaseState._registry_lock")
 
     def __init__(self, n: int):
-        self.cv = threading.Condition()
+        self.cv = lockwitness.condition(
+            "orchestration.scheduler._LeaseState.cv")
         self.free = list(range(n))
         self.big_waiting = 0
         self.n = n

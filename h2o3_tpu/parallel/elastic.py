@@ -57,6 +57,7 @@ import time
 import uuid
 import weakref
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils import timeline as _tl
 from h2o3_tpu.utils.tracing import TRACER
@@ -118,7 +119,7 @@ class _ElasticStats:
     _MAX_GROUPS = 8
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("parallel.elastic._ElasticStats._lock")
         self._groups: "dict[str, list[dict]]" = {}
         self._order: list[str] = []
 
@@ -253,7 +254,8 @@ class ElasticGroup:
         self.round_deadline_secs = (
             round_deadline_secs if round_deadline_secs is not None
             else env_deadline)
-        self._cond = threading.Condition()
+        self._cond = lockwitness.condition(
+            "parallel.elastic.ElasticGroup._cond")
         self._workers = {w: _Worker(w) for w in range(self.n)}
         if shards:
             for wid, sids in shards.items():

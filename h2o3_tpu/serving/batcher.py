@@ -44,6 +44,7 @@ import numpy as np
 
 from h2o3_tpu.serving.scorer import MAX_BUCKET
 from h2o3_tpu.serving.slo import window_s_from_env
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils import tracing as _tr
 
@@ -102,7 +103,8 @@ class ModelBatcher:
         self._replica = replica
         label = f"score-{entry.key}" if replica is None \
             else f"score-{entry.key}@{replica.label}"
-        self._cond = threading.Condition()
+        self._cond = lockwitness.condition(
+            "serving.batcher.ModelBatcher._cond")
         self._queue: list[_Pending] = []
         self._stopped = False
         self._dispatching = False    # a drained batch is on the device

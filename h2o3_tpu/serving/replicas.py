@@ -36,6 +36,7 @@ import threading
 import time
 
 from h2o3_tpu.serving.scorer import MAX_BUCKET, MIN_BUCKET, ScorerCache
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 #: seconds between scale decisions — the pool must not thrash a lease
@@ -100,7 +101,7 @@ class ScoringReplica:
         self.devices: tuple = ()
         self.slice_label: str | None = None
         self._batchers: dict[str, object] = {}     # model key -> ModelBatcher
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("serving.replicas.ScoringReplica._lock")
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._lease_error: BaseException | None = None
@@ -310,7 +311,7 @@ class ReplicaPool:
             n = min(n, scheduler.n)
         self.min_replicas = 1
         self.max_replicas = max(cap, 1)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("serving.replicas.ReplicaPool._lock")
         self._next_rid = 0
         self._shutdown = False
         self._replicas: list[ScoringReplica] = []

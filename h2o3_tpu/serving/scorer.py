@@ -21,13 +21,13 @@ still correct, just not fused into one executable.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 import jax
 import numpy as np
 
 from h2o3_tpu.serving.schema import ServingSchema
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.costs import COSTS, cost_of
 
@@ -120,7 +120,7 @@ class ScorerCache:
     per-model grouping (evicting a model drops all its signatures)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("serving.scorer.ScorerCache._lock")
         # (model_token, n_num, n_cat, dtype, bucket) -> CompiledScorer
         self._entries: dict[tuple, CompiledScorer] = {}
         self.hits = 0

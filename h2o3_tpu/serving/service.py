@@ -33,13 +33,13 @@ ReplicaPool` of slice-leased replicas instead of one in-process seat.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 from h2o3_tpu.serving.batcher import Evicted, ModelBatcher
 from h2o3_tpu.serving.schema import NotServable, serving_schema
 from h2o3_tpu.serving.scorer import ScorerCache
 from h2o3_tpu.serving.slo import SLOController, Shed, clamp_priority
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.memory import MEMORY, value_kind_bytes
 from h2o3_tpu.utils.registry import DKV
@@ -121,7 +121,7 @@ class ScoringService:
         #: residency budget in artifact bytes; None = unlimited (no eviction)
         self.budget_bytes = budget_bytes if budget_bytes is not None else (
             int(env) if env else None)
-        self._lock = threading.RLock()
+        self._lock = lockwitness.rlock("serving.service.ScoringService._lock")
         self._resident: dict[str, _Resident] = {}
         self.cache = ScorerCache()
         self.evictions = 0
@@ -353,9 +353,14 @@ class ScoringService:
 
     def _admit(self, model_key: str) -> _Resident:
         self._ensure_pool()
+        # DKV.get can fault a spilled model in from disk — a full snapshot
+        # load plus device transfer — so it must run BEFORE the service
+        # lock, or every warm-path scorer of every other model stalls
+        # behind one cold fault-in (DLK002)
+        current = DKV.get(model_key)
         with self._lock:
             entry = self._resident.get(model_key)
-            if entry is not None and entry.model is DKV.get(model_key):
+            if entry is not None and entry.model is current:
                 entry.last_used = time.monotonic()
                 entry.requests += 1
                 return entry
@@ -364,7 +369,9 @@ class ScoringService:
         # of other models never stall behind an admission (same reason
         # ScorerCache compiles outside its lock); re-checked under the lock
         # below since a concurrent admit may have won
-        model = DKV[model_key]         # KeyError → 404 upstream
+        model = current
+        if model is None:
+            model = DKV[model_key]     # KeyError → 404 upstream
         if not hasattr(model, "_score_raw"):
             raise NotServable(f"{model_key!r} is not a scorable model")
         incoming = value_kind_bytes(model)[1]

@@ -40,7 +40,8 @@ from __future__ import annotations
 
 import math
 import os
-import threading
+
+from h2o3_tpu.utils import lockwitness
 
 #: priority scale: 0 (shed first) .. 9 (effectively never shed)
 MIN_PRIORITY, MAX_PRIORITY, DEFAULT_PRIORITY = 0, 9, 5
@@ -106,7 +107,7 @@ class LatencyRing:
         self._buf: list[float] = [0.0] * self._size
         self._next = 0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("serving.slo.LatencyRing._lock")
 
     def record(self, latency_s: float) -> None:
         v = float(latency_s)
@@ -155,7 +156,7 @@ class SLOController:
             max_bucket = MAX_BUCKET
         self.base_window_s = float(base_window_s)
         self.max_bucket = int(max_bucket)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("serving.slo.SLOController._lock")
         self._slo_ms = float(slo_ms) if slo_ms else None
         self._window = self.base_window_s
         self._ring = LatencyRing()

@@ -6,8 +6,12 @@ import pulls in the ``h2o3_tpu`` package it ships inside.
 Usage::
 
     python -m h2o3_tpu.tools.lint            # human output, repo baseline
-    python -m h2o3_tpu.tools.lint --json     # machine output
+    python -m h2o3_tpu.tools.lint --json     # machine output (+ per-family
+                                             # wall time under "timings")
+    python -m h2o3_tpu.tools.lint --rules DLK,LCK   # family filter
+    python -m h2o3_tpu.tools.lint --graph    # lock-order graph as DOT
     python -m h2o3_tpu.tools.lint --update-baseline
+    python -m h2o3_tpu.tools.lint --prune-baseline
     python -m h2o3_tpu.tools.lint path/to/pkg --no-baseline
 
 Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
@@ -17,7 +21,11 @@ The baseline (``h2o3_tpu/tools/baseline.json``) holds fingerprint counts
 of accepted pre-existing findings: they print as warnings and do not fail
 the run, so the analyzer can land before every legacy site is fixed while
 still failing on *new* violations. Fingerprints carry no line numbers, so
-unrelated edits don't churn the file.
+unrelated edits don't churn the file. The optional ``reasons`` map pins a
+documented justification to a fingerprint (required for DLK entries — a
+baselined deadlock finding without a written invariant is just a silenced
+deadlock); ``--prune-baseline`` drops entries (and their reasons) that no
+longer match any current finding.
 """
 
 from __future__ import annotations
@@ -26,26 +34,42 @@ import argparse
 import collections
 import json
 import sys
+import time
 from pathlib import Path
 
-from h2o3_tpu.tools import (acts, cardinality, envs, ingest, locks, mem,
-                            meshes, metrics, profiles, rest, retry, sync,
-                            tracer, waits)
+from h2o3_tpu.tools import (acts, cardinality, envs, ingest, lockorder,
+                            locks, mem, meshes, metrics, profiles, rest,
+                            retry, sync, tracer, waits)
 from h2o3_tpu.tools.core import Finding, PackageIndex
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
+#: rule-family registry: prefix -> checker module (order = report order).
+FAMILIES: tuple[tuple[str, object], ...] = (
+    ("TRC", tracer), ("LCK", locks), ("RST", rest), ("MEM", mem),
+    ("SYN", sync), ("RTY", retry), ("MSH", meshes), ("PRF", profiles),
+    ("WTX", waits), ("ENV", envs), ("ING", ingest), ("MTR", metrics),
+    ("ACT", acts), ("CRD", cardinality), ("DLK", lockorder),
+)
 
-def run_lint(root: Path) -> list[Finding]:
+FAMILY_NAMES = tuple(name for name, _ in FAMILIES)
+
+
+def run_lint(root: Path, families: tuple[str, ...] | None = None,
+             timings: dict[str, float] | None = None) -> list[Finding]:
     """All non-suppressed findings for the package at ``root``, in stable
-    (path, line, rule) order."""
+    (path, line, rule) order. ``families`` restricts to the given rule
+    prefixes; ``timings`` (if given) is filled with per-family wall
+    seconds so slow families are attributable."""
     index = PackageIndex.scan(Path(root))
-    findings = (tracer.check(index) + locks.check(index) + rest.check(index)
-                + mem.check(index) + sync.check(index) + retry.check(index)
-                + meshes.check(index) + profiles.check(index)
-                + waits.check(index) + envs.check(index)
-                + ingest.check(index) + metrics.check(index)
-                + acts.check(index) + cardinality.check(index))
+    findings: list[Finding] = []
+    for name, checker in FAMILIES:
+        if families is not None and name not in families:
+            continue
+        t0 = time.perf_counter()
+        findings += checker.check(index)
+        if timings is not None:
+            timings[name] = time.perf_counter() - t0
     out = []
     for f in findings:
         mod = next((m for m in index.modules.values() if m.path == f.path),
@@ -66,12 +90,27 @@ def load_baseline(path: Path) -> dict[str, int]:
     return {str(k): int(v) for k, v in data.get("fingerprints", {}).items()}
 
 
-def save_baseline(path: Path, findings: list[Finding]) -> None:
+def load_reasons(path: Path) -> dict[str, str]:
+    """Documented justifications per baselined fingerprint."""
+    if not Path(path).exists():
+        return {}
+    data = json.loads(Path(path).read_text())
+    return {str(k): str(v) for k, v in data.get("reasons", {}).items()}
+
+
+def save_baseline(path: Path, findings: list[Finding],
+                  reasons: dict[str, str] | None = None) -> None:
+    """Write fingerprint counts; ``reasons`` defaults to the existing
+    file's reasons, pruned to fingerprints that still exist."""
     counts = collections.Counter(f.fingerprint for f in findings)
+    if reasons is None:
+        reasons = load_reasons(path)
     doc = {
         "comment": "graftlint accepted pre-existing findings; regenerate "
                    "with `python -m h2o3_tpu.tools.lint --update-baseline`",
         "fingerprints": dict(sorted(counts.items())),
+        "reasons": {k: v for k, v in sorted(reasons.items())
+                    if k in counts},
     }
     Path(path).write_text(json.dumps(doc, indent=1) + "\n")
 
@@ -92,25 +131,67 @@ def split_findings(findings: list[Finding], baseline: dict[str, int]
     return new, old
 
 
+def stale_entries(baseline: dict[str, int],
+                  findings: list[Finding]) -> dict[str, int]:
+    """Baseline counts no current finding backs: fingerprints with zero
+    matches, plus the excess where the count exceeds today's occurrences.
+    Non-empty means dead suppressions are accumulating."""
+    current = collections.Counter(f.fingerprint for f in findings)
+    out: dict[str, int] = {}
+    for fp, n in baseline.items():
+        excess = n - current.get(fp, 0)
+        if excess > 0:
+            out[fp] = excess
+    return out
+
+
+def prune_baseline(path: Path, findings: list[Finding]) -> dict[str, int]:
+    """Clamp every baselined count to the current occurrence count and
+    drop fingerprints (and their reasons) with none. Returns what was
+    removed."""
+    baseline = load_baseline(path)
+    reasons = load_reasons(path)
+    current = collections.Counter(f.fingerprint for f in findings)
+    stale = stale_entries(baseline, findings)
+    kept: list[Finding] = []
+    budget = {fp: min(n, current.get(fp, 0)) for fp, n in baseline.items()}
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            kept.append(f)
+    save_baseline(path, kept, reasons)
+    return stale
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.tools.lint",
-        description="graftlint: tracer-safety, lock-discipline, "
+        description="graftlint: tracer-safety, lock-discipline, lock-order, "
                     "REST-surface, memory, sync- and retry-discipline "
                     "analysis for h2o3_tpu")
     ap.add_argument("root", nargs="?", default=None,
                     help="package root to scan (default: the installed "
                          "h2o3_tpu package)")
+    ap.add_argument("--rules", default=None, metavar="FAM[,FAM...]",
+                    help="run only these rule families, e.g. DLK,LCK "
+                         f"(known: {','.join(FAMILY_NAMES)})")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as a JSON document")
+                    help="emit findings as a JSON document (includes "
+                         "per-family wall time under 'timings')")
+    ap.add_argument("--graph", action="store_true",
+                    help="emit the DLK lock-order graph as DOT and exit")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: {DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline: every finding fails the run")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="write the current findings as the new baseline")
+                    help="write the current findings as the new baseline "
+                         "(existing reasons for surviving entries are kept)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline fingerprints no current finding "
+                         "matches (and clamp over-counts)")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else \
@@ -120,12 +201,39 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
 
-    findings = run_lint(root)
+    families: tuple[str, ...] | None = None
+    if args.rules:
+        families = tuple(r.strip().upper() for r in args.rules.split(",")
+                         if r.strip())
+        unknown = [r for r in families if r not in FAMILY_NAMES]
+        if unknown:
+            print(f"graftlint: unknown rule famil"
+                  f"{'y' if len(unknown) == 1 else 'ies'}: "
+                  f"{','.join(unknown)} (known: {','.join(FAMILY_NAMES)})",
+                  file=sys.stderr)
+            return 2
+
+    if args.graph:
+        graph = lockorder.analyze(PackageIndex.scan(root))
+        print(graph.to_dot())
+        return 0
+
+    timings: dict[str, float] = {}
+    findings = run_lint(root, families=families, timings=timings)
 
     if args.update_baseline:
         save_baseline(baseline_path, findings)
         print(f"graftlint: baselined {len(findings)} finding(s) -> "
               f"{baseline_path}")
+        return 0
+
+    if args.prune_baseline:
+        stale = prune_baseline(baseline_path, findings)
+        n = sum(stale.values())
+        print(f"graftlint: pruned {n} stale baseline entr"
+              f"{'y' if n == 1 else 'ies'} -> {baseline_path}")
+        for fp, excess in sorted(stale.items()):
+            print(f"  -{excess} {fp}")
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
@@ -136,6 +244,7 @@ def main(argv: list[str] | None = None) -> int:
             "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
             "baselined": [vars(f) | {"fingerprint": f.fingerprint}
                           for f in old],
+            "timings": {k: round(v, 4) for k, v in timings.items()},
         }, indent=1))
     else:
         for f in old:

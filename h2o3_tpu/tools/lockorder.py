@@ -1,0 +1,1066 @@
+"""graftlint DLK — whole-program lock-order analysis.
+
+The remaining deadlock class after WTX (bounded waits) is inconsistent
+lock *acquisition order* across threads.  This module inventories every
+lock in the package, computes the "acquired-while-held" edge set via
+interprocedural call-graph reachability, and reports:
+
+- **DLK001** — cycle in the lock-order graph (potential deadlock); the
+  finding carries the full cycle path with one evidence site per hop.
+- **DLK002** — blocking operation (``Event.wait``/``Condition.wait`` on a
+  lock other than the one held, blocking ``queue.get``, socket/HTTP
+  calls, ``block_until_ready``/``device_get``, ``time.sleep``) reachable
+  while a lock is held — the lock-held-across-dispatch class.
+- **DLK003** — user-supplied callback/listener invoked while a lock is
+  held: arbitrary user code can re-enter the runtime and acquire in the
+  wrong order, and the stall is unbounded.
+
+Lock identity
+-------------
+Stable, line-number-free, shared with the runtime witness
+(``h2o3_tpu.utils.lockwitness``):
+
+- instance or class-attribute locks: ``<module>.<Class>.<attr>``
+  (e.g. ``utils.cleaner.Cleaner._io_lock``);
+- module-level locks: ``<module>.<NAME>`` (e.g. ``native._LOCK``);
+- a string literal passed to a ``lockwitness`` factory wins outright, so
+  static identity and witnessed identity agree by construction;
+- ``threading.Condition(existing_lock)`` aliases the condition to the
+  underlying lock — acquiring either is one identity.
+
+Like every graftlint family this is pure stdlib AST work; the code under
+analysis is never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+from h2o3_tpu.tools.core import (Finding, FunctionInfo, PackageIndex,
+                                 call_name, dotted_name)
+
+#: attribute/variable names accepted as locks under the naming contract
+#: even when the creation site wasn't seen (mirrors LCK001).
+_LOCKISH = re.compile(r"lock|cond|mutex|_mu$|sem", re.IGNORECASE)
+
+#: collections/parameters holding user-supplied code (DLK003).  The
+#: ``(^|[._])`` boundary keeps e.g. ``admission_base`` from matching
+#: ``on_`` mid-word (``_`` is a word char, so ``\b`` can't do this).
+_CALLBACKISH = re.compile(
+    r"(^|[._])(listeners?|callbacks?|hooks?|subscribers?|observers?"
+    r"|on_[a-z]\w*)", re.IGNORECASE)
+
+#: attribute calls that *manage* a callback collection rather than invoke
+#: user code — ``self._listeners.append(cb)`` is registration, not a call.
+_CB_MGMT = re.compile(r"^(add|remove|register|unregister|set|clear|del"
+                      r"|emit)_|^(append|remove|clear|discard|add|pop"
+                      r"|extend|insert|update|setdefault|get|index|count"
+                      r"|copy|items|keys|values)$", re.IGNORECASE)
+
+#: queue-like receiver names whose blocking ``.get`` stalls the holder
+#: (same contract as WTX).
+_QUEUEISH = re.compile(r"(^|_)(q|queue|inbox|mailbox|work_?items?)$",
+                       re.IGNORECASE)
+
+_SOCKETISH_ATTRS = {"recv", "recv_into", "accept", "sendall", "getresponse"}
+_BLOCKING_TAILS = {"urlopen": "urlopen", "block_until_ready":
+                   "block_until_ready", "device_get": "device_get",
+                   "sleep": "sleep"}
+
+#: method names too common for the unique-owner call-resolution fallback —
+#: they appear constantly on stdlib/third-party objects, so a single
+#: package-local definition is no evidence the call lands there.
+_COMMON_METHODS = {
+    "get", "put", "pop", "append", "add", "update", "items", "keys",
+    "values", "join", "start", "run", "close", "read", "write", "clear",
+    "remove", "copy", "send", "recv", "release", "acquire", "wait",
+    "notify", "notify_all", "flush", "stop", "reset", "submit", "result",
+    "register", "encode", "decode", "strip", "split", "format", "sort",
+    "extend", "insert", "index", "count", "open", "seek", "tell", "exists",
+    "mkdir", "unlink", "lower", "upper", "replace", "match", "search",
+    "group", "setdefault", "discard", "name", "sample", "snapshot",
+}
+
+_FACTORY_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+                  "lock": "lock", "rlock": "rlock", "condition": "condition"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockSite:
+    ident: str      # canonical identity (see module docstring)
+    kind: str       # lock | rlock | condition
+    path: str       # creation-site file (posix relpath)
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    path: str       # evidence: where dst was first seen acquired under src
+    line: int
+    where: str
+    via: str        # "" for a direct nested acquisition, else the callee
+
+
+def _factory_kind(mod, call: ast.Call) -> str | None:
+    """``threading.Lock()`` / ``lockwitness.rlock("...")`` -> kind."""
+    name = call_name(call)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    tail = name.rsplit(".", 1)[-1]
+    kind = _FACTORY_KINDS.get(tail)
+    if kind is None:
+        return None
+    src = mod.imports.get(head, head)
+    if rest:  # dotted: threading.Lock / lockwitness.lock
+        base = src
+    else:     # bare: from threading import Lock / from ..lockwitness import lock
+        base = src.rsplit(".", 1)[0] if "." in src else src
+    if base == "threading" or base.split(".")[-1] == "lockwitness":
+        return kind
+    return None
+
+
+def _literal_name(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _condition_source(call: ast.Call) -> ast.expr | None:
+    """The underlying-lock expression of ``Condition(lock)`` /
+    ``lockwitness.condition(name, lock=...)``, if any."""
+    for kw in call.keywords:
+        if kw.arg == "lock":
+            return kw.value
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    if tail == "Condition" and call.args:
+        return call.args[0]
+    if tail == "condition" and len(call.args) > 1:
+        return call.args[1]
+    return None
+
+
+class LockInventory:
+    """Every lock creation site in the package, with canonical identities."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.locks: dict[str, LockSite] = {}
+        self._attr: dict[tuple[str, str, str], str] = {}    # (mod,cls,attr)
+        self._module: dict[tuple[str, str], str] = {}       # (mod,NAME)
+        self._singletons: dict[tuple[str, str], tuple[str, str]] = {}
+        self._canon: dict[str, str] = {}                    # alias -> canonical
+        # (mod,cls,attr) -> (mod,cls) of the *object* stored there, from
+        # `self.x = PackageClass(...)` or an annotated ctor parameter
+        self._attr_types: dict[tuple[str, str, str], tuple[str, str]] = {}
+        # lowercased class name -> [(mod, cls)] for attr-name type matching
+        self._class_by_lname: dict[str, list[tuple[str, str]]] = {}
+        self._build()
+
+    # -- canonicalisation ----------------------------------------------------
+
+    def canon(self, ident: str) -> str:
+        while ident in self._canon:
+            ident = self._canon[ident]
+        return ident
+
+    def _union(self, keep: str, alias: str) -> None:
+        keep, alias = self.canon(keep), self.canon(alias)
+        if keep != alias:
+            self._canon[alias] = keep
+            self.locks.pop(alias, None)
+
+    # -- construction --------------------------------------------------------
+
+    def _register(self, ident: str, kind: str, path: str, line: int) -> str:
+        ident = self.canon(ident)
+        if ident not in self.locks:
+            self.locks[ident] = LockSite(ident, kind, path, line)
+        return ident
+
+    def _build(self) -> None:
+        deferred: list[tuple] = []  # condition-alias pass after plain locks
+        aliases: list[tuple] = []   # ctor-parameter lock aliases, same idea
+        factory_calls: list[tuple] = []  # NAME = SINGLETON.method(...) sites
+        for mname, mod in self.index.modules.items():
+            for cname in mod.classes:
+                self._class_by_lname.setdefault(
+                    cname.lower(), []).append((mname, cname))
+        for mod in self.index.modules.values():
+            for stmt in mod.tree.body:
+                tgt, val = _simple_assign(stmt)
+                if tgt is None or not isinstance(val, ast.Call):
+                    continue
+                kind = _factory_kind(mod, val)
+                if kind:
+                    ident = _literal_name(val) or f"{mod.name}.{tgt}"
+                    self._module[(mod.name, tgt)] = self._register(
+                        ident, kind, mod.path, stmt.lineno)
+                    continue
+                cls = self._resolve_class(mod, call_name(val))
+                if cls:
+                    self._singletons[(mod.name, tgt)] = cls
+                    continue
+                factory_calls.append((mod, tgt, val))
+            for stmt in mod.tree.body:
+                if not isinstance(stmt, ast.ClassDef):
+                    continue
+                for sub in stmt.body:
+                    tgt, val = _simple_assign(sub)
+                    if tgt is None or not isinstance(val, ast.Call):
+                        continue
+                    kind = _factory_kind(mod, val)
+                    if kind:
+                        ident = _literal_name(val) or \
+                            f"{mod.name}.{stmt.name}.{tgt}"
+                        self._attr[(mod.name, stmt.name, tgt)] = \
+                            self._register(ident, kind, mod.path, sub.lineno)
+        # METRICS.counter("...") and friends, after every plain singleton
+        # is known: a module-level name built by a factory *method* is a
+        # singleton of whatever package class that method constructs
+        # (one-hop return-type inference, `return self._helper(...)`
+        # chains included)
+        for mod, tgt, val in factory_calls:
+            cls = self._factory_method_class(mod, val)
+            if cls:
+                self._singletons[(mod.name, tgt)] = cls
+        # self.X = <factory>() inside methods, in source order so a
+        # Condition(self._mu) alias sees the earlier _mu registration
+        for key in sorted(self.index.functions):
+            fn = self.index.functions[key]
+            if not fn.class_name:
+                continue
+            mod = fn.module
+            params = {a.arg: a.annotation for a in _all_args(fn.node)}
+            for node in ast.walk(fn.node):
+                tgt, val = _self_attr_assign(node)
+                if tgt is None:
+                    continue
+                akey = (mod.name, fn.class_name, tgt)
+                if isinstance(val, ast.Call):
+                    kind = _factory_kind(mod, val)
+                    if kind:
+                        ident = _literal_name(val) or \
+                            f"{mod.name}.{fn.class_name}.{tgt}"
+                        self._attr[akey] = self._register(
+                            ident, kind, mod.path, node.lineno)
+                        src = _condition_source(val)
+                        if src is not None:
+                            deferred.append((akey, mod, fn.class_name, src))
+                        continue
+                    # self.x = PackageClass(...): remember the attr's type
+                    owner = self._resolve_class(mod, call_name(val))
+                    if owner:
+                        self._attr_types[akey] = owner
+                    continue
+                # self.x = param (annotated): attr type from the annotation
+                if isinstance(val, ast.Name) and val.id in params:
+                    owner = self._annotation_class(mod, params[val.id])
+                    if owner:
+                        self._attr_types[akey] = owner
+                    continue
+                # self._lock = registry._lock (annotated ctor param): the
+                # attr ALIASES the other object's lock — one identity
+                if isinstance(val, ast.Attribute) \
+                        and isinstance(val.value, ast.Name) \
+                        and val.value.id in params:
+                    owner = self._annotation_class(
+                        mod, params[val.value.id])
+                    if owner:
+                        aliases.append((akey, owner + (val.attr,)))
+        for akey, mod, cls, src in deferred:
+            under = dotted_name(src)
+            if under and under.startswith("self."):
+                ukey = (mod.name, cls, under[5:])
+                uid = self._attr.get(ukey)
+                if uid:
+                    # condition wraps an existing lock: one identity, the
+                    # condition's name wins (it is the acquisition surface)
+                    self._union(self._attr[akey], uid)
+                    self._attr[ukey] = self.canon(self._attr[akey])
+        for akey, ukey in aliases:
+            uid = self._attr.get(ukey)
+            if uid and akey not in self._attr:
+                self._attr[akey] = self.canon(uid)
+
+    def _resolve_class(self, mod, name: str | None
+                       ) -> tuple[str, str] | None:
+        """Class name (possibly imported) -> (defining module, class)."""
+        if not name or "." in name:
+            return None
+        if name in mod.classes:
+            return (mod.name, name)
+        src = mod.imports.get(name)
+        if not src:
+            return None
+        mod_part, _, cls = src.rpartition(".")
+        for mname, m in self.index.modules.items():
+            if mname == mod_part or mod_part.endswith("." + mname):
+                if cls in m.classes:
+                    return (mname, cls)
+        return None
+
+    def _annotation_class(self, mod, ann) -> tuple[str, str] | None:
+        """A parameter annotation -> package class, accepting the quoted
+        forward-reference form (``registry: "MetricsRegistry"``)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip().rsplit(".", 1)[-1]
+        else:
+            name = dotted_name(ann)
+            if name:
+                name = name.rsplit(".", 1)[-1]
+        return self._resolve_class(mod, name)
+
+    def _factory_method_class(self, mod, call: ast.Call
+                              ) -> tuple[str, str] | None:
+        """``SINGLETON.method(...)`` -> the package class that method's
+        ``return`` statements construct, if unambiguous."""
+        name = call_name(call)
+        if name is None or "." not in name:
+            return None
+        head, _, meth = name.rpartition(".")
+        if "." in head:
+            return None
+        owner = self.resolve_singleton(mod, head)
+        if owner is None:
+            return None
+        return self._returned_class(owner[0], owner[1], meth)
+
+    def _returned_class(self, mname: str, cname: str, meth: str,
+                        depth: int = 3) -> tuple[str, str] | None:
+        """The unique package class a method returns instances of,
+        following ``return self._helper(...)`` one class-local hop at a
+        time (bounded)."""
+        if depth == 0:
+            return None
+        mod = self.index.modules.get(mname)
+        qual = mod.classes.get(cname, {}).get(meth) if mod else None
+        fn = self.index.functions.get(f"{mname}::{qual}") if qual else None
+        if fn is None:
+            return None
+        local_ctor: dict[str, tuple[str, str]] = {}
+        for node in ast.walk(fn.node):
+            tgt, val = _simple_assign(node)
+            if tgt and isinstance(val, ast.Call):
+                hit = self._resolve_class(mod, call_name(val))
+                if hit:
+                    local_ctor[tgt] = hit
+        found: set[tuple[str, str]] = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            hit = None
+            if isinstance(node.value, ast.Name):
+                hit = local_ctor.get(node.value.id)  # fam = _Family(...)
+            elif isinstance(node.value, ast.Call):
+                name = call_name(node.value)
+                hit = self._resolve_class(mod, name)
+                if hit is None and name and name.startswith("self.") \
+                        and "." not in name[5:]:
+                    hit = self._returned_class(mname, cname, name[5:],
+                                               depth - 1)
+            if hit:
+                found.add(hit)
+        return found.pop() if len(found) == 1 else None
+
+    # -- use-site resolution -------------------------------------------------
+
+    def resolve_module(self, mod, alias: str) -> str | None:
+        """An imported-module alias (``_tm``) -> scanned module name."""
+        src = mod.imports.get(alias)
+        if not src:
+            return None
+        for mname in self.index.modules:
+            if mname == src or src.endswith("." + mname):
+                return mname
+        return None
+
+    def resolve_attr_type(self, fn: FunctionInfo,
+                          attr: str) -> tuple[str, str] | None:
+        """The package class stored in ``self.<attr>``: a recorded
+        assignment type if one was seen, else the attr name itself names
+        exactly one package class (``self._job`` -> ``Job``)."""
+        if fn.class_name:
+            hit = self._attr_types.get(
+                (fn.module.name, fn.class_name, attr))
+            if hit:
+                return hit
+        owners = self._class_by_lname.get(
+            attr.strip("_").replace("_", "").lower(), [])
+        return owners[0] if len(owners) == 1 else None
+
+    def resolve_singleton(self, mod, name: str) -> tuple[str, str] | None:
+        hit = self._singletons.get((mod.name, name))
+        if hit:
+            return hit
+        src = mod.imports.get(name)
+        if not src:
+            return None
+        mod_part, _, obj = src.rpartition(".")
+        for mname in self.index.modules:
+            if mname == mod_part or mod_part.endswith("." + mname):
+                return self._singletons.get((mname, obj))
+        return None
+
+    def resolve_lock_expr(self, fn: FunctionInfo,
+                          expr: ast.expr) -> str | None:
+        """A ``with``-item / ``.acquire()`` receiver -> canonical identity."""
+        mod = fn.module
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        if "." not in name:
+            ident = self._module.get((mod.name, name))
+            if ident:
+                return self.canon(ident)
+            src = mod.imports.get(name)
+            if src:
+                mod_part, _, nm = src.rpartition(".")
+                for mname in self.index.modules:
+                    if mname == mod_part or mod_part.endswith("." + mname):
+                        ident = self._module.get((mname, nm))
+                        if ident:
+                            return self.canon(ident)
+            if _LOCKISH.search(name):
+                return self._register(f"{mod.name}.{name}", "lock",
+                                      mod.path, expr.lineno)
+            return None
+        head, _, rest = name.partition(".")
+        if head == "self" and fn.class_name and "." not in rest:
+            ident = self._attr.get((mod.name, fn.class_name, rest))
+            if ident:
+                return self.canon(ident)
+            if _LOCKISH.search(rest):
+                return self._register(
+                    f"{mod.name}.{fn.class_name}.{rest}", "lock",
+                    mod.path, expr.lineno)
+            return None
+        if head == "self" and fn.class_name and rest.count(".") == 1:
+            # with self._job._lock: — another object's lock, typed via the
+            # attr's recorded assignment or its name matching one class
+            attr, _, sub = rest.partition(".")
+            owner = self.resolve_attr_type(fn, attr)
+            if owner:
+                ident = self._attr.get(owner + (sub,))
+                if ident:
+                    return self.canon(ident)
+            return None
+        # SINGLETON._lock (e.g. DKV._lock from another module)
+        if "." not in rest:
+            owner = self.resolve_singleton(mod, head)
+            if owner:
+                dmod, dcls = owner
+                ident = self._attr.get((dmod, dcls, rest))
+                if ident:
+                    return self.canon(ident)
+                if _LOCKISH.search(rest):
+                    site = self.index.modules[dmod]
+                    return self._register(f"{dmod}.{dcls}.{rest}", "lock",
+                                          site.path, expr.lineno)
+        return None
+
+
+def _simple_assign(stmt) -> tuple[str | None, ast.expr | None]:
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name):
+        return stmt.targets[0].id, stmt.value
+    if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name) \
+            and stmt.value is not None:
+        return stmt.target.id, stmt.value
+    return None, None
+
+
+def _self_attr_assign(node) -> tuple[str | None, ast.expr | None]:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        t = node.targets[0]
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr, node.value
+    return None, None
+
+
+# -- per-function walk -------------------------------------------------------
+
+@dataclasses.dataclass
+class _CallSite:
+    caller: str          # mod::qual
+    target: str          # mod::qual
+    held: tuple[str, ...]
+    line: int
+    via: str             # rendered callee name for messages
+
+
+@dataclasses.dataclass
+class _BlockingOp:
+    slug: str
+    line: int
+    local_exempt: bool   # cond.wait on a lock held *locally* (legal pattern)
+    held: str | None     # innermost lock held at the op, if any
+
+
+class _FunctionFacts:
+    """Everything DLK needs from one function body."""
+
+    def __init__(self) -> None:
+        self.acquires: list[tuple[str, int]] = []
+        self.edges: list[Edge] = []
+        self.blocking: list[_BlockingOp] = []
+        self.callsites: list[_CallSite] = []
+        self.callbacks: list[tuple[str, str, int]] = []  # (held, desc, line)
+        self.yield_held: set[str] = set()
+        self.return_calls: list[str] = []  # resolved targets of `return f()`
+
+
+class LockOrderGraph:
+    """Static lock-order graph for one scanned package."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.inventory = LockInventory(index)
+        self.facts: dict[str, _FunctionFacts] = {}
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self._reach_acq: dict[str, set[str]] = {}
+        self._reach_blk: dict[str, dict[str, tuple[str, int, str]]] = {}
+        self._dlk_edges: dict[str, set[str]] = {}
+        self._yield_memo: dict[str, set[str]] = {}
+        self._build()
+
+    # -- call resolution (superset of PackageIndex.resolve_call) -------------
+
+    def _method_owners(self) -> dict[str, list[str]]:
+        owners: dict[str, list[str]] = {}
+        for key, fn in self.index.functions.items():
+            if fn.class_name and not fn.parent:
+                owners.setdefault(fn.node.name, []).append(key)
+        return owners
+
+    def _resolve_call(self, fn: FunctionInfo, call: ast.Call,
+                      key: str) -> str | None:
+        tgt = self.index.resolve_call(fn, call)
+        if tgt:
+            return tgt
+        name = call_name(call)
+        if name is None or "." not in name:
+            return None
+        head, _, rest = name.partition(".")
+        owner = None
+        if head != "self" and "." not in rest:
+            owner = self.inventory.resolve_singleton(fn.module, head)
+            meth = rest
+        elif rest.count(".") == 1:
+            mid, _, meth = rest.partition(".")
+            if head == "self":
+                # self._job.cancel() — receiver typed via the attr
+                owner = self.inventory.resolve_attr_type(fn, mid)
+            else:
+                # _tm.DKV_PUTS.inc() — singleton through a module alias
+                mname = self.inventory.resolve_module(fn.module, head)
+                if mname:
+                    owner = self.inventory._singletons.get((mname, mid))
+        if owner:
+            dmod, dcls = owner
+            qual = self.index.modules[dmod].classes.get(dcls, {}).get(meth)
+            if qual:
+                return f"{dmod}::{qual}"
+        # unique-owner fallback: obj.meth() where exactly one class in the
+        # package defines meth and the name isn't ubiquitous — keeps the
+        # static graph a superset of what the runtime witness can observe
+        meth = name.rsplit(".", 1)[-1]
+        if meth not in _COMMON_METHODS:
+            owners = self._owners.get(meth, [])
+            if len(owners) == 1 and owners[0] != key:
+                return owners[0]
+        return None
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        self._owners = self._method_owners()
+        for key in sorted(self.index.functions):
+            self.facts[key] = self._walk_function(key)
+        self._dlk_edges = {
+            key: {cs.target for cs in f.callsites}
+            for key, f in self.facts.items()
+        }
+        self._close_summaries()
+        self._add_interprocedural_edges()
+
+    def _walk_function(self, key: str) -> _FunctionFacts:
+        fn = self.index.functions[key]
+        facts = _FunctionFacts()
+        cbvars: set[str] = {
+            a.arg for a in _all_args(fn.node) if _CALLBACKISH.search(a.arg)}
+        held: list[str] = []
+
+        def emit_acquire(ident: str, line: int) -> None:
+            facts.acquires.append((ident, line))
+            if ident in held:
+                return  # reentrant (RLock) — no ordering edge
+            for h in held:
+                facts.edges.append(Edge(h, ident, fn.module.path, line,
+                                        fn.qualname, ""))
+
+        def classify_call(call: ast.Call) -> None:
+            name = call_name(call)
+            tail = name.rsplit(".", 1)[-1] if name else None
+            # lock method calls
+            if tail in ("acquire", "release") and isinstance(
+                    call.func, ast.Attribute):
+                ident = self.inventory.resolve_lock_expr(fn, call.func.value)
+                if ident:
+                    if tail == "acquire":
+                        emit_acquire(ident, call.lineno)
+                        held.append(ident)
+                    elif ident in held:
+                        held.reverse(); held.remove(ident); held.reverse()
+                    return
+            # blocking operations
+            slug = self._blocking_slug(fn, call, tail)
+            if slug:
+                exempt = False
+                if slug == "cond-wait":
+                    ident = self.inventory.resolve_lock_expr(
+                        fn, call.func.value)
+                    exempt = ident is not None and ident in held
+                facts.blocking.append(
+                    _BlockingOp(slug, call.lineno, exempt,
+                                held[-1] if held else None))
+            # user-supplied callback invocation
+            desc = self._callback_desc(call, cbvars)
+            if desc and held:
+                facts.callbacks.append((held[-1], desc, call.lineno))
+            # package-local call site
+            tgt = self._resolve_call(fn, call, key)
+            if tgt and tgt != key:
+                facts.callsites.append(_CallSite(
+                    key, tgt, tuple(held), call.lineno, name or "?"))
+
+        def visit_expr(node: ast.AST) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    classify_call(sub)
+                elif isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                    facts.yield_held.update(held)
+
+        def walk_block(stmts: list[ast.stmt]) -> None:
+            for st in stmts:
+                walk_stmt(st)
+
+        def walk_stmt(st: ast.stmt) -> None:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                return  # separate unit; reached via the call graph
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                depth = len(held)
+                for item in st.items:
+                    ctx = item.context_expr
+                    ident = None
+                    if isinstance(ctx, (ast.Name, ast.Attribute)):
+                        ident = self.inventory.resolve_lock_expr(fn, ctx)
+                    if ident:
+                        emit_acquire(ident, ctx.lineno)
+                        held.append(ident)
+                    else:
+                        visit_expr(ctx)
+                        if isinstance(ctx, ast.Call):
+                            tgt = self._resolve_call(fn, ctx, key)
+                            if tgt:
+                                for got in self._held_at_yield(tgt):
+                                    if got not in held:
+                                        held.append(got)
+                walk_block(st.body)
+                del held[depth:]
+                return
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                visit_expr(st.iter)
+                for tr in _iter_callback_targets(st):
+                    cbvars.add(tr)
+                walk_block(st.body)
+                walk_block(st.orelse)
+                return
+            if isinstance(st, ast.While):
+                visit_expr(st.test)
+                walk_block(st.body)
+                walk_block(st.orelse)
+                return
+            if isinstance(st, ast.If):
+                visit_expr(st.test)
+                walk_block(st.body)
+                walk_block(st.orelse)
+                return
+            if isinstance(st, ast.Try):
+                walk_block(st.body)
+                for h in st.handlers:
+                    walk_block(h.body)
+                walk_block(st.orelse)
+                walk_block(st.finalbody)
+                return
+            if isinstance(st, ast.Return):
+                if isinstance(st.value, ast.Call):
+                    tgt = self._resolve_call(fn, st.value, key)
+                    if tgt:
+                        facts.return_calls.append(tgt)
+                if st.value is not None:
+                    visit_expr(st.value)
+                return
+            tgt_var = _callbackish_binding(st)
+            if tgt_var:
+                cbvars.add(tgt_var)
+            visit_expr(st)
+
+        walk_block(list(fn.node.body))
+        return facts
+
+    def _blocking_slug(self, fn: FunctionInfo, call: ast.Call,
+                       tail: str | None) -> str | None:
+        if tail is None:
+            return None
+        if tail in ("wait", "wait_for") and isinstance(
+                call.func, ast.Attribute):
+            return "cond-wait"
+        if tail == "get" and isinstance(call.func, ast.Attribute):
+            recv = dotted_name(call.func.value)
+            last = recv.rsplit(".", 1)[-1] if recv else ""
+            if _QUEUEISH.search(last) and not _nonblocking_get(call):
+                return "queue-get"
+        if tail in _SOCKETISH_ATTRS and isinstance(call.func, ast.Attribute):
+            return f"socket-{tail}"
+        return _BLOCKING_TAILS.get(tail)
+
+    def _callback_desc(self, call: ast.Call, cbvars: set[str]) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in cbvars:
+            return f.id
+        if isinstance(f, ast.Subscript):
+            # self._callbacks[name](...) — direct invocation out of a
+            # user-code collection
+            try:
+                src = ast.unparse(f.value)
+            except Exception:  # pragma: no cover - malformed tree
+                return None
+            if _CALLBACKISH.search(src):
+                return src + "[...]"
+        if isinstance(f, ast.Attribute):
+            # self.on_progress(...) — the invoked attribute itself must be
+            # callback-ish; managing a listener list is registration
+            if _CALLBACKISH.search(f.attr) and not _CB_MGMT.search(f.attr):
+                try:
+                    return ast.unparse(f)
+                except Exception:  # pragma: no cover
+                    return f.attr
+        return None
+
+    # -- interprocedural closure ---------------------------------------------
+
+    def _held_at_yield(self, key: str, _seen: frozenset = frozenset()
+                       ) -> set[str]:
+        """Locks held at the yield of a generator contextmanager (what a
+        ``with f():`` body runs under).  Follows ``return g()`` chains."""
+        if key in self._yield_memo:
+            return self._yield_memo[key]
+        if key in _seen or key not in self.facts:
+            return set()
+        facts = self.facts[key]
+        out = set(facts.yield_held)
+        if not out:
+            for tgt in facts.return_calls:
+                out |= self._held_at_yield(tgt, _seen | {key})
+        self._yield_memo[key] = out
+        return out
+
+    def _close_summaries(self) -> None:
+        """Fixpoint transitive closure of per-function acquire/blocking
+        summaries over the package call graph (cycle-safe)."""
+        acq = {k: {i for i, _ in f.acquires} for k, f in self.facts.items()}
+        blk: dict[str, dict[str, tuple[str, int, str]]] = {}
+        for k, f in self.facts.items():
+            mod = self.index.functions[k].module
+            blk[k] = {op.slug: (mod.path, op.line,
+                                self.index.functions[k].qualname)
+                      for op in f.blocking}
+        changed = True
+        while changed:
+            changed = False
+            for k, outs in self._dlk_edges.items():
+                for tgt in outs:
+                    if tgt not in acq:
+                        continue
+                    before = len(acq[k])
+                    acq[k] |= acq[tgt]
+                    if len(acq[k]) != before:
+                        changed = True
+                    for slug, ev in blk[tgt].items():
+                        if slug not in blk[k]:
+                            blk[k][slug] = ev
+                            changed = True
+        self._reach_acq = acq
+        self._reach_blk = blk
+
+    def _add_interprocedural_edges(self) -> None:
+        for key in sorted(self.facts):
+            facts = self.facts[key]
+            for e in facts.edges:
+                self.edges.setdefault((e.src, e.dst), e)
+            for cs in facts.callsites:
+                if not cs.held:
+                    continue
+                mod = self.index.functions[key].module
+                for ident in sorted(self._reach_acq.get(cs.target, ())):
+                    for h in cs.held:
+                        if h == ident:
+                            continue
+                        self.edges.setdefault(
+                            (h, ident),
+                            Edge(h, ident, mod.path, cs.line,
+                                 self.index.functions[key].qualname, cs.via))
+
+    # -- outputs -------------------------------------------------------------
+
+    def edge_pairs(self) -> set[tuple[str, str]]:
+        return set(self.edges)
+
+    def lock_ids(self) -> set[str]:
+        return set(self.inventory.locks)
+
+    def cycles(self) -> list[list[str]]:
+        """Each cycle once, as a canonical node path (smallest node first,
+        closed implicitly: last -> first)."""
+        sccs = _tarjan_sccs(sorted(self.lock_ids() | {
+            n for e in self.edges for n in e}),
+            {a: sorted(b for (x, b) in self.edges if x == a)
+             for a in {s for s, _ in self.edges}})
+        out = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            start = min(scc)
+            path = _cycle_through(start, set(scc), self.edges)
+            if path:
+                out.append(path)
+        out.sort()
+        return out
+
+    def to_dot(self) -> str:
+        cyc_nodes = {n for c in self.cycles() for n in c}
+        lines = ["digraph lockorder {", "  rankdir=LR;",
+                 '  node [shape=box, fontname="monospace"];']
+        for ident in sorted(self.lock_ids() | {
+                n for e in self.edges for n in e}):
+            attrs = f'label="{ident}"'
+            site = self.inventory.locks.get(ident)
+            if site:
+                attrs += f', tooltip="{site.path}:{site.line} ({site.kind})"'
+            if ident in cyc_nodes:
+                attrs += ", color=red, penwidth=2"
+            lines.append(f'  "{ident}" [{attrs}];')
+        for (a, b) in sorted(self.edges):
+            e = self.edges[(a, b)]
+            style = ", color=red" if a in cyc_nodes and b in cyc_nodes else ""
+            lines.append(f'  "{a}" -> "{b}" '
+                         f'[tooltip="{e.path}:{e.line}"{style}];')
+        lines.append("}")
+        return "\n".join(lines)
+
+    # -- findings ------------------------------------------------------------
+
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+        for path in self.cycles():
+            hops = []
+            ring = path + [path[0]]
+            for a, b in zip(ring, ring[1:]):
+                e = self.edges[(a, b)]
+                hops.append(f"{b} ({e.path}:{e.line} in {e.where})")
+            first = self.edges[(path[0], path[1])]
+            out.append(Finding(
+                rule="DLK001", path=first.path, line=first.line,
+                where=first.where,
+                message=("potential deadlock: lock-order cycle "
+                         + " -> ".join([path[0]] + hops)),
+                detail="cycle:" + "->".join(path)))
+        for key in sorted(self.facts):
+            facts = self.facts[key]
+            fn = self.index.functions[key]
+            seen: set[tuple[str, str]] = set()
+
+            def blocked(ident: str, slug: str, line: int, via: str) -> None:
+                if (ident, slug) in seen:
+                    return
+                seen.add((ident, slug))
+                note = f" (via {via})" if via else ""
+                out.append(Finding(
+                    rule="DLK002", path=fn.module.path, line=line,
+                    where=fn.qualname,
+                    message=(f"blocking operation [{slug}] reachable while "
+                             f"holding {ident}{note}: the lock is stalled "
+                             f"for the full wait"),
+                    detail=f"{slug}-under-{ident}"))
+
+            for op in facts.blocking:
+                if op.held and not op.local_exempt:
+                    blocked(op.held, op.slug, op.line, "")
+            for cs in facts.callsites:
+                if not cs.held:
+                    continue
+                for slug, ev in sorted(
+                        self._reach_blk.get(cs.target, {}).items()):
+                    blocked(cs.held[-1], slug, cs.line, cs.via)
+            for ident, desc, line in facts.callbacks:
+                out.append(Finding(
+                    rule="DLK003", path=fn.module.path, line=line,
+                    where=fn.qualname,
+                    message=(f"user-supplied callback `{desc}` invoked while "
+                             f"holding {ident}: user code can re-enter the "
+                             f"runtime and acquire locks in any order — "
+                             f"snapshot under the lock, call outside it"),
+                    detail=f"callback-under-{ident}"))
+        return out
+
+def _nonblocking_get(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    return False
+
+
+def _all_args(fn_node) -> list[ast.arg]:
+    a = fn_node.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs,
+            *( [a.vararg] if a.vararg else []),
+            *( [a.kwarg] if a.kwarg else [])]
+
+
+def _iter_callback_targets(st) -> list[str]:
+    """``for cb in self._listeners:`` -> loop vars bound to user code."""
+    try:
+        src = ast.unparse(st.iter)
+    except Exception:  # pragma: no cover
+        return []
+    if not _CALLBACKISH.search(src):
+        return []
+    tgt = st.target
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, ast.Tuple):
+        return [e.id for e in tgt.elts if isinstance(e, ast.Name)]
+    return []
+
+
+def _callbackish_binding(st) -> str | None:
+    """``cb = self._callbacks[name]`` -> "cb"."""
+    if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+        return None
+    t = st.targets[0]
+    if not isinstance(t, ast.Name):
+        return None
+    if isinstance(st.value, (ast.Subscript, ast.Attribute, ast.Call)):
+        try:
+            src = ast.unparse(st.value)
+        except Exception:  # pragma: no cover
+            return None
+        if _CALLBACKISH.search(src) and not isinstance(st.value, ast.Call):
+            return t.id
+    return None
+
+
+# -- cycle machinery ---------------------------------------------------------
+
+def _tarjan_sccs(nodes: list[str], succ: dict[str, list[str]]
+                 ) -> list[list[str]]:
+    """Iterative Tarjan — the lock graph is tiny but recursion limits are
+    a silly way to die."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(succ.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(succ.get(nxt, ()))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(scc))
+    return sccs
+
+
+def _cycle_through(start: str, scc: set[str],
+                   edges: dict[tuple[str, str], Edge]) -> list[str] | None:
+    """Shortest cycle through ``start`` inside one SCC (BFS)."""
+    succ: dict[str, list[str]] = {}
+    for (a, b) in sorted(edges):
+        if a in scc and b in scc:
+            succ.setdefault(a, []).append(b)
+    best: list[str] | None = None
+    frontier = [[start]]
+    seen = {start}
+    while frontier and best is None:
+        nxt: list[list[str]] = []
+        for path in frontier:
+            for b in succ.get(path[-1], ()):
+                if b == start:
+                    best = path
+                    break
+                if b not in seen:
+                    seen.add(b)
+                    nxt.append(path + [b])
+            if best:
+                break
+        frontier = nxt
+    return best
+
+
+# -- entry points ------------------------------------------------------------
+
+def analyze(index: PackageIndex) -> LockOrderGraph:
+    return LockOrderGraph(index)
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    return analyze(index).findings()

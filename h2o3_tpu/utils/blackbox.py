@@ -48,6 +48,8 @@ import threading
 import time
 import traceback
 
+from h2o3_tpu.utils import lockwitness
+
 _LOG = logging.getLogger("h2o3_tpu")
 
 
@@ -75,12 +77,17 @@ def _member_flight() -> bytes:
 
 def _member_threads() -> bytes:
     """Every live thread's stack — the wedge's smoking gun (which frame
-    is the stalled loop parked in)."""
+    is the stalled loop parked in). When the lock witness is armed, each
+    thread also lists the witnessed locks it currently holds, so a wedge
+    dump shows who holds what without reading the stacks."""
     names = {t.ident: t.name for t in threading.enumerate()}
+    # {} when unarmed: nothing is ever recorded
+    held = lockwitness.WITNESS.held_by_thread()
     out = []
     for ident, frame in sys._current_frames().items():
         out.append({"thread_id": ident,
                     "name": names.get(ident, f"thread-{ident}"),
+                    "held_locks": held.get(ident, []),
                     "stack": traceback.format_stack(frame)})
     return _jsonable(out)
 
@@ -133,7 +140,7 @@ class BlackBox:
     carry their own once-per-instance fire flag and dump directory."""
 
     def __init__(self, dump_dir: "str | None" = None):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.blackbox.BlackBox._lock")
         self._dump_dir = dump_dir
         self._watch: "dict[str, float]" = {}      # name -> expected period
         self._beats: "dict[str, float]" = {}      # name -> last monotonic

@@ -36,10 +36,10 @@ import contextlib
 import os
 import shutil
 import tempfile
-import threading
 import time
 import uuid
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.registry import DKV
 
@@ -99,13 +99,13 @@ class Cleaner:
         # LRU bookkeeping is mutated from every DKV.put/get/remove caller
         # thread; the lock keeps it owned HERE — callers must use touch/
         # forget, never reach into ``_touch`` (graftlint LCK003)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.cleaner.Cleaner._lock")
         self._touch: dict[str, float] = {}
         # serializes spill-side disk I/O against fault-in: a sweep rewriting
         # a key's snapshot while a concurrent ``resolve`` reads it is a torn
         # read (half-written frame.json). Reentrant because a fault-in's own
         # DKV.put re-enters sweep on the same thread.
-        self._io_lock = threading.RLock()
+        self._io_lock = lockwitness.rlock("utils.cleaner.Cleaner._io_lock")
         # spill/restore accounting (served in /3/Memory's ``spill`` view)
         self._spills = 0
         self._spill_bytes = 0
@@ -253,11 +253,11 @@ class Cleaner:
                     # resharded mesh views (Frame.on_mesh) rebuild from
                     # their source columns on next use — spilling one would
                     # write a snapshot nobody ever reloads and leave a stub
-                    # posing as a user frame; just drop it (identity-checked
-                    # so a concurrently re-put key is never collateral)
-                    with DKV._lock:
-                        if DKV._store.get(k) is v:
-                            DKV.remove(k)
+                    # posing as a user frame; just drop it. Identity-checked
+                    # INSIDE remove (only_if): holding DKV._lock around the
+                    # remove here would invert the io->store lock order the
+                    # fault-in path relies on (DLK001)
+                    DKV.remove(k, only_if=v)
                 elif type(v).__name__ == "RawFile":
                     # unique path per spill: a restored key's snapshot is
                     # discarded AFTER install, and a re-spill racing that

@@ -53,6 +53,7 @@ import sys
 import threading
 import time
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 _LOG = logging.getLogger("h2o3_tpu")
@@ -228,7 +229,7 @@ class FlightRecorder:
                  rollup_secs: "float | None" = None,
                  max_series: "int | None" = None):
         self._interval_explicit = interval_s is not None
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.flight.FlightRecorder._lock")
         self.interval_s = (interval_s if interval_s is not None
                            else interval_from_env())
         self._raw_len = raw_len if raw_len is not None else \

@@ -47,6 +47,7 @@ import threading
 import time
 
 from h2o3_tpu.utils import flight as _fl
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 from h2o3_tpu.utils.incidents import INCIDENTS
 
@@ -458,8 +459,11 @@ class HealthEvaluator:
                            else interval_from_env())
         self.rules = list(rules) if rules is not None else default_rules()
         self.incidents = incidents if incidents is not None else INCIDENTS
-        self._lock = threading.Lock()       # verdict + lifecycle state
-        self._eval_lock = threading.Lock()  # one evaluation at a time
+        # verdict + lifecycle state
+        self._lock = lockwitness.lock("utils.health.HealthEvaluator._lock")
+        # one evaluation at a time
+        self._eval_lock = lockwitness.lock(
+            "utils.health.HealthEvaluator._eval_lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._last: dict | None = None

@@ -43,6 +43,7 @@ import threading
 import time
 import uuid
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 #: every incident OPEN, by rule and subsystem (repeats do not re-count)
@@ -146,7 +147,7 @@ class IncidentLog:
     (``GET /3/Incidents`` / ``GET /3/Incidents/{id}``)."""
 
     def __init__(self, capacity: int | None = None):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.incidents.IncidentLog._lock")
         self._capacity = capacity if capacity is not None \
             else ring_size_from_env()
         self._ring: "dict[str, dict]" = {}          # id -> record

@@ -38,10 +38,10 @@ dict write under one lock, and nothing is ever traced into an XLA program.
 from __future__ import annotations
 
 import os
-import threading
 
 import numpy as np
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 #: consecutive sweeps of growth / idleness before a key is flagged
@@ -357,7 +357,7 @@ class MemoryMeter:
     Cleaner advances leak sweeps, and the REST layer serves summaries."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.memory.MemoryMeter._lock")
         # key -> (kind, bytes, host_bytes)
         self._keyed: dict[str, tuple[str, int, int]] = {}
         self._by_kind: dict[str, int] = {}

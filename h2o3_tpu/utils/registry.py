@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
 from typing import Any, Iterator
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import telemetry as _tm
 
 
@@ -28,7 +30,7 @@ def _tenancy():
 
 class KeyedStore:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.rlock("utils.registry.KeyedStore._lock")
         self._store: dict[str, Any] = {}
 
     def put(self, key: str | None, value: Any) -> str | None:
@@ -130,9 +132,18 @@ class KeyedStore:
         MEMORY.note_access(key)
         return self._resolve(key, v)
 
-    def remove(self, key: str) -> Any:
+    def remove(self, key: str, *, only_if: Any = None) -> Any:
+        """Remove ``key``; with ``only_if`` the pop happens only while the
+        store still holds that exact object (identity CAS, atomic under
+        the store lock). Callers that used to spell this as
+        ``with DKV._lock: if DKV._store.get(k) is v: DKV.remove(k)``
+        held the store lock across the remove cascade — which reaches the
+        Cleaner's IO lock, inverting the io->store order every fault-in
+        uses (DLK001)."""
         from h2o3_tpu.utils.memory import MEMORY
         with self._lock:
+            if only_if is not None and self._store.get(key) is not only_if:
+                return None
             v = self._store.pop(key, None)
             n = len(self._store)
             MEMORY.unregister(key)
@@ -240,7 +251,8 @@ class KeyLocks:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._cond = threading.Condition(self._mu)
+        self._cond = lockwitness.condition(
+            "utils.registry.KeyLocks._cond", lock=self._mu)
         # key -> [readers, writer_thread_ident | None, writer_depth]
         self._state: dict[str, list] = {}
 

@@ -29,6 +29,8 @@ import logging
 import math
 import threading
 
+from h2o3_tpu.utils import lockwitness
+
 # Latency buckets (seconds) for request/dispatch histograms: µs-scale
 # dispatches up through slow requests.
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -216,7 +218,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.rlock("utils.telemetry.MetricsRegistry._lock")
         self._families: dict[str, _Family] = {}
 
     def _family(self, name: str, kind: str, help: str, labelnames,

@@ -24,6 +24,7 @@ import threading
 import time
 import traceback
 
+from h2o3_tpu.utils import lockwitness
 from h2o3_tpu.utils import tracing as _tracing
 
 RING_SIZE = 2048   # reference: TimeLine.MAX_EVENTS=2048
@@ -43,7 +44,7 @@ class TimeLine:
         self._events: list[tuple] = [None] * size
         self._idx = 0
         self._epoch = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.timeline.TimeLine._lock")
 
     def record(self, kind: str, what: str, dur_ns: int = 0) -> None:
         with self._lock:
@@ -253,7 +254,7 @@ class FaultInjector:
         self.site_rates = dict(site_rates or {})
         self.worker_rates = dict(worker_rates or {})
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.lock("utils.timeline.FaultInjector._lock")
         # stall gate: held stalls block on this event up to their bound;
         # release_stalls() wakes every held worker early (bounded hold that
         # RELEASES — a stall can never wedge a test past its bound)

@@ -11,8 +11,10 @@ import textwrap
 
 import pytest
 
-from h2o3_tpu.tools.lint import (DEFAULT_BASELINE, load_baseline, main,
-                                 run_lint, save_baseline, split_findings)
+from h2o3_tpu.tools.lint import (DEFAULT_BASELINE, FAMILY_NAMES,
+                                 load_baseline, load_reasons, main,
+                                 run_lint, save_baseline, split_findings,
+                                 stale_entries)
 
 
 def make_pkg(tmp_path, files):
@@ -1143,7 +1145,284 @@ def test_cli_json_output(tmp_path, capsys):
     pkg = make_pkg(tmp_path, {"mod.py": "x = 1\n"})
     assert main([str(pkg), "--json", "--no-baseline"]) == 0
     doc = json.loads(capsys.readouterr().out)
-    assert doc == {"new": [], "baselined": []}
+    assert doc["new"] == [] and doc["baselined"] == []
+    # per-family wall time: one non-negative number per family run
+    assert set(doc["timings"]) == set(FAMILY_NAMES)
+    assert all(isinstance(v, float) and v >= 0
+               for v in doc["timings"].values())
+
+
+def test_cli_rules_filter(tmp_path, capsys):
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = "idle"
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.state = "running"
+    """})
+    # unfiltered: the LCK002 unlocked-shared-state finding is present
+    assert main([str(pkg), "--json", "--no-baseline"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert any(f["rule"].startswith("LCK") for f in doc["new"])
+    # --rules DLK: the LCK family never runs, and only DLK is timed
+    assert main([str(pkg), "--json", "--no-baseline", "--rules", "DLK"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["new"] == []
+    assert set(doc["timings"]) == {"DLK"}
+    # unknown family name is a usage error, not a silent no-op
+    assert main([str(pkg), "--rules", "NOPE", "--no-baseline"]) == 2
+
+
+# -- lock-order analysis (DLK) -----------------------------------------------
+
+def test_dlk001_three_lock_cycle(tmp_path):
+    """A three-lock cycle with one interprocedural hop is detected, and
+    the finding carries the full cycle path (ISSUE 18 acceptance)."""
+    pkg = make_pkg(tmp_path, {"pipe.py": """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._head_lock = threading.Lock()
+                self._mid_lock = threading.Lock()
+                self._tail_lock = threading.Lock()
+
+            def stage_one(self):
+                with self._head_lock:
+                    with self._mid_lock:
+                        pass
+
+            def stage_two(self):
+                with self._mid_lock:
+                    self._finish()
+
+            def _finish(self):
+                with self._tail_lock:
+                    pass
+
+            def stage_three(self):
+                with self._tail_lock:
+                    with self._head_lock:
+                        pass
+    """})
+    findings = run_lint(pkg, families=("DLK",))
+    cyc = [f for f in findings if f.rule == "DLK001"]
+    assert len(cyc) == 1
+    msg = cyc[0].message
+    for ident in ("pipe.Pipeline._head_lock", "pipe.Pipeline._mid_lock",
+                  "pipe.Pipeline._tail_lock"):
+        assert ident in msg
+    assert "->" in msg and "cycle" in msg
+
+
+def test_dlk001_consistent_order_clean(tmp_path):
+    """The same locks nested in one consistent global order are not a
+    cycle — order discipline, not nesting, is what DLK001 checks."""
+    pkg = make_pkg(tmp_path, {"pipe.py": """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._head_lock = threading.Lock()
+                self._tail_lock = threading.Lock()
+
+            def stage_one(self):
+                with self._head_lock:
+                    with self._tail_lock:
+                        pass
+
+            def stage_two(self):
+                with self._head_lock:
+                    self._finish()
+
+            def _finish(self):
+                with self._tail_lock:
+                    pass
+    """})
+    assert [f for f in run_lint(pkg, families=("DLK",))
+            if f.rule == "DLK001"] == []
+
+
+def test_dlk002_blocking_under_lock_flagged(tmp_path):
+    """Event-wait, blocking queue get, and an HTTP round-trip (direct or
+    through a helper) while a lock is held are each one DLK002."""
+    pkg = make_pkg(tmp_path, {"worker.py": """
+        import queue
+        import threading
+        from urllib.request import urlopen
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+                self._q = queue.Queue()
+
+            def bad_wait(self):
+                with self._lock:
+                    self._done.wait()
+
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get()
+
+            def bad_http(self):
+                with self._lock:
+                    return urlopen("http://h/metrics")
+
+            def bad_nested(self):
+                with self._lock:
+                    self._fetch()
+
+            def _fetch(self):
+                return urlopen("http://h/health")
+    """})
+    hits = [f for f in run_lint(pkg, families=("DLK",))
+            if f.rule == "DLK002"]
+    wheres = sorted(f.where for f in hits)
+    assert wheres == ["Worker.bad_get", "Worker.bad_http",
+                      "Worker.bad_nested", "Worker.bad_wait"]
+    slugs = {f.where: f.detail.split("-under-")[0] for f in hits}
+    assert slugs["Worker.bad_wait"] == "cond-wait"
+    assert slugs["Worker.bad_get"] == "queue-get"
+    assert slugs["Worker.bad_http"] == "urlopen"
+    assert slugs["Worker.bad_nested"] == "urlopen"
+
+
+def test_dlk002_timeout_loop_clean(tmp_path):
+    """The sanctioned coordination shape — condition-wait with a timeout
+    on the SAME lock the waiter holds, in a recheck loop — is clean: the
+    waiter releasing its own lock while waiting is how conditions work."""
+    pkg = make_pkg(tmp_path, {"batcher.py": """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def wait_for_batch(self):
+                with self._cond:
+                    while not self._items:
+                        self._cond.wait(timeout=0.25)
+                    return list(self._items)
+    """})
+    assert [f for f in run_lint(pkg, families=("DLK",))
+            if f.rule == "DLK002"] == []
+
+
+def test_dlk003_callback_under_lock(tmp_path):
+    """Invoking user-supplied listeners while holding a lock is DLK003;
+    registering them under the lock, or snapshotting the list under the
+    lock and invoking outside it, is the clean pattern."""
+    pkg = make_pkg(tmp_path, {"pub.py": """
+        import threading
+
+        class Publisher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def add_listener(self, cb):
+                with self._lock:
+                    self._listeners.append(cb)
+
+            def publish_bad(self, event):
+                with self._lock:
+                    for cb in self._listeners:
+                        cb(event)
+
+            def publish_good(self, event):
+                with self._lock:
+                    pending = list(self._listeners)
+                for cb in pending:
+                    cb(event)
+    """})
+    hits = [f for f in run_lint(pkg, families=("DLK",))
+            if f.rule == "DLK003"]
+    assert [f.where for f in hits] == ["Publisher.publish_bad"]
+
+
+def test_dlk_suppressible(tmp_path):
+    pkg = make_pkg(tmp_path, {"worker.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def drain(self):
+                with self._lock:
+                    self._done.wait(0.1)   # graftlint: ok(drain is shutdown-only, nothing else can want the lock)
+    """})
+    assert [f for f in run_lint(pkg, families=("DLK",))
+            if f.rule == "DLK002"] == []
+
+
+def test_cli_graph_emits_dot(tmp_path, capsys):
+    pkg = make_pkg(tmp_path, {"pipe.py": """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._head_lock = threading.Lock()
+                self._tail_lock = threading.Lock()
+
+            def run(self):
+                with self._head_lock:
+                    with self._tail_lock:
+                        pass
+    """})
+    assert main([str(pkg), "--graph"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph lockorder")
+    assert '"pipe.Pipeline._head_lock" -> "pipe.Pipeline._tail_lock"' in out
+
+
+def test_cli_prune_baseline(tmp_path, capsys):
+    """--prune-baseline drops fingerprints (and their reasons) no current
+    finding matches, and keeps live entries with their reasons."""
+    pkg = make_pkg(tmp_path, {"mod.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._data[k] = v
+
+            def drop(self, k):
+                self._data.pop(k, None)
+    """})
+    bl = tmp_path / "bl.json"
+    assert main([str(pkg), "--baseline", str(bl), "--update-baseline"]) == 0
+    doc = json.loads(bl.read_text())
+    live_fp = next(iter(doc["fingerprints"]))
+    doc["fingerprints"]["LCK001:gone.py:Gone.stale:attr"] = 2
+    doc["reasons"] = {
+        live_fp: "documented live reason",
+        "LCK001:gone.py:Gone.stale:attr": "stale reason",
+    }
+    bl.write_text(json.dumps(doc))
+    assert main([str(pkg), "--baseline", str(bl), "--prune-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned 2 stale" in out
+    after = json.loads(bl.read_text())
+    assert "LCK001:gone.py:Gone.stale:attr" not in after["fingerprints"]
+    assert after["reasons"] == {live_fp: "documented live reason"}
+    assert live_fp in after["fingerprints"]
+    # and the pruned baseline still accepts the live findings
+    assert main([str(pkg), "--baseline", str(bl)]) == 0
 
 
 # -- the live package --------------------------------------------------------
@@ -1250,3 +1529,58 @@ def test_package_fix_targets_stay_clean(live_findings):
              "models/deeplearning.py", "models/job.py", "utils/registry.py"}
     hits = [f for f in live_findings if f.path in fixed]
     assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_package_has_no_dlk001_findings(live_findings):
+    """Zero lock-order cycles anywhere, baselined or not — a cycle is a
+    deadlock waiting for the right interleaving, and the one live cycle
+    the analyzer found (Cleaner.sweep holding DKV._lock across the remove
+    cascade into _io_lock, vs fault-in's _io_lock -> DKV._lock) was FIXED
+    (KeyedStore.remove(only_if=...)), not grandfathered."""
+    hits = [f for f in live_findings if f.rule == "DLK001"]
+    assert hits == [], "\n".join(f.render() for f in hits)
+
+
+def test_thread_heavy_packages_dlk_clean_or_baselined(live_findings):
+    """ISSUE 18 satellite: every DLK finding in the thread-heavy packages
+    is either absent or explicitly baselined WITH a documented reason —
+    an unexplained suppression in serving/ops-plane/elastic/cleaner
+    territory is a silenced deadlock."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    reasons = load_reasons(DEFAULT_BASELINE)
+
+    def thread_heavy(path):
+        return (path.startswith("serving/") or path.startswith("ops_plane/")
+                or path in ("parallel/elastic.py", "utils/cleaner.py",
+                            "utils/health.py", "utils/flight.py",
+                            "utils/incidents.py"))
+
+    for f in live_findings:
+        if not f.rule.startswith("DLK") or not thread_heavy(f.path):
+            continue
+        assert f.fingerprint in baseline, f"unbaselined: {f.render()}"
+        assert reasons.get(f.fingerprint, "").strip(), \
+            f"baselined without a documented reason: {f.fingerprint}"
+
+
+def test_dlk_baseline_entries_have_reasons():
+    """Every DLK fingerprint in the shipped baseline carries a non-empty
+    documented reason (the acceptance bar: baselined == by-design, with
+    the invariant written down)."""
+    baseline = load_baseline(DEFAULT_BASELINE)
+    reasons = load_reasons(DEFAULT_BASELINE)
+    dlk = [fp for fp in baseline if fp.startswith("DLK")]
+    assert dlk, "expected the triaged DLK002 invariants in the baseline"
+    for fp in dlk:
+        assert reasons.get(fp, "").strip(), \
+            f"DLK baseline entry without a reason: {fp}"
+
+
+def test_no_stale_baseline_entries(live_findings):
+    """ISSUE 18 satellite: zero stale baseline entries — every fingerprint
+    count in baseline.json is backed by a live finding, so dead
+    suppressions cannot accumulate (`--prune-baseline` is the fix when
+    this fails)."""
+    stale = stale_entries(load_baseline(DEFAULT_BASELINE), live_findings)
+    assert stale == {}, f"stale baseline entries (run --prune-baseline): " \
+                        f"{stale}"
